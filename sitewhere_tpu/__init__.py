@@ -1,0 +1,25 @@
+"""sitewhere-tpu: a TPU-native IoT event-processing framework.
+
+Re-implements the capabilities of SiteWhere 2.0 (see SURVEY.md) as a sharded
+SPMD program on JAX/XLA/Pallas: multi-protocol ingest and decode, device
+registration/assignment validation, context enrichment, rule evaluation
+(thresholds + geofencing), last-known-state and presence tracking, durable
+event persistence, outbound fan-out, command delivery, batch operations,
+scheduling and multi-tenant administration — with the hot pipeline
+(reference: service-inbound-processing / service-rule-processing /
+service-device-state) compiled to a single jitted step over struct-of-array
+event tensors, and inter-stage fan-out riding ICI collectives instead of
+Kafka hops.
+"""
+
+__version__ = "0.1.0"
+
+from sitewhere_tpu.schema import (  # noqa: F401
+    EventBatch,
+    EventType,
+    Registry,
+    DeviceState,
+    RuleTable,
+    ZoneTable,
+    AssignmentStatus,
+)

@@ -1,0 +1,181 @@
+"""Host-edge identity: string tokens → dense int32 handles.
+
+The reference addresses everything by string tokens/UUIDs (device tokens key
+Kafka partitioning — ``MicroserviceKafkaProducer.java:106``,
+``EventSourcesManager.java:166`` — and every gRPC lookup is by token).
+Strings are hostile to TPU execution, so *all* identity is resolved at the
+host edge (SURVEY.md §7 "String/ID handling on TPU"): each namespace gets a
+:class:`HandleSpace` minting dense, stable ``int32`` handles that index
+registry/state tensors directly.  Handles are never reused within a space's
+lifetime unless explicitly freed, and the mapping is serializable so
+checkpoints can restore it (reference analog: Mongo `_id` ↔ token indexes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Dict, Iterable, List, Optional
+
+NULL_ID = -1
+
+
+def stable_hash64(token: str) -> int:
+    """Collision-safe 64-bit content hash of a token.
+
+    Used for cross-process-stable identity (e.g. alternate-id event
+    deduplication, reference ``AlternateIdDeduplicator.java``) — NOT for
+    registry indexing, which uses dense minted handles.
+    """
+    digest = hashlib.blake2b(token.encode("utf-8"), digest_size=8).digest()
+    return int.from_bytes(digest, "little", signed=True)
+
+
+class HandleSpace:
+    """Mints dense int32 handles for one namespace of string tokens.
+
+    Thread-safe; the ingest frontends resolve tokens concurrently while the
+    management services mint new handles (reference analog: the near-cache in
+    ``CachedDeviceManagementApiChannel.java`` in front of Mongo lookups —
+    here the "cache" IS the authoritative map and lookup is O(1) exact).
+    """
+
+    def __init__(self, name: str, capacity: int = 1 << 22):
+        self.name = name
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._token_to_id: Dict[str, int] = {}
+        self._id_to_token: List[Optional[str]] = []
+        self._free: List[int] = []
+
+    def __len__(self) -> int:
+        return len(self._token_to_id)
+
+    def __contains__(self, token: str) -> bool:
+        return token in self._token_to_id
+
+    def lookup(self, token: str) -> int:
+        """Return the handle for ``token`` or NULL_ID if unknown."""
+        return self._token_to_id.get(token, NULL_ID)
+
+    def lookup_many(self, tokens: Iterable[str]) -> List[int]:
+        get = self._token_to_id.get
+        return [get(t, NULL_ID) for t in tokens]
+
+    def mint(self, token: str) -> int:
+        """Return the handle for ``token``, minting a new one if needed."""
+        hid = self._token_to_id.get(token, NULL_ID)
+        if hid != NULL_ID:
+            return hid
+        with self._lock:
+            hid = self._token_to_id.get(token, NULL_ID)
+            if hid != NULL_ID:
+                return hid
+            if self._free:
+                hid = self._free.pop()
+                self._id_to_token[hid] = token
+            else:
+                hid = len(self._id_to_token)
+                if hid >= self.capacity:
+                    raise RuntimeError(
+                        f"HandleSpace '{self.name}' exhausted at {self.capacity}"
+                    )
+                self._id_to_token.append(token)
+            self._token_to_id[token] = hid
+            return hid
+
+    def free(self, token: str) -> None:
+        """Release a handle for reuse (e.g. device deleted)."""
+        with self._lock:
+            hid = self._token_to_id.pop(token, NULL_ID)
+            if hid != NULL_ID:
+                self._id_to_token[hid] = None
+                self._free.append(hid)
+
+    def token_of(self, hid: int) -> Optional[str]:
+        """Reverse lookup (host-side only, e.g. for REST responses)."""
+        if 0 <= hid < len(self._id_to_token):
+            return self._id_to_token[hid]
+        return None
+
+    def tokens(self) -> List[str]:
+        return list(self._token_to_id)
+
+    # --- serialization (checkpoint/resume; SURVEY.md §5 checkpointing) ---
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "capacity": self.capacity,
+                "id_to_token": list(self._id_to_token),
+            }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "HandleSpace":
+        space = cls(data["name"], data["capacity"])
+        space._id_to_token = list(data["id_to_token"])
+        for hid, token in enumerate(space._id_to_token):
+            if token is None:
+                space._free.append(hid)
+            else:
+                space._token_to_id[token] = hid
+        return space
+
+
+class IdentityMap:
+    """The full set of handle namespaces used by the framework.
+
+    One per id column in :mod:`sitewhere_tpu.schema`.  Mirrors the entity
+    kinds of the reference model (devices, assignments, device types, areas,
+    customers, assets, tenants, measurement names, alert types, commands).
+    """
+
+    SPACES = (
+        "device",
+        "assignment",
+        "device_type",
+        "area",
+        "customer",
+        "asset",
+        "tenant",
+        "mtype",
+        "alert_type",
+        "command",
+        "zone",
+        "user",
+        "area_type",
+        "customer_type",
+        "device_group",
+        "schedule",
+        "batch_operation",
+    )
+
+    def __init__(self, capacity: int = 1 << 22):
+        self.spaces: Dict[str, HandleSpace] = {
+            name: HandleSpace(name, capacity) for name in self.SPACES
+        }
+
+    def __getattr__(self, name: str) -> HandleSpace:
+        try:
+            return self.__dict__["spaces"][name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def save(self, path: str) -> None:
+        payload = {name: space.to_dict() for name, space in self.spaces.items()}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # atomic: a crash mid-dump can't corrupt the map
+
+    @classmethod
+    def load(cls, path: str) -> "IdentityMap":
+        with open(path) as f:
+            payload = json.load(f)
+        im = cls()
+        for name, data in payload.items():
+            im.spaces[name] = HandleSpace.from_dict(data)
+        return im

@@ -1,0 +1,15 @@
+"""Device-mesh topology, shardings and collectives.
+
+Replaces the reference's distribution fabric — Kafka topic partitioning
+keyed by device token (``MicroserviceKafkaProducer.java:106``), consumer
+groups, and gRPC demux round-robin (``ApiDemux.java:42-110``) — with a
+``jax.sharding.Mesh`` over TPU chips: events are sharded by device hash so
+registry lookups are shard-local gathers, and cross-shard fan-out rides XLA
+collectives over ICI instead of broker hops (SURVEY.md §2.4).
+"""
+
+from sitewhere_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    make_mesh,
+    shard_for_device,
+)

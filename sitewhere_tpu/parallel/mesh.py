@@ -1,0 +1,102 @@
+"""Mesh construction + canonical shardings for the event pipeline.
+
+TPU-first replacement for the reference's partitioning scheme: Kafka
+partitions events by device token so each device's stream is ordered and
+lands on one consumer (``EventSourcesManager.java:166``); here the host
+batcher routes events to the mesh shard that owns the device's registry
+block, so validation/enrichment gathers are shard-local and only rollups,
+zone broadcasts and rebalances touch ICI collectives.
+
+Axes:
+- ``shard`` — data axis: event batches (along B) and registry/state tensors
+  (along D) are block-sharded over it.  This is the analog of Kafka
+  partition count + consumer-group scale-out (SURVEY.md §2.4).
+- ``model`` — tensor-parallel axis for the analytics model family
+  (:mod:`sitewhere_tpu.models`); size 1 for the pure event pipeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Static description of the mesh topology (the framework's 'service
+    discovery' — reference: Consul registration in
+    ``ConsulServiceDiscoveryProvider.java`` — is replaced by this static
+    slice description, SURVEY.md §2.4)."""
+
+    n_shards: int
+    model_parallel: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.n_shards * self.model_parallel
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    model_parallel: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(shard, model)`` mesh over the available devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(
+            f"requested n_devices={n_devices} but only {len(devices)} available "
+            f"({[d.platform for d in devices[:4]]}…)"
+        )
+    if n_devices % model_parallel != 0:
+        raise ValueError(
+            f"n_devices={n_devices} not divisible by model_parallel={model_parallel}"
+        )
+    grid = np.asarray(devices[:n_devices]).reshape(
+        n_devices // model_parallel, model_parallel
+    )
+    return Mesh(grid, (SHARD_AXIS, MODEL_AXIS))
+
+
+def event_sharding(mesh: Mesh) -> NamedSharding:
+    """Events sharded along the batch dim (Kafka-partition analog)."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def registry_sharding(mesh: Mesh) -> NamedSharding:
+    """Registry/state tensors block-sharded along the device-capacity dim."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Small broadcast tables (rules, zones) replicated on every shard."""
+    return NamedSharding(mesh, P())
+
+
+def shard_for_device(device_id: int, capacity: int, n_shards: int) -> int:
+    """Host-side routing: which shard owns this device's registry row.
+
+    Registry arrays are block-sharded, so shard ``k`` owns rows
+    ``[k*capacity/n_shards, (k+1)*capacity/n_shards)``.  The ingest batcher
+    uses this to place each event in the sub-batch of the owning shard —
+    the analog of Kafka's keyed partitioner keeping per-device order
+    (``MicroserviceKafkaProducer.java:106``).
+    """
+    if capacity < n_shards or capacity % n_shards != 0:
+        # NamedSharding enforces the same invariant at device_put; fail
+        # here with routing semantics instead of a later layout error.
+        raise ValueError(
+            f"registry capacity={capacity} must be a positive multiple of "
+            f"n_shards={n_shards}"
+        )
+    return device_id // (capacity // n_shards)

@@ -1,0 +1,362 @@
+"""Core tensor schema: the data model everything compiles against.
+
+The reference keeps its data model in ``com.sitewhere:sitewhere-java-model``
+(interfaces ``IDeviceEvent`` + 6 subtypes, ``IDevice``, ``IDeviceAssignment``,
+used throughout e.g. ``sitewhere-core-api/src/main/java/com/sitewhere/spi/device/
+event/IDeviceEventManagement.java``).  Here the model is a set of fixed-shape
+struct-of-array pytrees so that the whole pipeline — validation, enrichment,
+rule evaluation, state materialization (reference call stack SURVEY.md §3.2) —
+compiles to one XLA program:
+
+- :class:`EventBatch`   — a batch of decoded device events (one row per event).
+- :class:`Registry`     — device + assignment system-of-record columns, indexed
+  by dense device id (the TPU-resident mirror of the reference's
+  ``service-device-management`` MongoDB collections).
+- :class:`DeviceState`  — last-known state per device (reference:
+  ``service-device-state`` materialized ``IDeviceState`` docs).
+- :class:`RuleTable`    — vectorized threshold rules (reference:
+  ``service-rule-processing`` ``IRuleProcessor`` impls).
+- :class:`ZoneTable`    — padded zone polygons for geofencing (reference:
+  ``service-rule-processing/.../geospatial/ZoneTestRuleProcessor.java:32-70``).
+
+Design notes (TPU-first):
+- All ids are dense ``int32`` handles minted at the host edge by
+  :mod:`sitewhere_tpu.ids` — string tokens never reach the device.
+- Timestamps are ``(ts_s, ts_ns)`` int32 pairs (seconds since epoch,
+  nanoseconds within second) compared lexicographically; no int64 on the
+  hot path.
+- Every array has a static shape; absent values are ``-1`` (ids) / NaN-free
+  zeros (floats) with explicit validity masks.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from sitewhere_tpu.ids import NULL_ID  # single source of the "no id" sentinel
+
+
+class EventType(enum.IntEnum):
+    """The six device event types of the reference model.
+
+    Reference: ``IDeviceEventManagement`` exposes add/list pairs for exactly
+    these six (``sitewhere-core-api/.../spi/device/event/IDeviceEventManagement.java``),
+    and the inbound storage switch handles them in
+    ``service-inbound-processing/.../UnaryEventStorageStrategy.java:53-82``.
+    """
+
+    MEASUREMENT = 0
+    LOCATION = 1
+    ALERT = 2
+    COMMAND_INVOCATION = 3
+    COMMAND_RESPONSE = 4
+    STATE_CHANGE = 5
+
+
+class AssignmentStatus(enum.IntEnum):
+    """Mirror of the reference's ``DeviceAssignmentStatus`` enum."""
+
+    NONE = 0  # device exists but has no assignment (reference: null assignment)
+    ACTIVE = 1
+    MISSING = 2
+    RELEASED = 3
+
+
+class AlertLevel(enum.IntEnum):
+    """Mirror of the reference's ``AlertLevel`` (java-model)."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+    CRITICAL = 3
+
+
+class ComparisonOp(enum.IntEnum):
+    """Threshold-rule comparison operators."""
+
+    GT = 0
+    LT = 1
+    GTE = 2
+    LTE = 3
+    EQ = 4
+    NEQ = 5
+
+
+class ZoneCondition(enum.IntEnum):
+    """Geofence firing condition.
+
+    Reference ``ZoneTestRuleProcessor`` supports alerting on zone
+    containment; we support both polarities.
+    """
+
+    ALERT_IF_INSIDE = 0
+    ALERT_IF_OUTSIDE = 1
+
+
+def _i32(shape, fill=0):
+    return jnp.full(shape, fill, dtype=jnp.int32)
+
+
+def _f32(shape, fill=0.0):
+    return jnp.full(shape, fill, dtype=jnp.float32)
+
+
+def _bool(shape, fill=False):
+    return jnp.full(shape, fill, dtype=jnp.bool_)
+
+
+@struct.dataclass
+class EventBatch:
+    """A fixed-width batch of decoded device events (struct-of-arrays).
+
+    One row per event; ``valid`` masks padding rows.  This is the TPU
+    equivalent of a Kafka record batch of ``GDecodedEventPayload`` protobufs
+    on the ``event-source-decoded-events`` topic (reference:
+    ``sitewhere-grpc-client/.../event/EventModelMarshaler.java`` payloads,
+    topic naming ``KafkaTopicNaming.java:154-156``).
+
+    Type-specific columns are a union: only the columns for ``event_type``
+    are meaningful in a given row (e.g. ``value`` for MEASUREMENT,
+    ``lat/lon/elevation`` for LOCATION, ``alert_code/alert_level`` for ALERT,
+    ``command_id`` for COMMAND_INVOCATION).  ``payload_ref`` is a host-side
+    journal offset pointing at the raw payload + string metadata which never
+    leave the host (SURVEY.md §7 hard-part: string handling).
+    """
+
+    valid: jax.Array        # bool[B]   — row is a real event
+    device_id: jax.Array    # int32[B]  — dense registry slot, NULL_ID if unknown
+    tenant_id: jax.Array    # int32[B]
+    event_type: jax.Array   # int32[B]  — EventType
+    ts_s: jax.Array         # int32[B]  — unix seconds
+    ts_ns: jax.Array        # int32[B]  — nanoseconds within second
+    mtype_id: jax.Array     # int32[B]  — measurement-name handle (MEASUREMENT)
+    value: jax.Array        # float32[B]
+    lat: jax.Array          # float32[B]
+    lon: jax.Array          # float32[B]
+    elevation: jax.Array    # float32[B]
+    alert_code: jax.Array   # int32[B]  — alert-type handle (ALERT)
+    alert_level: jax.Array  # int32[B]  — AlertLevel
+    command_id: jax.Array   # int32[B]  — command handle (COMMAND_INVOCATION/RESPONSE)
+    payload_ref: jax.Array  # int32[B]  — host journal offset (opaque on device)
+
+    @property
+    def width(self) -> int:
+        return self.valid.shape[-1]
+
+    @classmethod
+    def empty(cls, width: int) -> "EventBatch":
+        return cls(
+            valid=_bool((width,)),
+            device_id=_i32((width,), NULL_ID),
+            tenant_id=_i32((width,), NULL_ID),
+            event_type=_i32((width,)),
+            ts_s=_i32((width,)),
+            ts_ns=_i32((width,)),
+            mtype_id=_i32((width,), NULL_ID),
+            value=_f32((width,)),
+            lat=_f32((width,)),
+            lon=_f32((width,)),
+            elevation=_f32((width,)),
+            alert_code=_i32((width,), NULL_ID),
+            alert_level=_i32((width,)),
+            command_id=_i32((width,), NULL_ID),
+            payload_ref=_i32((width,), NULL_ID),
+        )
+
+
+@struct.dataclass
+class Registry:
+    """Device + assignment system-of-record columns, indexed by dense device id.
+
+    TPU-resident mirror of the reference's device-management store
+    (``service-device-management/.../persistence/mongodb/MongoDeviceManagement.java``):
+    the columns a hot-path event needs for validation + enrichment — exactly
+    what ``InboundPayloadProcessingLogic.validateAssignment``
+    (``service-inbound-processing/.../InboundPayloadProcessingLogic.java:185-219``)
+    fetches per event over cached gRPC, collapsed into shard-local gathers.
+
+    The host :class:`~sitewhere_tpu.services.device_management.DeviceManagement`
+    store owns the authoritative records (strings, metadata) and publishes new
+    epochs of these arrays on mutation (double-buffered; SURVEY.md §7).
+    """
+
+    active: jax.Array             # bool[D]  — slot holds a registered device
+    tenant_id: jax.Array          # int32[D]
+    device_type_id: jax.Array     # int32[D]
+    assignment_id: jax.Array      # int32[D] — NULL_ID if unassigned
+    assignment_status: jax.Array  # int32[D] — AssignmentStatus
+    area_id: jax.Array            # int32[D]
+    customer_id: jax.Array        # int32[D]
+    asset_id: jax.Array           # int32[D]
+    epoch: jax.Array              # int32[]  — registry version (host bump on mutation)
+
+    @property
+    def capacity(self) -> int:
+        return self.active.shape[-1]
+
+    @classmethod
+    def empty(cls, capacity: int) -> "Registry":
+        return cls(
+            active=_bool((capacity,)),
+            tenant_id=_i32((capacity,), NULL_ID),
+            device_type_id=_i32((capacity,), NULL_ID),
+            assignment_id=_i32((capacity,), NULL_ID),
+            assignment_status=_i32((capacity,), AssignmentStatus.NONE),
+            area_id=_i32((capacity,), NULL_ID),
+            customer_id=_i32((capacity,), NULL_ID),
+            asset_id=_i32((capacity,), NULL_ID),
+            epoch=jnp.zeros((), dtype=jnp.int32),
+        )
+
+
+@struct.dataclass
+class DeviceState:
+    """Last-known state per device (+ per measurement slot).
+
+    Reference: ``service-device-state`` merges each enriched event into a
+    per-device ``IDeviceState`` document
+    (``processing/DeviceStateProcessingLogic.java:46-80``) and a background
+    presence thread marks devices missing
+    (``presence/DevicePresenceManager.java:49-88``).  Here the merge is a
+    masked scatter executed inside the same pipeline step, and the presence
+    scan is a vectorized sweep over these arrays.
+
+    ``last_values`` keeps the most recent value per (device, measurement
+    slot); measurement-name handles are mapped to ``[0, M)`` slots at the
+    edge (M = ``num_mtype_slots``).
+    """
+
+    last_event_ts_s: jax.Array   # int32[D] — most recent event time
+    last_event_ts_ns: jax.Array  # int32[D]
+    last_event_type: jax.Array   # int32[D]
+    last_values: jax.Array       # float32[D, M]
+    last_value_ts_s: jax.Array   # int32[D, M]
+    last_lat: jax.Array          # float32[D]
+    last_lon: jax.Array          # float32[D]
+    last_elevation: jax.Array    # float32[D]
+    last_location_ts_s: jax.Array  # int32[D]
+    last_alert_code: jax.Array   # int32[D]
+    last_alert_ts_s: jax.Array   # int32[D]
+    presence_missing: jax.Array  # bool[D]
+
+    @property
+    def capacity(self) -> int:
+        return self.last_event_ts_s.shape[-1]
+
+    @property
+    def num_mtype_slots(self) -> int:
+        return self.last_values.shape[-1]
+
+    @classmethod
+    def empty(cls, capacity: int, num_mtype_slots: int = 8) -> "DeviceState":
+        return cls(
+            last_event_ts_s=_i32((capacity,)),
+            last_event_ts_ns=_i32((capacity,)),
+            last_event_type=_i32((capacity,), NULL_ID),
+            last_values=_f32((capacity, num_mtype_slots)),
+            last_value_ts_s=_i32((capacity, num_mtype_slots)),
+            last_lat=_f32((capacity,)),
+            last_lon=_f32((capacity,)),
+            last_elevation=_f32((capacity,)),
+            last_location_ts_s=_i32((capacity,)),
+            last_alert_code=_i32((capacity,), NULL_ID),
+            last_alert_ts_s=_i32((capacity,)),
+            presence_missing=_bool((capacity,)),
+        )
+
+
+@struct.dataclass
+class RuleTable:
+    """Vectorized threshold rules, evaluated for every measurement event.
+
+    Reference: rule processors implement per-event callbacks
+    (``service-rule-processing/.../spi/IRuleProcessor.java:50-97``); the
+    built-in style of "fire an alert when a measurement crosses a bound" is
+    expressed here as R parallel comparisons.  A rule matches an event when
+    tenant and measurement type match (NULL_ID = wildcard) and
+    ``value <op> threshold`` holds.
+    """
+
+    active: jax.Array       # bool[R]
+    tenant_id: jax.Array    # int32[R] — NULL_ID = all tenants
+    mtype_id: jax.Array     # int32[R] — NULL_ID = all measurement types
+    op: jax.Array           # int32[R] — ComparisonOp
+    threshold: jax.Array    # float32[R]
+    alert_code: jax.Array   # int32[R] — alert to fire
+    alert_level: jax.Array  # int32[R]
+
+    @property
+    def capacity(self) -> int:
+        return self.active.shape[-1]
+
+    @classmethod
+    def empty(cls, capacity: int) -> "RuleTable":
+        return cls(
+            active=_bool((capacity,)),
+            tenant_id=_i32((capacity,), NULL_ID),
+            mtype_id=_i32((capacity,), NULL_ID),
+            op=_i32((capacity,)),
+            threshold=_f32((capacity,)),
+            alert_code=_i32((capacity,), NULL_ID),
+            alert_level=_i32((capacity,)),
+        )
+
+
+@struct.dataclass
+class ZoneTable:
+    """Padded zone polygons for geofence evaluation.
+
+    Reference: zones are polygons attached to areas
+    (``sitewhere-core/.../geospatial/GeoUtils.java`` builds JTS polygons) and
+    ``ZoneTestRuleProcessor.java:32-70`` tests each location event against
+    cached polygons, firing an alert per matching condition.  Here polygons
+    are padded to ``V`` vertices (``nvert`` gives the true count) so the
+    point-in-polygon test is a dense ``[B, Z, V]`` computation (Pallas kernel
+    for large Z; see ``sitewhere_tpu/ops/geo.py``).
+    """
+
+    active: jax.Array      # bool[Z]
+    tenant_id: jax.Array   # int32[Z] — NULL_ID = all tenants
+    area_id: jax.Array     # int32[Z] — NULL_ID = all areas
+    verts: jax.Array       # float32[Z, V, 2] — (lon, lat), padded by repeating last vertex
+    nvert: jax.Array       # int32[Z]
+    condition: jax.Array   # int32[Z] — ZoneCondition
+    alert_code: jax.Array  # int32[Z]
+    alert_level: jax.Array  # int32[Z]
+
+    @property
+    def capacity(self) -> int:
+        return self.active.shape[-1]
+
+    @property
+    def max_verts(self) -> int:
+        return self.verts.shape[-2]
+
+    @classmethod
+    def empty(cls, capacity: int, max_verts: int = 16) -> "ZoneTable":
+        return cls(
+            active=_bool((capacity,)),
+            tenant_id=_i32((capacity,), NULL_ID),
+            area_id=_i32((capacity,), NULL_ID),
+            verts=_f32((capacity, max_verts, 2)),
+            nvert=_i32((capacity,)),
+            condition=_i32((capacity,), ZoneCondition.ALERT_IF_INSIDE),
+            alert_code=_i32((capacity,), NULL_ID),
+            alert_level=_i32((capacity,), AlertLevel.WARNING),
+        )
+
+
+def time_lt(a_s: jax.Array, a_ns: jax.Array, b_s: jax.Array, b_ns: jax.Array) -> jax.Array:
+    """Lexicographic ``(s, ns) < (s, ns)`` without int64."""
+    return (a_s < b_s) | ((a_s == b_s) & (a_ns < b_ns))
+
+
+def as_numpy(tree: Any) -> Any:
+    """Device→host copy of a schema pytree (for persistence/serialization)."""
+    return jax.tree_util.tree_map(np.asarray, tree)

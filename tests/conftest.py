@@ -1,0 +1,39 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference has almost no CI-runnable tests (SURVEY.md §4 — live-instance
+drivers against a hard-coded host).  We instead run the full SPMD program on
+a forced-CPU JAX backend with 8 virtual devices so multi-chip sharding logic
+is exercised on every test run without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax initializes its backends.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize forces jax_platforms="axon,cpu" at import time,
+# overriding the JAX_PLATFORMS env var — so force CPU via the config API
+# (must happen before any backend is initialized).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from sitewhere_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_devices=8)

@@ -1,0 +1,65 @@
+"""Mesh topology + routing tests (8 virtual CPU devices)."""
+
+import jax
+import numpy as np
+import pytest
+
+from sitewhere_tpu.parallel import MeshSpec, make_mesh, shard_for_device
+from sitewhere_tpu.parallel.mesh import (
+    SHARD_AXIS,
+    MODEL_AXIS,
+    event_sharding,
+    registry_sharding,
+    replicated,
+)
+
+
+def test_cpu_backend_has_8_devices(devices):
+    assert len(devices) == 8
+    assert all(d.platform == "cpu" for d in devices)
+
+
+def test_make_mesh_shapes(mesh8):
+    assert mesh8.shape[SHARD_AXIS] == 8
+    assert mesh8.shape[MODEL_AXIS] == 1
+
+
+def test_make_mesh_model_parallel():
+    m = make_mesh(8, model_parallel=2)
+    assert m.shape[SHARD_AXIS] == 4
+    assert m.shape[MODEL_AXIS] == 2
+    with pytest.raises(ValueError):
+        make_mesh(8, model_parallel=3)
+
+
+def test_mesh_spec():
+    spec = MeshSpec(n_shards=4, model_parallel=2)
+    assert spec.n_devices == 8
+
+
+def test_sharding_placement(mesh8):
+    import jax.numpy as jnp
+
+    x = jnp.zeros((1024,))
+    xs = jax.device_put(x, event_sharding(mesh8))
+    # block-sharded: each device holds 128 contiguous rows
+    assert xs.sharding.shard_shape(x.shape) == (128,)
+    r = jax.device_put(jnp.zeros((64,)), replicated(mesh8))
+    assert r.sharding.shard_shape((64,)) == (64,)
+
+
+def test_shard_for_device_matches_block_sharding(mesh8):
+    """Host routing must agree with XLA's block-sharding of the registry."""
+    import jax.numpy as jnp
+
+    capacity, n_shards = 4096, 8
+    reg_col = jax.device_put(
+        jnp.arange(capacity, dtype=jnp.int32), registry_sharding(mesh8)
+    )
+    # For each shard, the device rows XLA placed there:
+    for shard_idx, piece in enumerate(reg_col.addressable_shards):
+        rows = np.asarray(piece.data)
+        for d in (int(rows[0]), int(rows[-1])):
+            assert shard_for_device(d, capacity, n_shards) == piece.index[0].start // (
+                capacity // n_shards
+            ) == shard_idx

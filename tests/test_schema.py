@@ -1,0 +1,101 @@
+"""Schema + identity-map unit tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu import schema
+from sitewhere_tpu.ids import NULL_ID, HandleSpace, IdentityMap, stable_hash64
+
+
+def test_event_batch_empty_shapes():
+    b = schema.EventBatch.empty(128)
+    assert b.width == 128
+    assert b.valid.dtype == jnp.bool_
+    assert b.device_id.dtype == jnp.int32
+    assert b.value.dtype == jnp.float32
+    assert not bool(b.valid.any())
+    assert int(b.device_id[0]) == NULL_ID
+
+
+def test_event_batch_is_pytree():
+    b = schema.EventBatch.empty(16)
+    leaves = jax.tree_util.tree_leaves(b)
+    assert len(leaves) == 15
+    b2 = jax.tree_util.tree_map(lambda x: x, b)
+    assert b2.width == 16
+
+
+def test_registry_and_state_empty():
+    r = schema.Registry.empty(64)
+    s = schema.DeviceState.empty(64, num_mtype_slots=4)
+    assert r.capacity == 64
+    assert s.capacity == 64
+    assert s.num_mtype_slots == 4
+    assert s.last_values.shape == (64, 4)
+
+
+def test_zone_table_shapes():
+    z = schema.ZoneTable.empty(8, max_verts=12)
+    assert z.capacity == 8
+    assert z.max_verts == 12
+    assert z.verts.shape == (8, 12, 2)
+
+
+def test_time_lt_lexicographic():
+    a_s = jnp.array([1, 1, 2, 1])
+    a_ns = jnp.array([5, 5, 0, 9])
+    b_s = jnp.array([1, 1, 1, 1])
+    b_ns = jnp.array([6, 5, 5, 5])
+    out = np.asarray(schema.time_lt(a_s, a_ns, b_s, b_ns))
+    assert out.tolist() == [True, False, False, False]
+
+
+def test_handle_space_mint_stable():
+    hs = HandleSpace("device")
+    a = hs.mint("dev-a")
+    b = hs.mint("dev-b")
+    assert a != b
+    assert hs.mint("dev-a") == a
+    assert hs.lookup("dev-a") == a
+    assert hs.lookup("nope") == NULL_ID
+    assert hs.token_of(b) == "dev-b"
+    assert len(hs) == 2
+
+
+def test_handle_space_free_and_reuse():
+    hs = HandleSpace("device")
+    a = hs.mint("dev-a")
+    hs.free("dev-a")
+    assert hs.lookup("dev-a") == NULL_ID
+    c = hs.mint("dev-c")
+    assert c == a  # slot reused
+    assert hs.token_of(c) == "dev-c"
+
+
+def test_handle_space_roundtrip():
+    hs = HandleSpace("mtype", capacity=100)
+    for name in ["temp", "humidity", "pressure"]:
+        hs.mint(name)
+    hs.free("humidity")
+    hs2 = HandleSpace.from_dict(hs.to_dict())
+    assert hs2.lookup("temp") == hs.lookup("temp")
+    assert hs2.lookup("humidity") == NULL_ID
+    assert hs2.mint("new") == 1  # reuses freed slot
+
+
+def test_identity_map_roundtrip(tmp_path):
+    im = IdentityMap()
+    d = im.device.mint("dev-1")
+    t = im.tenant.mint("acme")
+    path = str(tmp_path / "ids.json")
+    im.save(path)
+    im2 = IdentityMap.load(path)
+    assert im2.device.lookup("dev-1") == d
+    assert im2.tenant.lookup("acme") == t
+
+
+def test_stable_hash64_deterministic():
+    assert stable_hash64("abc") == stable_hash64("abc")
+    assert stable_hash64("abc") != stable_hash64("abd")
+    assert -(1 << 63) <= stable_hash64("x") < (1 << 63)
