@@ -1,0 +1,8 @@
+"""Reusable device-side ops: geospatial kernels and masked time-ordered scatters."""
+
+from sitewhere_tpu.ops.geo import points_in_polygons  # noqa: F401
+from sitewhere_tpu.ops.scatter import (  # noqa: F401
+    bincount_fixed,
+    scatter_last_by_time,
+    scatter_max_by_key,
+)

@@ -1,0 +1,47 @@
+"""Geospatial kernels: batched point-in-polygon for geofence rules.
+
+The reference tests each location event against JTS polygons one at a time
+on the JVM (``service-rule-processing/.../geospatial/ZoneTestRuleProcessor.java:32-70``,
+polygons built by ``sitewhere-core/.../geospatial/GeoUtils.java``).  Here the
+test is a dense ``[B, Z, V]`` ray-crossing computation over padded vertex
+tensors — one fused XLA op on the VPU.  (A tiled Pallas variant for very
+large ``Z*V`` is planned; this module is its drop-in home.)
+
+Padding contract (matches :class:`sitewhere_tpu.schema.ZoneTable`): polygons
+are padded to ``V`` vertices by repeating the last real vertex, so padded
+edges are zero-length (contribute no crossings) and the wraparound edge
+``v[V-1] → v[0]`` coincides with the true closing edge.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def points_in_polygons(points: jax.Array, verts: jax.Array) -> jax.Array:
+    """Ray-crossing containment test for every (point, polygon) pair.
+
+    Args:
+      points: ``float32[B, 2]`` — (x, y) == (lon, lat).
+      verts:  ``float32[Z, V, 2]`` — padded polygon rings (see module doc).
+
+    Returns:
+      ``bool[B, Z]`` — point strictly inside polygon (boundary points may
+      land either way, same as the reference's JTS ``contains`` edge cases).
+    """
+    px = points[:, 0][:, None, None]  # [B, 1, 1]
+    py = points[:, 1][:, None, None]
+    x1 = verts[None, :, :, 0]  # [1, Z, V]
+    y1 = verts[None, :, :, 1]
+    x2 = jnp.roll(verts[:, :, 0], -1, axis=-1)[None]  # wraparound edge
+    y2 = jnp.roll(verts[:, :, 1], -1, axis=-1)[None]
+
+    straddles = (y1 > py) != (y2 > py)
+    # Safe division: where the edge is horizontal/degenerate, straddles is
+    # False and the quotient is irrelevant — guard the denominator only.
+    denom = jnp.where(y2 == y1, 1.0, y2 - y1)
+    x_cross = (x2 - x1) * (py - y1) / denom + x1
+    crossing = straddles & (px < x_cross)
+    # Odd number of crossings => inside.
+    return (jnp.sum(crossing.astype(jnp.int32), axis=-1) % 2) == 1

@@ -1,0 +1,105 @@
+"""Masked, time-ordered scatters: the TPU replacement for per-event writes.
+
+The reference's state materialization processes one Kafka record at a time
+(``service-device-state/.../processing/DeviceStateProcessingLogic.java:46-80``),
+so "last write wins" falls out of per-partition ordering.  In a batched SPMD
+step many events for one device land in the same batch, so we scatter with
+an explicit time key: first a scatter-max of the ``(ts_s, ts_ns)`` key, then
+payload writes masked to the rows that won.  Ties (identical key) resolve
+arbitrarily among tied rows, like concurrent writes in the reference's Mongo
+upsert path.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def scatter_last_by_time(
+    cur_ts_s: jax.Array,
+    cur_ts_ns: jax.Array,
+    cur_payload: Sequence[jax.Array],
+    ids: jax.Array,
+    ts_s: jax.Array,
+    ts_ns: jax.Array,
+    payload: Sequence[jax.Array],
+    mask: jax.Array,
+) -> Tuple[jax.Array, jax.Array, Tuple[jax.Array, ...]]:
+    """Scatter ``payload`` rows into per-id slots, newest ``(ts_s, ts_ns)`` wins.
+
+    Args:
+      cur_ts_s/cur_ts_ns: ``int32[D]`` current per-slot time key.
+      cur_payload: arrays of shape ``[D, ...]`` to update alongside the key.
+      ids: ``int32[B]`` target slot per event (rows with ``mask=False`` or
+        out-of-range ids are dropped).
+      ts_s/ts_ns: ``int32[B]`` event time key.
+      payload: arrays of shape ``[B, ...]`` matching ``cur_payload``.
+      mask: ``bool[B]``.
+
+    Returns:
+      ``(new_ts_s, new_ts_ns, new_payload)``.
+    """
+    if len(cur_payload) != len(payload):
+        raise ValueError(
+            f"payload arity mismatch: {len(cur_payload)} state arrays vs "
+            f"{len(payload)} event arrays (pass tuples, not bare arrays)"
+        )
+    capacity = cur_ts_s.shape[0]
+    # mode="drop" drops ids >= capacity but NEGATIVE ids would wrap
+    # (python-style indexing) — sanitize both to the drop sentinel.
+    mask = mask & (ids >= 0)
+    safe_ids = jnp.where(mask, ids, capacity)
+
+    # Pass 1: winning second per slot.
+    new_s = cur_ts_s.at[safe_ids].max(ts_s, mode="drop")
+    # Pass 2: winning ns among events that have the winning second.  If the
+    # second advanced past the current slot value, the old ns must not be
+    # compared — reset it to -1 (below any real ns).
+    base_ns = jnp.where(cur_ts_s == new_s, cur_ts_ns, -1)
+    sec_won = mask & (ts_s == new_s[jnp.clip(ids, 0, capacity - 1)])
+    ns_ids = jnp.where(sec_won, ids, capacity)
+    new_ns = base_ns.at[ns_ids].max(ts_ns, mode="drop")
+
+    # Winner rows: their (s, ns) equals the final slot key.
+    clip_ids = jnp.clip(ids, 0, capacity - 1)
+    won = sec_won & (ts_ns == new_ns[clip_ids])
+    win_ids = jnp.where(won, ids, capacity)
+    new_payload = tuple(
+        cur.at[win_ids].set(val, mode="drop") for cur, val in zip(cur_payload, payload)
+    )
+    return new_s, new_ns, new_payload
+
+
+def scatter_max_by_key(
+    cur_key: jax.Array,
+    cur_payload: Sequence[jax.Array],
+    ids: jax.Array,
+    key: jax.Array,
+    payload: Sequence[jax.Array],
+    mask: jax.Array,
+) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+    """Single-key (seconds-only) variant of :func:`scatter_last_by_time`."""
+    if len(cur_payload) != len(payload):
+        raise ValueError(
+            f"payload arity mismatch: {len(cur_payload)} state arrays vs "
+            f"{len(payload)} event arrays (pass tuples, not bare arrays)"
+        )
+    capacity = cur_key.shape[0]
+    mask = mask & (ids >= 0)  # negative ids would wrap; see scatter_last_by_time
+    safe_ids = jnp.where(mask, ids, capacity)
+    new_key = cur_key.at[safe_ids].max(key, mode="drop")
+    won = mask & (key == new_key[jnp.clip(ids, 0, capacity - 1)])
+    win_ids = jnp.where(won, ids, capacity)
+    new_payload = tuple(
+        cur.at[win_ids].set(val, mode="drop") for cur, val in zip(cur_payload, payload)
+    )
+    return new_key, new_payload
+
+
+def bincount_fixed(ids: jax.Array, mask: jax.Array, length: int) -> jax.Array:
+    """Masked bincount with static length (metrics rollups)."""
+    safe = jnp.where(mask & (ids >= 0), ids, length)
+    return jnp.zeros((length,), jnp.int32).at[safe].add(1, mode="drop")

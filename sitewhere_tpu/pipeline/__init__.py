@@ -1,0 +1,12 @@
+"""The event pipeline: one jitted SPMD step replacing the reference's
+Kafka-connected microservice chain (SURVEY.md §3.2 call stack)."""
+
+from sitewhere_tpu.pipeline.step import (  # noqa: F401
+    PipelineOutputs,
+    StepMetrics,
+    pipeline_step,
+    validate_and_enrich,
+    eval_threshold_rules,
+    eval_zone_rules,
+    update_device_state,
+)

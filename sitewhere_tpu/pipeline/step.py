@@ -1,0 +1,354 @@
+"""The fused inbound pipeline step.
+
+One jitted function replaces the reference's per-event journey across four
+microservices and three Kafka hops (SURVEY.md §3.2):
+
+1. *validate + enrich* — the per-event device/assignment gRPC lookups of
+   ``service-inbound-processing/.../InboundPayloadProcessingLogic.java:148-219``
+   and the context build of ``OutboundPayloadEnrichmentLogic.java:54-88``
+   become registry gathers.
+2. *rule evaluation* — ``service-rule-processing``'s per-event callbacks
+   (``spi/IRuleProcessor.java:50-97``, ``ZoneTestRuleProcessor.java:32-70``)
+   become dense ``[B, R]`` comparisons and a ``[B, Z]`` geofence kernel.
+3. *state materialization* — ``service-device-state``'s per-record merge
+   (``DeviceStateProcessingLogic.java:46-80``) becomes time-ordered scatters.
+
+Dead-letter routing (unregistered / unassigned events → Kafka topics in
+``InboundPayloadProcessingLogic.java:228-247``) comes out as boolean masks
+the host journal uses to divert rows.  Derived alert events (the reference
+fires them back through event management, ``ZoneTestRuleProcessor.java:60``)
+come out as a same-width :class:`EventBatch` ready for re-injection.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from sitewhere_tpu.ids import NULL_ID
+from sitewhere_tpu.ops.geo import points_in_polygons
+from sitewhere_tpu.ops.scatter import bincount_fixed, scatter_last_by_time
+from sitewhere_tpu.schema import (
+    AssignmentStatus,
+    DeviceState,
+    EventBatch,
+    EventType,
+    Registry,
+    RuleTable,
+    ZoneCondition,
+    ZoneTable,
+)
+
+NUM_EVENT_TYPES = 6
+
+
+@struct.dataclass
+class StepMetrics:
+    """Per-step counters — the analog of the Dropwizard meters on the
+    reference hot path (``InboundPayloadProcessingLogic.java:90-97``,
+    ``InboundEventSource.java:79-81``)."""
+
+    processed: jax.Array          # int32[] — valid rows seen
+    accepted: jax.Array           # int32[] — passed validation
+    unregistered: jax.Array       # int32[] — unknown device (dead-letter)
+    unassigned: jax.Array         # int32[] — no active assignment (dead-letter)
+    threshold_alerts: jax.Array   # int32[]
+    zone_alerts: jax.Array        # int32[]
+    by_type: jax.Array            # int32[NUM_EVENT_TYPES] — accepted, by event type
+
+    def __add__(self, other: "StepMetrics") -> "StepMetrics":
+        return jax.tree_util.tree_map(lambda a, b: a + b, self, other)
+
+
+@struct.dataclass
+class PipelineOutputs:
+    """Everything the host needs from one pipeline step."""
+
+    # Routing masks (dead-letter topics of KafkaTopicNaming.java:48-78):
+    accepted: jax.Array      # bool[B]
+    unregistered: jax.Array  # bool[B] → auto-registration (SURVEY.md §3.5)
+    unassigned: jax.Array    # bool[B]
+    # Enrichment context (reference IDeviceEventContext):
+    device_type_id: jax.Array  # int32[B]
+    assignment_id: jax.Array   # int32[B]
+    area_id: jax.Array         # int32[B]
+    customer_id: jax.Array     # int32[B]
+    asset_id: jax.Array        # int32[B]
+    # Rule results (first firing rule/zone per event; counts in metrics):
+    rule_id: jax.Array         # int32[B] — NULL_ID if none fired
+    zone_id: jax.Array         # int32[B] — NULL_ID if none fired
+    # Derived alert events ready for re-injection (same width as input):
+    derived_alerts: EventBatch
+    metrics: StepMetrics
+
+
+def validate_and_enrich(
+    registry: Registry, batch: EventBatch
+) -> Tuple[jax.Array, jax.Array, jax.Array, dict]:
+    """Registry gather replacing the per-event device/assignment lookups.
+
+    Reference: ``InboundPayloadProcessingLogic.validateAssignment:185-219``
+    — device-by-token then assignment lookup over cached gRPC; missing
+    device → unregistered dead-letter (``:228-233``), missing/inactive
+    assignment → unassigned dead-letter.
+    """
+    cap = registry.capacity
+    ids = batch.device_id
+    in_range = (ids >= 0) & (ids < cap)
+    safe = jnp.clip(ids, 0, cap - 1)
+
+    registered = in_range & registry.active[safe]
+    # Tenant isolation: an event claiming tenant T must hit a device owned
+    # by T (reference: per-tenant engines are shared-nothing slices,
+    # MultitenantMicroservice.java:242-260).
+    tenant_ok = registry.tenant_id[safe] == batch.tenant_id
+    assigned = registry.assignment_status[safe] == AssignmentStatus.ACTIVE
+
+    valid = batch.valid
+    unregistered = valid & ~(registered & tenant_ok)
+    unassigned = valid & registered & tenant_ok & ~assigned
+    accepted = valid & registered & tenant_ok & assigned
+
+    enrich = {
+        "device_type_id": jnp.where(accepted, registry.device_type_id[safe], NULL_ID),
+        "assignment_id": jnp.where(accepted, registry.assignment_id[safe], NULL_ID),
+        "area_id": jnp.where(accepted, registry.area_id[safe], NULL_ID),
+        "customer_id": jnp.where(accepted, registry.customer_id[safe], NULL_ID),
+        "asset_id": jnp.where(accepted, registry.asset_id[safe], NULL_ID),
+    }
+    return accepted, unregistered, unassigned, enrich
+
+
+def eval_threshold_rules(
+    rules: RuleTable, batch: EventBatch, accepted: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Dense [B, R] threshold evaluation over measurement events.
+
+    Returns ``(fired_any, first_rule_id)`` per event.
+    """
+    is_meas = accepted & (batch.event_type == EventType.MEASUREMENT)
+    v = batch.value[:, None]  # [B, 1]
+    thr = rules.threshold[None, :]  # [1, R]
+    op = rules.op[None, :]
+    cmp = jnp.stack(
+        [v > thr, v < thr, v >= thr, v <= thr, v == thr, v != thr], axis=0
+    )  # [6, B, R]
+    hit = jnp.take_along_axis(cmp, op[None], axis=0)[0]  # [B, R]
+
+    tenant_ok = (rules.tenant_id[None, :] == NULL_ID) | (
+        rules.tenant_id[None, :] == batch.tenant_id[:, None]
+    )
+    mtype_ok = (rules.mtype_id[None, :] == NULL_ID) | (
+        rules.mtype_id[None, :] == batch.mtype_id[:, None]
+    )
+    fired = hit & tenant_ok & mtype_ok & rules.active[None, :] & is_meas[:, None]
+    fired_any = fired.any(axis=1)
+    first = jnp.argmax(fired, axis=1).astype(jnp.int32)
+    return fired_any, jnp.where(fired_any, first, NULL_ID)
+
+
+def eval_zone_rules(
+    zones: ZoneTable, batch: EventBatch, accepted: jax.Array, area_id: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Geofence evaluation over location events.
+
+    Reference: ``ZoneTestRuleProcessor.onLocation`` tests each location
+    against cached zone polygons and fires a configured alert.  Zone
+    applicability = active ∧ tenant match ∧ (zone area wildcard or equal to
+    the event's enriched area).
+    """
+    is_loc = accepted & (batch.event_type == EventType.LOCATION)
+    pts = jnp.stack([batch.lon, batch.lat], axis=-1)  # (x, y)
+    inside = points_in_polygons(pts, zones.verts)  # [B, Z]
+
+    tenant_ok = (zones.tenant_id[None, :] == NULL_ID) | (
+        zones.tenant_id[None, :] == batch.tenant_id[:, None]
+    )
+    area_ok = (zones.area_id[None, :] == NULL_ID) | (
+        zones.area_id[None, :] == area_id[:, None]
+    )
+    applies = zones.active[None, :] & tenant_ok & area_ok & is_loc[:, None]
+    cond_inside = zones.condition[None, :] == ZoneCondition.ALERT_IF_INSIDE
+    fired = applies & jnp.where(cond_inside, inside, ~inside)
+    fired_any = fired.any(axis=1)
+    first = jnp.argmax(fired, axis=1).astype(jnp.int32)
+    return fired_any, jnp.where(fired_any, first, NULL_ID)
+
+
+def update_device_state(
+    state: DeviceState, batch: EventBatch, accepted: jax.Array
+) -> DeviceState:
+    """Merge accepted events into last-known state (time-ordered scatters).
+
+    Reference: ``DeviceStateProcessingLogic.java:46-80`` merges each event
+    into the per-device state doc; here each event-type family updates its
+    columns via :func:`scatter_last_by_time`.
+    """
+    ids = batch.device_id
+
+    # Any-event columns.
+    new_s, new_ns, (new_type,) = scatter_last_by_time(
+        state.last_event_ts_s,
+        state.last_event_ts_ns,
+        (state.last_event_type,),
+        ids,
+        batch.ts_s,
+        batch.ts_ns,
+        (batch.event_type,),
+        accepted,
+    )
+    # An accepted event marks the device present again (reference:
+    # DevicePresenceManager resets on new events).
+    present_now = jnp.zeros_like(state.presence_missing).at[
+        jnp.where(accepted, ids, state.capacity)
+    ].set(True, mode="drop")
+    presence = state.presence_missing & ~present_now
+
+    # Location columns.
+    is_loc = accepted & (batch.event_type == EventType.LOCATION)
+    loc_s, loc_ns, (lat, lon, elev) = scatter_last_by_time(
+        state.last_location_ts_s,
+        state.last_location_ts_ns,
+        (state.last_lat, state.last_lon, state.last_elevation),
+        ids,
+        batch.ts_s,
+        batch.ts_ns,
+        (batch.lat, batch.lon, batch.elevation),
+        is_loc,
+    )
+
+    # Alert columns.
+    is_alert = accepted & (batch.event_type == EventType.ALERT)
+    alert_s, alert_ns, (alert_code,) = scatter_last_by_time(
+        state.last_alert_ts_s,
+        state.last_alert_ts_ns,
+        (state.last_alert_code,),
+        ids,
+        batch.ts_s,
+        batch.ts_ns,
+        (batch.alert_code,),
+        is_alert,
+    )
+
+    # Measurement matrix: slot = mtype_id mod M (host keeps mtype handles
+    # dense per tenant; collisions degrade to "newest of colliding types",
+    # documented in schema.DeviceState).  Unknown measurement types
+    # (mtype_id == NULL_ID) are dropped, not aliased onto slot 0.
+    M = state.num_mtype_slots
+    is_meas = accepted & (batch.event_type == EventType.MEASUREMENT) & (
+        batch.mtype_id >= 0
+    )
+    flat_ids = ids * M + batch.mtype_id % M
+    val_s, val_ns, (values,) = scatter_last_by_time(
+        state.last_value_ts_s.reshape(-1),
+        state.last_value_ts_ns.reshape(-1),
+        (state.last_values.reshape(-1),),
+        flat_ids,
+        batch.ts_s,
+        batch.ts_ns,
+        (batch.value,),
+        is_meas,
+    )
+
+    mshape = state.last_value_ts_s.shape
+    return state.replace(
+        last_event_ts_s=new_s,
+        last_event_ts_ns=new_ns,
+        last_event_type=new_type,
+        presence_missing=presence,
+        last_location_ts_s=loc_s,
+        last_location_ts_ns=loc_ns,
+        last_lat=lat,
+        last_lon=lon,
+        last_elevation=elev,
+        last_alert_ts_s=alert_s,
+        last_alert_ts_ns=alert_ns,
+        last_alert_code=alert_code,
+        last_value_ts_s=val_s.reshape(mshape),
+        last_value_ts_ns=val_ns.reshape(mshape),
+        last_values=values.reshape(state.last_values.shape),
+    )
+
+
+def _build_derived_alerts(
+    batch: EventBatch,
+    rules: RuleTable,
+    zones: ZoneTable,
+    rule_id: jax.Array,
+    zone_id: jax.Array,
+) -> EventBatch:
+    """Alert events fired by rules, ready for re-injection.
+
+    Reference: rule processors create alert events back through event
+    management (``ZoneTestRuleProcessor.java:60``).  Zone alerts take
+    priority over threshold alerts when both fire for one source event.
+    """
+    rule_fired = rule_id != NULL_ID
+    zone_fired = zone_id != NULL_ID
+    fired = rule_fired | zone_fired
+
+    safe_rule = jnp.clip(rule_id, 0, rules.capacity - 1)
+    safe_zone = jnp.clip(zone_id, 0, zones.capacity - 1)
+    code = jnp.where(
+        zone_fired, zones.alert_code[safe_zone], rules.alert_code[safe_rule]
+    )
+    level = jnp.where(
+        zone_fired, zones.alert_level[safe_zone], rules.alert_level[safe_rule]
+    )
+    empty = EventBatch.empty(batch.width)
+    return empty.replace(
+        valid=fired,
+        device_id=jnp.where(fired, batch.device_id, NULL_ID),
+        tenant_id=jnp.where(fired, batch.tenant_id, NULL_ID),
+        event_type=jnp.full_like(batch.event_type, EventType.ALERT),
+        ts_s=batch.ts_s,
+        ts_ns=batch.ts_ns,
+        alert_code=jnp.where(fired, code, NULL_ID),
+        alert_level=jnp.where(fired, level, 0),
+        # Derived events carry the source event's journal ref so the host
+        # can link alert → cause (reference: alert events reference the
+        # triggering event ids).
+        payload_ref=batch.payload_ref,
+    )
+
+
+def pipeline_step(
+    registry: Registry,
+    state: DeviceState,
+    rules: RuleTable,
+    zones: ZoneTable,
+    batch: EventBatch,
+) -> Tuple[DeviceState, PipelineOutputs]:
+    """The fused inbound step: validate → enrich → rules → state → outputs.
+
+    Pure function of its inputs — jit/pjit it once and feed batches forever.
+    """
+    accepted, unregistered, unassigned, enrich = validate_and_enrich(registry, batch)
+    rule_fired, rule_id = eval_threshold_rules(rules, batch, accepted)
+    zone_fired, zone_id = eval_zone_rules(zones, batch, accepted, enrich["area_id"])
+    new_state = update_device_state(state, batch, accepted)
+    derived = _build_derived_alerts(batch, rules, zones, rule_id, zone_id)
+
+    metrics = StepMetrics(
+        processed=batch.valid.sum().astype(jnp.int32),
+        accepted=accepted.sum().astype(jnp.int32),
+        unregistered=unregistered.sum().astype(jnp.int32),
+        unassigned=unassigned.sum().astype(jnp.int32),
+        threshold_alerts=rule_fired.sum().astype(jnp.int32),
+        zone_alerts=zone_fired.sum().astype(jnp.int32),
+        by_type=bincount_fixed(batch.event_type, accepted, NUM_EVENT_TYPES),
+    )
+    outputs = PipelineOutputs(
+        accepted=accepted,
+        unregistered=unregistered,
+        unassigned=unassigned,
+        rule_id=rule_id,
+        zone_id=zone_id,
+        derived_alerts=derived,
+        metrics=metrics,
+        **enrich,
+    )
+    return new_state, outputs

@@ -237,12 +237,15 @@ class DeviceState:
     last_event_type: jax.Array   # int32[D]
     last_values: jax.Array       # float32[D, M]
     last_value_ts_s: jax.Array   # int32[D, M]
+    last_value_ts_ns: jax.Array  # int32[D, M]
     last_lat: jax.Array          # float32[D]
     last_lon: jax.Array          # float32[D]
     last_elevation: jax.Array    # float32[D]
     last_location_ts_s: jax.Array  # int32[D]
+    last_location_ts_ns: jax.Array  # int32[D]
     last_alert_code: jax.Array   # int32[D]
     last_alert_ts_s: jax.Array   # int32[D]
+    last_alert_ts_ns: jax.Array  # int32[D]
     presence_missing: jax.Array  # bool[D]
 
     @property
@@ -261,12 +264,15 @@ class DeviceState:
             last_event_type=_i32((capacity,), NULL_ID),
             last_values=_f32((capacity, num_mtype_slots)),
             last_value_ts_s=_i32((capacity, num_mtype_slots)),
+            last_value_ts_ns=_i32((capacity, num_mtype_slots)),
             last_lat=_f32((capacity,)),
             last_lon=_f32((capacity,)),
             last_elevation=_f32((capacity,)),
             last_location_ts_s=_i32((capacity,)),
+            last_location_ts_ns=_i32((capacity,)),
             last_alert_code=_i32((capacity,), NULL_ID),
             last_alert_ts_s=_i32((capacity,)),
+            last_alert_ts_ns=_i32((capacity,)),
             presence_missing=_bool((capacity,)),
         )
 

@@ -1,0 +1,100 @@
+"""Shared test builders: small populated registries/batches."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.schema import (
+    AssignmentStatus,
+    EventBatch,
+    EventType,
+    Registry,
+    RuleTable,
+    ZoneTable,
+)
+
+
+def to_mutable(tree):
+    """Copy a schema pytree to writable numpy arrays (np.asarray may
+    return read-only views of jax buffers)."""
+    return jax.tree_util.tree_map(lambda x: np.array(x), tree)
+
+def make_registry(capacity=64, n_devices=8, tenant=0, area=1, customer=2, asset=3):
+    """Registry with devices 0..n_devices-1 active+assigned to one tenant."""
+    reg = Registry.empty(capacity)
+    idx = jnp.arange(capacity)
+    on = idx < n_devices
+    return reg.replace(
+        active=on,
+        tenant_id=jnp.where(on, tenant, -1),
+        device_type_id=jnp.where(on, 7, -1),
+        assignment_id=jnp.where(on, idx, -1),
+        assignment_status=jnp.where(on, AssignmentStatus.ACTIVE, AssignmentStatus.NONE),
+        area_id=jnp.where(on, area, -1),
+        customer_id=jnp.where(on, customer, -1),
+        asset_id=jnp.where(on, asset, -1),
+    )
+
+
+def make_batch(rows):
+    """Build an EventBatch from a list of dict rows (unset fields default)."""
+    width = len(rows)
+    b = to_mutable(EventBatch.empty(width))
+    b = {f: getattr(b, f) for f in b.__dataclass_fields__}
+    for i, row in enumerate(rows):
+        b["valid"][i] = row.get("valid", True)
+        for key, val in row.items():
+            if key == "valid":
+                continue
+            b[key][i] = val
+    return EventBatch(**{k: jnp.asarray(v) for k, v in b.items()})
+
+
+def measurement(device, mtype=0, value=0.0, ts=1000, tenant=0, **kw):
+    return dict(
+        device_id=device, tenant_id=tenant, event_type=EventType.MEASUREMENT,
+        mtype_id=mtype, value=value, ts_s=ts, **kw,
+    )
+
+
+def location(device, lat=0.0, lon=0.0, ts=1000, tenant=0, **kw):
+    return dict(
+        device_id=device, tenant_id=tenant, event_type=EventType.LOCATION,
+        lat=lat, lon=lon, ts_s=ts, **kw,
+    )
+
+
+def alert(device, code=5, level=1, ts=1000, tenant=0, **kw):
+    return dict(
+        device_id=device, tenant_id=tenant, event_type=EventType.ALERT,
+        alert_code=code, alert_level=level, ts_s=ts, **kw,
+    )
+
+
+def square_zone(zones: ZoneTable, i, x0, y0, x1, y1, tenant=-1, area=-1,
+                condition=0, alert_code=100):
+    """Write an axis-aligned square into zone slot i (host-side builder)."""
+    z = to_mutable(zones)
+    verts = np.array([[x0, y0], [x1, y0], [x1, y1], [x0, y1]], np.float32)
+    V = z.verts.shape[1]
+    padded = np.concatenate([verts, np.repeat(verts[-1:], V - 4, axis=0)])
+    z.active[i] = True
+    z.verts[i] = padded
+    z.nvert[i] = 4
+    z.tenant_id[i] = tenant
+    z.area_id[i] = area
+    z.condition[i] = condition
+    z.alert_code[i] = alert_code
+    return ZoneTable(**{f: jnp.asarray(getattr(z, f)) for f in z.__dataclass_fields__})
+
+
+def threshold_rule(rules: RuleTable, i, mtype=0, op=0, threshold=50.0,
+                   alert_code=200, tenant=-1):
+    r = to_mutable(rules)
+    r.active[i] = True
+    r.mtype_id[i] = mtype
+    r.op[i] = op
+    r.threshold[i] = threshold
+    r.alert_code[i] = alert_code
+    r.tenant_id[i] = tenant
+    return RuleTable(**{f: jnp.asarray(getattr(r, f)) for f in r.__dataclass_fields__})

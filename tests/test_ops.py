@@ -1,0 +1,122 @@
+"""Kernel-level tests: point-in-polygon and time-ordered scatters."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.ops.geo import points_in_polygons
+from sitewhere_tpu.ops.scatter import (
+    bincount_fixed,
+    scatter_last_by_time,
+    scatter_max_by_key,
+)
+
+
+def pad_poly(verts, V):
+    verts = np.asarray(verts, np.float32)
+    return np.concatenate([verts, np.repeat(verts[-1:], V - len(verts), axis=0)])
+
+
+def test_pip_triangle():
+    tri = pad_poly([[0, 0], [4, 0], [2, 4]], 8)
+    pts = jnp.array([[2.0, 1.0], [2.0, 5.0], [0.1, 3.0], [2.0, 3.9]], jnp.float32)
+    out = np.asarray(points_in_polygons(pts, jnp.asarray(tri[None])))
+    assert out[:, 0].tolist() == [True, False, False, True]
+
+
+def test_pip_concave():
+    # U-shaped (concave) polygon: notch between x=2..4 above y=2.
+    poly = pad_poly(
+        [[0, 0], [6, 0], [6, 5], [4, 5], [4, 2], [2, 2], [2, 5], [0, 5]], 16
+    )
+    pts = jnp.array(
+        [[1.0, 4.0],   # left arm — inside
+         [3.0, 4.0],   # in the notch — outside
+         [5.0, 4.0],   # right arm — inside
+         [3.0, 1.0]],  # base — inside
+        jnp.float32,
+    )
+    out = np.asarray(points_in_polygons(pts, jnp.asarray(poly[None])))
+    assert out[:, 0].tolist() == [True, False, True, True]
+
+
+def test_pip_multiple_polygons():
+    a = pad_poly([[0, 0], [1, 0], [1, 1], [0, 1]], 8)
+    b = pad_poly([[10, 10], [12, 10], [12, 12], [10, 12]], 8)
+    pts = jnp.array([[0.5, 0.5], [11.0, 11.0]], jnp.float32)
+    out = np.asarray(points_in_polygons(pts, jnp.asarray(np.stack([a, b]))))
+    assert out.tolist() == [[True, False], [False, True]]
+
+
+def test_pip_degenerate_padding_zone():
+    # All-zero (empty slot) polygon must contain nothing — including the
+    # origin, where all padded vertices sit.
+    zero = np.zeros((1, 8, 2), np.float32)
+    pts = jnp.array([[0.0, 0.0], [1.0, 1.0]], jnp.float32)
+    out = np.asarray(points_in_polygons(pts, jnp.asarray(zero)))
+    assert not out.any()
+
+
+def test_scatter_last_by_time_basic():
+    cur_s = jnp.zeros(4, jnp.int32)
+    cur_ns = jnp.zeros(4, jnp.int32)
+    payload = jnp.zeros(4, jnp.float32)
+    ids = jnp.array([1, 1, 2, 0], jnp.int32)
+    ts_s = jnp.array([10, 20, 5, 7], jnp.int32)
+    ts_ns = jnp.array([0, 0, 0, 0], jnp.int32)
+    vals = jnp.array([1.0, 2.0, 3.0, 4.0], jnp.float32)
+    mask = jnp.array([True, True, True, False])
+    s, ns, (p,) = scatter_last_by_time(
+        cur_s, cur_ns, (payload,), ids, ts_s, ts_ns, (vals,), mask
+    )
+    assert s.tolist() == [0, 20, 5, 0]
+    assert p.tolist() == [0.0, 2.0, 3.0, 0.0]  # masked row 3 dropped
+
+
+def test_scatter_last_by_time_stale_event_ignored():
+    # Slot already at t=100; an event at t=50 must not regress it.
+    cur_s = jnp.array([100], jnp.int32)
+    cur_ns = jnp.array([7], jnp.int32)
+    payload = jnp.array([9.0], jnp.float32)
+    s, ns, (p,) = scatter_last_by_time(
+        cur_s, cur_ns, (payload,),
+        jnp.array([0]), jnp.array([50]), jnp.array([999]),
+        (jnp.array([1.0]),), jnp.array([True]),
+    )
+    assert int(s[0]) == 100 and int(ns[0]) == 7 and float(p[0]) == 9.0
+
+
+def test_scatter_last_by_time_ns_ordering():
+    cur_s = jnp.array([100], jnp.int32)
+    cur_ns = jnp.array([500], jnp.int32)
+    payload = jnp.array([9.0], jnp.float32)
+    # Same second, smaller ns -> ignored; larger ns -> wins.
+    s, ns, (p,) = scatter_last_by_time(
+        cur_s, cur_ns, (payload,),
+        jnp.array([0, 0]), jnp.array([100, 100]), jnp.array([100, 600]),
+        (jnp.array([1.0, 2.0]),), jnp.array([True, True]),
+    )
+    assert int(ns[0]) == 600 and float(p[0]) == 2.0
+
+
+def test_scatter_out_of_range_ids_dropped():
+    cur = jnp.zeros(2, jnp.int32)
+    pay = jnp.zeros(2, jnp.float32)
+    key, (p,) = scatter_max_by_key(
+        cur, (pay,),
+        jnp.array([-1, 7, 0]), jnp.array([5, 5, 5]),
+        (jnp.array([1.0, 2.0, 3.0]),), jnp.array([True, True, True]),
+    )
+    assert key.tolist() == [5, 0]
+    assert p.tolist() == [3.0, 0.0]
+
+
+def test_bincount_fixed():
+    out = bincount_fixed(
+        jnp.array([0, 2, 2, 5, 1]), jnp.array([True, True, True, True, False]), 6
+    )
+    assert out.tolist() == [1, 0, 2, 0, 0, 1]
+
+
+def test_bincount_negative_ids_dropped():
+    out = bincount_fixed(jnp.array([-1, 0]), jnp.array([True, True]), 3)
+    assert out.tolist() == [1, 0, 0]
