@@ -1,6 +1,6 @@
 """Reusable device-side ops: geospatial kernels and masked time-ordered scatters."""
 
-from sitewhere_tpu.ops.geo import points_in_polygons  # noqa: F401
+from sitewhere_tpu.ops.geo import pad_polygon, points_in_polygons  # noqa: F401
 from sitewhere_tpu.ops.scatter import (  # noqa: F401
     bincount_fixed,
     scatter_last_by_time,

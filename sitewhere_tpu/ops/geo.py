@@ -17,6 +17,21 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def pad_polygon(verts, max_verts: int) -> np.ndarray:
+    """Host-side: pad a polygon ring to ``max_verts`` per the module contract
+    (repeat the last real vertex).  The single source of the padding rule —
+    use this when building :class:`~sitewhere_tpu.schema.ZoneTable` rows.
+    """
+    verts = np.asarray(verts, np.float32)
+    if verts.ndim != 2 or verts.shape[1] != 2 or len(verts) < 3:
+        raise ValueError(f"polygon needs shape [>=3, 2], got {verts.shape}")
+    if len(verts) > max_verts:
+        raise ValueError(f"polygon has {len(verts)} verts > max {max_verts}")
+    pad = np.repeat(verts[-1:], max_verts - len(verts), axis=0)
+    return np.concatenate([verts, pad])
 
 
 def points_in_polygons(points: jax.Array, verts: jax.Array) -> jax.Array:

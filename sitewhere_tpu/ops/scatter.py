@@ -5,9 +5,10 @@ The reference's state materialization processes one Kafka record at a time
 so "last write wins" falls out of per-partition ordering.  In a batched SPMD
 step many events for one device land in the same batch, so we scatter with
 an explicit time key: first a scatter-max of the ``(ts_s, ts_ns)`` key, then
-payload writes masked to the rows that won.  Ties (identical key) resolve
-arbitrarily among tied rows, like concurrent writes in the reference's Mongo
-upsert path.
+payload writes masked to the rows that won.  Ties (identical key) are broken
+by batch row index (highest row wins) so exactly ONE event row writes all
+payload columns — independent per-column scatters with duplicate indices
+would otherwise be free to mix columns from different tied events.
 """
 
 from __future__ import annotations
@@ -66,11 +67,24 @@ def scatter_last_by_time(
     # Winner rows: their (s, ns) equals the final slot key.
     clip_ids = jnp.clip(ids, 0, capacity - 1)
     won = sec_won & (ts_ns == new_ns[clip_ids])
-    win_ids = jnp.where(won, ids, capacity)
+    win_ids, won = _unique_winner(won, ids, capacity)
     new_payload = tuple(
         cur.at[win_ids].set(val, mode="drop") for cur, val in zip(cur_payload, payload)
     )
     return new_s, new_ns, new_payload
+
+
+def _unique_winner(won: jax.Array, ids: jax.Array, capacity: int):
+    """Reduce a (possibly tied) winner mask to exactly one row per slot.
+
+    Highest batch row index wins among tied rows, so all payload columns are
+    written by the same event.
+    """
+    row = jnp.arange(won.shape[0], dtype=jnp.int32)
+    cand_ids = jnp.where(won, ids, capacity)
+    best_row = jnp.full((capacity,), -1, jnp.int32).at[cand_ids].max(row, mode="drop")
+    final = won & (row == best_row[jnp.clip(ids, 0, capacity - 1)])
+    return jnp.where(final, ids, capacity), final
 
 
 def scatter_max_by_key(
@@ -92,7 +106,7 @@ def scatter_max_by_key(
     safe_ids = jnp.where(mask, ids, capacity)
     new_key = cur_key.at[safe_ids].max(key, mode="drop")
     won = mask & (key == new_key[jnp.clip(ids, 0, capacity - 1)])
-    win_ids = jnp.where(won, ids, capacity)
+    win_ids, _ = _unique_winner(won, ids, capacity)
     new_payload = tuple(
         cur.at[win_ids].set(val, mode="drop") for cur, val in zip(cur_payload, payload)
     )

@@ -1,0 +1,154 @@
+"""SPMD pipeline over a device mesh — the Kafka-partitioning analog.
+
+The reference scales the pipeline horizontally by partitioning Kafka topics
+on device token (``MicroserviceKafkaProducer.java:106``) and running one
+consumer-group member per partition set (``KafkaRuleProcessorHost.java:78-80``).
+Here the same decomposition is a ``shard_map`` over the ``shard`` mesh axis:
+
+- registry + state tensors are block-sharded along device capacity;
+- the host batcher routes each event into the sub-batch of the shard that
+  owns its registry row (:func:`sitewhere_tpu.parallel.mesh.shard_for_device`),
+  so validation/enrichment gathers are strictly shard-local — zero ICI
+  traffic on the hot path;
+- rules + zones are replicated (small broadcast tables, the analog of each
+  consumer holding its own rule/zone cache);
+- metrics are ``psum``-ed over the shard axis so the host sees one global
+  counter set (the analog of the aggregated Dropwizard metrics).
+
+A mis-routed event (its device row lives on another shard) cannot be
+validated locally and is reported ``unregistered`` — the host dead-letter
+path re-routes it, mirroring how the reference replays events that hit a
+stale consumer after a rebalance.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from sitewhere_tpu.parallel.mesh import SHARD_AXIS
+from sitewhere_tpu.pipeline.step import PipelineOutputs, StepMetrics, pipeline_step
+from sitewhere_tpu.schema import (
+    DeviceState,
+    EventBatch,
+    Registry,
+    RuleTable,
+    ZoneTable,
+)
+
+
+def _specs_sharded(tree) -> object:
+    """P(shard) on the leading axis of every array leaf; scalars replicated."""
+    return jax.tree_util.tree_map(
+        lambda x: P() if jnp.ndim(x) == 0 else P(SHARD_AXIS, *([None] * (jnp.ndim(x) - 1))),
+        tree,
+    )
+
+
+def _specs_replicated(tree) -> object:
+    return jax.tree_util.tree_map(lambda x: P(), tree)
+
+
+def build_sharded_step(mesh: Mesh):
+    """Build the jitted multi-chip pipeline step for ``mesh``.
+
+    Returns ``step(registry, state, rules, zones, batch) -> (state, outputs)``
+    operating on globally-sharded arrays (place inputs with
+    :func:`place_inputs` or equivalent ``device_put``).
+    """
+    reg_t = Registry.empty(8)
+    state_t = DeviceState.empty(8)
+    rules_t = RuleTable.empty(1)
+    zones_t = ZoneTable.empty(1, max_verts=4)
+    batch_t = EventBatch.empty(8)
+
+    in_specs = (
+        _specs_sharded(reg_t),
+        _specs_sharded(state_t),
+        _specs_replicated(rules_t),
+        _specs_replicated(zones_t),
+        _specs_sharded(batch_t),
+    )
+    # Derive outputs specs from a template so new PipelineOutputs fields
+    # inherit row-level sharding automatically; only metrics (psum-ed
+    # global counters) are replicated.
+    metrics_t = StepMetrics(
+        processed=jnp.int32(0), accepted=jnp.int32(0), unregistered=jnp.int32(0),
+        unassigned=jnp.int32(0), threshold_alerts=jnp.int32(0),
+        zone_alerts=jnp.int32(0), by_type=jnp.zeros(6, jnp.int32),
+    )
+    outputs_t = PipelineOutputs(
+        accepted=jnp.zeros(8, bool), unregistered=jnp.zeros(8, bool),
+        unassigned=jnp.zeros(8, bool), device_type_id=jnp.zeros(8, jnp.int32),
+        assignment_id=jnp.zeros(8, jnp.int32), area_id=jnp.zeros(8, jnp.int32),
+        customer_id=jnp.zeros(8, jnp.int32), asset_id=jnp.zeros(8, jnp.int32),
+        rule_id=jnp.zeros(8, jnp.int32), zone_id=jnp.zeros(8, jnp.int32),
+        derived_alerts=batch_t, metrics=metrics_t,
+    )
+    out_specs = (
+        _specs_sharded(state_t),
+        _specs_sharded(outputs_t).replace(metrics=_specs_replicated(metrics_t)),
+    )
+
+    def local_step(registry, state, rules, zones, batch):
+        # Global device id -> local registry row on this shard.
+        rows_local = registry.capacity
+        offset = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int32) * rows_local
+        local_ids = jnp.where(batch.device_id >= 0, batch.device_id - offset, -1)
+        # Foreign rows fall outside [0, rows_local) and are reported
+        # unregistered by validate_and_enrich's range check.
+        local_batch = batch.replace(device_id=local_ids)
+
+        new_state, out = pipeline_step(registry, state, rules, zones, local_batch)
+
+        # Restore global ids in row-level outputs.
+        derived = out.derived_alerts
+        derived = derived.replace(
+            device_id=jnp.where(derived.device_id >= 0, derived.device_id + offset,
+                                derived.device_id)
+        )
+        metrics = jax.tree_util.tree_map(
+            lambda c: jax.lax.psum(c, SHARD_AXIS), out.metrics
+        )
+        out = out.replace(derived_alerts=derived, metrics=metrics)
+        return new_state, out
+
+    mapped = shard_map(
+        local_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(1,))
+
+
+def place_inputs(
+    mesh: Mesh,
+    registry: Registry,
+    state: DeviceState,
+    rules: RuleTable,
+    zones: ZoneTable,
+) -> Tuple[Registry, DeviceState, RuleTable, ZoneTable]:
+    """Device-put the resident tables with their canonical shardings."""
+
+    def put(tree, specs):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+        )
+
+    return (
+        put(registry, _specs_sharded(registry)),
+        put(state, _specs_sharded(state)),
+        put(rules, _specs_replicated(rules)),
+        put(zones, _specs_replicated(zones)),
+    )
+
+
+def place_batch(mesh: Mesh, batch: EventBatch) -> EventBatch:
+    """Device-put an event batch sharded along its width."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P(SHARD_AXIS))), batch
+    )

@@ -74,10 +74,12 @@ def alert(device, code=5, level=1, ts=1000, tenant=0, **kw):
 def square_zone(zones: ZoneTable, i, x0, y0, x1, y1, tenant=-1, area=-1,
                 condition=0, alert_code=100):
     """Write an axis-aligned square into zone slot i (host-side builder)."""
+    from sitewhere_tpu.ops.geo import pad_polygon
+
     z = to_mutable(zones)
-    verts = np.array([[x0, y0], [x1, y0], [x1, y1], [x0, y1]], np.float32)
-    V = z.verts.shape[1]
-    padded = np.concatenate([verts, np.repeat(verts[-1:], V - 4, axis=0)])
+    padded = pad_polygon(
+        [[x0, y0], [x1, y0], [x1, y1], [x0, y1]], z.verts.shape[1]
+    )
     z.active[i] = True
     z.verts[i] = padded
     z.nvert[i] = 4

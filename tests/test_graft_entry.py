@@ -1,0 +1,19 @@
+"""Driver-contract smoke tests for __graft_entry__ (CPU mesh)."""
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+
+import __graft_entry__ as graft
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    _, out = jax.jit(fn)(*args)
+    assert int(out.metrics.processed) == args[-1].width
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)

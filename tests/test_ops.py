@@ -11,9 +11,7 @@ from sitewhere_tpu.ops.scatter import (
 )
 
 
-def pad_poly(verts, V):
-    verts = np.asarray(verts, np.float32)
-    return np.concatenate([verts, np.repeat(verts[-1:], V - len(verts), axis=0)])
+from sitewhere_tpu.ops.geo import pad_polygon as pad_poly
 
 
 def test_pip_triangle():
@@ -120,3 +118,31 @@ def test_bincount_fixed():
 def test_bincount_negative_ids_dropped():
     out = bincount_fixed(jnp.array([-1, 0]), jnp.array([True, True]), 3)
     assert out.tolist() == [1, 0, 0]
+
+
+def test_scatter_exact_tie_one_row_wins_all_columns():
+    # Two events with IDENTICAL (s, ns): one whole row must win — columns
+    # must never mix between tied rows.
+    cur_s = jnp.zeros(2, jnp.int32)
+    cur_ns = jnp.zeros(2, jnp.int32)
+    lat = jnp.zeros(2, jnp.float32)
+    lon = jnp.zeros(2, jnp.float32)
+    s, ns, (la, lo) = scatter_last_by_time(
+        cur_s, cur_ns, (lat, lon),
+        jnp.array([1, 1]), jnp.array([1000, 1000]), jnp.array([0, 0]),
+        (jnp.array([10.0, 20.0]), jnp.array([-10.0, -20.0])),
+        jnp.array([True, True]),
+    )
+    # Highest row index wins: row 1 -> (20, -20).
+    assert (float(la[1]), float(lo[1])) == (20.0, -20.0)
+
+
+def test_pad_polygon_contract():
+    p = pad_poly([[0, 0], [1, 0], [0, 1]], 6)
+    assert p.shape == (6, 2)
+    assert (p[3:] == p[2]).all()
+    import pytest
+    with pytest.raises(ValueError):
+        pad_poly([[0, 0], [1, 0]], 6)  # too few verts
+    with pytest.raises(ValueError):
+        pad_poly([[0, 0]] * 9, 6)      # too many
