@@ -1,0 +1,23 @@
+"""Host-side ingest: protocol frontends → decode → dedupe → batch → journal.
+
+Replaces the reference's ``service-event-sources`` (receivers + decoders +
+deduplicators, ``sources/InboundEventSource.java:35-309``) and the Kafka
+durability layer (``MicroserviceKafkaProducer/Consumer``): events enter via
+protocol frontends, are decoded to typed requests, deduplicated, appended to
+a durable journal with offsets (the Kafka-topic analog), and assembled into
+fixed-shape :class:`~sitewhere_tpu.schema.EventBatch` tensors routed by
+owning shard for the SPMD pipeline step.
+"""
+
+from sitewhere_tpu.ingest.journal import Journal, JournalReader  # noqa: F401
+from sitewhere_tpu.ingest.decoders import (  # noqa: F401
+    DecodedRequest,
+    RequestKind,
+    JsonDecoder,
+    JsonBatchDecoder,
+    BinaryDecoder,
+    CompositeDecoder,
+    DecodeError,
+)
+from sitewhere_tpu.ingest.dedup import AlternateIdDeduplicator  # noqa: F401
+from sitewhere_tpu.ingest.batcher import Batcher, BatchPlan  # noqa: F401

@@ -1,0 +1,366 @@
+"""Payload decoders: raw protocol bytes → typed device requests.
+
+Reference: ``IDeviceEventDecoder`` implementations in
+``service-event-sources`` — JSON (``decoder/json/JsonDeviceRequestDecoder.java``,
+``JsonBatchEventDecoder.java``), protobuf
+(``decoder/protobuf/ProtobufDeviceEventDecoder.java``), scripted decoders
+(``decoder/GroovyEventDecoder.java``), and a composite decoder that picks a
+sub-decoder per device type
+(``decoder/composite/BinaryCompositeDeviceEventDecoder.java``).
+
+Here decoders are plain callables ``bytes -> list[DecodedRequest]``:
+
+- :class:`JsonDecoder` — the envelope ``{"deviceToken": ..., "type": ...,
+  "request": {...}}`` (the shape the reference's MQTT conformance senders
+  emit, ``MqttTests.java:107-168``; ``hardwareId`` accepted as alias).
+- :class:`JsonBatchDecoder` — ``{"deviceToken": ..., "events": [...]}``.
+- :class:`BinaryDecoder` — a compact length-prefixed binary framing (the
+  protobuf-decoder analog, without a schema compiler dependency).
+- :class:`CompositeDecoder` — metadata extractor chooses a sub-decoder.
+- "Scripting" (the Groovy analog) = any user-supplied callable with the
+  same signature.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import enum
+import json
+import struct
+from typing import Callable, Dict, List, Optional, Tuple
+
+from sitewhere_tpu.schema import AlertLevel, EventType
+
+
+class DecodeError(Exception):
+    """Failed decode → dead-letter journal (reference: failed-decode topic,
+    ``EventSourcesManager.java:189``)."""
+
+
+class RequestKind(enum.IntEnum):
+    # The 6 event types (EventType values 0..5), plus host-plane requests.
+    MEASUREMENT = 0
+    LOCATION = 1
+    ALERT = 2
+    COMMAND_INVOCATION = 3
+    COMMAND_RESPONSE = 4
+    STATE_CHANGE = 5
+    REGISTRATION = 10       # reference: RegisterDevice → registration topic
+    STREAM_DATA = 11        # reference: device stream chunks
+    MAPPING = 12            # reference: DeviceMappingCreateRequest
+
+
+_TYPE_ALIASES = {
+    "measurement": RequestKind.MEASUREMENT,
+    "measurements": RequestKind.MEASUREMENT,
+    "devicemeasurements": RequestKind.MEASUREMENT,
+    "location": RequestKind.LOCATION,
+    "devicelocation": RequestKind.LOCATION,
+    "alert": RequestKind.ALERT,
+    "devicealert": RequestKind.ALERT,
+    "registerdevice": RequestKind.REGISTRATION,
+    "registration": RequestKind.REGISTRATION,
+    "acknowledge": RequestKind.COMMAND_RESPONSE,
+    "commandresponse": RequestKind.COMMAND_RESPONSE,
+    "statechange": RequestKind.STATE_CHANGE,
+    "streamdata": RequestKind.STREAM_DATA,
+}
+
+_LEVEL_ALIASES = {
+    "info": AlertLevel.INFO,
+    "warning": AlertLevel.WARNING,
+    "error": AlertLevel.ERROR,
+    "critical": AlertLevel.CRITICAL,
+}
+
+
+@dataclasses.dataclass
+class DecodedRequest:
+    """One typed inbound request (reference: ``IDecodedDeviceRequest``)."""
+
+    kind: RequestKind
+    device_token: str
+    ts_s: int
+    ts_ns: int = 0
+    # measurement
+    mtype: Optional[str] = None
+    value: float = 0.0
+    # location
+    lat: float = 0.0
+    lon: float = 0.0
+    elevation: float = 0.0
+    # alert
+    alert_type: Optional[str] = None
+    alert_level: int = AlertLevel.INFO
+    alert_message: Optional[str] = None
+    # command response (reference: Acknowledge w/ originating event id)
+    originating_event: Optional[str] = None
+    response: Optional[str] = None
+    # registration
+    device_type_token: Optional[str] = None
+    area_token: Optional[str] = None
+    customer_token: Optional[str] = None
+    # generic
+    metadata: Optional[dict] = None
+    alternate_id: Optional[str] = None   # dedup key (AlternateIdDeduplicator)
+    update_state: bool = True            # reference: event.isUpdateState()
+
+    @property
+    def event_type(self) -> Optional[EventType]:
+        """The on-device event type, or None for host-plane requests."""
+        if self.kind <= RequestKind.STATE_CHANGE:
+            return EventType(int(self.kind))
+        return None
+
+
+def _parse_ts(value) -> Tuple[int, int]:
+    """Accept epoch seconds (int/float), epoch millis (int > 1e11), or ISO."""
+    if value is None:
+        return 0, 0
+    if isinstance(value, (int, float)):
+        if value > 1e11:  # epoch millis
+            value = value / 1000.0
+        s = int(value)
+        return s, int(round((value - s) * 1e9))
+    if isinstance(value, str):
+        try:
+            dt = _dt.datetime.fromisoformat(value.replace("Z", "+00:00"))
+        except ValueError as e:
+            raise DecodeError(f"bad eventDate {value!r}") from e
+        ts = dt.timestamp()
+        s = int(ts)
+        return s, int(round((ts - s) * 1e9))
+    raise DecodeError(f"bad eventDate {value!r}")
+
+
+def _decode_one(token: str, kind_name: str, req: dict) -> DecodedRequest:
+    try:
+        return _decode_one_inner(token, kind_name, req)
+    except DecodeError:
+        raise
+    except (ValueError, TypeError, KeyError) as e:
+        # Malformed field values (float("abc"), int(None), …) must become
+        # DecodeError so sources dead-letter them instead of the exception
+        # killing the receiver thread.
+        raise DecodeError(f"bad field in {kind_name!r} request: {e}") from e
+
+
+def _decode_one_inner(token: str, kind_name: str, req: dict) -> DecodedRequest:
+    kind = _TYPE_ALIASES.get(kind_name.strip().lower())
+    if kind is None:
+        raise DecodeError(f"unknown request type {kind_name!r}")
+    ts_s, ts_ns = _parse_ts(req.get("eventDate", req.get("timestamp")))
+    common = dict(
+        kind=kind,
+        device_token=token,
+        ts_s=ts_s,
+        ts_ns=ts_ns,
+        metadata=req.get("metadata"),
+        alternate_id=req.get("alternateId"),
+        update_state=bool(req.get("updateState", True)),
+    )
+    if kind == RequestKind.MEASUREMENT:
+        name = req.get("name", req.get("measurementId"))
+        if name is None or "value" not in req:
+            raise DecodeError("measurement needs name+value")
+        return DecodedRequest(mtype=str(name), value=float(req["value"]), **common)
+    if kind == RequestKind.LOCATION:
+        try:
+            return DecodedRequest(
+                lat=float(req["latitude"]),
+                lon=float(req["longitude"]),
+                elevation=float(req.get("elevation", 0.0)),
+                **common,
+            )
+        except KeyError as e:
+            raise DecodeError(f"location missing {e}") from e
+    if kind == RequestKind.ALERT:
+        level = req.get("level", "info")
+        if isinstance(level, str):
+            level = _LEVEL_ALIASES.get(level.lower())
+            if level is None:
+                raise DecodeError(f"bad alert level {req.get('level')!r}")
+        return DecodedRequest(
+            alert_type=str(req.get("type", req.get("alertType", "alert"))),
+            alert_level=int(level),
+            alert_message=req.get("message"),
+            **common,
+        )
+    if kind == RequestKind.COMMAND_RESPONSE:
+        return DecodedRequest(
+            originating_event=req.get("originatingEventId"),
+            response=req.get("response"),
+            **common,
+        )
+    if kind == RequestKind.REGISTRATION:
+        return DecodedRequest(
+            device_type_token=req.get("deviceTypeToken", req.get("specificationToken")),
+            area_token=req.get("areaToken"),
+            customer_token=req.get("customerToken"),
+            **common,
+        )
+    if kind in (RequestKind.STATE_CHANGE, RequestKind.STREAM_DATA,
+                RequestKind.MAPPING):
+        return DecodedRequest(**common)
+    raise DecodeError(f"unsupported request type {kind_name!r}")
+
+
+class JsonDecoder:
+    """``{"deviceToken"|"hardwareId": ..., "type": ..., "request": {...}}``"""
+
+    def __call__(self, payload: bytes) -> List[DecodedRequest]:
+        try:
+            doc = json.loads(payload)
+        except (ValueError, UnicodeDecodeError) as e:
+            raise DecodeError(f"bad json: {e}") from e
+        if not isinstance(doc, dict):
+            raise DecodeError("json payload must be an object")
+        token = doc.get("deviceToken", doc.get("hardwareId"))
+        if not token:
+            raise DecodeError("missing deviceToken/hardwareId")
+        kind = doc.get("type")
+        if not kind:
+            raise DecodeError("missing type")
+        req = doc.get("request", {})
+        if not isinstance(req, dict):
+            raise DecodeError("request must be an object")
+        return [_decode_one(str(token), str(kind), req)]
+
+
+class JsonBatchDecoder:
+    """``{"deviceToken": ..., "events": [{"type": ..., ...}, ...]}``
+
+    Reference: ``JsonBatchEventDecoder.java`` — many events in one payload.
+    """
+
+    def __call__(self, payload: bytes) -> List[DecodedRequest]:
+        try:
+            doc = json.loads(payload)
+        except (ValueError, UnicodeDecodeError) as e:
+            raise DecodeError(f"bad json: {e}") from e
+        token = doc.get("deviceToken", doc.get("hardwareId"))
+        if not token:
+            raise DecodeError("missing deviceToken/hardwareId")
+        events = doc.get("events")
+        if not isinstance(events, list) or not events:
+            raise DecodeError("missing events[]")
+        out = []
+        for ev in events:
+            if not isinstance(ev, dict) or "type" not in ev:
+                raise DecodeError("each event needs a type")
+            out.append(_decode_one(str(token), str(ev["type"]), ev))
+        return out
+
+
+# Compact binary framing:  magic "SW" | u8 kind | u8 token_len | token |
+# f64 ts | kind-specific payload.  The schema-compiled-protobuf analog.
+_BIN_MAGIC = b"SW"
+_BIN_HEAD = struct.Struct("<2sBB")
+_BIN_TS = struct.Struct("<d")
+_BIN_MEAS = struct.Struct("<Bd")       # mtype_len follows; value
+_BIN_LOC = struct.Struct("<ddd")       # lat, lon, elevation
+_BIN_ALERT = struct.Struct("<BB")      # level, type_len
+
+
+class BinaryDecoder:
+    """Compact binary event framing (see module source for layout)."""
+
+    def __call__(self, payload: bytes) -> List[DecodedRequest]:
+        try:
+            magic, kind, token_len = _BIN_HEAD.unpack_from(payload, 0)
+            if magic != _BIN_MAGIC:
+                raise DecodeError("bad magic")
+            pos = _BIN_HEAD.size
+            token = payload[pos : pos + token_len].decode("utf-8")
+            pos += token_len
+            (ts,) = _BIN_TS.unpack_from(payload, pos)
+            pos += _BIN_TS.size
+            ts_s = int(ts)
+            ts_ns = int(round((ts - ts_s) * 1e9))
+            kind = RequestKind(kind)
+            if kind == RequestKind.MEASUREMENT:
+                name_len, value = _BIN_MEAS.unpack_from(payload, pos)
+                pos += _BIN_MEAS.size
+                name = payload[pos : pos + name_len].decode("utf-8")
+                return [
+                    DecodedRequest(
+                        kind=kind, device_token=token, ts_s=ts_s, ts_ns=ts_ns,
+                        mtype=name, value=value,
+                    )
+                ]
+            if kind == RequestKind.LOCATION:
+                lat, lon, elev = _BIN_LOC.unpack_from(payload, pos)
+                return [
+                    DecodedRequest(
+                        kind=kind, device_token=token, ts_s=ts_s, ts_ns=ts_ns,
+                        lat=lat, lon=lon, elevation=elev,
+                    )
+                ]
+            if kind == RequestKind.ALERT:
+                level, type_len = _BIN_ALERT.unpack_from(payload, pos)
+                pos += _BIN_ALERT.size
+                atype = payload[pos : pos + type_len].decode("utf-8")
+                return [
+                    DecodedRequest(
+                        kind=kind, device_token=token, ts_s=ts_s, ts_ns=ts_ns,
+                        alert_type=atype, alert_level=level,
+                    )
+                ]
+            if kind == RequestKind.REGISTRATION:
+                (dt_len,) = struct.unpack_from("<B", payload, pos)
+                pos += 1
+                dt_token = payload[pos : pos + dt_len].decode("utf-8")
+                return [
+                    DecodedRequest(
+                        kind=kind, device_token=token, ts_s=ts_s, ts_ns=ts_ns,
+                        device_type_token=dt_token or None,
+                    )
+                ]
+            raise DecodeError(f"unsupported binary kind {int(kind)}")
+        except (struct.error, UnicodeDecodeError, ValueError) as e:
+            raise DecodeError(f"bad binary payload: {e}") from e
+
+    @staticmethod
+    def encode(req: DecodedRequest) -> bytes:
+        """Inverse framing (used by tests and device simulators)."""
+        token = req.device_token.encode("utf-8")
+        ts = req.ts_s + req.ts_ns / 1e9
+        head = _BIN_HEAD.pack(_BIN_MAGIC, int(req.kind), len(token))
+        body = head + token + _BIN_TS.pack(ts)
+        if req.kind == RequestKind.MEASUREMENT:
+            name = (req.mtype or "").encode("utf-8")
+            return body + _BIN_MEAS.pack(len(name), req.value) + name
+        if req.kind == RequestKind.LOCATION:
+            return body + _BIN_LOC.pack(req.lat, req.lon, req.elevation)
+        if req.kind == RequestKind.ALERT:
+            atype = (req.alert_type or "").encode("utf-8")
+            return body + _BIN_ALERT.pack(req.alert_level, len(atype)) + atype
+        if req.kind == RequestKind.REGISTRATION:
+            dt = (req.device_type_token or "").encode("utf-8")
+            return body + struct.pack("<B", len(dt)) + dt
+        raise ValueError(f"cannot encode kind {req.kind}")
+
+
+class CompositeDecoder:
+    """Metadata extractor chooses a sub-decoder per payload.
+
+    Reference: ``BinaryCompositeDeviceEventDecoder`` — a metadata extractor
+    reads the payload, yields a key (there: the device type), and a keyed
+    sub-decoder decodes the body.
+    """
+
+    def __init__(
+        self,
+        extractor: Callable[[bytes], Tuple[str, bytes]],
+        decoders: Dict[str, Callable[[bytes], List[DecodedRequest]]],
+    ):
+        self.extractor = extractor
+        self.decoders = decoders
+
+    def __call__(self, payload: bytes) -> List[DecodedRequest]:
+        key, body = self.extractor(payload)
+        decoder = self.decoders.get(key)
+        if decoder is None:
+            raise DecodeError(f"no decoder for key {key!r}")
+        return decoder(body)

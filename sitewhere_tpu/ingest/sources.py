@@ -1,0 +1,349 @@
+"""Inbound event sources: protocol receivers + decode + dedupe + forward.
+
+Reference: ``service-event-sources`` — an ``InboundEventSource`` composes a
+list of ``IInboundEventReceiver`` s with one ``IDeviceEventDecoder`` and an
+optional deduplicator (``sources/InboundEventSource.java:35-309``;
+``onEncodedEventReceived:189-199`` → decode → dedupe → forward), and the
+``EventSourcesManager`` forwards decoded events / registrations / failed
+decodes to their Kafka topics (``EventSourcesManager.java:153-189``).
+
+Here the forward targets are callables (wired to journals + batcher by the
+runtime), and receivers are threads owning sockets:
+
+- :class:`TcpReceiver` — raw TCP with pluggable framing (reference:
+  ``socket/SocketInboundEventReceiver.java`` + interaction handlers).
+- :class:`UdpReceiver` — datagram-per-event (the CoAP receiver's transport;
+  full CoAP option parsing is handled by the ``coap`` frontend).
+- :class:`HttpReceiver` — HTTP POST endpoint (reference REST receivers).
+- :class:`MqttReceiver` — broker subscription via the stdlib MQTT client
+  (reference ``mqtt/MqttInboundEventReceiver.java``).
+- :class:`PollingRestReceiver` — periodic HTTP GET poll (reference
+  ``rest/PollingRestInboundEventReceiver.java``).
+
+AMQP brokers (ActiveMQ/RabbitMQ/EventHub in the reference) are gated: no
+client libraries exist in this image; their role (durable broker buffering)
+is covered by the journal, and the receiver SPI accepts new implementations.
+"""
+
+from __future__ import annotations
+
+import http.server
+import logging
+import socket
+import socketserver
+import struct
+import threading
+import urllib.request
+from typing import Callable, List, Optional
+
+logger = logging.getLogger("sitewhere_tpu.ingest")
+
+from sitewhere_tpu.ingest.decoders import DecodedRequest, DecodeError, RequestKind
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+
+Decoder = Callable[[bytes], List[DecodedRequest]]
+Forward = Callable[[DecodedRequest, bytes], None]
+FailedDecode = Callable[[bytes, str, Exception], None]
+
+
+class InboundEventSource(LifecycleComponent):
+    """receivers × decoder × dedup → forward (see module docstring)."""
+
+    def __init__(
+        self,
+        source_id: str,
+        receivers: List["Receiver"],
+        decoder: Decoder,
+        deduplicator=None,
+        on_event: Optional[Forward] = None,
+        on_registration: Optional[Forward] = None,
+        on_failed_decode: Optional[FailedDecode] = None,
+        on_host_request: Optional[Forward] = None,
+    ):
+        super().__init__(name=f"event-source:{source_id}")
+        self.source_id = source_id
+        self.receivers = receivers
+        self.decoder = decoder
+        self.deduplicator = deduplicator
+        self.on_event = on_event
+        self.on_registration = on_registration
+        self.on_failed_decode = on_failed_decode
+        self.on_host_request = on_host_request
+        self.decoded_count = 0
+        self.failed_count = 0
+        self.duplicate_count = 0
+        self.dropped_host_requests = 0
+        for r in receivers:
+            r.sink = self.on_encoded_payload
+            self.add_child(r)
+
+    def on_encoded_payload(self, payload: bytes) -> None:
+        """Receiver callback (reference ``onEncodedEventReceived:189-199``).
+
+        Never lets an exception escape into the transport thread: decode
+        failures dead-letter; forward-target failures are logged and
+        counted (a broken sink must not kill the receiver).
+        """
+        try:
+            requests = self.decoder(payload)
+        except DecodeError as e:
+            self.failed_count += 1
+            if self.on_failed_decode is not None:
+                self.on_failed_decode(payload, self.source_id, e)
+            return
+        for req in requests:
+            if self.deduplicator is not None and self.deduplicator.is_duplicate(req):
+                self.duplicate_count += 1
+                continue
+            self.decoded_count += 1
+            try:
+                if req.kind == RequestKind.REGISTRATION:
+                    if self.on_registration is not None:
+                        self.on_registration(req, payload)
+                elif req.event_type is None:
+                    # Host-plane requests (stream data, mappings): never
+                    # into the tensor batcher.
+                    if self.on_host_request is not None:
+                        self.on_host_request(req, payload)
+                    else:
+                        self.dropped_host_requests += 1
+                elif self.on_event is not None:
+                    self.on_event(req, payload)
+            except Exception:
+                self.failed_count += 1
+                logger.exception(
+                    "forward failed for %s from source %s",
+                    req.kind.name, self.source_id,
+                )
+
+
+class Receiver(LifecycleComponent):
+    """Base receiver: owns a transport, pushes raw payloads to ``sink``."""
+
+    def __init__(self, name: str):
+        super().__init__(name=name)
+        self.sink: Optional[Callable[[bytes], None]] = None
+        self.received_count = 0
+
+    def _emit(self, payload: bytes) -> None:
+        self.received_count += 1
+        if self.sink is not None:
+            self.sink(payload)
+
+
+def length_prefixed_frames(conn: socket.socket, emit: Callable[[bytes], None]) -> None:
+    """Framing: u32-be length + body (the default interaction handler)."""
+    buf = b""
+    while True:
+        data = conn.recv(65536)
+        if not data:
+            return
+        buf += data
+        while len(buf) >= 4:
+            (ln,) = struct.unpack_from(">I", buf, 0)
+            if ln > 16 << 20:
+                raise ValueError(f"frame too large: {ln}")
+            if len(buf) < 4 + ln:
+                break
+            emit(buf[4 : 4 + ln])
+            buf = buf[4 + ln :]
+
+
+def newline_frames(conn: socket.socket, emit: Callable[[bytes], None]) -> None:
+    """Framing: newline-delimited payloads (e.g. JSON lines)."""
+    buf = b""
+    while True:
+        data = conn.recv(65536)
+        if not data:
+            if buf.strip():
+                emit(buf.strip())
+            return
+        buf += data
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if line.strip():
+                emit(line.strip())
+
+
+class TcpReceiver(Receiver):
+    """Threaded TCP server with pluggable framing."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 framing: Callable = length_prefixed_frames):
+        super().__init__(name=f"tcp-receiver:{port}")
+        self.host, self.port = host, port
+        self.framing = framing
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        receiver = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    receiver.framing(self.request, receiver._emit)
+                except (ValueError, OSError):
+                    pass
+
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        self._server = socketserver.ThreadingTCPServer(
+            (self.host, self.port), Handler
+        )
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name=self.name
+        )
+        self._thread.start()
+        super().start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        super().stop()
+
+
+class UdpReceiver(Receiver):
+    """One datagram = one payload."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(name=f"udp-receiver:{port}")
+        self.host, self.port = host, port
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._alive = False
+
+    def start(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]
+        self._alive = True
+
+        def loop():
+            while self._alive:
+                try:
+                    data, _ = self._sock.recvfrom(65536)
+                except OSError:
+                    return
+                if data:
+                    self._emit(data)
+
+        self._thread = threading.Thread(target=loop, daemon=True, name=self.name)
+        self._thread.start()
+        super().start()
+
+    def stop(self) -> None:
+        self._alive = False
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+        super().stop()
+
+
+class HttpReceiver(Receiver):
+    """POST <path> with the payload as body → one event payload."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 path: str = "/events"):
+        super().__init__(name=f"http-receiver:{port}")
+        self.host, self.port, self.path = host, port, path
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        receiver = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                if self.path != receiver.path:
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                receiver._emit(body)
+                self.send_response(202)
+                self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        self._server = http.server.ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name=self.name
+        )
+        self._thread.start()
+        super().start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        super().stop()
+
+
+class MqttReceiver(Receiver):
+    """Subscribe to a broker topic; every message is a payload."""
+
+    def __init__(self, host: str, port: int = 1883, topic: str = "sitewhere/input",
+                 qos: int = 0, client_id: str = "sw-tpu-ingest", **client_kw):
+        super().__init__(name=f"mqtt-receiver:{topic}")
+        from sitewhere_tpu.ingest.mqtt import MqttClient
+
+        self.topic, self.qos = topic, qos
+        self.client = MqttClient(host, port, client_id=client_id, **client_kw)
+
+    def start(self) -> None:
+        self.client.on_message = lambda topic, payload: self._emit(payload)
+        self.client.connect()
+        self.client.subscribe(self.topic, self.qos)
+        super().start()
+
+    def stop(self) -> None:
+        self.client.disconnect()
+        super().stop()
+
+
+class PollingRestReceiver(Receiver):
+    """Poll an HTTP endpoint on an interval; non-empty bodies are payloads.
+
+    Reference: ``rest/PollingRestInboundEventReceiver.java`` (scripted
+    response→payload mapping there; a ``transform`` callable here).
+    """
+
+    def __init__(self, url: str, interval_s: float = 10.0,
+                 transform: Optional[Callable[[bytes], List[bytes]]] = None):
+        super().__init__(name=f"poll-receiver:{url}")
+        self.url = url
+        self.interval_s = interval_s
+        self.transform = transform or (lambda body: [body] if body else [])
+        self._alive = False
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+
+    def start(self) -> None:
+        self._alive = True
+
+        def loop():
+            while self._alive:
+                try:
+                    with urllib.request.urlopen(self.url, timeout=10) as resp:
+                        body = resp.read()
+                    for payload in self.transform(body):
+                        self._emit(payload)
+                except OSError:
+                    pass
+                self._wake.wait(self.interval_s)
+                self._wake.clear()
+
+        self._thread = threading.Thread(target=loop, daemon=True, name=self.name)
+        self._thread.start()
+        super().start()
+
+    def stop(self) -> None:
+        self._alive = False
+        self._wake.set()
+        super().stop()

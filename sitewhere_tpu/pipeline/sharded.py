@@ -23,7 +23,6 @@ stale consumer after a rebalance.
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax

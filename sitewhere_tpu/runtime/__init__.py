@@ -1,0 +1,15 @@
+"""Host runtime kernel: lifecycle, config, metrics, logging.
+
+Replaces the reference's L1/L2 layers (``sitewhere-core-lifecycle`` +
+``sitewhere-microservice``) with a slim host runtime: hierarchical
+lifecycle components, a typed config tree with env overrides (instead of
+ZooKeeper XML), and in-process metrics (instead of Dropwizard+Kafka).
+"""
+
+from sitewhere_tpu.runtime.lifecycle import (  # noqa: F401
+    LifecycleComponent,
+    LifecycleState,
+    LifecycleError,
+)
+from sitewhere_tpu.runtime.metrics import MetricsRegistry  # noqa: F401
+from sitewhere_tpu.runtime.config import Config  # noqa: F401

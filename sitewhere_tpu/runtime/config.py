@@ -1,0 +1,162 @@
+"""Typed configuration tree with env overrides.
+
+Replaces the reference's ZooKeeper-hosted XML configuration system
+(``sitewhere-configuration/.../ConfigurationContentParser.java``, tenant
+XML → Spring contexts in ``MicroserviceTenantEngine.java:169-176``) and the
+env-flag settings (``microservice/instance/InstanceSettings.java:22-78``)
+with one nested dict + dataclass-style accessors:
+
+- load from JSON file(s), overlay per-tenant fragments;
+- ``SW_TPU_<PATH>`` env vars override dotted paths
+  (``SW_TPU_PIPELINE__WIDTH=65536`` → ``pipeline.width``);
+- live reload hook: callers register listeners, ``reload()`` re-reads and
+  notifies (the ConfigurationMonitor/TreeCache analog,
+  ``ConfigurationMonitor.java:70-120``).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+ENV_PREFIX = "SW_TPU_"
+
+
+def _coerce(value: str) -> Any:
+    low = value.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(value)
+    except ValueError:
+        pass
+    try:
+        return float(value)
+    except ValueError:
+        pass
+    if value.startswith(("[", "{")):
+        try:
+            return json.loads(value)
+        except ValueError:
+            pass
+    return value
+
+
+DEFAULTS: Dict[str, Any] = {
+    "instance": {"id": "sitewhere-tpu", "data_dir": "./data"},
+    "pipeline": {
+        "width": 65536,
+        "registry_capacity": 1 << 20,
+        "mtype_slots": 8,
+        "deadline_ms": 5.0,
+        "n_shards": 1,
+    },
+    "journal": {"fsync_every": 256, "segment_bytes": 64 << 20},
+    "presence": {"scan_interval_s": 600.0, "missing_after_s": 8 * 3600.0},
+    "api": {"host": "127.0.0.1", "port": 8080, "jwt_ttl_s": 3600},
+    "metrics": {"report_interval_s": 20.0},
+}
+
+
+class Config:
+    """Nested config with dotted-path access and env overrides."""
+
+    def __init__(self, tree: Optional[Dict[str, Any]] = None,
+                 apply_env: bool = True):
+        self._tree = copy.deepcopy(DEFAULTS)
+        if tree:
+            _deep_merge(self._tree, tree)
+        if apply_env:
+            self._apply_env()
+        self._listeners: List[Callable[["Config"], None]] = []
+        self._lock = threading.Lock()
+        self._sources: List[str] = []
+
+    @classmethod
+    def load(cls, *paths: str, apply_env: bool = True) -> "Config":
+        tree: Dict[str, Any] = {}
+        for path in paths:
+            with open(path) as f:
+                _deep_merge(tree, json.load(f))
+        cfg = cls(tree, apply_env=apply_env)
+        cfg._sources = list(paths)
+        return cfg
+
+    def _apply_env(self) -> None:
+        for key, value in os.environ.items():
+            if not key.startswith(ENV_PREFIX):
+                continue
+            path = key[len(ENV_PREFIX):].lower().split("__")
+            node = self._tree
+            for part in path[:-1]:
+                node = node.setdefault(part, {})
+            node[path[-1]] = _coerce(value)
+
+    # -- access -------------------------------------------------------------
+
+    def get(self, dotted: str, default: Any = None) -> Any:
+        node: Any = self._tree
+        for part in dotted.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return default
+            node = node[part]
+        return node
+
+    def __getitem__(self, dotted: str) -> Any:
+        value = self.get(dotted, _MISSING)
+        if value is _MISSING:
+            raise KeyError(dotted)
+        return value
+
+    def section(self, dotted: str) -> Dict[str, Any]:
+        value = self.get(dotted, {})
+        if not isinstance(value, dict):
+            raise TypeError(f"{dotted} is not a section")
+        return copy.deepcopy(value)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return copy.deepcopy(self._tree)
+
+    # -- tenant overlays (per-tenant engine config analog) -------------------
+
+    def for_tenant(self, overrides: Dict[str, Any]) -> "Config":
+        merged = self.as_dict()
+        _deep_merge(merged, overrides)
+        return Config(merged, apply_env=False)
+
+    # -- live reload ---------------------------------------------------------
+
+    def on_change(self, listener: Callable[["Config"], None]) -> None:
+        self._listeners.append(listener)
+
+    def reload(self) -> None:
+        """Re-read source files + env; notify listeners (dynamic restart
+        analog, ``MultitenantMicroservice.java:342``)."""
+        with self._lock:
+            tree: Dict[str, Any] = {}
+            for path in self._sources:
+                with open(path) as f:
+                    _deep_merge(tree, json.load(f))
+            self._tree = copy.deepcopy(DEFAULTS)
+            _deep_merge(self._tree, tree)
+            self._apply_env()
+        for listener in self._listeners:
+            listener(self)
+
+
+class _Missing:
+    pass
+
+
+_MISSING = _Missing()
+
+
+def _deep_merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+    for key, value in src.items():
+        if isinstance(value, dict) and isinstance(dst.get(key), dict):
+            _deep_merge(dst[key], value)
+        else:
+            dst[key] = copy.deepcopy(value)

@@ -1,0 +1,123 @@
+"""In-process metrics: counters, gauges, timers with percentiles.
+
+Reference: Dropwizard ``MetricRegistry`` per microservice with meters and
+timers on the hot path (``Microservice.java:147``,
+``InboundPayloadProcessingLogic.java:90-97``) reported on an interval
+(``Microservice.java:264-272``).  Here a lock-light registry the REST
+surface and log reporter read; pipeline-step counters (device-side psums)
+are folded in by the dispatcher.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+
+class Timer:
+    """Reservoir timer with p50/p95/p99 (bounded sorted reservoir)."""
+
+    def __init__(self, reservoir: int = 4096):
+        self.reservoir = reservoir
+        self._samples: List[float] = []
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            bisect.insort(self._samples, seconds)
+            if len(self._samples) > self.reservoir:
+                # drop alternating extremes to keep the distribution shape
+                del self._samples[0 if self.count % 2 else -1]
+
+    def time(self):
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                timer.observe(time.perf_counter() - self.t0)
+                return False
+
+        return _Ctx()
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            idx = min(len(self._samples) - 1, int(q * len(self._samples)))
+            return self._samples[idx]
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class MetricsRegistry:
+    """Named metrics, hierarchical dotted keys."""
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._timers: Dict[str, Timer] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            return self._timers.setdefault(name, Timer())
+
+    def snapshot(self) -> dict:
+        """Serializable view for the REST/admin surface."""
+        with self._lock:
+            return {
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+                "timers": {
+                    k: {
+                        "count": t.count,
+                        "mean_ms": t.mean * 1e3,
+                        "p50_ms": t.percentile(0.50) * 1e3,
+                        "p95_ms": t.percentile(0.95) * 1e3,
+                        "p99_ms": t.percentile(0.99) * 1e3,
+                    }
+                    for k, t in self._timers.items()
+                },
+            }

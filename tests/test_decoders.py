@@ -1,0 +1,206 @@
+"""Decoder + dedup tests. Payload shapes mirror the reference's MQTT
+conformance senders (MqttTests.java) as JSON fixtures."""
+
+import json
+
+import pytest
+
+from sitewhere_tpu.ingest.decoders import (
+    BinaryDecoder,
+    CompositeDecoder,
+    DecodeError,
+    DecodedRequest,
+    JsonBatchDecoder,
+    JsonDecoder,
+    RequestKind,
+)
+from sitewhere_tpu.ingest.dedup import AlternateIdDeduplicator
+
+
+def test_json_measurement():
+    payload = json.dumps({
+        "deviceToken": "dev-1",
+        "type": "Measurement",
+        "request": {"name": "engine.temp", "value": 98.6,
+                    "eventDate": 1753800000.25,
+                    "metadata": {"src": "test"}},
+    }).encode()
+    (req,) = JsonDecoder()(payload)
+    assert req.kind == RequestKind.MEASUREMENT
+    assert req.device_token == "dev-1"
+    assert req.mtype == "engine.temp"
+    assert req.value == 98.6
+    assert req.ts_s == 1753800000
+    assert req.ts_ns == 250_000_000
+    assert req.metadata == {"src": "test"}
+
+
+def test_json_hardware_id_alias_and_iso_date():
+    payload = json.dumps({
+        "hardwareId": "dev-2",
+        "type": "DeviceLocation",
+        "request": {"latitude": 33.75, "longitude": -84.39, "elevation": 10.0,
+                    "eventDate": "2026-07-29T12:00:00Z"},
+    }).encode()
+    (req,) = JsonDecoder()(payload)
+    assert req.kind == RequestKind.LOCATION
+    assert req.device_token == "dev-2"
+    assert (req.lat, req.lon, req.elevation) == (33.75, -84.39, 10.0)
+    assert req.ts_s > 1_700_000_000
+
+
+def test_json_alert_and_registration():
+    (alert,) = JsonDecoder()(json.dumps({
+        "deviceToken": "d", "type": "Alert",
+        "request": {"type": "engine.overheat", "level": "Critical",
+                    "message": "too hot"},
+    }).encode())
+    assert alert.kind == RequestKind.ALERT
+    assert alert.alert_type == "engine.overheat"
+    assert alert.alert_level == 3
+    assert alert.alert_message == "too hot"
+
+    (reg,) = JsonDecoder()(json.dumps({
+        "deviceToken": "d", "type": "RegisterDevice",
+        "request": {"deviceTypeToken": "raspberry-pi", "areaToken": "plant-1"},
+    }).encode())
+    assert reg.kind == RequestKind.REGISTRATION
+    assert reg.device_type_token == "raspberry-pi"
+    assert reg.area_token == "plant-1"
+    assert reg.event_type is None  # host-plane request
+
+
+def test_json_command_response():
+    (req,) = JsonDecoder()(json.dumps({
+        "deviceToken": "d", "type": "Acknowledge",
+        "request": {"originatingEventId": "evt-123", "response": "done"},
+    }).encode())
+    assert req.kind == RequestKind.COMMAND_RESPONSE
+    assert req.originating_event == "evt-123"
+
+
+@pytest.mark.parametrize("bad", [
+    b"not json at all",
+    b'{"type": "Measurement", "request": {}}',          # no token
+    b'{"deviceToken": "d", "request": {}}',             # no type
+    b'{"deviceToken": "d", "type": "Bogus", "request": {}}',
+    b'{"deviceToken": "d", "type": "Measurement", "request": {"name": "t"}}',
+    b'{"deviceToken": "d", "type": "Alert", "request": {"level": "loud"}}',
+    b'[1,2,3]',
+])
+def test_json_decode_errors(bad):
+    with pytest.raises(DecodeError):
+        JsonDecoder()(bad)
+
+
+def test_json_batch():
+    payload = json.dumps({
+        "deviceToken": "dev-9",
+        "events": [
+            {"type": "Measurement", "name": "t", "value": 1.0},
+            {"type": "DeviceLocation", "latitude": 1.0, "longitude": 2.0},
+            {"type": "Alert", "level": "warning"},
+        ],
+    }).encode()
+    reqs = JsonBatchDecoder()(payload)
+    assert [r.kind for r in reqs] == [
+        RequestKind.MEASUREMENT, RequestKind.LOCATION, RequestKind.ALERT,
+    ]
+    assert all(r.device_token == "dev-9" for r in reqs)
+
+
+def test_binary_roundtrip():
+    for req in [
+        DecodedRequest(kind=RequestKind.MEASUREMENT, device_token="bin-dev",
+                       ts_s=1000, ts_ns=500_000_000, mtype="temp", value=3.25),
+        DecodedRequest(kind=RequestKind.LOCATION, device_token="bin-dev",
+                       ts_s=1000, lat=1.5, lon=-2.5, elevation=7.0),
+        DecodedRequest(kind=RequestKind.ALERT, device_token="bin-dev",
+                       ts_s=1000, alert_type="x", alert_level=2),
+        DecodedRequest(kind=RequestKind.REGISTRATION, device_token="bin-dev",
+                       ts_s=1000, device_type_token="pi"),
+    ]:
+        (out,) = BinaryDecoder()(BinaryDecoder.encode(req))
+        assert out.kind == req.kind
+        assert out.device_token == req.device_token
+        assert out.ts_s == req.ts_s
+        if req.kind == RequestKind.MEASUREMENT:
+            assert (out.mtype, out.value) == (req.mtype, req.value)
+        if req.kind == RequestKind.LOCATION:
+            assert (out.lat, out.lon, out.elevation) == (req.lat, req.lon, req.elevation)
+        if req.kind == RequestKind.ALERT:
+            assert (out.alert_type, out.alert_level) == (req.alert_type, req.alert_level)
+        if req.kind == RequestKind.REGISTRATION:
+            assert out.device_type_token == req.device_type_token
+
+
+def test_binary_bad_payloads():
+    with pytest.raises(DecodeError):
+        BinaryDecoder()(b"XX\x00\x00")
+    with pytest.raises(DecodeError):
+        BinaryDecoder()(b"SW\x00")
+
+
+def test_composite_decoder():
+    # First byte selects the device-type key; body follows.
+    def extractor(payload):
+        return ("json" if payload[0:1] == b"{" else "bin"), payload
+
+    comp = CompositeDecoder(extractor, {"json": JsonDecoder(), "bin": BinaryDecoder()})
+    (r1,) = comp(json.dumps({"deviceToken": "d", "type": "Measurement",
+                             "request": {"name": "t", "value": 1}}).encode())
+    assert r1.kind == RequestKind.MEASUREMENT
+    (r2,) = comp(BinaryDecoder.encode(DecodedRequest(
+        kind=RequestKind.LOCATION, device_token="d", ts_s=5, lat=1, lon=2)))
+    assert r2.kind == RequestKind.LOCATION
+
+    def bad_extractor(payload):
+        return "nope", payload
+
+    with pytest.raises(DecodeError):
+        CompositeDecoder(bad_extractor, {})(b"zz")
+
+
+def test_alternate_id_dedup():
+    d = AlternateIdDeduplicator(window=100)
+    r1 = DecodedRequest(kind=RequestKind.MEASUREMENT, device_token="a",
+                        ts_s=1, alternate_id="msg-1")
+    r2 = DecodedRequest(kind=RequestKind.MEASUREMENT, device_token="a",
+                        ts_s=2, alternate_id="msg-1")
+    r3 = DecodedRequest(kind=RequestKind.MEASUREMENT, device_token="b",
+                        ts_s=2, alternate_id="msg-1")  # different device
+    r4 = DecodedRequest(kind=RequestKind.MEASUREMENT, device_token="a", ts_s=3)
+    assert not d.is_duplicate(r1)
+    assert d.is_duplicate(r2)
+    assert not d.is_duplicate(r3)
+    assert not d.is_duplicate(r4)  # no alternate id -> never deduped
+    assert d.duplicates == 1
+
+
+def test_dedup_window_eviction():
+    d = AlternateIdDeduplicator(window=2)
+    mk = lambda i: DecodedRequest(kind=RequestKind.MEASUREMENT,
+                                  device_token="a", ts_s=i,
+                                  alternate_id=f"m{i}")
+    assert not d.is_duplicate(mk(1))
+    assert not d.is_duplicate(mk(2))
+    assert not d.is_duplicate(mk(3))  # evicts m1
+    assert not d.is_duplicate(mk(1))  # m1 forgotten (bounded window)
+
+
+def test_bad_field_values_become_decode_errors():
+    # float("abc") must surface as DecodeError, not ValueError (which would
+    # kill a receiver thread).
+    for req in (
+        {"name": "x", "value": "abc"},
+        {"name": "x", "value": None},
+    ):
+        with pytest.raises(DecodeError):
+            JsonDecoder()(json.dumps({
+                "deviceToken": "t", "type": "Measurement", "request": req,
+            }).encode())
+    with pytest.raises(DecodeError):
+        JsonDecoder()(json.dumps({
+            "deviceToken": "t", "type": "DeviceLocation",
+            "request": {"latitude": "north", "longitude": 0},
+        }).encode())

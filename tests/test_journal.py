@@ -1,0 +1,135 @@
+"""Durable journal: append/scan/commit/replay/crash-recovery tests."""
+
+import os
+
+import pytest
+
+from sitewhere_tpu.ingest.journal import CorruptJournal, Journal, JournalReader
+
+
+def test_append_scan_roundtrip(tmp_path):
+    j = Journal(str(tmp_path), fsync_every=0)
+    offs = [j.append(f"rec{i}".encode()) for i in range(10)]
+    assert offs == list(range(10))
+    got = list(j.scan(0))
+    assert [(o, p.decode()) for o, p in got] == [(i, f"rec{i}") for i in range(10)]
+    assert list(j.scan(4, 7)) == [(i, f"rec{i}".encode()) for i in range(4, 7)]
+    assert j.read_one(3) == b"rec3"
+    j.close()
+
+
+def test_reopen_resumes_offsets(tmp_path):
+    j = Journal(str(tmp_path))
+    for i in range(5):
+        j.append(f"a{i}".encode())
+    j.close()
+    j2 = Journal(str(tmp_path))
+    assert j2.end_offset == 5
+    assert j2.append(b"next") == 5
+    assert j2.read_one(5) == b"next"
+    j2.close()
+
+
+def test_segment_rotation(tmp_path):
+    j = Journal(str(tmp_path), segment_bytes=64, fsync_every=0)
+    for i in range(20):
+        j.append(f"payload-{i:04d}".encode())
+    files = [f for f in os.listdir(j.dir) if f.endswith(".log")]
+    assert len(files) > 1
+    # All records still readable across segments, in order.
+    got = [p.decode() for _, p in j.scan(0)]
+    assert got == [f"payload-{i:04d}" for i in range(20)]
+    # Partial scan starting mid-segment-chain.
+    got = [o for o, _ in j.scan(15)]
+    assert got == [15, 16, 17, 18, 19]
+    j.close()
+
+
+def test_torn_tail_truncated_on_reopen(tmp_path):
+    j = Journal(str(tmp_path), fsync_every=0)
+    for i in range(3):
+        j.append(f"ok{i}".encode())
+    j.close()
+    # Simulate crash mid-append: garbage half-record at the tail.
+    seg = os.path.join(j.dir, sorted(os.listdir(j.dir))[0])
+    with open(seg, "ab") as f:
+        f.write(b"\x55\x00\x00\x00GARBAGE")  # claims 85 bytes, has 7
+    j2 = Journal(str(tmp_path))
+    assert j2.end_offset == 3  # torn record dropped
+    assert j2.append(b"after-crash") == 3
+    assert [p for _, p in j2.scan(0)] == [b"ok0", b"ok1", b"ok2", b"after-crash"]
+    j2.close()
+
+
+def test_corrupt_middle_raises(tmp_path):
+    j = Journal(str(tmp_path), fsync_every=0)
+    for i in range(3):
+        j.append(b"x" * 32)
+    j.close()
+    seg = os.path.join(j.dir, sorted(os.listdir(j.dir))[0])
+    # Flip a payload byte of record 1 (not the tail).
+    with open(seg, "r+b") as f:
+        f.seek(8 + 32 + 8 + 5)
+        f.write(b"\xff")
+    with pytest.raises(CorruptJournal):
+        Journal(str(tmp_path))
+
+
+def test_reader_commit_and_replay(tmp_path):
+    j = Journal(str(tmp_path), fsync_every=0)
+    for i in range(10):
+        j.append_json({"i": i})
+    r = JournalReader(j, "pipeline")
+    batch1 = r.poll(4)
+    assert [o for o, _ in batch1] == [0, 1, 2, 3]
+    r.commit()
+    batch2 = r.poll(4)
+    assert [o for o, _ in batch2] == [4, 5, 6, 7]
+    # Crash before commit: a fresh reader resumes at the committed offset.
+    r2 = JournalReader(j, "pipeline")
+    assert r2.position == 4
+    assert [o for o, _ in r2.poll(100)] == [4, 5, 6, 7, 8, 9]
+    assert r2.lag == 0
+    # Independent group starts at 0 (consumer-group isolation).
+    other = JournalReader(j, "connector-a")
+    assert other.position == 0
+    j.close()
+
+
+def test_reader_seek_reprocess(tmp_path):
+    j = Journal(str(tmp_path), fsync_every=0)
+    for i in range(5):
+        j.append(bytes([i]))
+    r = JournalReader(j, "g")
+    r.poll(5)
+    r.commit()
+    r.seek(2)  # reprocess-topic analog
+    assert [o for o, _ in r.poll(10)] == [2, 3, 4]
+    j.close()
+
+
+def test_torn_partial_header_truncated(tmp_path):
+    j = Journal(str(tmp_path), fsync_every=0)
+    j.append(b"good")
+    j.close()
+    seg = os.path.join(j.dir, sorted(os.listdir(j.dir))[0])
+    with open(seg, "ab") as f:
+        f.write(b"\x01\x02\x03")  # crash mid-header: 3 stray bytes
+    j2 = Journal(str(tmp_path), fsync_every=0)
+    assert j2.end_offset == 1
+    j2.append(b"after")
+    # the record appended after recovery must be readable
+    assert [p for _, p in j2.scan(0)] == [b"good", b"after"]
+    j2.close()
+
+
+def test_sparse_index_scan_correct(tmp_path):
+    j = Journal(str(tmp_path), fsync_every=0)
+    for i in range(300):  # crosses several index points (every 64)
+        j.append(f"r{i}".encode())
+    assert [p.decode() for _, p in j.scan(200, 205)] == [
+        f"r{i}" for i in range(200, 205)]
+    j.close()
+    j2 = Journal(str(tmp_path), fsync_every=0)  # index rebuilt on reopen
+    assert [p.decode() for _, p in j2.scan(290, 292)] == ["r290", "r291"]
+    j2.close()

@@ -1,0 +1,112 @@
+"""Runtime kernel tests: lifecycle, config, metrics."""
+
+import json
+
+import pytest
+
+from sitewhere_tpu.runtime.config import Config
+from sitewhere_tpu.runtime.lifecycle import (
+    LifecycleComponent,
+    LifecycleError,
+    LifecycleState,
+)
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+
+class Probe(LifecycleComponent):
+    def __init__(self, name, fail_on=None):
+        super().__init__(name=name)
+        self.calls = []
+        self.fail_on = fail_on
+
+    def start(self):
+        self.calls.append("start")
+        if self.fail_on == "start":
+            raise RuntimeError("boom")
+        super().start()
+
+    def stop(self):
+        self.calls.append("stop")
+        if self.fail_on == "stop":
+            raise RuntimeError("boom")
+        super().stop()
+
+
+def test_lifecycle_order_and_reverse_stop():
+    root = LifecycleComponent("root")
+    a, b = Probe("a"), Probe("b")
+    root.add_child(a)
+    root.add_child(b)
+    root.start()
+    assert root.state == LifecycleState.STARTED
+    assert a.state == b.state == LifecycleState.STARTED
+    root.stop()
+    # children stopped in reverse order
+    assert b.calls.index("stop") <= a.calls.index("stop")
+    assert root.state == LifecycleState.STOPPED
+
+
+def test_lifecycle_child_failure_marks_error():
+    root = LifecycleComponent("root")
+    root.add_child(Probe("ok"))
+    root.add_child(Probe("bad", fail_on="start"))
+    with pytest.raises(RuntimeError):
+        root.start()
+    assert root.state == LifecycleState.ERROR
+
+
+def test_lifecycle_stop_failure_still_stops_others():
+    root = LifecycleComponent("root")
+    a = Probe("a")
+    bad = Probe("bad", fail_on="stop")
+    root.add_child(a)
+    root.add_child(bad)
+    root.start()
+    with pytest.raises(LifecycleError):
+        root.stop()
+    assert "stop" in a.calls  # earlier sibling still stopped
+
+
+def test_config_defaults_env_and_tenant(monkeypatch, tmp_path):
+    monkeypatch.setenv("SW_TPU_PIPELINE__WIDTH", "1024")
+    monkeypatch.setenv("SW_TPU_API__HOST", "0.0.0.0")
+    cfg = Config()
+    assert cfg["pipeline.width"] == 1024     # env override, coerced to int
+    assert cfg["api.host"] == "0.0.0.0"
+    assert cfg["journal.fsync_every"] == 256  # default intact
+
+    tenant = cfg.for_tenant({"pipeline": {"deadline_ms": 2.0}})
+    assert tenant["pipeline.deadline_ms"] == 2.0
+    assert tenant["pipeline.width"] == 1024   # inherits
+
+    with pytest.raises(KeyError):
+        cfg["nope.nope"]
+
+
+def test_config_file_load_and_reload(tmp_path, monkeypatch):
+    path = tmp_path / "cfg.json"
+    path.write_text(json.dumps({"pipeline": {"width": 512}}))
+    cfg = Config.load(str(path), apply_env=False)
+    assert cfg["pipeline.width"] == 512
+
+    seen = []
+    cfg.on_change(lambda c: seen.append(c["pipeline.width"]))
+    path.write_text(json.dumps({"pipeline": {"width": 2048}}))
+    cfg.reload()
+    assert seen == [2048]
+    assert cfg["pipeline.width"] == 2048
+
+
+def test_metrics_registry():
+    m = MetricsRegistry()
+    m.counter("events.processed").inc(5)
+    m.counter("events.processed").inc(2)
+    m.gauge("journal.lag").set(17)
+    t = m.timer("step.latency")
+    for v in (0.001, 0.002, 0.003, 0.100):
+        t.observe(v)
+    snap = m.snapshot()
+    assert snap["counters"]["events.processed"] == 7
+    assert snap["gauges"]["journal.lag"] == 17
+    assert snap["timers"]["step.latency"]["count"] == 4
+    assert snap["timers"]["step.latency"]["p99_ms"] >= 2.9
