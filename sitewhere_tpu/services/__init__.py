@@ -1,0 +1,29 @@
+"""Host-side management services — the L6 domain services of the reference.
+
+Each module mirrors one ``service-*-management`` microservice of the
+reference (SURVEY.md §2.3), re-shaped for the TPU design: the services own
+authoritative records (strings, metadata, hierarchy) on the host and
+publish dense tensor epochs (``Registry``, ``ZoneTable``…) that the SPMD
+pipeline gathers against.  There is no gRPC fabric between them — they are
+in-process components addressed directly; the network surface is the REST
+gateway (:mod:`sitewhere_tpu.web`).
+"""
+
+from sitewhere_tpu.services.common import (
+    DuplicateToken,
+    EntityNotFound,
+    InvalidReference,
+    SearchCriteria,
+    SearchResults,
+)
+from sitewhere_tpu.services.device_management import DeviceManagement, RegistryMirror
+
+__all__ = [
+    "DuplicateToken",
+    "EntityNotFound",
+    "InvalidReference",
+    "SearchCriteria",
+    "SearchResults",
+    "DeviceManagement",
+    "RegistryMirror",
+]

@@ -1,0 +1,131 @@
+"""Shared service plumbing: errors, paging, entity base.
+
+Reference analogs: ``SiteWhereException``/``SiteWhereSystemException`` error
+codes (``sitewhere-core-api``), ``ISearchResults``/``ISearchCriteria`` paging
+(used by every list API, e.g. ``IDeviceManagement.listDevices``), and the
+create/update field validation of ``sitewhere-core/.../persistence/
+Persistence.java``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Callable, Dict, Generic, Iterable, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ServiceError(Exception):
+    """Base for service-level failures (maps to HTTP codes at the gateway)."""
+
+    http_status = 500
+
+
+class EntityNotFound(ServiceError):
+    http_status = 404
+
+
+class DuplicateToken(ServiceError):
+    http_status = 409
+
+
+class InvalidReference(ServiceError):
+    """A referenced entity (type, area, customer…) does not exist."""
+
+    http_status = 400
+
+
+class ValidationError(ServiceError):
+    http_status = 400
+
+
+class AuthError(ServiceError):
+    http_status = 401
+
+
+class ForbiddenError(ServiceError):
+    http_status = 403
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchCriteria:
+    """Page + optional time-range criteria.
+
+    Reference: ``ISearchCriteria`` (1-based page index) and
+    ``IDateRangeSearchCriteria`` used across every list API.
+    """
+
+    page: int = 1
+    page_size: int = 100
+    start_s: Optional[int] = None  # inclusive unix-seconds lower bound
+    end_s: Optional[int] = None    # inclusive upper bound
+
+    def slice(self, items: List[T]) -> List[T]:
+        if self.page_size <= 0:
+            return list(items)
+        lo = (max(self.page, 1) - 1) * self.page_size
+        return items[lo : lo + self.page_size]
+
+
+@dataclasses.dataclass
+class SearchResults(Generic[T]):
+    """A page of results + the total match count (reference ``ISearchResults``)."""
+
+    results: List[T]
+    total: int
+
+    def __iter__(self):
+        return iter(self.results)
+
+    def __len__(self):
+        return len(self.results)
+
+
+def paged(matches: List[T], criteria: Optional[SearchCriteria]) -> SearchResults[T]:
+    criteria = criteria or SearchCriteria()
+    return SearchResults(results=criteria.slice(matches), total=len(matches))
+
+
+def now_s() -> int:
+    return int(time.time())
+
+
+_uuid_counter = itertools.count()
+_uuid_lock = threading.Lock()
+
+
+def mint_token(prefix: str) -> str:
+    """Generate a unique token for entities created without one.
+
+    Reference: entity tokens default to UUIDs
+    (``Persistence.java`` create helpers).  Uses a counter + time so tokens
+    are unique and stable within a process without consuming entropy.
+    """
+    with _uuid_lock:
+        n = next(_uuid_counter)
+    return f"{prefix}-{int(time.time() * 1000):x}-{n:x}"
+
+
+@dataclasses.dataclass
+class Entity:
+    """Base fields shared by every persisted entity.
+
+    Reference: ``IPersistentEntity`` — token, created/updated audit stamps,
+    free-form metadata map.
+    """
+
+    token: str
+    created_s: int = dataclasses.field(default_factory=now_s)
+    updated_s: int = dataclasses.field(default_factory=now_s)
+    metadata: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def touch(self) -> None:
+        self.updated_s = now_s()
+
+
+def require(condition: bool, error: ServiceError) -> None:
+    if not condition:
+        raise error
