@@ -73,18 +73,21 @@ class HandleSpace:
             hid = self._token_to_id.get(token, NULL_ID)
             if hid != NULL_ID:
                 return hid
-            if self._free:
-                hid = self._free.pop()
-                self._id_to_token[hid] = token
-            else:
-                hid = len(self._id_to_token)
-                if hid >= self.capacity:
-                    raise RuntimeError(
-                        f"HandleSpace '{self.name}' exhausted at {self.capacity}"
-                    )
-                self._id_to_token.append(token)
-            self._token_to_id[token] = hid
-            return hid
+            return self._mint_locked(token)
+
+    def _mint_locked(self, token: str) -> int:
+        if self._free:
+            hid = self._free.pop()
+            self._id_to_token[hid] = token
+        else:
+            hid = len(self._id_to_token)
+            if hid >= self.capacity:
+                raise RuntimeError(
+                    f"HandleSpace '{self.name}' exhausted at {self.capacity}"
+                )
+            self._id_to_token.append(token)
+        self._token_to_id[token] = hid
+        return hid
 
     def free(self, token: str) -> None:
         """Release a handle for reuse (e.g. device deleted)."""

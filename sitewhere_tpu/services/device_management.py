@@ -210,6 +210,10 @@ class RegistryMirror:
         self.max_zones = max_zones
         self.max_verts = max_verts
         self._lock = threading.Lock()
+        # Serializes device creation across all tenants' service instances
+        # (see DeviceManagement.create_device).  Distinct from _lock, which
+        # only guards row writes and is taken inside it.
+        self.creation_lock = threading.Lock()
         self.epoch = 0
         self._dirty = True
         self._zones_dirty = True
@@ -382,6 +386,15 @@ _ASSIGN_STATUS = {
 }
 
 
+
+def _check_fields(entity, fields) -> None:
+    """Reject unknown field names BEFORE any mutation, so a bad update
+    cannot leave an entity half-modified."""
+    for k in fields:
+        if not hasattr(entity, k):
+            raise ValidationError(f"unknown {type(entity).__name__} field {k}")
+
+
 class DeviceManagement:
     """Per-tenant device model service over a shared mirror + identity map.
 
@@ -448,9 +461,8 @@ class DeviceManagement:
     def update_device_type(self, token: str, **fields) -> DeviceType:
         with self._lock:
             dt = self.get_device_type(token)
+            _check_fields(dt, fields)
             for k, v in fields.items():
-                if not hasattr(dt, k):
-                    raise ValidationError(f"unknown device type field {k}")
                 setattr(dt, k, v)
             dt.touch()
             self._notify("deviceType.updated", dt)
@@ -524,15 +536,6 @@ class DeviceManagement:
     def create_device(self, token: Optional[str] = None, **fields) -> Device:
         with self._lock:
             token = token or mint_token("dev")
-            # Device tokens are GLOBAL (the ingest edge resolves raw tokens
-            # with no tenant context, like Kafka keying on the raw token), so
-            # uniqueness is checked against the shared handle space — a
-            # second tenant reusing a token must not hijack the first's
-            # registry row.
-            require(
-                self.identity.device.lookup(token) == NULL_ID,
-                DuplicateToken(f"device {token}"),
-            )
             dev = Device(token=token, **fields)
             require(
                 dev.device_type in self.device_types,
@@ -543,10 +546,25 @@ class DeviceManagement:
                     dev.parent_device in self.devices,
                     InvalidReference(f"parent device {dev.parent_device}"),
                 )
-            # Mint + mirror-write before committing to the store so a
-            # capacity failure can't leave a device without a registry row.
-            device_id = self.identity.device.mint(token)
-            try:
+            # Device tokens are GLOBAL (the ingest edge resolves raw tokens
+            # with no tenant context, like Kafka keying on the raw token).
+            # All device creations — across every tenant's service instance —
+            # serialize on the mirror's creation lock so the uniqueness
+            # check, the mint and the liveness write are one atomic step
+            # (two tenants racing on one token cannot both claim the
+            # handle).  A handle whose mirror row is inactive is a tombstone
+            # of a deleted device: recreating that token reuses the handle
+            # (same token == same device; tenant-scoped queries keep the old
+            # tenant's history invisible to the new owner).
+            with self.mirror.creation_lock:
+                existing = self.identity.device.lookup(token)
+                require(
+                    existing == NULL_ID or not self.mirror.active[existing],
+                    DuplicateToken(f"device {token}"),
+                )
+                device_id = self.identity.device.mint(token)
+                # Mirror-write before committing to the store so a capacity
+                # failure can't leave a device without a registry row.
                 self.mirror.set_device_row(
                     device_id,
                     active=True,
@@ -555,9 +573,6 @@ class DeviceManagement:
                         self._scoped(dev.device_type)
                     ),
                 )
-            except ValidationError:
-                self.identity.device.free(token)
-                raise
             self.devices[token] = dev
             self._notify("device.created", dev)
             return dev
@@ -575,14 +590,13 @@ class DeviceManagement:
     def update_device(self, token: str, **fields) -> Device:
         with self._lock:
             dev = self.get_device(token)
+            _check_fields(dev, fields)
             if "device_type" in fields:
                 require(
                     fields["device_type"] in self.device_types,
                     InvalidReference(f"device type {fields['device_type']}"),
                 )
             for k, v in fields.items():
-                if not hasattr(dev, k):
-                    raise ValidationError(f"unknown device field {k}")
                 setattr(dev, k, v)
             dev.touch()
             device_id = self.identity.device.lookup(token)
@@ -625,8 +639,11 @@ class DeviceManagement:
             del self.devices[token]
             device_id = self.identity.device.lookup(token)
             if device_id != NULL_ID:
+                # Tombstone, don't free: the event store holds immutable rows
+                # keyed by this handle, so recycling it onto an unrelated
+                # token would graft the old device's history onto the new
+                # one.  The handle stays bound to this token forever.
                 self.mirror.clear_device_row(device_id)
-                self.identity.device.free(token)
             self._notify("device.deleted", dev)
             return dev
 
@@ -689,11 +706,13 @@ class DeviceManagement:
                 )
             if fields.get("area") is not None:
                 require(fields["area"] in self.areas, InvalidReference(f"area {fields['area']}"))
+            _check_fields(a, fields)
+            require(
+                fields.get("status", a.status) in _ASSIGN_STATUS,
+                ValidationError(f"bad status {fields.get('status')}"),
+            )
             for k, v in fields.items():
-                if not hasattr(a, k):
-                    raise ValidationError(f"unknown assignment field {k}")
                 setattr(a, k, v)
-            require(a.status in _ASSIGN_STATUS, ValidationError(f"bad status {a.status}"))
             a.touch()
             self._sync_device_row(a.device)
             self._notify("assignment.updated", a)
@@ -832,9 +851,8 @@ class DeviceManagement:
     def update_area(self, token: str, **fields) -> Area:
         with self._lock:
             area = self.get_area(token)
+            _check_fields(area, fields)
             for k, v in fields.items():
-                if not hasattr(area, k):
-                    raise ValidationError(f"unknown area field {k}")
                 setattr(area, k, v)
             area.touch()
             return area
@@ -973,13 +991,12 @@ class DeviceManagement:
     def update_zone(self, token: str, **fields) -> Zone:
         with self._lock:
             z = self.get_zone(token)
+            _check_fields(z, fields)
             if "bounds" in fields:
                 self._validate_zone_bounds(fields["bounds"])
             if "area" in fields:
                 require(fields["area"] in self.areas, InvalidReference(f"area {fields['area']}"))
             for k, v in fields.items():
-                if not hasattr(z, k):
-                    raise ValidationError(f"unknown zone field {k}")
                 setattr(z, k, v)
             z.touch()
             self._sync_zone_row(self.identity.zone.lookup(self._scoped(token)), z)

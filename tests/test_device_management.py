@@ -276,6 +276,33 @@ def test_bad_zone_update_leaves_store_consistent(dm):
     assert zid == NULL_ID
 
 
+def test_rejected_update_leaves_entity_untouched(dm):
+    dm.create_device(token="d-1", device_type="thermo")
+    dm.create_device_assignment(token="a-1", device="d-1")
+    with pytest.raises(ValidationError):
+        dm.update_device_assignment("a-1", status="Bogus")
+    a = dm.get_device_assignment("a-1")
+    assert a.status == "Active"  # rejected update did not half-apply
+    did = dm.identity.device.lookup("d-1")
+    assert dm.mirror.assignment_status[did] == AssignmentStatus.ACTIVE
+    with pytest.raises(ValidationError):
+        dm.update_device("d-1", comments="x", not_a_field=1)
+    assert dm.get_device("d-1").comments == ""
+
+
+def test_deleted_device_token_reuse_keeps_handle(dm):
+    dm.create_device(token="d-1", device_type="thermo")
+    did = dm.identity.device.lookup("d-1")
+    dm.delete_device("d-1")
+    # Handle is tombstoned, not freed: a new unrelated device gets a fresh
+    # handle; recreating the same token reuses the old one.
+    dm.create_device(token="d-2", device_type="thermo")
+    assert dm.identity.device.lookup("d-2") != did
+    dm.create_device(token="d-1", device_type="thermo")
+    assert dm.identity.device.lookup("d-1") == did
+    assert dm.mirror.active[did]
+
+
 def test_tenant_isolation_between_services():
     identity = IdentityMap(capacity=4096)
     mirror = RegistryMirror(capacity=4096)

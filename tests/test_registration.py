@@ -1,0 +1,78 @@
+"""Auto-registration: unknown devices become registered + replayable.
+
+Reference parity: DeviceRegistrationManager defaults/switches and the
+reprocess replay path (SURVEY.md §3.5).
+"""
+
+import pytest
+
+from sitewhere_tpu.ids import NULL_ID, IdentityMap
+from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
+from sitewhere_tpu.services.device_management import DeviceManagement, RegistryMirror
+from sitewhere_tpu.services.registration import RegistrationManager
+
+
+@pytest.fixture()
+def dm():
+    identity = IdentityMap(capacity=1024)
+    mirror = RegistryMirror(capacity=1024)
+    svc = DeviceManagement("default", identity, mirror)
+    svc.create_device_type(token="thermo", name="Thermostat")
+    svc.create_area_type(token="site", name="Site")
+    svc.create_area(token="hq", area_type="site", name="HQ")
+    return svc
+
+
+def reg_req(token, **kw):
+    return DecodedRequest(
+        kind=RequestKind.REGISTRATION, device_token=token, ts_s=1000, **kw
+    )
+
+
+def test_explicit_registration_with_defaults(dm):
+    mgr = RegistrationManager(dm, default_device_type="thermo", default_area="hq")
+    assert mgr.handle_registration(reg_req("new-dev"))
+    dev = dm.get_device("new-dev")
+    assert dev.device_type == "thermo"
+    a = dm.get_active_assignment("new-dev")
+    assert a is not None and a.area == "hq"
+    did = dm.identity.device.lookup("new-dev")
+    assert dm.mirror.active[did]
+    assert mgr.registered == 1
+    # idempotent re-registration
+    assert mgr.handle_registration(reg_req("new-dev"))
+    assert mgr.registered == 1
+
+
+def test_registration_names_its_own_type(dm):
+    dm.create_device_type(token="meter", name="Meter")
+    mgr = RegistrationManager(dm, default_device_type="thermo")
+    assert mgr.handle_registration(reg_req("m-1", device_type_token="meter"))
+    assert dm.get_device("m-1").device_type == "meter"
+
+
+def test_rejection_paths(dm):
+    mgr = RegistrationManager(dm, default_device_type=None)
+    assert not mgr.handle_registration(reg_req("no-type"))  # no type known
+    assert mgr.rejected == 1
+
+    closed = RegistrationManager(dm, default_device_type="thermo", allow_new_devices=False)
+    assert not closed.handle_registration(reg_req("blocked"))
+    assert "blocked" not in dm.devices
+
+
+def test_unregistered_events_replay(dm):
+    mgr = RegistrationManager(dm, default_device_type="thermo")
+    events = [
+        DecodedRequest(
+            kind=RequestKind.MEASUREMENT, device_token="d-x", ts_s=5, mtype="t", value=1.0
+        ),
+        DecodedRequest(
+            kind=RequestKind.MEASUREMENT, device_token="d-y", ts_s=6, mtype="t", value=2.0
+        ),
+    ]
+    replay = mgr.process_unregistered(events)
+    assert len(replay) == 2
+    assert replay[0] is events[0]  # original event returned for re-injection
+    assert "d-x" in dm.devices and "d-y" in dm.devices
+    assert dm.get_active_assignment("d-x") is not None
