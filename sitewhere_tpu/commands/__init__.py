@@ -1,0 +1,41 @@
+"""Command delivery — invocations out to devices.
+
+Reference: ``service-command-delivery`` (SURVEY.md §2.2, §3.4): enriched
+command-invocation events flow through a processing strategy (target
+resolver → execution builder), a router picks a destination, and the
+destination encodes + parameter-extracts + delivers (MQTT/CoAP/SMS).
+Failures land on the undelivered dead-letter topic.
+"""
+
+from sitewhere_tpu.commands.model import CommandExecution, CommandInvocation
+from sitewhere_tpu.commands.encoders import (
+    BinaryCommandEncoder,
+    JsonCommandEncoder,
+    decode_binary_execution,
+)
+from sitewhere_tpu.commands.destinations import (
+    CallbackDeliveryProvider,
+    CommandDestination,
+    MqttDeliveryProvider,
+    TopicParameterExtractor,
+)
+from sitewhere_tpu.commands.routing import (
+    DeviceTypeMappingRouter,
+    SingleDestinationRouter,
+)
+from sitewhere_tpu.commands.processing import CommandProcessor
+
+__all__ = [
+    "CommandExecution",
+    "CommandInvocation",
+    "BinaryCommandEncoder",
+    "JsonCommandEncoder",
+    "decode_binary_execution",
+    "CallbackDeliveryProvider",
+    "CommandDestination",
+    "MqttDeliveryProvider",
+    "TopicParameterExtractor",
+    "DeviceTypeMappingRouter",
+    "SingleDestinationRouter",
+    "CommandProcessor",
+]

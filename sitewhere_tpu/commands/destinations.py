@@ -1,0 +1,134 @@
+"""Command destinations: encoder × parameter extractor × delivery provider.
+
+Reference: ``ICommandDestination`` composes exactly these three SPIs
+(``service-command-delivery/.../destination/mqtt/MqttCommandDestination.java``
++ ``MqttParameterExtractor`` computing a per-device topic +
+``MqttCommandDeliveryProvider`` publishing).  SMS (Twilio) and CoAP
+destinations follow the same shape; here providers without client
+libraries in the image are represented by :class:`CallbackDeliveryProvider`
+(any callable transport — the SPI point where a Twilio/CoAP client plugs
+in).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional
+
+from sitewhere_tpu.commands.model import CommandExecution
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.services.common import ServiceError
+
+logger = logging.getLogger("sitewhere_tpu.commands")
+
+
+class DeliveryError(ServiceError):
+    """Transport-level delivery failure → undelivered dead-letter."""
+
+
+class TopicParameterExtractor:
+    """Per-device delivery parameters from a topic pattern.
+
+    Reference: ``MqttParameterExtractor`` expands command/system topic
+    patterns with the device's hardware id.  Placeholders: ``{device}``,
+    ``{tenant}``, ``{type}``.
+    """
+
+    def __init__(
+        self,
+        command_topic: str = "sitewhere/command/{device}",
+        system_topic: str = "sitewhere/system/{device}",
+    ):
+        self.command_topic = command_topic
+        self.system_topic = system_topic
+
+    def __call__(self, execution: CommandExecution) -> Dict[str, str]:
+        inv = execution.invocation
+        fields = {
+            "device": inv.device_token or "",
+            "tenant": inv.tenant or "",
+            "type": inv.device_type_token or "",
+        }
+        return {
+            "topic": self.command_topic.format(**fields),
+            "system_topic": self.system_topic.format(**fields),
+        }
+
+
+class MqttDeliveryProvider(LifecycleComponent):
+    """Publish encoded executions to a broker topic.
+
+    Reference: ``MqttCommandDeliveryProvider`` over the shared
+    ``MqttLifecycleComponent``; here over
+    :class:`sitewhere_tpu.ingest.mqtt.MqttClient`.
+    """
+
+    def __init__(self, host: str, port: int = 1883, qos: int = 0, client=None):
+        super().__init__("mqtt-delivery")
+        self.host = host
+        self.port = port
+        self.qos = qos
+        self._client = client  # injectable for tests
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        super().start()
+        if self._client is None:
+            from sitewhere_tpu.ingest.mqtt import MqttClient
+
+            self._client = MqttClient(self.host, self.port)
+            self._client.connect()
+
+    def stop(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.disconnect()
+            except Exception:
+                pass
+            self._client = None
+        super().stop()
+
+    def deliver(self, execution: CommandExecution, payload: bytes, params: Dict[str, str]) -> None:
+        if self._client is None:
+            raise DeliveryError("mqtt delivery provider not started")
+        try:
+            with self._lock:
+                self._client.publish(params["topic"], payload, qos=self.qos)
+        except Exception as e:
+            raise DeliveryError(f"mqtt publish failed: {e}") from e
+
+
+class CallbackDeliveryProvider:
+    """Deliver through any callable — the plug-in point for transports
+    whose client libraries aren't in this image (Twilio SMS, CoAP POST)."""
+
+    def __init__(self, fn: Callable[[CommandExecution, bytes, Dict[str, str]], None]):
+        self.fn = fn
+
+    def deliver(self, execution: CommandExecution, payload: bytes, params: Dict[str, str]) -> None:
+        try:
+            self.fn(execution, payload, params)
+        except Exception as e:
+            raise DeliveryError(str(e)) from e
+
+
+class CommandDestination:
+    """One named delivery path: encode → extract params → deliver."""
+
+    def __init__(
+        self,
+        destination_id: str,
+        encoder: Callable[[CommandExecution], bytes],
+        extractor: Callable[[CommandExecution], Dict[str, str]],
+        provider,
+    ):
+        self.destination_id = destination_id
+        self.encoder = encoder
+        self.extractor = extractor
+        self.provider = provider
+
+    def deliver(self, execution: CommandExecution) -> None:
+        payload = self.encoder(execution)
+        params = self.extractor(execution)
+        self.provider.deliver(execution, payload, params)

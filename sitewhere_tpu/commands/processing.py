@@ -1,0 +1,161 @@
+"""Command processing strategy: resolve target → build execution → route →
+deliver, with undelivered dead-lettering.
+
+Reference: ``DefaultCommandProcessingStrategy.java:61-102`` +
+``CommandRoutingLogic.routeCommand:38-55`` (SURVEY.md §3.4).  The reference
+consumes enriched command-invocation events from Kafka; here the pipeline
+dispatcher hands :class:`CommandProcessor` the command-invocation rows it
+diverted (they are also persisted as events, preserving the
+invocation-is-an-event model).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from sitewhere_tpu.commands.destinations import CommandDestination, DeliveryError
+from sitewhere_tpu.commands.model import CommandExecution, CommandInvocation
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.services.common import EntityNotFound, ServiceError
+from sitewhere_tpu.services.device_management import DeviceManagement
+
+logger = logging.getLogger("sitewhere_tpu.commands")
+
+Undelivered = Callable[[CommandInvocation, str], None]
+
+
+class CommandProcessor(LifecycleComponent):
+    """The command-delivery service head.
+
+    ``invoke`` is the full path; partial failures dead-letter through
+    ``on_undelivered`` (reference: undelivered-command-invocations topic,
+    ``KafkaTopicNaming.java:70-73``).
+    """
+
+    def __init__(
+        self,
+        device_management: DeviceManagement,
+        destinations: Optional[List[CommandDestination]] = None,
+        router: Optional[Callable[[CommandExecution], str]] = None,
+        on_undelivered: Optional[Undelivered] = None,
+        name: str = "command-processor",
+    ):
+        super().__init__(name)
+        self.dm = device_management
+        self.destinations: Dict[str, CommandDestination] = {
+            d.destination_id: d for d in (destinations or [])
+        }
+        self.router = router
+        self.on_undelivered = on_undelivered
+        self._lock = threading.Lock()
+        self.delivered = 0
+        self.undelivered = 0
+
+    def add_destination(self, destination: CommandDestination) -> None:
+        self.destinations[destination.destination_id] = destination
+
+    # -- target resolution + execution build --------------------------------
+
+    def resolve_target(self, invocation: CommandInvocation) -> CommandInvocation:
+        """Fill device/type/tenant from the target assignment.
+
+        Reference: ``ICommandTargetResolver`` (invocation → assignments).
+        """
+        a = self.dm.get_device_assignment(invocation.target_assignment)
+        dev = self.dm.get_device(a.device)
+        invocation.device_token = dev.token
+        invocation.device_type_token = dev.device_type
+        invocation.tenant = self.dm.tenant
+        return invocation
+
+    def build_execution(self, invocation: CommandInvocation) -> CommandExecution:
+        """Join invocation with its command definition.
+
+        Reference: ``ICommandExecutionBuilder.createExecution``.  Parameter
+        values are validated against the declared specs: required params
+        must be present, unknown params are rejected, and values are coerced
+        to their declared types — the schema comes from the device type's
+        data, not from compiled code.
+        """
+        if invocation.device_type_token is None:
+            self.resolve_target(invocation)
+        dt = self.dm.get_device_type(invocation.device_type_token)
+        cmd = dt.commands.get(invocation.command_token)
+        if cmd is None:
+            raise EntityNotFound(
+                f"command {invocation.command_token} not in type {dt.token}"
+            )
+        declared = {name for (name, _t, _r) in cmd.parameters}
+        unknown = set(invocation.parameter_values) - declared
+        if unknown:
+            raise ServiceError(f"unknown parameters {sorted(unknown)}")
+        params = []
+        for name, ptype, required in cmd.parameters:
+            if name in invocation.parameter_values:
+                params.append(
+                    (name, ptype, _coerce(ptype, invocation.parameter_values[name]))
+                )
+            elif required:
+                raise ServiceError(f"missing required parameter {name}")
+        return CommandExecution(
+            invocation=invocation,
+            command_name=cmd.name,
+            namespace=cmd.namespace,
+            parameters=params,
+        )
+
+    # -- routing + delivery --------------------------------------------------
+
+    def route(self, execution: CommandExecution) -> CommandDestination:
+        if not self.destinations:
+            raise ServiceError("no command destinations registered")
+        if self.router is not None:
+            dest_id = self.router(execution)
+        elif len(self.destinations) == 1:
+            dest_id = next(iter(self.destinations))
+        else:
+            raise ServiceError("multiple destinations but no router configured")
+        dest = self.destinations.get(dest_id)
+        if dest is None:
+            raise EntityNotFound(f"destination {dest_id}")
+        return dest
+
+    def invoke(self, invocation: CommandInvocation) -> bool:
+        """Full delivery path; returns True when the device got the bytes."""
+        try:
+            self.resolve_target(invocation)
+            execution = self.build_execution(invocation)
+            self.route(execution).deliver(execution)
+        except Exception as e:
+            # EVERY failure dead-letters (reference: undelivered topic) —
+            # including coercion/encoding surprises (ValueError/TypeError),
+            # so one bad invocation can never abort a batch.
+            with self._lock:
+                self.undelivered += 1
+            logger.warning("command %s undelivered: %s", invocation.token, e)
+            if self.on_undelivered is not None:
+                self.on_undelivered(invocation, str(e))
+            return False
+        with self._lock:
+            self.delivered += 1
+        return True
+
+    def invoke_many(self, invocations: List[CommandInvocation]) -> int:
+        """Batch path used by the dispatcher; returns delivered count."""
+        return sum(1 for inv in invocations if self.invoke(inv))
+
+
+def _coerce(ptype: str, value):
+    if ptype == "double":
+        return float(value)
+    if ptype in ("int32", "int64"):
+        return int(value)
+    if ptype == "bool":
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes")
+        return bool(value)
+    if ptype == "bytes":
+        return value if isinstance(value, (bytes, bytearray)) else str(value).encode()
+    return str(value)

@@ -1,0 +1,41 @@
+"""Outbound delivery of enriched events to external systems.
+
+Reference: ``service-outbound-connectors`` — one Kafka consumer per
+connector over the enriched-events topic, each connector wrapped in
+filters, some with multicast routing (SURVEY.md §2.2).  Here the
+dispatcher hands every accepted (enriched) batch to the
+:class:`~sitewhere_tpu.outbound.manager.OutboundConnectorsManager`;
+filters are *vectorized column masks* rather than per-event predicates —
+the TPU-shaped reformulation of ``FilteredOutboundConnector``.
+"""
+
+from sitewhere_tpu.outbound.filters import (
+    AreaFilter,
+    CallbackFilter,
+    DeviceFilter,
+    DeviceTypeFilter,
+    EventTypeFilter,
+)
+from sitewhere_tpu.outbound.connectors import (
+    CallbackConnector,
+    FileConnector,
+    MqttOutboundConnector,
+    OutboundConnector,
+)
+from sitewhere_tpu.outbound.manager import OutboundConnectorsManager
+from sitewhere_tpu.outbound.search import EventSearchProvider, SearchProvidersManager
+
+__all__ = [
+    "AreaFilter",
+    "CallbackFilter",
+    "DeviceFilter",
+    "DeviceTypeFilter",
+    "EventTypeFilter",
+    "CallbackConnector",
+    "FileConnector",
+    "MqttOutboundConnector",
+    "OutboundConnector",
+    "OutboundConnectorsManager",
+    "EventSearchProvider",
+    "SearchProvidersManager",
+]

@@ -1,0 +1,133 @@
+"""Outbound connectors manager: fan each enriched batch to every connector.
+
+Reference: ``KafkaOutboundConnectorHost.java:44-89`` runs one Kafka
+consumer (own consumer group = own offset cursor) per connector, so a slow
+or failing connector never blocks the others.  Here each connector
+processes each batch on its own worker thread with error isolation; a
+connector exception is counted and logged, never propagated to the
+dispatcher (the pipeline equivalent of a consumer group falling behind is
+the connector's queue depth).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from sitewhere_tpu.outbound.connectors import OutboundConnector
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+
+logger = logging.getLogger("sitewhere_tpu.outbound")
+
+
+class OutboundConnectorsManager(LifecycleComponent):
+    """Owns the connector set; dispatches batches to per-connector queues."""
+
+    def __init__(self, connectors: Optional[List[OutboundConnector]] = None,
+                 queue_depth: int = 64):
+        super().__init__("outbound-connectors")
+        self.queue_depth = queue_depth
+        self._workers: Dict[str, "_Worker"] = {}
+        for c in connectors or []:
+            self.add_connector(c)
+
+    def add_connector(self, connector: OutboundConnector) -> None:
+        self.add_child(connector)
+        worker = _Worker(connector, self.queue_depth)
+        self._workers[connector.connector_id] = worker
+        if self.state.name == "STARTED":
+            worker.start()
+
+    def start(self) -> None:
+        super().start()
+        for worker in self._workers.values():
+            worker.start()
+
+    def stop(self) -> None:
+        for worker in self._workers.values():
+            worker.shutdown()
+        super().stop()
+
+    def submit(self, cols: Dict[str, np.ndarray], mask: np.ndarray) -> None:
+        """Offer one enriched batch to every connector (non-blocking; a
+        full queue drops the batch for that connector and counts it —
+        backpressure stays local, like an overwhelmed consumer group)."""
+        for worker in self._workers.values():
+            worker.offer(cols, mask)
+
+    def drain(self, timeout: float = 10.0) -> None:
+        """Block until all queued batches are processed (tests/shutdown)."""
+        for worker in self._workers.values():
+            worker.drain(timeout)
+
+    def stats(self) -> Dict[str, dict]:
+        return {
+            cid: {
+                "processed": w.connector.processed,
+                "errors": w.connector.errors,
+                "dropped": w.dropped,
+                "queued": w.q.qsize(),
+            }
+            for cid, w in self._workers.items()
+        }
+
+
+class _Worker:
+    def __init__(self, connector: OutboundConnector, depth: int):
+        self.connector = connector
+        self.q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self.dropped = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"outbound-{self.connector.connector_id}", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self.q.put(None)  # wake
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def offer(self, cols, mask) -> None:
+        try:
+            self.q.put_nowait((cols, mask))
+        except queue.Full:
+            self.dropped += 1
+
+    def drain(self, timeout: float) -> None:
+        import time
+
+        # unfinished_tasks only reaches 0 after task_done() — i.e. after the
+        # in-flight batch has fully processed, not merely been dequeued.
+        deadline = time.monotonic() + timeout
+        while self.q.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            item = self.q.get()
+            try:
+                if item is None:
+                    continue
+                cols, mask = item
+                try:
+                    self.connector.process_batch(cols, mask)
+                except Exception:
+                    with self.connector._lock:
+                        self.connector.errors += 1
+                    logger.exception("connector %s failed on batch",
+                                     self.connector.connector_id)
+            finally:
+                self.q.task_done()

@@ -1,0 +1,53 @@
+"""Event search providers — federated search over indexed events.
+
+Reference: ``service-event-search`` manages named ``ISearchProvider``s
+(Solr impl) queried through the REST ``ExternalSearch`` controller
+(SURVEY.md §2.2).  Here the built-in provider searches the columnar
+:class:`~sitewhere_tpu.services.event_store.EventStore` directly (the
+store *is* the index — chunk pruning + vectorized masks), and the manager
+keeps the named-provider SPI so an external indexer can be plugged in.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.services.common import (
+    EntityNotFound,
+    SearchCriteria,
+    SearchResults,
+)
+from sitewhere_tpu.services.event_store import EventRecord, EventStore
+
+
+class EventSearchProvider:
+    """Search the event store (reference: ``SolrSearchProvider``)."""
+
+    def __init__(self, provider_id: str, store: EventStore, name: str = ""):
+        self.provider_id = provider_id
+        self.name = name or provider_id
+        self.store = store
+
+    def search(self, criteria: Optional[SearchCriteria] = None, **filters) -> SearchResults[EventRecord]:
+        return self.store.query(criteria, **filters)
+
+
+class SearchProvidersManager:
+    """Named provider registry (reference: ``SearchProviderManager``)."""
+
+    def __init__(self, providers: Optional[List[EventSearchProvider]] = None):
+        self._providers: Dict[str, EventSearchProvider] = {
+            p.provider_id: p for p in providers or []
+        }
+
+    def add_provider(self, provider: EventSearchProvider) -> None:
+        self._providers[provider.provider_id] = provider
+
+    def get_provider(self, provider_id: str) -> EventSearchProvider:
+        p = self._providers.get(provider_id)
+        if p is None:
+            raise EntityNotFound(f"search provider {provider_id}")
+        return p
+
+    def list_providers(self) -> List[EventSearchProvider]:
+        return list(self._providers.values())

@@ -1,0 +1,227 @@
+"""Command delivery: encoding round-trips, routing, full processing path.
+
+Reference parity: DefaultCommandProcessingStrategy → router → destination
+(encode / extract params / deliver), undelivered dead-letters, and the
+runtime schema-from-device-type encoding semantic.
+"""
+
+import json
+
+import pytest
+
+from sitewhere_tpu.commands import (
+    BinaryCommandEncoder,
+    CallbackDeliveryProvider,
+    CommandDestination,
+    CommandInvocation,
+    CommandProcessor,
+    DeviceTypeMappingRouter,
+    JsonCommandEncoder,
+    SingleDestinationRouter,
+    TopicParameterExtractor,
+    decode_binary_execution,
+)
+from sitewhere_tpu.ids import IdentityMap
+from sitewhere_tpu.services.common import EntityNotFound, ServiceError
+from sitewhere_tpu.services.device_management import DeviceManagement, RegistryMirror
+
+
+@pytest.fixture()
+def dm():
+    svc = DeviceManagement("default", IdentityMap(capacity=1024), RegistryMirror(1024))
+    svc.create_device_type(token="thermo", name="Thermostat")
+    svc.create_device_command(
+        "thermo",
+        token="set-point",
+        name="setPoint",
+        namespace="http://acme/thermo",
+        parameters=[
+            ("target", "double", True),
+            ("mode", "string", False),
+            ("retries", "int32", False),
+            ("urgent", "bool", False),
+        ],
+    )
+    svc.create_device(token="d-1", device_type="thermo")
+    svc.create_device_assignment(token="a-1", device="d-1")
+    return svc
+
+
+def make_processor(dm, sink, encoder=None, **kw):
+    dest = CommandDestination(
+        "mqtt-main",
+        encoder or BinaryCommandEncoder(),
+        TopicParameterExtractor(),
+        CallbackDeliveryProvider(sink),
+    )
+    return CommandProcessor(dm, destinations=[dest], **kw)
+
+
+def test_full_invoke_path_binary_roundtrip(dm):
+    seen = []
+    proc = make_processor(dm, lambda ex, payload, params: seen.append((payload, params)))
+    inv = CommandInvocation(
+        command_token="set-point",
+        target_assignment="a-1",
+        parameter_values={"target": 21.5, "mode": "eco", "urgent": True, "retries": -2},
+    )
+    assert proc.invoke(inv)
+    assert proc.delivered == 1
+    payload, params = seen[0]
+    assert params["topic"] == "sitewhere/command/d-1"
+    doc = decode_binary_execution(payload)
+    assert doc["command"] == "setPoint"
+    assert doc["namespace"] == "http://acme/thermo"
+    assert doc["parameters"] == {
+        "target": 21.5, "mode": "eco", "urgent": True, "retries": -2
+    }
+    assert doc["invocation"] == inv.token
+
+
+def test_json_encoder(dm):
+    seen = []
+    proc = make_processor(
+        dm, lambda ex, p, prm: seen.append(p), encoder=JsonCommandEncoder()
+    )
+    inv = CommandInvocation(
+        command_token="set-point", target_assignment="a-1",
+        parameter_values={"target": "19.0"},  # string coerced to declared double
+    )
+    assert proc.invoke(inv)
+    doc = json.loads(seen[0])
+    assert doc["command"] == "setPoint"
+    assert doc["parameters"]["target"] == 19.0
+
+
+def test_parameter_validation(dm):
+    dead = []
+    proc = make_processor(
+        dm, lambda *a: None, on_undelivered=lambda inv, why: dead.append(why)
+    )
+    # missing required
+    assert not proc.invoke(
+        CommandInvocation(command_token="set-point", target_assignment="a-1")
+    )
+    # unknown parameter
+    assert not proc.invoke(
+        CommandInvocation(
+            command_token="set-point", target_assignment="a-1",
+            parameter_values={"target": 1.0, "nope": 2},
+        )
+    )
+    # unknown command
+    assert not proc.invoke(
+        CommandInvocation(command_token="missing-cmd", target_assignment="a-1",
+                          parameter_values={}),
+    )
+    # unknown assignment
+    assert not proc.invoke(
+        CommandInvocation(command_token="set-point", target_assignment="a-404",
+                          parameter_values={"target": 1.0}),
+    )
+    assert proc.undelivered == 4
+    assert len(dead) == 4
+    assert "missing required parameter target" in dead[0]
+
+
+def test_device_type_mapping_router(dm):
+    dm.create_device_type(token="meter", name="Meter")
+    dm.create_device_command("meter", token="reset", name="reset", parameters=[])
+    dm.create_device(token="m-1", device_type="meter")
+    dm.create_device_assignment(token="a-m", device="m-1")
+
+    thermo_seen, meter_seen = [], []
+    dests = [
+        CommandDestination("thermo-dest", JsonCommandEncoder(), TopicParameterExtractor(),
+                           CallbackDeliveryProvider(lambda *a: thermo_seen.append(a))),
+        CommandDestination("meter-dest", JsonCommandEncoder(), TopicParameterExtractor(),
+                           CallbackDeliveryProvider(lambda *a: meter_seen.append(a))),
+    ]
+    proc = CommandProcessor(
+        dm, destinations=dests,
+        router=DeviceTypeMappingRouter({"thermo": "thermo-dest", "meter": "meter-dest"}),
+    )
+    assert proc.invoke(CommandInvocation(command_token="set-point", target_assignment="a-1",
+                                         parameter_values={"target": 1.0}))
+    assert proc.invoke(CommandInvocation(command_token="reset", target_assignment="a-m"))
+    assert len(thermo_seen) == 1 and len(meter_seen) == 1
+
+    # unmapped type with no default → undelivered
+    dm.create_device_type(token="cam", name="Cam")
+    dm.create_device_command("cam", token="snap", name="snap", parameters=[])
+    dm.create_device(token="c-1", device_type="cam")
+    dm.create_device_assignment(token="a-c", device="c-1")
+    assert not proc.invoke(CommandInvocation(command_token="snap", target_assignment="a-c"))
+
+
+def test_delivery_failure_dead_letters(dm):
+    def boom(*a):
+        raise OSError("broker down")
+
+    dead = []
+    proc = make_processor(dm, boom, on_undelivered=lambda inv, why: dead.append(inv))
+    inv = CommandInvocation(command_token="set-point", target_assignment="a-1",
+                            parameter_values={"target": 2.0})
+    assert not proc.invoke(inv)
+    assert dead == [inv]
+
+
+def test_binary_decoder_rejects_garbage():
+    from sitewhere_tpu.services.common import ValidationError
+
+    with pytest.raises(ValidationError):
+        decode_binary_execution(b"\x00\x01junk")
+    with pytest.raises(ValidationError):
+        decode_binary_execution(b"\xc7\x09")  # bad version
+
+
+def test_coercion_error_dead_letters_not_raises(dm):
+    dead = []
+    proc = make_processor(
+        dm, lambda *a: None, on_undelivered=lambda inv, why: dead.append(why)
+    )
+    invs = [
+        CommandInvocation(command_token="set-point", target_assignment="a-1",
+                          parameter_values={"target": "not-a-number"}),
+        CommandInvocation(command_token="set-point", target_assignment="a-1",
+                          parameter_values={"target": 5.0}),
+    ]
+    # bad coercion dead-letters; the rest of the batch still delivers
+    assert proc.invoke_many(invs) == 1
+    assert len(dead) == 1
+
+
+def test_no_destinations_message(dm):
+    dead = []
+    proc = CommandProcessor(dm, on_undelivered=lambda inv, why: dead.append(why))
+    proc.invoke(CommandInvocation(command_token="set-point", target_assignment="a-1",
+                                  parameter_values={"target": 1.0}))
+    assert "no command destinations registered" in dead[0]
+
+
+def test_truncated_binary_payloads_rejected(dm):
+    from sitewhere_tpu.commands.model import CommandExecution
+    from sitewhere_tpu.services.common import ValidationError
+
+    inv = CommandInvocation(command_token="set-point", target_assignment="a-1")
+    ex = CommandExecution(invocation=inv, command_name="c", namespace="ns",
+                          parameters=[("blob", "bytes", b"x" * 100)])
+    payload = BinaryCommandEncoder()(ex)
+    with pytest.raises(ValidationError):
+        decode_binary_execution(payload[:-50])
+    ex2 = CommandExecution(invocation=inv, command_name="c", namespace="ns",
+                           parameters=[("v", "double", 1.5)])
+    payload2 = BinaryCommandEncoder()(ex2)
+    with pytest.raises(ValidationError):
+        decode_binary_execution(payload2[:-4])
+
+
+def test_invoke_many(dm):
+    n_ok = []
+    proc = make_processor(dm, lambda *a: n_ok.append(1))
+    invs = [
+        CommandInvocation(command_token="set-point", target_assignment="a-1",
+                          parameter_values={"target": float(i)})
+        for i in range(3)
+    ] + [CommandInvocation(command_token="set-point", target_assignment="a-404")]
+    assert proc.invoke_many(invs) == 3

@@ -1,0 +1,162 @@
+"""Outbound connectors: vectorized filters, delivery, manager isolation,
+and event search providers."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.outbound import (
+    AreaFilter,
+    CallbackConnector,
+    CallbackFilter,
+    DeviceTypeFilter,
+    EventSearchProvider,
+    EventTypeFilter,
+    FileConnector,
+    MqttOutboundConnector,
+    OutboundConnectorsManager,
+    SearchProvidersManager,
+)
+from sitewhere_tpu.outbound.connectors import marshal_row
+from sitewhere_tpu.services.common import EntityNotFound, SearchCriteria
+
+
+def make_cols(n=8):
+    return {
+        "device_id": np.arange(n, dtype=np.int32),
+        "tenant_id": np.zeros(n, np.int32),
+        "event_type": np.asarray([i % 3 for i in range(n)], np.int32),
+        "ts_s": np.arange(n, dtype=np.int32) + 1000,
+        "ts_ns": np.zeros(n, np.int32),
+        "mtype_id": np.zeros(n, np.int32),
+        "value": np.linspace(0, 1, n).astype(np.float32),
+        "lat": np.ones(n, np.float32),
+        "lon": np.ones(n, np.float32),
+        "elevation": np.zeros(n, np.float32),
+        "alert_code": np.full(n, 7, np.int32),
+        "alert_level": np.ones(n, np.int32),
+        "command_id": np.full(n, -1, np.int32),
+        "area_id": np.asarray([1, 1, 2, 2, 3, 3, 1, 1], np.int32)[:n],
+        "customer_id": np.zeros(n, np.int32),
+        "asset_id": np.zeros(n, np.int32),
+        "assignment_id": np.arange(n, dtype=np.int32),
+        "device_type_id": np.asarray([0, 1] * (n // 2), np.int32),
+    }
+
+
+def test_filters_compose():
+    cols = make_cols()
+    mask = np.ones(8, np.bool_)
+    seen = []
+    conn = CallbackConnector(
+        "c", lambda c, m: seen.append(m.copy()),
+        filters=[
+            AreaFilter([1], include=True),          # rows 0,1,6,7
+            DeviceTypeFilter([1], include=False),   # drop odd rows
+            CallbackFilter(lambda c: c["value"] < 0.9),  # drop row 7 (value 1.0)
+        ],
+    )
+    n = conn.process_batch(cols, mask)
+    assert n == 2
+    assert list(np.nonzero(seen[0])[0]) == [0, 6]
+    assert conn.processed == 2
+
+
+def test_event_type_filter_alerts_only():
+    cols = make_cols()
+    got = []
+    conn = CallbackConnector(
+        "alerts", lambda c, m: got.extend(np.nonzero(m)[0].tolist()),
+        filters=[EventTypeFilter([2], include=True)],
+    )
+    conn.process_batch(cols, np.ones(8, np.bool_))
+    assert got == [2, 5]
+
+
+def test_file_connector_writes_jsonl(tmp_path):
+    path = str(tmp_path / "out" / "events.jsonl")
+    conn = FileConnector("file", path)
+    conn.process_batch(make_cols(), np.ones(8, np.bool_))
+    lines = [json.loads(line) for line in open(path)]
+    assert len(lines) == 8
+    assert lines[0]["eventType"] == "measurement"
+    assert lines[1]["eventType"] == "location"
+    assert lines[2]["eventType"] == "alert"
+    assert lines[2]["alertCode"] == 7
+    assert lines[0]["areaId"] == 1
+
+
+def test_mqtt_connector_multicast_routes():
+    published = []
+
+    class FakeClient:
+        def publish(self, topic, payload, qos=0):
+            published.append((topic, json.loads(payload)))
+
+    conn = MqttOutboundConnector(
+        "mqtt", FakeClient(),
+        multicaster=lambda doc: (
+            ["alerts", "all"] if doc["eventType"] == "alert" else ["all"]
+        ),
+        route_builder=lambda route, doc: f"sw/{route}/{doc['deviceId']}",
+    )
+    conn.process_batch(make_cols(), np.ones(8, np.bool_))
+    topics = [t for t, _ in published]
+    assert "sw/all/0" in topics
+    assert "sw/alerts/2" in topics
+    assert len([t for t in topics if t.startswith("sw/alerts/")]) == 2
+
+
+def test_mqtt_publish_failure_counted_not_raised():
+    class BoomClient:
+        def publish(self, *a, **k):
+            raise OSError("down")
+
+    conn = MqttOutboundConnector("mqtt", BoomClient())
+    conn.process_batch(make_cols(), np.ones(8, np.bool_))
+    assert conn.errors == 8
+
+
+def test_manager_fans_out_and_isolates_failures():
+    good, order = [], []
+
+    def slow_deliver(c, m):
+        time.sleep(0.01)
+        good.append(int(m.sum()))
+
+    def bad_deliver(c, m):
+        raise RuntimeError("connector bug")
+
+    mgr = OutboundConnectorsManager([
+        CallbackConnector("good", slow_deliver),
+        CallbackConnector("bad", bad_deliver),
+    ])
+    mgr.initialize()
+    mgr.start()
+    try:
+        for _ in range(3):
+            mgr.submit(make_cols(), np.ones(8, np.bool_))
+        mgr.drain()  # accurate: returns only after in-flight batches finish
+        stats = mgr.stats()
+        assert sum(good) == 24
+        assert stats["bad"]["errors"] == 3
+        assert stats["good"]["processed"] == 24
+    finally:
+        mgr.stop()
+
+
+def test_search_providers(tmp_path):
+    from sitewhere_tpu.services.event_store import EventStore
+
+    store = EventStore(str(tmp_path))
+    store.add_event(device_id=4, tenant_id=0, event_type=2, ts_s=50, alert_code=9)
+    mgr = SearchProvidersManager([EventSearchProvider("default", store)])
+    res = mgr.get_provider("default").search(device_id=4)
+    assert res.total == 1
+    assert res.results[0].alert_code == 9
+    assert len(mgr.list_providers()) == 1
+    with pytest.raises(EntityNotFound):
+        mgr.get_provider("solr")
