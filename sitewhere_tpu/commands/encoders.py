@@ -21,6 +21,7 @@ encoders.  Here:
 
 from __future__ import annotations
 
+import base64
 import json
 import struct
 from typing import List, Tuple
@@ -45,7 +46,13 @@ class JsonCommandEncoder:
             "command": execution.command_name,
             "namespace": execution.namespace,
             "parameters": {
-                name: value for (name, _type, value) in execution.parameters
+                # bytes params ride as base64 (JSON has no binary type).
+                name: (
+                    base64.b64encode(bytes(value)).decode("ascii")
+                    if _type == "bytes"
+                    else value
+                )
+                for (name, _type, value) in execution.parameters
             },
         }
         return json.dumps(doc, sort_keys=True).encode("utf-8")
@@ -66,6 +73,8 @@ def _varint(n: int) -> bytes:
 
 
 def _zigzag(n: int) -> int:
+    if not -(1 << 63) <= n < (1 << 63):
+        raise ValidationError(f"integer {n} outside int64 range")
     return (n << 1) ^ (n >> 63) if n < 0 else n << 1
 
 

@@ -44,9 +44,9 @@ class CommandProcessor(LifecycleComponent):
     ):
         super().__init__(name)
         self.dm = device_management
-        self.destinations: Dict[str, CommandDestination] = {
-            d.destination_id: d for d in (destinations or [])
-        }
+        self.destinations: Dict[str, CommandDestination] = {}
+        for d in destinations or []:
+            self.add_destination(d)
         self.router = router
         self.on_undelivered = on_undelivered
         self._lock = threading.Lock()
@@ -55,6 +55,10 @@ class CommandProcessor(LifecycleComponent):
 
     def add_destination(self, destination: CommandDestination) -> None:
         self.destinations[destination.destination_id] = destination
+        # Providers with a lifecycle (e.g. MqttDeliveryProvider owning a
+        # broker connection) start/stop with the processor.
+        if isinstance(destination.provider, LifecycleComponent):
+            self.add_child(destination.provider)
 
     # -- target resolution + execution build --------------------------------
 
@@ -147,11 +151,18 @@ class CommandProcessor(LifecycleComponent):
         return sum(1 for inv in invocations if self.invoke(inv))
 
 
+_INT_RANGES = {"int32": (-(1 << 31), (1 << 31) - 1), "int64": (-(1 << 63), (1 << 63) - 1)}
+
+
 def _coerce(ptype: str, value):
     if ptype == "double":
         return float(value)
     if ptype in ("int32", "int64"):
-        return int(value)
+        n = int(value)
+        lo, hi = _INT_RANGES[ptype]
+        if not lo <= n <= hi:
+            raise ServiceError(f"value {n} out of range for {ptype}")
+        return n
     if ptype == "bool":
         if isinstance(value, str):
             return value.lower() in ("1", "true", "yes")

@@ -96,7 +96,10 @@ class _Worker:
     def shutdown(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self.q.put(None)  # wake
+            try:
+                self.q.put_nowait(None)  # wake; a full queue still wakes the
+            except queue.Full:           # worker on its next get()
+                pass
             self._thread.join(timeout=5)
             self._thread = None
 

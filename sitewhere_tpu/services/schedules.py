@@ -1,0 +1,336 @@
+"""Schedule management — timed (batch) command invocations.
+
+Reference: ``service-schedule-management`` — Quartz-backed
+``QuartzScheduleManager.java`` with ``ISchedule`` (simple
+interval/repeat or cron trigger, optional start/end window) and scheduled
+jobs (``jobs/CommandInvocationJob.java``,
+``jobs/BatchCommandInvocationJob.java``).  Quartz is replaced by a single
+ticker thread + a pure next-fire computation (unit-testable without
+sleeping): simple triggers fire every ``interval_s`` up to ``repeat_count``
+times; cron triggers support the standard 5-field subset
+(``m h dom mon dow`` with ``*``, lists, ranges, ``*/n``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.services.common import (
+    Entity,
+    EntityNotFound,
+    SearchCriteria,
+    SearchResults,
+    ValidationError,
+    mint_token,
+    now_s,
+    paged,
+    require,
+)
+
+logger = logging.getLogger("sitewhere_tpu.schedules")
+
+
+# -- cron subset -------------------------------------------------------------
+
+
+def _parse_field(spec: str, lo: int, hi: int) -> frozenset:
+    out = set()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+            if step <= 0:
+                raise ValidationError(f"bad cron step {step_s}")
+        if part in ("*", ""):
+            lo_p, hi_p = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            lo_p, hi_p = int(a), int(b)
+        else:
+            lo_p = hi_p = int(part)
+        if not (lo <= lo_p <= hi and lo <= hi_p <= hi):
+            raise ValidationError(f"cron field {spec} out of range [{lo},{hi}]")
+        out.update(range(lo_p, hi_p + 1, step))
+    return frozenset(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class CronSpec:
+    """Parsed 5-field cron expression."""
+
+    minutes: frozenset
+    hours: frozenset
+    dom: frozenset
+    months: frozenset
+    dow: frozenset  # 0=Monday .. 6=Sunday (python weekday)
+
+    @classmethod
+    def parse(cls, expr: str) -> "CronSpec":
+        fields = expr.split()
+        require(len(fields) == 5, ValidationError(f"cron needs 5 fields: {expr!r}"))
+        return cls(
+            minutes=_parse_field(fields[0], 0, 59),
+            hours=_parse_field(fields[1], 0, 23),
+            dom=_parse_field(fields[2], 1, 31),
+            months=_parse_field(fields[3], 1, 12),
+            dow=_parse_field(fields[4], 0, 6),
+        )
+
+    def matches(self, t: time.struct_time) -> bool:
+        return (
+            t.tm_min in self.minutes
+            and t.tm_hour in self.hours
+            and t.tm_mday in self.dom
+            and t.tm_mon in self.months
+            and t.tm_wday in self.dow
+        )
+
+    def next_fire(self, after_s: int, horizon_days: int = 366) -> Optional[int]:
+        """Smallest minute-aligned time > after_s matching the spec.
+
+        Skips whole days/hours whose date/hour fields don't match, so a
+        never-matching spec (e.g. Feb 31) costs ~hundreds of localtime
+        calls over the horizon, not one per minute.
+        """
+        t = (after_s // 60 + 1) * 60
+        end = after_s + horizon_days * 86400
+        while t <= end:
+            st = time.localtime(t)
+            if not (
+                st.tm_mday in self.dom
+                and st.tm_mon in self.months
+                and st.tm_wday in self.dow
+            ):
+                # jump to the next local midnight (sec offset keeps t
+                # minute-aligned; DST shifts are re-checked next loop)
+                t += (
+                    (24 - st.tm_hour) * 3600 - st.tm_min * 60 - st.tm_sec
+                )
+                continue
+            if st.tm_hour not in self.hours:
+                t += 3600 - st.tm_min * 60 - st.tm_sec
+                continue
+            if st.tm_min in self.minutes:
+                return t
+            t += 60
+        return None
+
+
+# -- model -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Schedule(Entity):
+    """Reference ``ISchedule``: trigger + optional active window."""
+
+    name: str = ""
+    trigger_type: str = "Simple"  # Simple | Cron
+    interval_s: int = 60          # Simple
+    repeat_count: int = -1        # Simple; -1 = forever
+    cron: str = ""                # Cron
+    start_s: Optional[int] = None
+    end_s: Optional[int] = None
+
+    def spec(self) -> Optional[CronSpec]:
+        return CronSpec.parse(self.cron) if self.trigger_type == "Cron" else None
+
+
+@dataclasses.dataclass
+class ScheduledJob(Entity):
+    """Reference ``IScheduledJob``: what to run when the schedule fires."""
+
+    schedule: str = ""
+    job_type: str = "CommandInvocation"  # or BatchCommandInvocation
+    config: Dict[str, object] = dataclasses.field(default_factory=dict)
+    fire_count: int = 0
+    last_fire_s: Optional[int] = None
+
+
+JobExecutor = Callable[[ScheduledJob], None]
+
+
+class ScheduleManager(LifecycleComponent):
+    """Schedules + jobs + the ticker that fires them.
+
+    ``executors`` maps job type → callable; the node wires
+    ``CommandInvocation`` to the command processor and
+    ``BatchCommandInvocation`` to the batch manager (reference job classes).
+    """
+
+    def __init__(
+        self,
+        executors: Optional[Dict[str, JobExecutor]] = None,
+        tick_s: float = 1.0,
+        name: str = "schedule-manager",
+    ):
+        super().__init__(name)
+        self.executors = dict(executors or {})
+        self.tick_s = tick_s
+        self.schedules: Dict[str, Schedule] = {}
+        self.jobs: Dict[str, ScheduledJob] = {}
+        self._lock = threading.RLock()
+        # schedule token → (next_fire_s, fires_so_far)
+        self._next: Dict[str, int] = {}
+        self._fires: Dict[str, int] = {}
+        self._ticker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- CRUD ----------------------------------------------------------------
+
+    def create_schedule(self, token: Optional[str] = None, **fields) -> Schedule:
+        with self._lock:
+            token = token or mint_token("sched")
+            require(token not in self.schedules, ValidationError(f"schedule {token} exists"))
+            s = Schedule(token=token, **fields)
+            require(
+                s.trigger_type in ("Simple", "Cron"),
+                ValidationError(f"bad trigger type {s.trigger_type}"),
+            )
+            if s.trigger_type == "Cron":
+                CronSpec.parse(s.cron)  # validate now
+            else:
+                require(s.interval_s > 0, ValidationError("interval must be positive"))
+            self.schedules[token] = s
+            self._schedule_next(s, base_s=max(now_s(), s.start_s or 0))
+            return s
+
+    def get_schedule(self, token: str) -> Schedule:
+        s = self.schedules.get(token)
+        require(s is not None, EntityNotFound(f"schedule {token}"))
+        return s
+
+    def list_schedules(self, criteria: Optional[SearchCriteria] = None) -> SearchResults[Schedule]:
+        with self._lock:
+            return paged(sorted(self.schedules.values(), key=lambda s: s.token), criteria)
+
+    def delete_schedule(self, token: str) -> Schedule:
+        with self._lock:
+            s = self.schedules.pop(token, None)
+            require(s is not None, EntityNotFound(f"schedule {token}"))
+            self._next.pop(token, None)
+            self._fires.pop(token, None)
+            for job in [j for j in self.jobs.values() if j.schedule == token]:
+                del self.jobs[job.token]
+            return s
+
+    def create_job(self, token: Optional[str] = None, **fields) -> ScheduledJob:
+        with self._lock:
+            token = token or mint_token("job")
+            require(token not in self.jobs, ValidationError(f"job {token} exists"))
+            job = ScheduledJob(token=token, **fields)
+            require(job.schedule in self.schedules, EntityNotFound(f"schedule {job.schedule}"))
+            require(
+                job.job_type in self.executors or not self.executors,
+                ValidationError(f"no executor for job type {job.job_type}"),
+            )
+            self.jobs[token] = job
+            return job
+
+    def get_job(self, token: str) -> ScheduledJob:
+        job = self.jobs.get(token)
+        require(job is not None, EntityNotFound(f"job {token}"))
+        return job
+
+    def list_jobs(
+        self, criteria: Optional[SearchCriteria] = None, schedule: Optional[str] = None
+    ) -> SearchResults[ScheduledJob]:
+        with self._lock:
+            items = sorted(self.jobs.values(), key=lambda j: j.token)
+        if schedule is not None:
+            items = [j for j in items if j.schedule == schedule]
+        return paged(items, criteria)
+
+    def delete_job(self, token: str) -> ScheduledJob:
+        with self._lock:
+            job = self.jobs.pop(token, None)
+            require(job is not None, EntityNotFound(f"job {token}"))
+            return job
+
+    # -- firing --------------------------------------------------------------
+
+    def _schedule_next(self, s: Schedule, base_s: int) -> None:
+        fires = self._fires.get(s.token, 0)
+        if s.trigger_type == "Simple":
+            if s.repeat_count >= 0 and fires > s.repeat_count:
+                self._next.pop(s.token, None)
+                return
+            nxt = base_s if fires == 0 else base_s + s.interval_s
+        else:
+            spec = s.spec()
+            nxt = spec.next_fire(base_s)
+            if nxt is None:
+                self._next.pop(s.token, None)
+                return
+        if s.end_s is not None and nxt > s.end_s:
+            self._next.pop(s.token, None)
+            return
+        self._next[s.token] = nxt
+
+    def due_schedules(self, at_s: Optional[int] = None) -> List[str]:
+        at_s = at_s if at_s is not None else now_s()
+        with self._lock:
+            return [tok for tok, t in self._next.items() if t <= at_s]
+
+    def fire(self, schedule_token: str, at_s: Optional[int] = None) -> int:
+        """Run all jobs attached to a schedule; returns jobs fired.
+
+        Public so tests (and the REST trigger endpoint) can fire without
+        waiting on wall-clock.
+        """
+        at_s = at_s if at_s is not None else now_s()
+        with self._lock:
+            s = self.get_schedule(schedule_token)
+            jobs = [j for j in self.jobs.values() if j.schedule == schedule_token]
+            self._fires[schedule_token] = self._fires.get(schedule_token, 0) + 1
+            self._schedule_next(s, base_s=at_s)
+        fired = 0
+        for job in jobs:
+            executor = self.executors.get(job.job_type)
+            if executor is None:
+                logger.warning("no executor for job type %s", job.job_type)
+                continue
+            try:
+                executor(job)
+                job.fire_count += 1
+                job.last_fire_s = at_s
+                fired += 1
+            except Exception:
+                logger.exception("scheduled job %s failed", job.token)
+        return fired
+
+    def _tick(self) -> None:
+        for token in self.due_schedules():
+            try:
+                self.fire(token)
+            except EntityNotFound:
+                # deleted between due_schedules() and fire() — drop its slot
+                with self._lock:
+                    self._next.pop(token, None)
+            except Exception:
+                logger.exception("firing schedule %s failed", token)
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            try:
+                self._tick()
+            except Exception:
+                logger.exception("schedule tick failed")
+
+    def start(self) -> None:
+        super().start()
+        self._stop.clear()
+        self._ticker = threading.Thread(target=self._tick_loop, name=self.name, daemon=True)
+        self._ticker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=5)
+            self._ticker = None
+        super().stop()
