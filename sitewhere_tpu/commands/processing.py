@@ -17,7 +17,7 @@ from typing import Callable, Dict, List, Optional
 
 from sitewhere_tpu.commands.destinations import CommandDestination, DeliveryError
 from sitewhere_tpu.commands.model import CommandExecution, CommandInvocation
-from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, LifecycleState
 from sitewhere_tpu.services.common import EntityNotFound, ServiceError
 from sitewhere_tpu.services.device_management import DeviceManagement
 
@@ -54,11 +54,19 @@ class CommandProcessor(LifecycleComponent):
         self.undelivered = 0
 
     def add_destination(self, destination: CommandDestination) -> None:
+        replaced = self.destinations.get(destination.destination_id)
         self.destinations[destination.destination_id] = destination
+        if replaced is not None and isinstance(replaced.provider, LifecycleComponent):
+            if replaced.provider.state == LifecycleState.STARTED:
+                replaced.provider.stop()
+            self._children.remove(replaced.provider)
         # Providers with a lifecycle (e.g. MqttDeliveryProvider owning a
-        # broker connection) start/stop with the processor.
+        # broker connection) start/stop with the processor — including ones
+        # registered after the processor is already running.
         if isinstance(destination.provider, LifecycleComponent):
             self.add_child(destination.provider)
+            if self.state == LifecycleState.STARTED:
+                destination.provider.start()
 
     # -- target resolution + execution build --------------------------------
 

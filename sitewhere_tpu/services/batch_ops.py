@@ -250,7 +250,7 @@ class BatchOperationManager(LifecycleComponent):
                     el.error = "undelivered"
                     failures += 1
             el.processed_s = now_s()
-            if self.throttle_delay_ms:
+            if self.throttle_delay_ms and el is not op.elements[-1]:
                 # Reference: BatchOperationManager throttleDelayMs pacing so
                 # a huge fleet doesn't stampede the delivery path.
                 time.sleep(self.throttle_delay_ms / 1000.0)

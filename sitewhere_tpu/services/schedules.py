@@ -42,18 +42,24 @@ def _parse_field(spec: str, lo: int, hi: int) -> frozenset:
     out = set()
     for part in spec.split(","):
         step = 1
-        if "/" in part:
-            part, step_s = part.split("/", 1)
+        rng = part
+        has_step = "/" in part
+        if has_step:
+            rng, step_s = part.split("/", 1)
             step = int(step_s)
             if step <= 0:
                 raise ValidationError(f"bad cron step {step_s}")
-        if part in ("*", ""):
+        if rng in ("*", ""):
             lo_p, hi_p = lo, hi
-        elif "-" in part:
-            a, b = part.split("-", 1)
+        elif "-" in rng:
+            a, b = rng.split("-", 1)
             lo_p, hi_p = int(a), int(b)
         else:
-            lo_p = hi_p = int(part)
+            lo_p = int(rng)
+            # cron: "a/n" means start at a, step n to the field max.
+            hi_p = hi if has_step else lo_p
+        if lo_p > hi_p:
+            raise ValidationError(f"reversed cron range {part!r}")
         if not (lo <= lo_p <= hi and lo <= hi_p <= hi):
             raise ValidationError(f"cron field {spec} out of range [{lo},{hi}]")
         out.update(range(lo_p, hi_p + 1, step))
@@ -68,7 +74,7 @@ class CronSpec:
     hours: frozenset
     dom: frozenset
     months: frozenset
-    dow: frozenset  # 0=Monday .. 6=Sunday (python weekday)
+    dow: frozenset  # cron numbering: 0=Sunday .. 6=Saturday (7 accepted as Sunday)
 
     @classmethod
     def parse(cls, expr: str) -> "CronSpec":
@@ -79,7 +85,7 @@ class CronSpec:
             hours=_parse_field(fields[1], 0, 23),
             dom=_parse_field(fields[2], 1, 31),
             months=_parse_field(fields[3], 1, 12),
-            dow=_parse_field(fields[4], 0, 6),
+            dow=frozenset(d % 7 for d in _parse_field(fields[4], 0, 7)),
         )
 
     def matches(self, t: time.struct_time) -> bool:
@@ -88,7 +94,7 @@ class CronSpec:
             and t.tm_hour in self.hours
             and t.tm_mday in self.dom
             and t.tm_mon in self.months
-            and t.tm_wday in self.dow
+            and (t.tm_wday + 1) % 7 in self.dow
         )
 
     def next_fire(self, after_s: int, horizon_days: int = 366) -> Optional[int]:
@@ -105,7 +111,7 @@ class CronSpec:
             if not (
                 st.tm_mday in self.dom
                 and st.tm_mon in self.months
-                and st.tm_wday in self.dow
+                and (st.tm_wday + 1) % 7 in self.dow
             ):
                 # jump to the next local midnight (sec offset keeps t
                 # minute-aligned; DST shifts are re-checked next loop)

@@ -100,7 +100,7 @@ def test_batch_throttle_paces(stack):
     op = mgr.create_batch_command_invocation("ping", devices=["d-0", "d-1", "d-2"])
     t0 = time.monotonic()
     mgr.process_now(op.token)
-    assert time.monotonic() - t0 >= 0.05  # 3 elements × 20ms
+    assert time.monotonic() - t0 >= 0.04  # 2 inter-element gaps × 20ms
 
     with pytest.raises(ValidationError):
         mgr.create_batch_command_invocation("ping", devices=[])
@@ -123,6 +123,26 @@ def test_cron_spec():
         CronSpec.parse("61 * * * *")
     with pytest.raises(ValidationError):
         CronSpec.parse("* * *")
+
+
+def test_cron_dow_is_cron_numbering():
+    # Standard cron: 0 (and 7) = Sunday.  2026-08-02 is a Sunday.
+    sunday_noon = CronSpec.parse("0 12 * * 0")
+    base = int(time.mktime((2026, 8, 2, 0, 0, 0, 0, 0, -1)))
+    t = time.localtime(sunday_noon.next_fire(base))
+    assert (t.tm_year, t.tm_mon, t.tm_mday, t.tm_hour) == (2026, 8, 2, 12)
+    assert CronSpec.parse("0 12 * * 7").dow == CronSpec.parse("0 12 * * 0").dow
+    # Mon-Fri must match a Monday (2026-08-03).
+    weekdays = CronSpec.parse("0 9 * * 1-5")
+    t = time.localtime(weekdays.next_fire(base))
+    assert (t.tm_mday, t.tm_wday) == (3, 0)
+
+
+def test_cron_step_and_reversed_range():
+    # "5/15" = start at 5, step 15 to field max (standard cron).
+    assert CronSpec.parse("5/15 * * * *").minutes == frozenset({5, 20, 35, 50})
+    with pytest.raises(ValidationError):
+        CronSpec.parse("0 17-9 * * *")
 
 
 def test_schedule_simple_fire_and_repeat_limit():

@@ -1,0 +1,160 @@
+"""Users, authorities, JWT and request-context security.
+
+The reference's only real unit test is a JWT round-trip
+(``sitewhere-microservice/src/test/java/.../TokenManagementTest.java:28-29``)
+— reproduced here, plus the coverage it lacks (tampering, expiry,
+password hashing, authority gating).
+"""
+
+import pytest
+
+from sitewhere_tpu.security import (
+    SecurityContext,
+    TokenExpired,
+    TokenInvalid,
+    TokenManagement,
+    UserManagement,
+    current_user,
+    require_authority,
+    system_user,
+)
+from sitewhere_tpu.security.context import security_context
+from sitewhere_tpu.security.users import check_password, hash_password
+from sitewhere_tpu.services.common import (
+    AuthError,
+    DuplicateToken,
+    EntityNotFound,
+    ForbiddenError,
+    InvalidReference,
+)
+
+
+class TestTokens:
+    def test_round_trip(self):
+        tm = TokenManagement()
+        tok = tm.mint("admin", ["REST_ACCESS", "ADMINISTER_USERS"])
+        assert tm.username(tok) == "admin"
+        assert tm.authorities(tok) == ["REST_ACCESS", "ADMINISTER_USERS"]
+
+    def test_tenant_claim(self):
+        tm = TokenManagement()
+        tok = tm.mint("ops", [], tenant="acme")
+        assert tm.claims(tok)["tenant"] == "acme"
+
+    def test_tampered_signature_rejected(self):
+        tm = TokenManagement()
+        tok = tm.mint("admin", ["REST_ACCESS"])
+        head, payload, sig = tok.split(".")
+        bad = ".".join([head, payload, sig[:-2] + ("AA" if sig[-2:] != "AA" else "BB")])
+        with pytest.raises(TokenInvalid):
+            tm.claims(bad)
+
+    def test_cross_instance_secret_rejected(self):
+        tok = TokenManagement().mint("admin", [])
+        with pytest.raises(TokenInvalid):
+            TokenManagement().claims(tok)
+
+    def test_shared_secret_verifies(self):
+        a = TokenManagement(secret=b"s" * 32)
+        b = TokenManagement(secret=b"s" * 32)
+        assert b.username(a.mint("admin", [])) == "admin"
+
+    def test_expired(self):
+        tm = TokenManagement()
+        tok = tm.mint("admin", [], expiration_min=1, now_s=1000)
+        assert tm.claims(tok, now_s=1059)["sub"] == "admin"
+        with pytest.raises(TokenExpired):
+            tm.claims(tok, now_s=1061)
+
+    def test_malformed(self):
+        tm = TokenManagement()
+        with pytest.raises(TokenInvalid):
+            tm.claims("not-a-token")
+
+
+class TestPasswords:
+    def test_hash_and_check(self):
+        h = hash_password("s3cret")
+        assert check_password("s3cret", h)
+        assert not check_password("wrong", h)
+
+    def test_salted(self):
+        assert hash_password("x") != hash_password("x")
+
+
+class TestUserManagement:
+    def make(self):
+        um = UserManagement()
+        um.create_user(
+            "admin", "password", first_name="Ada", authorities=["REST_ACCESS", "ADMINISTER_USERS"]
+        )
+        return um
+
+    def test_create_get_list(self):
+        um = self.make()
+        assert um.get_user("admin").first_name == "Ada"
+        um.create_user("bob", "pw")
+        assert [u.username for u in um.list_users()] == ["admin", "bob"]
+
+    def test_duplicate_and_unknown_authority(self):
+        um = self.make()
+        with pytest.raises(DuplicateToken):
+            um.create_user("admin", "pw")
+        with pytest.raises(InvalidReference):
+            um.create_user("eve", "pw", authorities=["NOT_AN_AUTHORITY"])
+
+    def test_authenticate(self):
+        um = self.make()
+        user = um.authenticate("admin", "password")
+        assert user.last_login_s is not None
+        with pytest.raises(AuthError):
+            um.authenticate("admin", "wrong")
+        with pytest.raises(AuthError):
+            um.authenticate("ghost", "pw")
+
+    def test_locked_account_rejected(self):
+        um = self.make()
+        um.update_user("admin", status="locked")
+        with pytest.raises(AuthError):
+            um.authenticate("admin", "password")
+
+    def test_update_password_and_authorities(self):
+        um = self.make()
+        um.update_user("admin", password="new", authorities=["REST_ACCESS"])
+        assert um.authenticate("admin", "new").authorities == ["REST_ACCESS"]
+
+    def test_delete(self):
+        um = self.make()
+        um.delete_user("admin")
+        with pytest.raises(EntityNotFound):
+            um.get_user("admin")
+
+    def test_authority_catalog(self):
+        um = UserManagement()
+        names = [a.authority for a in um.list_granted_authorities()]
+        assert "REST_ACCESS" in names and "ADMINISTER_TENANTS" in names
+        um.create_granted_authority("CUSTOM_THING", "custom")
+        assert um.get_granted_authority("CUSTOM_THING").description == "custom"
+
+
+class TestContext:
+    def test_bind_and_restore(self):
+        assert current_user() is None
+        with security_context(SecurityContext("u", ["REST_ACCESS"])):
+            assert current_user().username == "u"
+            assert require_authority("REST_ACCESS").username == "u"
+        assert current_user() is None
+
+    def test_missing_authority(self):
+        with security_context(SecurityContext("u", [])):
+            with pytest.raises(ForbiddenError):
+                require_authority("ADMINISTER_USERS")
+
+    def test_unauthenticated(self):
+        with pytest.raises(AuthError):
+            require_authority("REST_ACCESS")
+
+    def test_system_user_has_all(self):
+        with system_user(tenant="acme") as ctx:
+            assert ctx.tenant == "acme"
+            require_authority("ADMINISTER_TENANTS")
