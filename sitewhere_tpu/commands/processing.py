@@ -57,9 +57,16 @@ class CommandProcessor(LifecycleComponent):
         replaced = self.destinations.get(destination.destination_id)
         self.destinations[destination.destination_id] = destination
         if replaced is not None and isinstance(replaced.provider, LifecycleComponent):
-            if replaced.provider.state == LifecycleState.STARTED:
-                replaced.provider.stop()
-            self._children.remove(replaced.provider)
+            # Providers can be shared across destinations (one broker
+            # connection, many routes) — only retire one no longer
+            # referenced by any destination.
+            still_used = any(
+                d.provider is replaced.provider for d in self.destinations.values()
+            )
+            if not still_used:
+                if replaced.provider.state == LifecycleState.STARTED:
+                    replaced.provider.stop()
+                self._children.remove(replaced.provider)
         # Providers with a lifecycle (e.g. MqttDeliveryProvider owning a
         # broker connection) start/stop with the processor — including ones
         # registered after the processor is already running.

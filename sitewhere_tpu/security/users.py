@@ -28,6 +28,7 @@ from sitewhere_tpu.services.common import (
     now_s,
     paged,
     require,
+    update_fields,
 )
 
 # The authority catalog — the reference ships a fixed authority hierarchy
@@ -166,22 +167,26 @@ class UserManagement:
         replaces the grant list (reference: updateUser + updateUserAuthorities)."""
         with self._lock:
             user = self.get_user(username)
-            password = fields.pop("password", None)
-            if password is not None:
-                user.hashed_password = hash_password(password)
-            auths = fields.pop("authorities", None)
-            if auths is not None:
-                for a in auths:
-                    require(
-                        a in self._authorities,
-                        InvalidReference(f"unknown authority {a!r}"),
-                    )
-                user.authorities = list(auths)
-            for key in ("first_name", "last_name", "status", "metadata"):
-                if key in fields:
-                    setattr(user, key, fields.pop(key))
-            require(not fields, ValidationError(f"unknown fields {sorted(fields)}"))
-            user.touch()
+
+            def validate(f):
+                if "authorities" in f:
+                    f["authorities"] = list(f["authorities"])
+                    for a in f["authorities"]:
+                        require(
+                            a in self._authorities,
+                            InvalidReference(f"unknown authority {a!r}"),
+                        )
+                if "password" in f:
+                    # hash_password validates (raises before any write) and
+                    # the hash replaces the plaintext in the field dict.
+                    f["hashed_password"] = hash_password(f.pop("password"))
+
+            update_fields(
+                user,
+                fields,
+                ("password", "authorities", "first_name", "last_name", "status", "metadata"),
+                validate,
+            )
             return user
 
     def delete_user(self, username: str) -> User:

@@ -129,3 +129,25 @@ class Entity:
 def require(condition: bool, error: ServiceError) -> None:
     if not condition:
         raise error
+
+
+def update_fields(
+    entity: Entity,
+    fields: Dict[str, object],
+    allowed: Iterable[str],
+    validate: Optional[Callable[[Dict[str, object]], None]] = None,
+) -> None:
+    """Validate-then-apply entity update (reference: the update half of
+    ``Persistence.java`` create/update validation).
+
+    All checks — unknown fields and the optional ``validate`` hook — run
+    before any attribute is written, so a rejected update never leaves a
+    partial write behind.
+    """
+    unknown = set(fields) - set(allowed)
+    require(not unknown, ValidationError(f"unknown fields {sorted(unknown)}"))
+    if validate is not None:
+        validate(fields)
+    for key, value in fields.items():
+        setattr(entity, key, value)
+    entity.touch()

@@ -75,6 +75,10 @@ class CronSpec:
     dom: frozenset
     months: frozenset
     dow: frozenset  # cron numbering: 0=Sunday .. 6=Saturday (7 accepted as Sunday)
+    # Vixie-cron day rule: when BOTH day fields are restricted (neither was
+    # "*"), a day matches if EITHER matches; otherwise both must match.
+    dom_star: bool = True
+    dow_star: bool = True
 
     @classmethod
     def parse(cls, expr: str) -> "CronSpec":
@@ -86,15 +90,23 @@ class CronSpec:
             dom=_parse_field(fields[2], 1, 31),
             months=_parse_field(fields[3], 1, 12),
             dow=frozenset(d % 7 for d in _parse_field(fields[4], 0, 7)),
+            dom_star=fields[2] == "*",
+            dow_star=fields[4] == "*",
         )
+
+    def _day_matches(self, t: time.struct_time) -> bool:
+        dom_ok = t.tm_mday in self.dom
+        dow_ok = (t.tm_wday + 1) % 7 in self.dow
+        if not self.dom_star and not self.dow_star:
+            return dom_ok or dow_ok
+        return dom_ok and dow_ok
 
     def matches(self, t: time.struct_time) -> bool:
         return (
             t.tm_min in self.minutes
             and t.tm_hour in self.hours
-            and t.tm_mday in self.dom
             and t.tm_mon in self.months
-            and (t.tm_wday + 1) % 7 in self.dow
+            and self._day_matches(t)
         )
 
     def next_fire(self, after_s: int, horizon_days: int = 366) -> Optional[int]:
@@ -108,11 +120,7 @@ class CronSpec:
         end = after_s + horizon_days * 86400
         while t <= end:
             st = time.localtime(t)
-            if not (
-                st.tm_mday in self.dom
-                and st.tm_mon in self.months
-                and (st.tm_wday + 1) % 7 in self.dow
-            ):
+            if not (st.tm_mon in self.months and self._day_matches(st)):
                 # jump to the next local midnight (sec offset keeps t
                 # minute-aligned; DST shifts are re-checked next loop)
                 t += (

@@ -1,0 +1,134 @@
+"""Asset catalog: types, assets, dense-id binding to the registry."""
+
+import pytest
+
+from sitewhere_tpu.ids import IdentityMap, NULL_ID
+from sitewhere_tpu.services.assets import AssetManagement
+from sitewhere_tpu.services.common import (
+    DuplicateToken,
+    EntityNotFound,
+    InvalidReference,
+    ValidationError,
+)
+from sitewhere_tpu.services.device_management import DeviceManagement, RegistryMirror
+
+
+@pytest.fixture
+def am():
+    mgmt = AssetManagement("default", IdentityMap(capacity=1024))
+    mgmt.create_asset_type("person", name="Person", category="person")
+    return mgmt
+
+
+class TestAssetTypes:
+    def test_crud(self, am):
+        am.create_asset_type("tracker", name="GPS Tracker", category="hardware")
+        assert am.get_asset_type("tracker").category == "hardware"
+        am.update_asset_type("tracker", description="handheld")
+        assert am.get_asset_type("tracker").description == "handheld"
+        assert [t.token for t in am.list_asset_types()] == ["person", "tracker"]
+        am.delete_asset_type("tracker")
+        with pytest.raises(EntityNotFound):
+            am.get_asset_type("tracker")
+
+    def test_validation(self, am):
+        with pytest.raises(DuplicateToken):
+            am.create_asset_type("person", name="Again")
+        with pytest.raises(ValidationError):
+            am.create_asset_type("x", name="X", category="spaceship")
+        with pytest.raises(ValidationError):
+            am.create_asset_type("y")  # no name
+
+    def test_delete_in_use_refused(self, am):
+        am.create_asset("ada", name="Ada", asset_type="person")
+        with pytest.raises(InvalidReference):
+            am.delete_asset_type("person")
+
+
+class TestAssets:
+    def test_crud_and_dense_ids(self, am):
+        a = am.create_asset("ada", name="Ada Lovelace", asset_type="person")
+        aid = am.asset_dense_id("ada")
+        assert aid != NULL_ID
+        assert am.get_asset_by_id(aid) is a
+        am.update_asset("ada", name="A. Lovelace")
+        assert am.get_asset("ada").name == "A. Lovelace"
+        am.delete_asset("ada")
+        with pytest.raises(EntityNotFound):
+            am.get_asset("ada")
+
+    def test_unknown_type_rejected(self, am):
+        with pytest.raises(InvalidReference):
+            am.create_asset("x", name="X", asset_type="nope")
+
+    def test_rejected_update_leaves_no_partial_write(self, am):
+        with pytest.raises(ValidationError):
+            am.update_asset_type("person", category="spaceship")
+        assert am.get_asset_type("person").category == "person"
+        am.create_asset("ada", name="Ada", asset_type="person")
+        with pytest.raises(InvalidReference):
+            am.update_asset("ada", name="Changed", asset_type="nope")
+        assert am.get_asset("ada").name == "Ada"
+
+    def test_deleted_asset_handle_not_recycled(self, am):
+        am.create_asset("ada", name="Ada", asset_type="person")
+        aid = am.asset_dense_id("ada")
+        am.delete_asset("ada")
+        am.create_asset("someone-else", name="Eve", asset_type="person")
+        # Old handle must not resolve to the new asset.
+        with pytest.raises(EntityNotFound):
+            am.get_asset_by_id(aid)
+        # Recreating the same token reclaims the same handle.
+        am.create_asset("ada", name="Ada II", asset_type="person")
+        assert am.asset_dense_id("ada") == aid
+
+    def test_list_filter_by_type(self, am):
+        am.create_asset_type("hw", name="HW", category="hardware")
+        am.create_asset("ada", name="Ada", asset_type="person")
+        am.create_asset("widget", name="W", asset_type="hw")
+        assert [a.token for a in am.list_assets(asset_type="person")] == ["ada"]
+        assert len(am.list_assets()) == 2
+
+    def test_tenant_isolation(self):
+        identity = IdentityMap(capacity=1024)
+        a = AssetManagement("t-a", identity)
+        b = AssetManagement("t-b", identity)
+        a.create_asset_type("person", name="P", category="person")
+        b.create_asset_type("person", name="P", category="person")
+        a.create_asset("ada", name="Ada", asset_type="person")
+        b.create_asset("ada", name="Other Ada", asset_type="person")
+        id_a, id_b = a.asset_dense_id("ada"), b.asset_dense_id("ada")
+        assert id_a != id_b
+        with pytest.raises(EntityNotFound):
+            a.get_asset_by_id(id_b)  # other tenant's handle
+
+
+def test_assignment_asset_binding_shares_handles():
+    """The asset_id a DeviceManagement assignment publishes to the registry
+    resolves through AssetManagement — enrichment output → asset record."""
+    identity = IdentityMap(capacity=1024)
+    dm = DeviceManagement("default", identity, RegistryMirror(1024))
+    am = AssetManagement("default", identity)
+    am.create_asset_type("person", name="Person", category="person")
+    am.create_asset("ada", name="Ada", asset_type="person")
+    dm.create_device_type("mote", name="Mote")
+    dm.create_device("d-1", device_type="mote")
+    dm.create_device_assignment(device="d-1", asset="ada")
+
+    registry = dm.mirror.publish_registry()
+    import numpy as np
+
+    device_id = identity.device.lookup("d-1")  # device tokens are global
+    aid = int(np.asarray(registry.asset_id)[device_id])
+    assert am.get_asset_by_id(aid).name == "Ada"
+
+
+def test_engine_wires_asset_management():
+    from sitewhere_tpu.services.tenants import MultitenantEngineManager, TenantManagement
+
+    tm = TenantManagement()
+    mgr = MultitenantEngineManager(tm)
+    mgr.start()
+    tm.create_tenant("acme", name="Acme")
+    engine = mgr.get_engine("acme")
+    assert engine.asset_management.identity is engine.identity

@@ -138,6 +138,20 @@ def test_cron_dow_is_cron_numbering():
     assert (t.tm_mday, t.tm_wday) == (3, 0)
 
 
+def test_cron_dom_dow_or_semantics():
+    # Vixie cron: "0 0 13 * 5" fires on the 13th OR any Friday.
+    spec = CronSpec.parse("0 0 13 * 5")
+    base = int(time.mktime((2026, 8, 4, 0, 30, 0, 0, 0, -1)))  # Tue Aug 4
+    t = time.localtime(spec.next_fire(base))
+    assert (t.tm_mday, t.tm_wday) == (7, 4)  # Fri Aug 7 (before the 13th)
+    t2 = time.localtime(spec.next_fire(int(time.mktime((2026, 8, 10, 1, 0, 0, 0, 0, -1)))))
+    assert t2.tm_mday == 13  # Thu Aug 13 (before Fri the 14th)
+    # Restricted dom + star dow still ANDs.
+    only13 = CronSpec.parse("0 0 13 * *")
+    t3 = time.localtime(only13.next_fire(base))
+    assert t3.tm_mday == 13
+
+
 def test_cron_step_and_reversed_range():
     # "5/15" = start at 5, step 15 to field max (standard cron).
     assert CronSpec.parse("5/15 * * * *").minutes == frozenset({5, 20, 35, 50})

@@ -123,6 +123,15 @@ class TestUserManagement:
         um.update_user("admin", password="new", authorities=["REST_ACCESS"])
         assert um.authenticate("admin", "new").authorities == ["REST_ACCESS"]
 
+    def test_rejected_update_leaves_no_partial_write(self):
+        um = self.make()
+        with pytest.raises(Exception):
+            um.update_user("admin", password="changed", stattus="locked")  # typo'd field
+        um.authenticate("admin", "password")  # old password still valid
+        with pytest.raises(InvalidReference):
+            um.update_user("admin", password="changed", authorities=["NOPE"])
+        um.authenticate("admin", "password")
+
     def test_delete(self):
         um = self.make()
         um.delete_user("admin")

@@ -1,0 +1,142 @@
+"""Tenant CRUD, templates, and the per-tenant engine manager."""
+
+import pytest
+
+from sitewhere_tpu.services.common import (
+    DuplicateToken,
+    EntityNotFound,
+    InvalidReference,
+    ValidationError,
+)
+from sitewhere_tpu.services.tenants import (
+    DatasetTemplate,
+    MultitenantEngineManager,
+    TenantManagement,
+    TenantTemplate,
+)
+
+
+@pytest.fixture
+def tm():
+    return TenantManagement()
+
+
+class TestTenantCrud:
+    def test_create_get_update_delete(self, tm):
+        t = tm.create_tenant("acme", name="Acme Corp")
+        assert t.auth_token  # generated
+        assert tm.get_tenant("acme").name == "Acme Corp"
+        tm.update_tenant("acme", name="Acme Inc", logo_url="http://x/logo.png")
+        assert tm.get_tenant("acme").name == "Acme Inc"
+        tm.delete_tenant("acme")
+        with pytest.raises(EntityNotFound):
+            tm.get_tenant("acme")
+
+    def test_validation(self, tm):
+        with pytest.raises(ValidationError):
+            tm.create_tenant("t1")  # no name
+        tm.create_tenant("t1", name="One")
+        with pytest.raises(DuplicateToken):
+            tm.create_tenant("t1", name="Again")
+        with pytest.raises(InvalidReference):
+            tm.create_tenant("t2", name="Two", tenant_template_id="nope")
+        with pytest.raises(ValidationError):
+            tm.update_tenant("t1", bogus_field=1)
+
+    def test_auth_token_lookup(self, tm):
+        t = tm.create_tenant("acme", name="Acme", auth_token="sekrit")
+        assert tm.get_tenant_by_auth_token("sekrit") is t
+        assert tm.get_tenant_by_auth_token("nope") is None
+
+    def test_authorized_users(self, tm):
+        tm.create_tenant("acme", name="Acme", authorized_user_ids=["ada"])
+        assert tm.authorized_for("acme", "ada")
+        assert not tm.authorized_for("acme", "eve")
+        tm.create_tenant("open", name="Open")  # empty list = everyone
+        assert tm.authorized_for("open", "anyone")
+
+    def test_paging(self, tm):
+        for i in range(5):
+            tm.create_tenant(f"t{i}", name=f"T{i}")
+        from sitewhere_tpu.services.common import SearchCriteria
+
+        page = tm.list_tenants(SearchCriteria(page=2, page_size=2))
+        assert page.total == 5 and [t.token for t in page] == ["t2", "t3"]
+
+
+class TestTemplates:
+    def test_catalog(self, tm):
+        tm.add_tenant_template(TenantTemplate(id="big", name="Big", config={"registry_capacity": 128}))
+        ids = [t.id for t in tm.list_tenant_templates()]
+        assert ids == ["big", "empty"]
+        assert tm.get_tenant_template("big").config["registry_capacity"] == 128
+        with pytest.raises(EntityNotFound):
+            tm.get_dataset_template("nope")
+
+
+class TestEngineManager:
+    def test_engines_follow_tenant_lifecycle(self, tm):
+        mgr = MultitenantEngineManager(tm)
+        tm.create_tenant("pre", name="Pre-existing")
+        mgr.start()
+        assert mgr.get_engine("pre").state.name == "STARTED"
+        # Engines spin up on create and down on delete (the
+        # tenant-model-updates topic analog).
+        tm.create_tenant("live", name="Created live")
+        engine = mgr.get_engine("live")
+        assert engine.state.name == "STARTED"
+        assert engine.device_management.tenant == "live"
+        tm.delete_tenant("live")
+        with pytest.raises(EntityNotFound):
+            mgr.get_engine("live")
+        mgr.stop()
+        assert mgr.get_engine("pre").state.name == "STOPPED"
+
+    def test_template_config_applies(self, tm):
+        tm.add_tenant_template(
+            TenantTemplate(id="tiny", name="Tiny", config={"registry_capacity": 64})
+        )
+        mgr = MultitenantEngineManager(tm)
+        mgr.start()
+        tm.create_tenant("small", name="S", tenant_template_id="tiny")
+        engine = mgr.get_engine("small")
+        assert engine.mirror.capacity == 64
+
+    def test_dataset_initializer_runs_once(self, tm):
+        calls = []
+
+        def seed(engine):
+            calls.append(engine.tenant.token)
+            engine.device_management.create_device_type("default-type", name="Default")
+
+        tm.add_dataset_template(DatasetTemplate(id="seeded", name="Seeded", initialize=seed))
+        mgr = MultitenantEngineManager(tm)
+        mgr.start()
+        tm.create_tenant("acme", name="Acme", dataset_template_id="seeded")
+        engine = mgr.get_engine("acme")
+        assert engine.device_management.get_device_type("default-type").name == "Default"
+        mgr.restart_engine("acme")
+        assert calls == ["acme"]  # bootstrapped marker prevents re-seeding
+
+    def test_dense_tenant_ids_stable_across_restart(self, tm):
+        mgr = MultitenantEngineManager(tm)
+        mgr.start()
+        tm.create_tenant("a", name="A")
+        tm.create_tenant("b", name="B")
+        id_a = mgr.get_engine("a").tenant_id
+        id_b = mgr.get_engine("b").tenant_id
+        assert id_a != id_b
+        assert mgr.restart_engine("a").tenant_id == id_a
+
+    def test_attach_extra_component(self, tm):
+        from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+
+        mgr = MultitenantEngineManager(tm)
+        mgr.start()
+        tm.create_tenant("a", name="A")
+        engine = mgr.get_engine("a")
+        comp = LifecycleComponent("extra")
+        engine.attach("extra", comp)
+        assert comp.state.name == "STARTED"  # started because engine is live
+        engine.stop()
+        assert comp.state.name == "STOPPED"
