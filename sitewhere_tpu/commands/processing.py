@@ -71,7 +71,8 @@ class CommandProcessor(LifecycleComponent):
         # broker connection) start/stop with the processor — including ones
         # registered after the processor is already running.
         if isinstance(destination.provider, LifecycleComponent):
-            self.add_child(destination.provider)
+            if destination.provider not in self._children:  # shared providers register once
+                self.add_child(destination.provider)
             if self.state == LifecycleState.STARTED:
                 destination.provider.start()
 

@@ -90,8 +90,10 @@ class CronSpec:
             dom=_parse_field(fields[2], 1, 31),
             months=_parse_field(fields[3], 1, 12),
             dow=frozenset(d % 7 for d in _parse_field(fields[4], 0, 7)),
-            dom_star=fields[2] == "*",
-            dow_star=fields[4] == "*",
+            # Vixie sets the star flag when the field BEGINS with '*'
+            # ("*/2" counts as star for the day-OR rule).
+            dom_star=fields[2].startswith("*"),
+            dow_star=fields[4].startswith("*"),
         )
 
     def _day_matches(self, t: time.struct_time) -> bool:

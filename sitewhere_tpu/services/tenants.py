@@ -312,6 +312,9 @@ class MultitenantEngineManager(LifecycleComponent):
         with self._lock:
             engine = self._engines.get(tenant.token)
             if engine is not None:
+                # Manager restart path: re-start engines parked by stop().
+                if engine.state != LifecycleState.STARTED:
+                    engine.start()
                 return engine
             template = self.tenants.get_tenant_template(tenant.tenant_template_id)
             engine = self.engine_factory(
@@ -323,7 +326,15 @@ class MultitenantEngineManager(LifecycleComponent):
                 # Bootstrap content exactly once (reference: dataset-bootstrapped
                 # marker in Zk makes initialization idempotent).
                 if not engine.tenant.metadata.get("dataset_bootstrapped"):
-                    dataset.initialize(engine)
+                    try:
+                        dataset.initialize(engine)
+                    except BaseException:
+                        # A failed bootstrap must not leak a running engine
+                        # nor register it — the tenant stays engine-less and
+                        # a later _ensure_engine (event or manager restart)
+                        # retries from scratch.
+                        engine.stop()
+                        raise
                     engine.tenant.metadata["dataset_bootstrapped"] = "true"
             self._engines[tenant.token] = engine
             return engine

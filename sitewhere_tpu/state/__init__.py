@@ -1,0 +1,24 @@
+"""Device state: last-known-state materialization + presence detection.
+
+Reference: ``service-device-state`` — enriched events merge into per-device
+``IDeviceState`` documents (``processing/DeviceStateProcessingLogic.java:
+46-80``) and a background presence thread marks devices missing after an
+interval, emitting StateChange events through a notification strategy
+(``presence/DevicePresenceManager.java:49-88``,
+``PresenceNotificationStrategies.java``).
+
+TPU-first reshape: the merge already happens *inside* the fused pipeline
+step (:func:`sitewhere_tpu.pipeline.update_device_state` — time-ordered
+scatters); this package owns the resulting :class:`DeviceState` tensors on
+the host side: the query surface over them, and the presence sweep — a
+single jitted vectorized pass over all devices instead of a per-device
+scan loop.
+"""
+
+from sitewhere_tpu.state.manager import DeviceStateManager
+from sitewhere_tpu.state.presence import (
+    PresenceManager,
+    presence_sweep,
+)
+
+__all__ = ["DeviceStateManager", "PresenceManager", "presence_sweep"]

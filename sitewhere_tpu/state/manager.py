@@ -1,0 +1,147 @@
+"""DeviceStateManager: owner + query surface of the DeviceState tensors.
+
+Reference: ``service-device-state`` is the queryable materialized view of
+last-known device state (``grpc/DeviceStateImpl.java`` + Mongo persistence
+``MongoDeviceStateManagement``) fed by the enriched-events consumer.  Here
+the view *is* the :class:`~sitewhere_tpu.schema.DeviceState` pytree the
+pipeline step threads through every batch; this manager holds the current
+epoch, applies step outputs, answers host queries (single-device reads,
+missing/recent scans), and runs the presence sweep against it.
+
+Device-resident by design: queries that scan all devices (missing list,
+recently-seen) are vectorized reductions on device, with only the
+resulting indices/rows copied back.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from sitewhere_tpu.ids import NULL_ID, IdentityMap
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.schema import DeviceState, EventBatch, EventType
+from sitewhere_tpu.services.common import EntityNotFound, require
+from sitewhere_tpu.state.presence import missing_state_changes, presence_sweep
+
+
+class DeviceStateManager(LifecycleComponent):
+    """Holds the authoritative :class:`DeviceState` epoch.
+
+    The pipeline dispatcher calls :meth:`commit` with each step's
+    ``new_state``; readers get consistent snapshots.  ``tenant_ids`` for
+    presence StateChange emission come from the registry mirror columns
+    (the enrichment source of truth).
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        identity: IdentityMap,
+        num_mtype_slots: int = 8,
+        tenant_id_of_device=None,  # Callable[[np.ndarray], np.ndarray]
+    ):
+        super().__init__(name="device-state-manager")
+        self.identity = identity
+        self._lock = threading.RLock()
+        self._state = DeviceState.empty(capacity, num_mtype_slots)
+        self._tenant_id_of_device = tenant_id_of_device
+
+    # -- epoch plumbing ----------------------------------------------------
+
+    @property
+    def current(self) -> DeviceState:
+        with self._lock:
+            return self._state
+
+    def commit(self, new_state: DeviceState) -> None:
+        """Adopt a pipeline step's output state (the merge already ran on
+        device inside the step)."""
+        with self._lock:
+            self._state = new_state
+
+    # -- presence ----------------------------------------------------------
+
+    def apply_presence_sweep(
+        self, now_s: int, missing_after_s: int
+    ) -> Optional[EventBatch]:
+        """Run the jitted sweep, adopt the flagged state, and build the
+        STATE_CHANGE batch for newly-missing devices (None if none)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            new_state, newly_missing = presence_sweep(
+                self._state, jnp.int32(now_s), jnp.int32(missing_after_s)
+            )
+            self._state = new_state
+        mask = np.asarray(newly_missing)
+        if self._tenant_id_of_device is not None:
+            tenant_ids = self._tenant_id_of_device(np.arange(mask.size))
+        else:
+            tenant_ids = np.zeros(mask.size, np.int32)
+        return missing_state_changes(mask, tenant_ids, now_s)
+
+    # -- queries (reference: DeviceStateImpl RPCs) --------------------------
+
+    def get_device_state(self, device_token: str) -> Dict[str, object]:
+        """Last-known state for one device, as a host dict."""
+        device_id = self.identity.device.lookup(device_token)
+        require(
+            device_id != NULL_ID, EntityNotFound(f"no device {device_token!r}")
+        )
+        return self.get_device_state_by_id(int(device_id))
+
+    def get_device_state_by_id(self, device_id: int) -> Dict[str, object]:
+        with self._lock:
+            s = self._state
+        require(
+            0 <= device_id < s.capacity, EntityNotFound(f"bad device id {device_id}")
+        )
+        row = {
+            "device_id": device_id,
+            "last_event_ts_s": int(np.asarray(s.last_event_ts_s[device_id])),
+            "last_event_type": int(np.asarray(s.last_event_type[device_id])),
+            "presence_missing": bool(np.asarray(s.presence_missing[device_id])),
+            "last_location": {
+                "lat": float(np.asarray(s.last_lat[device_id])),
+                "lon": float(np.asarray(s.last_lon[device_id])),
+                "elevation": float(np.asarray(s.last_elevation[device_id])),
+                "ts_s": int(np.asarray(s.last_location_ts_s[device_id])),
+            },
+            "last_alert": {
+                "code": int(np.asarray(s.last_alert_code[device_id])),
+                "ts_s": int(np.asarray(s.last_alert_ts_s[device_id])),
+            },
+            "last_values": np.asarray(s.last_values[device_id]).tolist(),
+            "last_value_ts_s": np.asarray(s.last_value_ts_s[device_id]).tolist(),
+        }
+        if row["last_event_type"] == NULL_ID:
+            row["last_event_type"] = None
+        return row
+
+    def missing_device_ids(self) -> List[int]:
+        """Devices currently flagged missing (vectorized scan + index copy)."""
+        with self._lock:
+            mask = np.asarray(self._state.presence_missing)
+        return [int(i) for i in np.nonzero(mask)[0]]
+
+    def seen_since(self, since_s: int) -> List[int]:
+        """Devices with any event at/after ``since_s``."""
+        with self._lock:
+            s = self._state
+            mask = np.asarray(
+                (s.last_event_type != NULL_ID) & (s.last_event_ts_s >= since_s)
+            )
+        return [int(i) for i in np.nonzero(mask)[0]]
+
+    def summary(self) -> Dict[str, int]:
+        with self._lock:
+            s = self._state
+            has = np.asarray(s.last_event_type != NULL_ID)
+            missing = np.asarray(s.presence_missing)
+        return {
+            "devices_with_state": int(has.sum()),
+            "devices_missing": int(missing.sum()),
+        }

@@ -1,0 +1,143 @@
+"""Presence detection: vectorized missing-device sweep.
+
+Reference: ``service-device-state/.../presence/DevicePresenceManager.java``
+— a background thread (default check every 10m) queries assignments whose
+last interaction predates the missing interval (default 8h) and fires
+StateChange events via ``PresenceNotificationStrategies.
+SendOnceNotificationStrategy`` (notify once per missing episode).
+
+Here the scan is one jitted pass over the ``DeviceState`` columns: a
+device is *newly missing* when it has seen at least one event, is not
+already flagged, and its last event is older than the missing interval.
+Send-once falls out of the ``presence_missing`` flag itself (the pipeline
+step clears it on any accepted event, re-arming notification).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.ids import NULL_ID
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.schema import DeviceState, EventBatch, EventType
+
+logger = logging.getLogger("sitewhere_tpu.state.presence")
+
+# StateChange codes carried in the alert_code column of STATE_CHANGE events
+# (reference: IDeviceStateChangeCreateRequest category/type strings
+# "presence"/"missing").
+STATE_CHANGE_PRESENCE_MISSING = 1
+
+
+@jax.jit
+def presence_sweep(
+    state: DeviceState, now_s: jax.Array, missing_after_s: jax.Array
+) -> Tuple[DeviceState, jax.Array]:
+    """One vectorized presence pass.
+
+    Returns ``(new_state, newly_missing)`` where ``newly_missing`` is a
+    ``bool[D]`` mask of devices flagged by THIS sweep (the send-once set).
+    """
+    has_events = state.last_event_type != NULL_ID
+    overdue = (now_s - state.last_event_ts_s) > missing_after_s
+    newly_missing = has_events & overdue & ~state.presence_missing
+    return (
+        state.replace(presence_missing=state.presence_missing | newly_missing),
+        newly_missing,
+    )
+
+
+def missing_state_changes(
+    newly_missing: np.ndarray, tenant_ids: np.ndarray, now_s: int
+) -> Optional[EventBatch]:
+    """Build a STATE_CHANGE event batch for newly-missing devices.
+
+    Host-side (variable count → exact-width batch) — re-injected through
+    the normal ingest path like the reference's presence StateChange events
+    flow back through event management.
+    """
+    (idx,) = np.nonzero(newly_missing)
+    if idx.size == 0:
+        return None
+    width = int(idx.size)
+    batch = EventBatch.empty(width)
+    return batch.replace(
+        valid=jnp.ones(width, bool),
+        device_id=jnp.asarray(idx.astype(np.int32)),
+        tenant_id=jnp.asarray(tenant_ids[idx].astype(np.int32)),
+        event_type=jnp.full(width, EventType.STATE_CHANGE, jnp.int32),
+        ts_s=jnp.full(width, now_s, jnp.int32),
+        alert_code=jnp.full(width, STATE_CHANGE_PRESENCE_MISSING, jnp.int32),
+    )
+
+
+class PresenceManager(LifecycleComponent):
+    """Background presence checker over a :class:`DeviceStateManager`.
+
+    ``on_state_changes`` receives the STATE_CHANGE :class:`EventBatch` for
+    each sweep that found newly-missing devices (the notification-strategy
+    hook); wire it to the ingest path for re-injection.
+    """
+
+    def __init__(
+        self,
+        state_manager,  # DeviceStateManager
+        check_interval_s: float = 600.0,  # reference default "10m"
+        missing_after_s: int = 8 * 3600,  # reference default "8h"
+        on_state_changes: Optional[Callable[[EventBatch], None]] = None,
+        clock: Callable[[], float] = None,
+    ):
+        super().__init__(name="presence-manager")
+        self.state_manager = state_manager
+        self.check_interval_s = check_interval_s
+        self.missing_after_s = missing_after_s
+        self.on_state_changes = on_state_changes
+        self._clock = clock or __import__("time").time
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.sweeps = 0
+        self.total_marked_missing = 0
+
+    def sweep_once(self, now_s: Optional[int] = None) -> int:
+        """Run one sweep; returns how many devices were newly marked.
+
+        Reference: one iteration of the ``PresenceChecker`` loop.
+        """
+        now = int(self._clock()) if now_s is None else now_s
+        marked = self.state_manager.apply_presence_sweep(now, self.missing_after_s)
+        self.sweeps += 1
+        if marked is not None:
+            count = int(marked.valid.sum())
+            self.total_marked_missing += count
+            if self.on_state_changes is not None:
+                self.on_state_changes(marked)
+            return count
+        return 0
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_interval_s):
+            try:
+                self.sweep_once()
+            except Exception:
+                logger.exception("presence sweep failed")
+
+    def start(self) -> None:
+        super().start()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="presence-checker", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        super().stop()

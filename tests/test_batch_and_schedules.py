@@ -150,6 +150,10 @@ def test_cron_dom_dow_or_semantics():
     only13 = CronSpec.parse("0 0 13 * *")
     t3 = time.localtime(only13.next_fire(base))
     assert t3.tm_mday == 13
+    # "*/2" counts as a star field for the day rule (Vixie): ANDs with dow.
+    stepped = CronSpec.parse("0 0 */2 * 1")
+    t4 = time.localtime(stepped.next_fire(base))
+    assert t4.tm_wday == 0 and t4.tm_mday % 2 == 1  # a Monday on an odd day
 
 
 def test_cron_step_and_reversed_range():

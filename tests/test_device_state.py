@@ -1,0 +1,167 @@
+"""Device-state manager + presence detection.
+
+Reference behaviors covered: last-known-state merge visibility through the
+query surface (DeviceStateImpl RPC analogs), presence sweep marking
+overdue devices (DevicePresenceManager), send-once notification semantics,
+and re-arming when a device comes back.
+"""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.ids import IdentityMap, NULL_ID
+from sitewhere_tpu.pipeline import pipeline_step
+from sitewhere_tpu.schema import DeviceState, EventType, RuleTable, ZoneTable
+from sitewhere_tpu.services.common import EntityNotFound
+from sitewhere_tpu.state import DeviceStateManager, PresenceManager, presence_sweep
+
+from helpers import make_batch, make_registry, measurement, location
+
+
+CAP = 64
+
+
+@pytest.fixture
+def identity():
+    im = IdentityMap(capacity=CAP)
+    for i in range(8):
+        assert im.device.mint(f"dev-{i}") == i
+    return im
+
+
+@pytest.fixture
+def manager(identity):
+    return DeviceStateManager(CAP, identity)
+
+
+def run_step(manager, rows):
+    registry = make_registry(capacity=CAP, n_devices=8)
+    rules = RuleTable.empty(4)
+    zones = ZoneTable.empty(4)
+    new_state, out = pipeline_step(
+        registry, manager.current, rules, zones, make_batch(rows)
+    )
+    manager.commit(new_state)
+    return out
+
+
+class TestStateManager:
+    def test_merge_visible_through_queries(self, manager):
+        run_step(
+            manager,
+            [
+                measurement(0, mtype=1, value=42.5, ts=5000),
+                location(3, lat=10.0, lon=20.0, ts=6000),
+            ],
+        )
+        s0 = manager.get_device_state("dev-0")
+        assert s0["last_event_type"] == EventType.MEASUREMENT
+        assert s0["last_event_ts_s"] == 5000
+        assert s0["last_values"][1] == 42.5
+        s3 = manager.get_device_state("dev-3")
+        assert s3["last_location"]["lat"] == 10.0
+        assert s3["last_location"]["lon"] == 20.0
+        # Device with no events yet.
+        assert manager.get_device_state("dev-7")["last_event_type"] is None
+
+    def test_unknown_device(self, manager):
+        with pytest.raises(EntityNotFound):
+            manager.get_device_state("nope")
+
+    def test_seen_since_and_summary(self, manager):
+        run_step(manager, [measurement(0, ts=1000), measurement(1, ts=9000)])
+        assert manager.seen_since(5000) == [1]
+        assert manager.summary()["devices_with_state"] == 2
+
+
+class TestPresenceSweep:
+    def test_overdue_devices_marked(self, manager):
+        run_step(manager, [measurement(0, ts=1000), measurement(1, ts=50_000)])
+        batch = manager.apply_presence_sweep(now_s=60_000, missing_after_s=30_000)
+        # dev-0 is 59k stale (> 30k) → missing; dev-1 is 10k stale → present.
+        assert manager.missing_device_ids() == [0]
+        assert batch is not None
+        ids = np.asarray(batch.device_id)[np.asarray(batch.valid)]
+        assert list(ids) == [0]
+        assert int(np.asarray(batch.event_type)[0]) == EventType.STATE_CHANGE
+
+    def test_devices_without_events_ignored(self, manager):
+        batch = manager.apply_presence_sweep(now_s=10**9, missing_after_s=1)
+        assert batch is None
+        assert manager.missing_device_ids() == []
+
+    def test_send_once(self, manager):
+        run_step(manager, [measurement(0, ts=1000)])
+        assert manager.apply_presence_sweep(50_000, 30_000) is not None
+        # Second sweep: still missing, but not NEWLY missing → no batch.
+        assert manager.apply_presence_sweep(60_000, 30_000) is None
+
+    def test_rearm_on_return(self, manager):
+        run_step(manager, [measurement(0, ts=1000)])
+        manager.apply_presence_sweep(50_000, 30_000)
+        assert manager.missing_device_ids() == [0]
+        # Device comes back: pipeline step clears the flag...
+        run_step(manager, [measurement(0, ts=55_000)])
+        assert manager.missing_device_ids() == []
+        # ...and a later lapse notifies again.
+        assert manager.apply_presence_sweep(100_000, 30_000) is not None
+
+
+class TestPresenceManager:
+    def test_sweep_once_and_counters(self, manager):
+        run_step(manager, [measurement(0, ts=1000)])
+        emitted = []
+        pm = PresenceManager(
+            manager,
+            missing_after_s=30_000,
+            on_state_changes=emitted.append,
+            clock=lambda: 50_000,
+        )
+        assert pm.sweep_once() == 1
+        assert pm.total_marked_missing == 1
+        assert len(emitted) == 1
+        assert pm.sweep_once() == 0  # send-once
+
+    def test_background_thread(self, manager):
+        import time as _time
+
+        run_step(manager, [measurement(0, ts=1000)])
+        pm = PresenceManager(
+            manager,
+            check_interval_s=0.02,
+            missing_after_s=30_000,
+            clock=lambda: 50_000,
+        )
+        pm.start()
+        deadline = _time.time() + 2
+        while pm.sweeps == 0 and _time.time() < deadline:
+            _time.sleep(0.01)
+        pm.stop()
+        assert pm.sweeps >= 1
+        assert manager.missing_device_ids() == [0]
+
+    def test_tenant_ids_in_state_changes(self, identity):
+        tenants = np.full(CAP, 3, np.int32)
+        mgr = DeviceStateManager(
+            CAP, identity, tenant_id_of_device=lambda ids: tenants[ids]
+        )
+        run_step(mgr, [measurement(0, ts=1000, tenant=0)])
+        # run_step's registry uses tenant 0; the emission callback uses the
+        # injected mapping (tenant 3) — verifying the hook is honored.
+        batch = mgr.apply_presence_sweep(50_000, 30_000)
+        assert int(np.asarray(batch.tenant_id)[0]) == 3
+
+
+def test_presence_sweep_is_jittable_and_pure():
+    import jax.numpy as jnp
+
+    state = DeviceState.empty(16)
+    state = state.replace(
+        last_event_type=state.last_event_type.at[2].set(EventType.MEASUREMENT),
+        last_event_ts_s=state.last_event_ts_s.at[2].set(100),
+    )
+    new_state, newly = presence_sweep(state, jnp.int32(10_000), jnp.int32(500))
+    assert bool(newly[2]) and not bool(newly[0])
+    # Input untouched (functional update).
+    assert not bool(state.presence_missing[2])
+    assert bool(new_state.presence_missing[2])

@@ -128,6 +128,37 @@ class TestEngineManager:
         assert id_a != id_b
         assert mgr.restart_engine("a").tenant_id == id_a
 
+    def test_manager_restart_restarts_engines(self, tm):
+        mgr = MultitenantEngineManager(tm)
+        mgr.start()
+        tm.create_tenant("a", name="A")
+        mgr.stop()
+        assert mgr.get_engine("a").state.name == "STOPPED"
+        mgr.start()
+        assert mgr.get_engine("a").state.name == "STARTED"
+
+    def test_failed_bootstrap_is_retryable(self, tm):
+        attempts = []
+
+        def flaky(engine):
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise RuntimeError("transient bootstrap failure")
+
+        tm.add_dataset_template(DatasetTemplate(id="flaky", name="Flaky", initialize=flaky))
+        mgr = MultitenantEngineManager(tm)
+        mgr.start()
+        tm.create_tenant("acme", name="Acme", dataset_template_id="flaky")
+        # Listener swallowed the failure: no engine registered, none leaked.
+        with pytest.raises(EntityNotFound):
+            mgr.get_engine("acme")
+        # Manager restart retries the bootstrap and succeeds.
+        mgr.stop()
+        mgr.start()
+        engine = mgr.get_engine("acme")
+        assert engine.state.name == "STARTED"
+        assert len(attempts) == 2
+
     def test_attach_extra_component(self, tm):
         from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 
