@@ -1,0 +1,143 @@
+"""Label generation: QR encode/decode round trips + manager surface.
+
+The reference has no tests for service-label-generation; these validate the
+from-spec symbology structurally (format info, RS syndromes, payload
+round-trip) across versions, EC levels and mask choices.
+"""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.labels import (
+    LabelGenerator,
+    LabelGeneratorManager,
+    png,
+    qr,
+    read_png_size,
+    render_batch,
+    render_modules,
+)
+
+
+class TestQrEncoder:
+    def test_round_trip_short(self):
+        mat = qr.encode("hello world")
+        assert qr.decode_matrix(mat) == b"hello world"
+
+    @pytest.mark.parametrize("level", ["L", "M"])
+    @pytest.mark.parametrize("length", [1, 7, 17, 40, 90, 150, 210])
+    def test_round_trip_lengths(self, level, length):
+        payload = bytes((i * 37 + 11) % 256 for i in range(length))
+        mat = qr.encode(payload, level=level)
+        assert qr.decode_matrix(mat) == payload
+
+    @pytest.mark.parametrize("version", [1, 2, 4, 7, 10])
+    def test_round_trip_pinned_versions(self, version):
+        payload = b"x" * qr.data_capacity_bytes("M", version)
+        mat = qr.encode(payload, level="M", version=version)
+        assert mat.shape == (qr.matrix_size(version),) * 2
+        assert qr.decode_matrix(mat) == payload
+
+    @pytest.mark.parametrize("mask", range(8))
+    def test_all_masks_decodable(self, mask):
+        mat = qr.encode("mask test payload", level="M", mask=mask)
+        assert qr.read_format(mat) == ("M", mask)
+        assert qr.decode_matrix(mat) == b"mask test payload"
+
+    def test_finder_and_timing_structure(self):
+        mat = qr.encode("structural check")
+        n = mat.shape[0]
+        finder = qr._FINDER
+        assert np.array_equal(mat[0:7, 0:7], finder)
+        assert np.array_equal(mat[0:7, n - 7 :], finder)
+        assert np.array_equal(mat[n - 7 :, 0:7], finder)
+        # timing rows alternate starting dark at even coordinates
+        for i in range(8, n - 8):
+            assert mat[6, i] == (i + 1) % 2
+            assert mat[i, 6] == (i + 1) % 2
+        # dark module
+        assert mat[n - 8, 8] == 1
+
+    def test_corruption_detected(self):
+        mat = qr.encode("detect me")
+        n = mat.shape[0]
+        mat = mat.copy()
+        # flip a handful of data modules in the lower-right data region
+        mat[n - 2, n - 2] ^= 1
+        mat[n - 3, n - 2] ^= 1
+        with pytest.raises(ValueError, match="syndrome"):
+            qr.decode_matrix(mat)
+
+    def test_payload_too_long(self):
+        with pytest.raises(ValueError, match="exceeds"):
+            qr.encode(b"y" * 1000, level="M")
+
+    def test_rs_ecc_known_property(self):
+        # data + ecc must have zero syndromes for any data
+        data = bytes(range(40))
+        ecc = qr.rs_ecc(data, 10)
+        assert len(ecc) == 10
+        assert qr.rs_syndromes_zero(data + ecc, 10)
+        corrupted = bytes([data[0] ^ 1]) + data[1:] + ecc
+        assert not qr.rs_syndromes_zero(corrupted, 10)
+
+
+class TestRendering:
+    def test_render_scale_border(self):
+        mat = qr.encode("render", level="L")
+        img = render_modules(mat, scale=3, border=2)
+        n = mat.shape[0]
+        assert img.shape == ((n + 4) * 3, (n + 4) * 3)
+        assert img.dtype == np.uint8
+        # quiet zone is light
+        assert (img[:6, :] == 255).all()
+
+    def test_png_round_trip_size(self):
+        mat = qr.encode("png")
+        img = render_modules(mat, scale=2, border=4)
+        data = png.write_png(img)
+        assert read_png_size(data) == (img.shape[1], img.shape[0])
+
+    def test_render_batch_uniform(self):
+        mats = [qr.encode(f"tok-{i}", version=3) for i in range(5)]
+        batch = render_batch(mats, scale=2, border=1)
+        assert batch.shape[0] == 5
+        for i, mat in enumerate(mats):
+            single = render_modules(mat, scale=2, border=1)
+            assert np.array_equal(batch[i], single)
+
+    def test_render_batch_rejects_mixed_sizes(self):
+        mats = [qr.encode("a", version=1), qr.encode("b", version=2)]
+        with pytest.raises(ValueError, match="mixed"):
+            render_batch(mats)
+
+
+class TestManager:
+    def test_generate_png_for_entity(self):
+        mgr = LabelGeneratorManager()
+        mgr.start()
+        data = mgr.generate_png("default", "device", "dev-123")
+        assert data[:8] == b"\x89PNG\r\n\x1a\n"
+        mat = mgr.generate_matrix("default", "device", "dev-123")
+        assert qr.decode_matrix(mat) == b"https://sitewhere-tpu.local/device/dev-123"
+        mgr.stop()
+
+    def test_unknown_generator_and_kind(self):
+        from sitewhere_tpu.services.common import EntityNotFound
+
+        mgr = LabelGeneratorManager()
+        with pytest.raises(EntityNotFound):
+            mgr.generate_png("nope", "device", "t")
+        with pytest.raises(EntityNotFound):
+            mgr.generate_png("default", "spaceship", "t")
+
+    def test_custom_generator_and_batch(self):
+        mgr = LabelGeneratorManager()
+        mgr.register(LabelGenerator(
+            "ops", "Ops labels", url_template="https://ops/{kind}/{token}",
+            scale=2, border=1, ec_level="L",
+        ))
+        pngs = mgr.generate_png_batch("ops", "area", [f"area-{i}" for i in range(4)])
+        assert len(pngs) == 4
+        sizes = {read_png_size(p) for p in pngs}
+        assert len(sizes) == 1  # uniform version ⇒ uniform image size
