@@ -73,7 +73,8 @@ class CommandProcessor(LifecycleComponent):
         if isinstance(destination.provider, LifecycleComponent):
             if destination.provider not in self._children:  # shared providers register once
                 self.add_child(destination.provider)
-            if self.state == LifecycleState.STARTED:
+            if (self.state == LifecycleState.STARTED
+                    and destination.provider.state != LifecycleState.STARTED):
                 destination.provider.start()
 
     # -- target resolution + execution build --------------------------------

@@ -270,7 +270,9 @@ class MultitenantEngineManager(LifecycleComponent):
 
     def start(self) -> None:
         super().start()
-        for tenant in self.tenants.list_tenants():
+        # page_size=0 = unpaged: every tenant's engine must come up, not
+        # just the first default page
+        for tenant in self.tenants.list_tenants(SearchCriteria(page_size=0)):
             self._ensure_engine(tenant)
 
     def stop(self) -> None:

@@ -18,13 +18,15 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from sitewhere_tpu.ids import NULL_ID, IdentityMap
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
-from sitewhere_tpu.schema import DeviceState, EventBatch, EventType
+from sitewhere_tpu.schema import DeviceState, EventBatch
 from sitewhere_tpu.services.common import EntityNotFound, require
-from sitewhere_tpu.state.presence import missing_state_changes, presence_sweep
+from sitewhere_tpu.state.presence import presence_sweep, state_changes_for
 
 
 class DeviceStateManager(LifecycleComponent):
@@ -56,10 +58,29 @@ class DeviceStateManager(LifecycleComponent):
         with self._lock:
             return self._state
 
-    def commit(self, new_state: DeviceState) -> None:
+    def commit(self, new_state: DeviceState,
+               batch: Optional[EventBatch] = None) -> None:
         """Adopt a pipeline step's output state (the merge already ran on
-        device inside the step)."""
+        device inside the step).
+
+        Pass the ``batch`` the step consumed so a presence sweep that ran
+        concurrently (between the dispatcher's read and this commit) is not
+        lost: ``presence_missing`` flags on the current epoch are re-applied
+        for devices the batch did not touch.  Computed on device — no host
+        transfer on the hot path.
+        """
         with self._lock:
+            current = self._state
+            if batch is not None and current is not new_state:
+                cap = new_state.capacity
+                ids = jnp.where(
+                    batch.valid & (batch.device_id >= 0), batch.device_id, cap
+                )
+                touched = jnp.zeros((cap,), bool).at[ids].set(True, mode="drop")
+                merged = new_state.presence_missing | (
+                    current.presence_missing & ~touched
+                )
+                new_state = new_state.replace(presence_missing=merged)
             self._state = new_state
 
     # -- presence ----------------------------------------------------------
@@ -69,19 +90,20 @@ class DeviceStateManager(LifecycleComponent):
     ) -> Optional[EventBatch]:
         """Run the jitted sweep, adopt the flagged state, and build the
         STATE_CHANGE batch for newly-missing devices (None if none)."""
-        import jax.numpy as jnp
-
         with self._lock:
             new_state, newly_missing = presence_sweep(
                 self._state, jnp.int32(now_s), jnp.int32(missing_after_s)
             )
             self._state = new_state
-        mask = np.asarray(newly_missing)
+        (idx,) = np.nonzero(np.asarray(newly_missing))
+        if idx.size == 0:
+            return None
+        idx = idx.astype(np.int32)
         if self._tenant_id_of_device is not None:
-            tenant_ids = self._tenant_id_of_device(np.arange(mask.size))
+            tenant_ids = np.asarray(self._tenant_id_of_device(idx), np.int32)
         else:
-            tenant_ids = np.zeros(mask.size, np.int32)
-        return missing_state_changes(mask, tenant_ids, now_s)
+            tenant_ids = np.zeros(idx.size, np.int32)
+        return state_changes_for(idx, tenant_ids, now_s)
 
     # -- queries (reference: DeviceStateImpl RPCs) --------------------------
 
@@ -99,23 +121,25 @@ class DeviceStateManager(LifecycleComponent):
         require(
             0 <= device_id < s.capacity, EntityNotFound(f"bad device id {device_id}")
         )
+        # one batched device→host transfer for the whole row
+        r = jax.device_get(jax.tree.map(lambda a: a[device_id], s))
         row = {
             "device_id": device_id,
-            "last_event_ts_s": int(np.asarray(s.last_event_ts_s[device_id])),
-            "last_event_type": int(np.asarray(s.last_event_type[device_id])),
-            "presence_missing": bool(np.asarray(s.presence_missing[device_id])),
+            "last_event_ts_s": int(r.last_event_ts_s),
+            "last_event_type": int(r.last_event_type),
+            "presence_missing": bool(r.presence_missing),
             "last_location": {
-                "lat": float(np.asarray(s.last_lat[device_id])),
-                "lon": float(np.asarray(s.last_lon[device_id])),
-                "elevation": float(np.asarray(s.last_elevation[device_id])),
-                "ts_s": int(np.asarray(s.last_location_ts_s[device_id])),
+                "lat": float(r.last_lat),
+                "lon": float(r.last_lon),
+                "elevation": float(r.last_elevation),
+                "ts_s": int(r.last_location_ts_s),
             },
             "last_alert": {
-                "code": int(np.asarray(s.last_alert_code[device_id])),
-                "ts_s": int(np.asarray(s.last_alert_ts_s[device_id])),
+                "code": int(r.last_alert_code),
+                "ts_s": int(r.last_alert_ts_s),
             },
-            "last_values": np.asarray(s.last_values[device_id]).tolist(),
-            "last_value_ts_s": np.asarray(s.last_value_ts_s[device_id]).tolist(),
+            "last_values": np.asarray(r.last_values).tolist(),
+            "last_value_ts_s": np.asarray(r.last_value_ts_s).tolist(),
         }
         if row["last_event_type"] == NULL_ID:
             row["last_event_type"] = None

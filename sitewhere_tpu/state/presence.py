@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -53,28 +54,40 @@ def presence_sweep(
     )
 
 
-def missing_state_changes(
-    newly_missing: np.ndarray, tenant_ids: np.ndarray, now_s: int
-) -> Optional[EventBatch]:
-    """Build a STATE_CHANGE event batch for newly-missing devices.
+def state_changes_for(
+    device_ids: np.ndarray, tenant_ids: np.ndarray, now_s: int
+) -> EventBatch:
+    """Build a presence STATE_CHANGE event batch for the given devices.
 
     Host-side (variable count → exact-width batch) — re-injected through
     the normal ingest path like the reference's presence StateChange events
-    flow back through event management.
+    flow back through event management.  ``tenant_ids`` aligns with
+    ``device_ids`` row for row.
     """
-    (idx,) = np.nonzero(newly_missing)
-    if idx.size == 0:
-        return None
-    width = int(idx.size)
+    width = int(device_ids.size)
     batch = EventBatch.empty(width)
     return batch.replace(
         valid=jnp.ones(width, bool),
-        device_id=jnp.asarray(idx.astype(np.int32)),
-        tenant_id=jnp.asarray(tenant_ids[idx].astype(np.int32)),
+        device_id=jnp.asarray(np.asarray(device_ids, np.int32)),
+        tenant_id=jnp.asarray(np.asarray(tenant_ids, np.int32)),
         event_type=jnp.full(width, EventType.STATE_CHANGE, jnp.int32),
         ts_s=jnp.full(width, now_s, jnp.int32),
         alert_code=jnp.full(width, STATE_CHANGE_PRESENCE_MISSING, jnp.int32),
     )
+
+
+def missing_state_changes(
+    newly_missing: np.ndarray, tenant_ids: np.ndarray, now_s: int
+) -> Optional[EventBatch]:
+    """Sweep mask → STATE_CHANGE batch (None if nothing newly missing).
+
+    ``tenant_ids`` here is the full per-device column; prefer
+    :func:`state_changes_for` when the caller already has the missing rows.
+    """
+    (idx,) = np.nonzero(newly_missing)
+    if idx.size == 0:
+        return None
+    return state_changes_for(idx.astype(np.int32), tenant_ids[idx], now_s)
 
 
 class PresenceManager(LifecycleComponent):
@@ -91,14 +104,14 @@ class PresenceManager(LifecycleComponent):
         check_interval_s: float = 600.0,  # reference default "10m"
         missing_after_s: int = 8 * 3600,  # reference default "8h"
         on_state_changes: Optional[Callable[[EventBatch], None]] = None,
-        clock: Callable[[], float] = None,
+        clock: Optional[Callable[[], float]] = None,
     ):
         super().__init__(name="presence-manager")
         self.state_manager = state_manager
         self.check_interval_s = check_interval_s
         self.missing_after_s = missing_after_s
         self.on_state_changes = on_state_changes
-        self._clock = clock or __import__("time").time
+        self._clock = clock or time.time
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.sweeps = 0

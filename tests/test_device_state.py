@@ -165,3 +165,32 @@ def test_presence_sweep_is_jittable_and_pure():
     # Input untouched (functional update).
     assert not bool(state.presence_missing[2])
     assert bool(new_state.presence_missing[2])
+
+
+class TestCommitMergeRace:
+    def test_concurrent_sweep_flags_survive_commit(self, manager):
+        """A sweep that lands between the dispatcher's state read and its
+        commit must not be clobbered (lost-update race): flags for devices
+        the batch did not touch are preserved when the batch is passed."""
+        # dev-0 and dev-5 have old events
+        run_step(manager, [measurement(0, ts=1000), measurement(5, ts=1000)])
+        base = manager.current  # dispatcher snapshot S0
+
+        # slow pipeline step computes from S0...
+        registry = make_registry(capacity=CAP, n_devices=8)
+        batch = make_batch([measurement(0, ts=90_000)])
+        new_state, _ = pipeline_step(
+            registry, base, RuleTable.empty(4), ZoneTable.empty(4), batch
+        )
+
+        # ...meanwhile the presence sweep marks both 0 and 5 missing
+        swept = manager.apply_presence_sweep(now_s=80_000, missing_after_s=10_000)
+        assert sorted(manager.missing_device_ids()) == [0, 5]
+        assert swept is not None
+
+        # dispatcher commits: dev-0 (touched, fresh event) cleared;
+        # dev-5 (untouched) keeps the sweep's flag
+        manager.commit(new_state, batch=batch)
+        assert manager.missing_device_ids() == [5]
+        # and the next sweep does NOT re-mark dev-5 (send-once holds)
+        assert manager.apply_presence_sweep(80_000, 10_000) is None

@@ -171,3 +171,20 @@ class TestEngineManager:
         assert comp.state.name == "STARTED"  # started because engine is live
         engine.stop()
         assert comp.state.name == "STOPPED"
+
+
+def test_manager_restart_covers_all_tenants_beyond_one_page(tm):
+    """start() must bring up every tenant engine, not just the default
+    first page of 100 (regression: restart left tenants 101+ parked)."""
+    from sitewhere_tpu.runtime.lifecycle import LifecycleState
+
+    for i in range(120):
+        tm.create_tenant(token=f"t-{i}", name=f"Tenant {i}")
+    mgr = MultitenantEngineManager(tm)
+    mgr.start()
+    assert len(mgr.list_engines()) == 120
+    mgr.stop()
+    mgr.start()
+    states = {e.state for e in mgr.list_engines()}
+    assert states == {LifecycleState.STARTED}
+    mgr.stop()
