@@ -49,15 +49,19 @@ class Journal:
         name: str = "events",
         segment_bytes: int = 64 << 20,
         fsync_every: int = 256,
+        index_every: int = _INDEX_EVERY,
     ):
         self.dir = os.path.join(root, name)
         os.makedirs(self.dir, exist_ok=True)
         self.segment_bytes = segment_bytes
         self.fsync_every = fsync_every
+        # 1 = dense index (O(1) point reads — e.g. large media chunks);
+        # higher = sparser index, less memory, scans seek then roll forward.
+        self.index_every = max(1, index_every)
         self._lock = threading.Lock()
         self._unsynced = 0
-        # Sparse offset index: (offset, segment path, byte pos) every
-        # _INDEX_EVERY records, so scans seek instead of replaying segments.
+        # Offset index: (offset, segment path, byte pos) every
+        # index_every records, so scans seek instead of replaying segments.
         self._index: List[Tuple[int, str, int]] = []
         # segments: sorted list of (base_offset, path)
         self._segments: List[Tuple[int, str]] = self._scan_segments()
@@ -112,7 +116,7 @@ class Journal:
                     # Corruption with valid data after it: not a crash
                     # artifact — refuse to silently drop records.
                     raise CorruptJournal(f"{path} @ byte {pos}")
-                if (base + n) % _INDEX_EVERY == 0:
+                if (base + n) % self.index_every == 0:
                     self._index.append((base + n, path, pos))
                 pos += _HEADER.size + length
                 n += 1
@@ -124,7 +128,7 @@ class Journal:
         """Append one record; returns its offset."""
         with self._lock:
             offset = self._next_offset
-            if offset % _INDEX_EVERY == 0:
+            if offset % self.index_every == 0:
                 self._index.append((offset, self._file.name, self._file.tell()))
             self._file.write(_HEADER.pack(len(payload), zlib.crc32(payload)))
             self._file.write(payload)

@@ -17,8 +17,16 @@ from sitewhere_tpu.services.common import (
     SearchResults,
 )
 from sitewhere_tpu.services.device_management import DeviceManagement, RegistryMirror
+from sitewhere_tpu.services.streams import (
+    DeviceStreamManagement,
+    DeviceStreamManager,
+    DeviceStreamStatus,
+)
 
 __all__ = [
+    "DeviceStreamManagement",
+    "DeviceStreamManager",
+    "DeviceStreamStatus",
     "DuplicateToken",
     "EntityNotFound",
     "InvalidReference",

@@ -59,23 +59,29 @@ class DeviceStateManager(LifecycleComponent):
             return self._state
 
     def commit(self, new_state: DeviceState,
-               batch: Optional[EventBatch] = None) -> None:
+               batch: Optional[EventBatch] = None,
+               accepted=None) -> None:
         """Adopt a pipeline step's output state (the merge already ran on
         device inside the step).
 
-        Pass the ``batch`` the step consumed so a presence sweep that ran
-        concurrently (between the dispatcher's read and this commit) is not
-        lost: ``presence_missing`` flags on the current epoch are re-applied
-        for devices the batch did not touch.  Computed on device — no host
-        transfer on the hot path.
+        Pass the ``batch`` the step consumed — and the step's ``accepted``
+        output mask (``PipelineOutputs.accepted``) — so a presence sweep
+        that ran concurrently (between the dispatcher's read and this
+        commit) is not lost: ``presence_missing`` flags on the current
+        epoch are re-applied for devices the step did not actually merge.
+        Rows the step REJECTED (unregistered/unassigned/tenant mismatch)
+        never cleared presence in the step, so they must not count as
+        touched here either.  Computed on device — no host transfer on the
+        hot path.
         """
         with self._lock:
             current = self._state
             if batch is not None and current is not new_state:
                 cap = new_state.capacity
-                ids = jnp.where(
-                    batch.valid & (batch.device_id >= 0), batch.device_id, cap
-                )
+                merged_rows = batch.valid & (batch.device_id >= 0)
+                if accepted is not None:
+                    merged_rows = merged_rows & accepted
+                ids = jnp.where(merged_rows, batch.device_id, cap)
                 touched = jnp.zeros((cap,), bool).at[ids].set(True, mode="drop")
                 merged = new_state.presence_missing | (
                     current.presence_missing & ~touched

@@ -76,20 +76,6 @@ def state_changes_for(
     )
 
 
-def missing_state_changes(
-    newly_missing: np.ndarray, tenant_ids: np.ndarray, now_s: int
-) -> Optional[EventBatch]:
-    """Sweep mask → STATE_CHANGE batch (None if nothing newly missing).
-
-    ``tenant_ids`` here is the full per-device column; prefer
-    :func:`state_changes_for` when the caller already has the missing rows.
-    """
-    (idx,) = np.nonzero(newly_missing)
-    if idx.size == 0:
-        return None
-    return state_changes_for(idx.astype(np.int32), tenant_ids[idx], now_s)
-
-
 class PresenceManager(LifecycleComponent):
     """Background presence checker over a :class:`DeviceStateManager`.
 

@@ -179,7 +179,7 @@ class TestCommitMergeRace:
         # slow pipeline step computes from S0...
         registry = make_registry(capacity=CAP, n_devices=8)
         batch = make_batch([measurement(0, ts=90_000)])
-        new_state, _ = pipeline_step(
+        new_state, out = pipeline_step(
             registry, base, RuleTable.empty(4), ZoneTable.empty(4), batch
         )
 
@@ -190,7 +190,36 @@ class TestCommitMergeRace:
 
         # dispatcher commits: dev-0 (touched, fresh event) cleared;
         # dev-5 (untouched) keeps the sweep's flag
-        manager.commit(new_state, batch=batch)
+        manager.commit(new_state, batch=batch, accepted=out.accepted)
         assert manager.missing_device_ids() == [5]
         # and the next sweep does NOT re-mark dev-5 (send-once holds)
         assert manager.apply_presence_sweep(80_000, 10_000) is None
+
+    def test_rejected_rows_do_not_clear_sweep_flags(self, manager):
+        """A batch row the step REJECTED (e.g. unregistered device id) must
+        not count as touched — its sweep flag survives the commit."""
+        run_step(manager, [measurement(0, ts=1000), measurement(5, ts=1000)])
+        base = manager.current
+
+        registry = make_registry(capacity=CAP, n_devices=8)
+        # row for dev-5 arrives but its registry slot is inactive → rejected
+        import numpy as np
+
+        from sitewhere_tpu.schema import AssignmentStatus
+
+        registry = registry.replace(
+            active=registry.active.at[5].set(False)
+        )
+        batch = make_batch([measurement(0, ts=90_000), measurement(5, ts=90_000)])
+        new_state, out = pipeline_step(
+            registry, base, RuleTable.empty(4), ZoneTable.empty(4), batch
+        )
+        assert not bool(np.asarray(out.accepted)[1])
+
+        manager.apply_presence_sweep(now_s=80_000, missing_after_s=10_000)
+        assert sorted(manager.missing_device_ids()) == [0, 5]
+
+        manager.commit(new_state, batch=batch, accepted=out.accepted)
+        # dev-0 cleared (accepted fresh event); dev-5's flag survives even
+        # though a (rejected) row named it
+        assert manager.missing_device_ids() == [5]

@@ -1,0 +1,264 @@
+"""Streaming media: durable chunked device streams + request manager.
+
+Reference behaviors covered (service-streaming-media):
+- stream create per active assignment, duplicate → EXISTS ack
+  (DeviceStreamManager.handleDeviceStreamRequest)
+- stream ids scoped per assignment (IDeviceStreamManagement SPI) — no
+  cross-device access or squatting
+- sequence-numbered chunk append / point get / ordered list,
+  last-write-wins per sequence
+- send-back request answers empty payload for missing chunks
+- durability: chunks and descriptors survive restart; torn tail writes
+  dropped, mid-file corruption refused loudly (Journal semantics)
+"""
+
+import os
+
+import pytest
+
+from sitewhere_tpu.ids import IdentityMap
+from sitewhere_tpu.ingest.journal import CorruptJournal
+from sitewhere_tpu.services.common import (
+    DuplicateToken,
+    EntityNotFound,
+    InvalidReference,
+    SearchCriteria,
+    ValidationError,
+)
+from sitewhere_tpu.services.device_management import DeviceManagement, RegistryMirror
+from sitewhere_tpu.services.streams import (
+    DeviceStreamManagement,
+    DeviceStreamManager,
+    DeviceStreamStatus,
+)
+
+
+@pytest.fixture()
+def dm():
+    svc = DeviceManagement(
+        "default", IdentityMap(capacity=256),
+        RegistryMirror(capacity=256, max_zones=8, max_verts=8),
+    )
+    svc.create_device_type(token="cam", name="Camera")
+    svc.create_device(token="cam-1", device_type="cam")
+    svc.create_device_assignment(device="cam-1")
+    svc.create_device(token="cam-2", device_type="cam")  # unassigned
+    svc.create_device(token="cam-3", device_type="cam")
+    svc.create_device_assignment(device="cam-3")
+    return svc
+
+
+@pytest.fixture()
+def streams(tmp_path):
+    svc = DeviceStreamManagement(str(tmp_path))
+    svc.start()
+    yield svc
+    svc.stop()
+    svc.terminate()
+
+
+class TestStreamStore:
+    def test_create_get_list(self, streams):
+        s1 = streams.create_device_stream("a-1", "video-1", "video/mp4")
+        streams.create_device_stream("a-1", "video-2")
+        streams.create_device_stream("a-2", "audio-1")
+        assert streams.get_device_stream(s1.token).content_type == "video/mp4"
+        assert streams.list_device_streams("a-1").total == 2
+        assert streams.list_device_streams().total == 3
+        with pytest.raises(DuplicateToken):
+            streams.create_device_stream("a-1", "video-1")
+        # same device-chosen id under a DIFFERENT assignment is fine
+        streams.create_device_stream("a-2", "video-1")
+        with pytest.raises(EntityNotFound):
+            streams.get_device_stream("nope")
+
+    def test_chunks_ordered_and_point_reads(self, streams):
+        s = streams.create_device_stream("a-1", "s")
+        for seq in (2, 0, 1):  # out-of-order arrival
+            streams.add_device_stream_data(s.token, seq, f"chunk{seq}".encode())
+        listed = streams.list_device_stream_data(s.token)
+        assert [c.sequence_number for c in listed] == [0, 1, 2]
+        assert [c.data for c in listed] == [b"chunk0", b"chunk1", b"chunk2"]
+        assert streams.get_device_stream_data(s.token, 1).data == b"chunk1"
+        assert streams.get_device_stream_data(s.token, 9) is None
+        assert streams.stream_content(s.token) == b"chunk0chunk1chunk2"
+
+    def test_last_write_wins_per_sequence(self, streams):
+        s = streams.create_device_stream("a-1", "s")
+        streams.add_device_stream_data(s.token, 0, b"old")
+        streams.add_device_stream_data(s.token, 0, b"new")
+        assert streams.get_device_stream_data(s.token, 0).data == b"new"
+        assert streams.list_device_stream_data(s.token).total == 1
+        assert streams.stream_content(s.token) == b"new"
+
+    def test_seq_bounds_validated(self, streams):
+        s = streams.create_device_stream("a-1", "s")
+        with pytest.raises(ValidationError):
+            streams.add_device_stream_data(s.token, -1, b"x")
+        with pytest.raises(ValidationError):
+            streams.add_device_stream_data(s.token, 1 << 64, b"x")
+
+    def test_paging(self, streams):
+        s = streams.create_device_stream("a-1", "s")
+        for seq in range(10):
+            streams.add_device_stream_data(s.token, seq, bytes([seq]))
+        page = streams.list_device_stream_data(
+            s.token, SearchCriteria(page=2, page_size=4)
+        )
+        assert [c.sequence_number for c in page.results] == [4, 5, 6, 7]
+        assert [c.data for c in page.results] == [b"\x04", b"\x05", b"\x06", b"\x07"]
+        assert page.total == 10
+
+    def test_interleaved_streams_stay_separate(self, streams):
+        sa = streams.create_device_stream("a-1", "sa")
+        sb = streams.create_device_stream("a-1", "sb")
+        for i in range(5):
+            streams.add_device_stream_data(sa.token, i, b"A%d" % i)
+            streams.add_device_stream_data(sb.token, i, b"B%d" % i)
+        assert streams.stream_content(sa.token) == b"A0A1A2A3A4"
+        assert streams.stream_content(sb.token) == b"B0B1B2B3B4"
+
+    def test_durability_across_restart(self, tmp_path):
+        svc = DeviceStreamManagement(str(tmp_path))
+        svc.start()
+        s = svc.create_device_stream("a-1", "s", "image/png", metadata={"k": "v"})
+        svc.add_device_stream_data(s.token, 0, b"\x00" * 1000)
+        svc.add_device_stream_data(s.token, 1, b"tail")
+        svc.stop()
+        svc.terminate()
+
+        svc2 = DeviceStreamManagement(str(tmp_path))
+        svc2.start()
+        stream = svc2.get_device_stream(s.token)
+        assert stream.content_type == "image/png"
+        assert stream.metadata == {"k": "v"}
+        assert svc2.get_assignment_stream("a-1", "s").token == s.token
+        assert svc2.stream_content(s.token) == b"\x00" * 1000 + b"tail"
+
+    def test_torn_tail_dropped_on_recovery(self, tmp_path):
+        svc = DeviceStreamManagement(str(tmp_path))
+        svc.start()
+        s = svc.create_device_stream("a-1", "s")
+        svc.add_device_stream_data(s.token, 0, b"good")
+        svc.add_device_stream_data(s.token, 1, b"willtear")
+        svc.stop()
+        svc.terminate()
+        # tear the final record (crash mid-append)
+        seg = sorted(
+            p for p in os.listdir(os.path.join(svc.dir, "media"))
+            if p.endswith(".log")
+        )[-1]
+        full = os.path.join(svc.dir, "media", seg)
+        with open(full, "r+b") as f:
+            f.seek(-3, os.SEEK_END)
+            f.truncate()
+
+        svc2 = DeviceStreamManagement(str(tmp_path))
+        svc2.start()
+        assert svc2.get_device_stream_data(s.token, 0).data == b"good"
+        assert svc2.get_device_stream_data(s.token, 1) is None
+        # appends continue cleanly after truncation
+        svc2.add_device_stream_data(s.token, 1, b"retry")
+        assert svc2.stream_content(s.token) == b"goodretry"
+
+    def test_mid_file_corruption_is_loud(self, tmp_path):
+        """Valid data after a corrupt record must not be silently dropped —
+        the store refuses to open (Journal CorruptJournal semantics)."""
+        svc = DeviceStreamManagement(str(tmp_path))
+        svc.start()
+        s = svc.create_device_stream("a-1", "s")
+        first = svc.add_device_stream_data(s.token, 0, b"AAAAAAAA")
+        svc.add_device_stream_data(s.token, 1, b"BBBBBBBB")
+        svc.stop()
+        svc.terminate()
+        seg = sorted(
+            p for p in os.listdir(os.path.join(svc.dir, "media"))
+            if p.endswith(".log")
+        )[0]
+        full = os.path.join(svc.dir, "media", seg)
+        with open(full, "r+b") as f:
+            data = f.read()
+            f.seek(data.index(b"AAAAAAAA"))
+            f.write(b"XXXX")
+        with pytest.raises(CorruptJournal):
+            DeviceStreamManagement(str(tmp_path))
+        assert first.sequence_number == 0  # silence unused warning
+
+
+class TestStreamManager:
+    def test_create_ack_and_duplicate(self, dm, streams):
+        acks = []
+        mgr = DeviceStreamManager(
+            dm, streams, deliver_command=lambda tok, cmd: acks.append((tok, cmd))
+        )
+        mgr.start()
+        assert (
+            mgr.handle_device_stream_request("cam-1", "rec-1", "video/mp4")
+            == DeviceStreamStatus.CREATED
+        )
+        assert (
+            mgr.handle_device_stream_request("cam-1", "rec-1")
+            == DeviceStreamStatus.EXISTS
+        )
+        assert [c["status"] for _, c in acks] == ["created", "exists"]
+        # stream is attached to the device's active assignment
+        a = dm.get_active_assignment("cam-1")
+        assert streams.get_assignment_stream(a.token, "rec-1") is not None
+
+    def test_unassigned_device_rejected(self, dm, streams):
+        mgr = DeviceStreamManager(dm, streams)
+        with pytest.raises(InvalidReference):
+            mgr.handle_device_stream_request("cam-2", "s")
+        with pytest.raises(EntityNotFound):
+            mgr.handle_device_stream_request("ghost", "s")
+
+    def test_cross_device_streams_isolated(self, dm, streams):
+        """cam-3 creating/writing 'rec-1' must not touch cam-1's 'rec-1'."""
+        mgr = DeviceStreamManager(dm, streams)
+        mgr.handle_device_stream_request("cam-1", "rec-1")
+        mgr.handle_device_stream_data_request("cam-1", "rec-1", 0, b"cam1-data")
+        # same id from another device: CREATED (own scope), not EXISTS
+        assert (
+            mgr.handle_device_stream_request("cam-3", "rec-1")
+            == DeviceStreamStatus.CREATED
+        )
+        mgr.handle_device_stream_data_request("cam-3", "rec-1", 0, b"cam3-data")
+        assert (
+            mgr.handle_send_device_stream_data_request("cam-1", "rec-1", 0)
+            == b"cam1-data"
+        )
+        assert (
+            mgr.handle_send_device_stream_data_request("cam-3", "rec-1", 0)
+            == b"cam3-data"
+        )
+        # writing to a stream id that only exists under ANOTHER assignment
+        with pytest.raises(EntityNotFound):
+            mgr.handle_device_stream_data_request("cam-3", "only-cam1", 0, b"x")
+
+    def test_data_and_send_back(self, dm, streams):
+        sent = []
+        mgr = DeviceStreamManager(
+            dm, streams, deliver_command=lambda tok, cmd: sent.append(cmd)
+        )
+        mgr.handle_device_stream_request("cam-1", "s")
+        mgr.handle_device_stream_data_request("cam-1", "s", 0, b"frame0")
+        assert mgr.handle_send_device_stream_data_request("cam-1", "s", 0) == b"frame0"
+        # missing chunk answers empty (reference: new byte[0])
+        assert mgr.handle_send_device_stream_data_request("cam-1", "s", 5) == b""
+        data_cmds = [c for c in sent if c["type"] == "stream_data"]
+        assert data_cmds[0]["data"] == b"frame0"
+        assert data_cmds[1]["data"] == b""
+
+
+def test_create_failure_acks_failed(dm, streams):
+    """Invalid create requests ack FAILED instead of erroring the device
+    (reference DeviceStreamManager.java:62-66)."""
+    acks = []
+    mgr = DeviceStreamManager(
+        dm, streams, deliver_command=lambda tok, cmd: acks.append(cmd)
+    )
+    assert (
+        mgr.handle_device_stream_request("cam-1", "")  # empty id
+        == DeviceStreamStatus.FAILED
+    )
+    assert acks[-1]["status"] == "failed"
