@@ -90,6 +90,7 @@ def host_batches(width: int, n_active: int, n_batches: int):
                 alert_level=np.zeros(width, np.int32),
                 command_id=np.full(width, -1, np.int32),
                 payload_ref=np.arange(width, dtype=np.int32),
+                update_state=np.ones(width, bool),
             )
         )
     return batches

@@ -23,3 +23,10 @@ from sitewhere_tpu.schema import (  # noqa: F401
     ZoneTable,
     AssignmentStatus,
 )
+
+# Composition root (imported lazily to keep bare-schema imports light).
+def make_instance(config=None, template=None):
+    """Build a fully wired :class:`sitewhere_tpu.instance.Instance`."""
+    from sitewhere_tpu.instance import Instance
+
+    return Instance(config, template)

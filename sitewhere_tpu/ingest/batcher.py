@@ -47,6 +47,7 @@ _FIELDS = (
     ("alert_level", np.int32, 0),
     ("command_id", np.int32, NULL_ID),
     ("payload_ref", np.int32, NULL_ID),
+    ("update_state", np.bool_, True),
 )
 
 
@@ -66,6 +67,7 @@ class _Row:
     alert_level: int
     command_id: int
     payload_ref: int
+    update_state: bool = True
     arrival: float = 0.0  # host clock at intake (deadline tracking only)
 
 
@@ -134,15 +136,9 @@ class Batcher:
                 f"{req.kind.name} is a host-plane request, not a pipeline event"
             )
         device_id = self.resolve_device(req.device_token)
-        if 0 <= device_id < self.capacity:
-            shard = shard_for_device(device_id, self.capacity, self.n_shards)
-        else:
-            device_id = NULL_ID
-            shard = self._rr = (self._rr + 1) % self.n_shards
         mtype_id = self.resolve_mtype(req.mtype) if req.mtype else NULL_ID
         alert_code = self.resolve_alert(req.alert_type) if req.alert_type else NULL_ID
-        now = self.clock()
-        self._pending[shard].append(
+        return self._enqueue(
             _Row(
                 device_id=device_id,
                 tenant_id=tenant_id,
@@ -158,11 +154,65 @@ class Batcher:
                 alert_level=int(req.alert_level),
                 command_id=NULL_ID,
                 payload_ref=payload_ref,
-                arrival=now,
+                update_state=bool(req.update_state),
             )
         )
+
+    def add_dense(
+        self,
+        *,
+        device_id: int,
+        tenant_id: int,
+        event_type: int,
+        ts_s: int,
+        ts_ns: int = 0,
+        mtype_id: int = NULL_ID,
+        value: float = 0.0,
+        lat: float = 0.0,
+        lon: float = 0.0,
+        elevation: float = 0.0,
+        alert_code: int = NULL_ID,
+        alert_level: int = 0,
+        command_id: int = NULL_ID,
+        payload_ref: int = NULL_ID,
+        update_state: bool = False,
+    ) -> Optional[BatchPlan]:
+        """Queue one already-resolved row — the re-injection path for
+        derived alerts and presence STATE_CHANGEs (reprocess-topic analog),
+        which carry dense handles instead of edge strings.  Defaults to
+        ``update_state=False``: system-generated events must not touch
+        last-known state or presence."""
+        return self._enqueue(
+            _Row(
+                device_id=int(device_id),
+                tenant_id=int(tenant_id),
+                event_type=int(event_type),
+                ts_s=int(ts_s),
+                ts_ns=int(ts_ns),
+                mtype_id=int(mtype_id),
+                value=float(value),
+                lat=float(lat),
+                lon=float(lon),
+                elevation=float(elevation),
+                alert_code=int(alert_code),
+                alert_level=int(alert_level),
+                command_id=int(command_id),
+                payload_ref=int(payload_ref),
+                update_state=bool(update_state),
+            )
+        )
+
+    def _enqueue(self, row: _Row) -> Optional[BatchPlan]:
+        """Shared routing/append/deadline/emit tail of the add paths."""
+        if 0 <= row.device_id < self.capacity:
+            shard = shard_for_device(row.device_id, self.capacity, self.n_shards)
+        else:
+            row.device_id = NULL_ID
+            shard = self._rr = (self._rr + 1) % self.n_shards
+        row.arrival = self.clock()
+        self._pending[shard].append(row)
         if self._oldest is None:
-            self._oldest = now
+            self._oldest = row.arrival
         if len(self._pending[shard]) >= self.seg:
             return self._emit()
         return None

@@ -19,6 +19,7 @@ record indices; a sparse index maps offsets to (segment, file position).
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import struct
@@ -67,6 +68,11 @@ class Journal:
         self._segments: List[Tuple[int, str]] = self._scan_segments()
         if not self._segments:
             self._segments = [(0, self._segment_path(0))]
+        # Re-index EVERY segment on open so point reads into older segments
+        # keep their index granularity; only the final segment may carry a
+        # torn tail (rotation fsyncs + closes the others).
+        for base, path in self._segments[:-1]:
+            self._count_records(path, base, truncate_tail=False)
         base, path = self._segments[-1]
         self._next_offset = base + self._count_records(path, base)
         self._file = open(path, "ab")
@@ -83,8 +89,14 @@ class Journal:
                 segs.append((int(fname[:-4]), os.path.join(self.dir, fname)))
         return segs
 
-    def _count_records(self, path: str, base: int = 0) -> int:
-        """Count (and truncate a torn tail of) the final segment on open."""
+    def _count_records(self, path: str, base: int = 0,
+                       truncate_tail: bool = True) -> int:
+        """Count (and index) a segment's records on open.
+
+        ``truncate_tail=True`` (final segment only): a torn tail from a
+        crash mid-append is truncated.  ``False`` (rotated segments): any
+        invalid record is real corruption → :class:`CorruptJournal`.
+        """
         n = 0
         try:
             size = os.path.getsize(path)
@@ -95,6 +107,8 @@ class Journal:
             while True:
                 if pos + _HEADER.size > size:
                     if pos < size:
+                        if not truncate_tail:
+                            raise CorruptJournal(f"{path} @ byte {pos}")
                         # Stray partial header from a crash mid-append:
                         # truncate so later appends stay readable.
                         with open(path, "ab") as tf:
@@ -103,12 +117,14 @@ class Journal:
                 length, crc = _HEADER.unpack(f.read(_HEADER.size))
                 payload = f.read(length)
                 if len(payload) < length:
+                    if not truncate_tail:
+                        raise CorruptJournal(f"{path} @ byte {pos}")
                     # Ran past EOF: torn tail from a crash mid-append.
                     with open(path, "ab") as tf:
                         tf.truncate(pos)
                     break
                 if zlib.crc32(payload) != crc:
-                    if pos + _HEADER.size + length >= size:
+                    if truncate_tail and pos + _HEADER.size + length >= size:
                         # Final record, bad checksum: torn tail — truncate.
                         with open(path, "ab") as tf:
                             tf.truncate(pos)
@@ -193,11 +209,18 @@ class Journal:
             if nxt <= start:
                 continue
             offset, seek_pos = base, 0
-            # Jump via the sparse index to the nearest entry <= start.
-            for ioff, ipath, ipos in reversed(index):
-                if ipath == path and base <= ioff and ioff <= max(start, base):
+            # Binary-search the index for the newest entry in THIS segment
+            # at or before max(start, base).
+            target = max(start, base)
+            lo = bisect.bisect_right(index, (target, chr(0x10FFFF), 0)) - 1
+            while lo >= 0:
+                ioff, ipath, ipos = index[lo]
+                if ioff < base:
+                    break
+                if ipath == path:
                     offset, seek_pos = ioff, ipos
                     break
+                lo -= 1
             with open(path, "rb") as f:
                 f.seek(seek_pos)
                 while True:

@@ -1,0 +1,259 @@
+"""Instance assembly + bootstrap — the application shell.
+
+Reference: ``service-instance-management`` bootstraps a SiteWhere instance:
+it writes the instance template configuration into ZooKeeper, runs Groovy
+user/tenant model initializers, and sets a bootstrapped marker so init is
+idempotent (``microservice/InstanceManagementMicroservice.java``,
+``templates/InstanceTemplateManager.java``,
+``initializer/GroovyUserModelInitializer.java``, marker logic
+``Microservice.java:516-518``).  The other 18 services then assemble
+themselves around that config.
+
+Here the whole platform runs as ONE process around one device mesh, so
+this module is both: the bootstrap (templates → users/tenants/datasets,
+idempotent via a marker file in the data dir) and the composition root
+(:class:`Instance`) that wires every component — identity, device
+management, event store, state, rules, dispatcher, ingest, outbound,
+commands, streams, labels — into a single lifecycle tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Callable, Dict, List, Optional
+
+from sitewhere_tpu.ids import IdentityMap
+from sitewhere_tpu.ingest.batcher import Batcher
+from sitewhere_tpu.ingest.journal import Journal
+from sitewhere_tpu.labels.manager import LabelGeneratorManager
+from sitewhere_tpu.pipeline.rules import RuleManager
+from sitewhere_tpu.runtime.config import Config
+from sitewhere_tpu.runtime.dispatcher import PipelineDispatcher
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.security.jwt import TokenManagement
+from sitewhere_tpu.security.users import UserManagement
+from sitewhere_tpu.services.device_management import DeviceManagement, RegistryMirror
+from sitewhere_tpu.services.event_store import EventStore
+from sitewhere_tpu.services.registration import RegistrationManager
+from sitewhere_tpu.services.streams import DeviceStreamManagement, DeviceStreamManager
+from sitewhere_tpu.services.tenants import TenantManagement
+from sitewhere_tpu.state.manager import DeviceStateManager
+from sitewhere_tpu.state.presence import PresenceManager
+
+logger = logging.getLogger("sitewhere_tpu.instance")
+
+
+@dataclasses.dataclass
+class InstanceTemplate:
+    """Bootstrap template (reference instance templates: default users,
+    tenants, and scripted dataset initializers — Python callables instead
+    of Groovy scripts)."""
+
+    template_id: str = "default"
+    users: List[Dict[str, object]] = dataclasses.field(
+        default_factory=lambda: [
+            {
+                "username": "admin",
+                "password": "password",
+                "first_name": "Admin",
+                "last_name": "User",
+                "authorities": ["ROLE_ADMIN"],
+            }
+        ]
+    )
+    tenants: List[Dict[str, object]] = dataclasses.field(
+        default_factory=lambda: [
+            {"token": "default", "name": "Default Tenant",
+             "auth_token": "sitewhere1234567890"}
+        ]
+    )
+    # dataset initializers run once per instance with the Instance as arg
+    # (GroovyDeviceModelInitializer analog)
+    dataset_initializers: List[Callable[["Instance"], None]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+class Instance(LifecycleComponent):
+    """The composition root: one configured SiteWhere-TPU instance."""
+
+    def __init__(self, config: Optional[Config] = None,
+                 template: Optional[InstanceTemplate] = None):
+        super().__init__("instance")
+        self.config = config or Config()
+        self.template = template or InstanceTemplate()
+        self.instance_id = self.config["instance.id"]
+        self.data_dir = os.path.abspath(self.config["instance.data_dir"])
+        os.makedirs(self.data_dir, exist_ok=True)
+
+        cap = int(self.config["pipeline.registry_capacity"])
+        width = int(self.config["pipeline.width"])
+        n_shards = int(self.config["pipeline.n_shards"])
+
+        # identity + security
+        self.identity = IdentityMap(capacity=cap)
+        self.users = UserManagement()
+        self.tokens = TokenManagement()
+        self.tenants = TenantManagement()
+
+        # device system-of-record + device-resident mirrors
+        self.mirror = RegistryMirror(capacity=cap)
+        self.device_management = DeviceManagement(
+            "default", self.identity, self.mirror
+        )
+        self.rules = RuleManager(self.identity)
+        self.device_state = self.add_child(DeviceStateManager(
+            cap, self.identity,
+            num_mtype_slots=int(self.config["pipeline.mtype_slots"]),
+            tenant_id_of_device=self._tenant_ids_of_devices,
+        ))
+
+        # durable stores
+        self.event_store = self.add_child(EventStore(
+            self.data_dir,
+            flush_interval_s=0.25,
+        ))
+        self.streams = self.add_child(DeviceStreamManagement(self.data_dir))
+        self.stream_manager = self.add_child(DeviceStreamManager(
+            self.device_management, self.streams
+        ))
+        self.labels = self.add_child(LabelGeneratorManager())
+        self.ingest_journal = Journal(
+            self.data_dir, name="ingest",
+            fsync_every=int(self.config["journal.fsync_every"]),
+            segment_bytes=int(self.config["journal.segment_bytes"]),
+        )
+        self.dead_letters = Journal(self.data_dir, name="dead-letters")
+
+        # registration + dispatch
+        self.registration = self.add_child(RegistrationManager(
+            self.device_management,
+            default_device_type=self.config.get("registration.default_device_type"),
+            allow_new_devices=bool(
+                self.config.get("registration.allow_new_devices", True)
+            ),
+        ))
+        self.batcher = Batcher(
+            width=width,
+            n_shards=n_shards,
+            registry_capacity=cap,
+            resolve_device=self.identity.device.lookup,
+            resolve_mtype=self.identity.mtype.mint,
+            resolve_alert=self.identity.alert_type.mint,
+            deadline_ms=float(self.config["pipeline.deadline_ms"]),
+        )
+        self.dispatcher = self.add_child(PipelineDispatcher(
+            batcher=self.batcher,
+            registry_provider=self.mirror.publish_registry,
+            state_manager=self.device_state,
+            rules_provider=self.rules.publish,
+            zones_provider=self.mirror.publish_zones,
+            event_store=self.event_store,
+            registration=self.registration,
+            journal=self.ingest_journal,
+            dead_letters=self.dead_letters,
+            resolve_tenant=self._tenant_dense_id,
+        ))
+        self.presence = self.add_child(PresenceManager(
+            self.device_state,
+            check_interval_s=float(self.config["presence.scan_interval_s"]),
+            missing_after_s=int(self.config["presence.missing_after_s"]),
+            on_state_changes=self._on_presence_changes,
+        ))
+        self.sources: List[LifecycleComponent] = []
+
+    # -- wiring helpers -----------------------------------------------------
+
+    def _tenant_dense_id(self, token: str) -> int:
+        return self.identity.tenant.mint(token)
+
+    def _tenant_ids_of_devices(self, device_ids):
+        import numpy as np
+
+        reg = self.mirror.publish_registry()
+        return np.asarray(reg.tenant_id)[device_ids]
+
+    def _on_presence_changes(self, batch) -> None:
+        import numpy as np
+
+        self.dispatcher.inject_batch(batch, np.asarray(batch.valid))
+
+    def add_source(self, source: LifecycleComponent) -> LifecycleComponent:
+        """Attach an ingest source wired into the dispatcher."""
+        source.on_event = self.dispatcher.ingest
+        source.on_registration = self.dispatcher.ingest_registration
+        source.on_failed_decode = self.dispatcher.ingest_failed_decode
+        self.sources.append(self.add_child(source))
+        return source
+
+    # -- bootstrap (service-instance-management) ----------------------------
+
+    @property
+    def _marker_path(self) -> str:
+        return os.path.join(self.data_dir, ".bootstrapped")
+
+    @property
+    def bootstrapped(self) -> bool:
+        return os.path.exists(self._marker_path)
+
+    def bootstrap(self) -> bool:
+        """Ensure template users/tenants exist (idempotent, re-run on every
+        start since the management stores are memory-resident until a
+        checkpoint restores them) and run dataset initializers ONCE — the
+        marker gates only the arbitrary-code initializers, the analog of
+        the reference's bootstrapped marker around its Groovy scripts
+        (``Microservice.java:516-518``).  Returns True if the dataset
+        initializers ran."""
+        for spec in self.template.users:
+            spec = dict(spec)
+            authorities = list(spec.pop("authorities", []))
+            existing = {a.authority for a in self.users.list_granted_authorities()}
+            for auth in authorities:
+                if auth not in existing:
+                    self.users.create_granted_authority(auth)
+            if not any(u.username == spec["username"] for u in
+                       self.users.list_users()):
+                self.users.create_user(authorities=authorities, **spec)
+        known = {t.token for t in self.tenants.list_tenants()}
+        for spec in self.template.tenants:
+            if spec["token"] not in known:
+                self.tenants.create_tenant(**spec)
+            self._tenant_dense_id(spec["token"])
+        if self.bootstrapped:
+            logger.info("instance %s already bootstrapped", self.instance_id)
+            return False
+        for initializer in self.template.dataset_initializers:
+            initializer(self)
+        with open(self._marker_path, "w") as f:
+            json.dump({"template": self.template.template_id}, f)
+        logger.info("bootstrapped instance %s from template %s",
+                    self.instance_id, self.template.template_id)
+        return True
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.bootstrap()
+        super().start()
+
+    def terminate(self) -> None:
+        super().terminate()
+        self.ingest_journal.close()
+        self.dead_letters.close()
+
+    # -- topology (admin surface) -------------------------------------------
+
+    def topology(self) -> dict:
+        """Live component tree + counters (reference
+        ``TopologyStateAggregator`` → admin UI WebSocket feed)."""
+        return {
+            "instance": self.instance_id,
+            "bootstrapped": self.bootstrapped,
+            "components": self.status_tree(),
+            "pipeline": self.dispatcher.metrics_snapshot(),
+            "devices": len(self.identity.device),
+            "events_stored": self.event_store.total_events,
+        }

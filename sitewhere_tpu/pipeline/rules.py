@@ -1,0 +1,161 @@
+"""Host-side rule management: CRUD over vectorized threshold rules.
+
+Reference: rule processors are per-tenant configured components
+(``service-rule-processing/.../RuleProcessorsManager.java`` +
+``spi/IRuleProcessor.java:50-97``); the built-in threshold/zone styles are
+expressed on TPU as the :class:`~sitewhere_tpu.schema.RuleTable` /
+``ZoneTable`` the fused step evaluates for every event.  This manager owns
+the authoritative rule records on the host and publishes fresh ``RuleTable``
+epochs on mutation — the same double-buffered pattern as
+:class:`~sitewhere_tpu.services.device_management.RegistryMirror`.
+
+This module covers the declarative threshold catalog; arbitrary host-side
+rule processors (the Groovy-processor analog) consume the same enriched
+stream through :mod:`sitewhere_tpu.outbound` callback connectors, exactly
+as the reference's rule hosts and outbound hosts share the enriched topic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.ids import NULL_ID, IdentityMap
+from sitewhere_tpu.schema import AlertLevel, ComparisonOp, RuleTable
+from sitewhere_tpu.services.common import (
+    DuplicateToken,
+    EntityNotFound,
+    ValidationError,
+    mint_token,
+    now_s,
+    require,
+)
+
+
+@dataclasses.dataclass
+class ThresholdRule:
+    """One declarative threshold rule (host record)."""
+
+    token: str
+    mtype: Optional[str]          # measurement name; None = all
+    op: ComparisonOp
+    threshold: float
+    alert_type: str               # alert code to fire
+    alert_level: AlertLevel = AlertLevel.WARNING
+    tenant: Optional[str] = None  # None = all tenants
+    created_s: int = dataclasses.field(default_factory=now_s)
+
+
+class RuleManager:
+    """Threshold-rule catalog publishing :class:`RuleTable` epochs."""
+
+    def __init__(self, identity: IdentityMap, capacity: int = 256):
+        self.identity = identity
+        self.capacity = capacity
+        self._lock = threading.RLock()
+        self._rules: Dict[str, ThresholdRule] = {}
+        self._slots: Dict[str, int] = {}
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._dirty = True
+        self._epoch = 0
+        self._table: Optional[RuleTable] = None
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create_rule(
+        self,
+        mtype: Optional[str],
+        op: ComparisonOp,
+        threshold: float,
+        alert_type: str,
+        alert_level: AlertLevel = AlertLevel.WARNING,
+        tenant: Optional[str] = None,
+        token: Optional[str] = None,
+    ) -> ThresholdRule:
+        require(bool(alert_type), ValidationError("alert_type required"))
+        with self._lock:
+            token = token or mint_token("rule")
+            require(token not in self._rules, DuplicateToken(f"rule {token!r}"))
+            require(bool(self._free), ValidationError("rule table full"))
+            rule = ThresholdRule(
+                token=token,
+                mtype=mtype,
+                op=ComparisonOp(op),
+                threshold=float(threshold),
+                alert_type=alert_type,
+                alert_level=AlertLevel(alert_level),
+                tenant=tenant,
+            )
+            self._rules[token] = rule
+            self._slots[token] = self._free.pop()
+            self._dirty = True
+            return rule
+
+    def get_rule(self, token: str) -> ThresholdRule:
+        with self._lock:
+            rule = self._rules.get(token)
+            require(rule is not None, EntityNotFound(f"no rule {token!r}"))
+            return rule
+
+    def list_rules(self, tenant: Optional[str] = None) -> List[ThresholdRule]:
+        with self._lock:
+            return [
+                r
+                for r in self._rules.values()
+                if tenant is None or r.tenant in (None, tenant)
+            ]
+
+    def delete_rule(self, token: str) -> ThresholdRule:
+        with self._lock:
+            rule = self.get_rule(token)
+            del self._rules[token]
+            self._free.append(self._slots.pop(token))
+            self._dirty = True
+            return rule
+
+    # -- epoch publication --------------------------------------------------
+
+    @property
+    def dirty(self) -> bool:
+        with self._lock:
+            return self._dirty
+
+    def publish(self) -> RuleTable:
+        """Current :class:`RuleTable` epoch (rebuilt only when dirty)."""
+        with self._lock:
+            if not self._dirty and self._table is not None:
+                return self._table
+            active = np.zeros(self.capacity, bool)
+            tenant_id = np.full(self.capacity, NULL_ID, np.int32)
+            mtype_id = np.full(self.capacity, NULL_ID, np.int32)
+            op = np.zeros(self.capacity, np.int32)
+            threshold = np.zeros(self.capacity, np.float32)
+            alert_code = np.full(self.capacity, NULL_ID, np.int32)
+            alert_level = np.zeros(self.capacity, np.int32)
+            for token, rule in self._rules.items():
+                slot = self._slots[token]
+                active[slot] = True
+                if rule.tenant is not None:
+                    tenant_id[slot] = self.identity.tenant.mint(rule.tenant)
+                if rule.mtype is not None:
+                    mtype_id[slot] = self.identity.mtype.mint(rule.mtype)
+                op[slot] = int(rule.op)
+                threshold[slot] = rule.threshold
+                alert_code[slot] = self.identity.alert_type.mint(rule.alert_type)
+                alert_level[slot] = int(rule.alert_level)
+            self._table = RuleTable(
+                active=jnp.asarray(active),
+                tenant_id=jnp.asarray(tenant_id),
+                mtype_id=jnp.asarray(mtype_id),
+                op=jnp.asarray(op),
+                threshold=jnp.asarray(threshold),
+                alert_code=jnp.asarray(alert_code),
+                alert_level=jnp.asarray(alert_level),
+            )
+            self._dirty = False
+            self._epoch += 1
+            return self._table

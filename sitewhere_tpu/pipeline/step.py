@@ -185,9 +185,13 @@ def update_device_state(
 
     Reference: ``DeviceStateProcessingLogic.java:46-80`` merges each event
     into the per-device state doc; here each event-type family updates its
-    columns via :func:`scatter_last_by_time`.
+    columns via :func:`scatter_last_by_time`.  Rows with
+    ``update_state=False`` (system-generated events, reference
+    ``IDeviceEvent.isUpdateState()``) are persisted/fanned-out upstream but
+    never merged here — and never mark a device present.
     """
     ids = batch.device_id
+    accepted = accepted & batch.update_state
 
     # Any-event columns.
     new_s, new_ns, (new_type,) = scatter_last_by_time(
@@ -312,6 +316,9 @@ def _build_derived_alerts(
         # can link alert → cause (reference: alert events reference the
         # triggering event ids).
         payload_ref=batch.payload_ref,
+        # System-generated: persist + fan out, but never merge into
+        # last-known state or mark the device present.
+        update_state=jnp.zeros_like(fired),
     )
 
 

@@ -1,0 +1,326 @@
+"""Pipeline dispatcher: the host loop driving the fused TPU step.
+
+This is the TPU reshape of the reference's inbound-processing service
+(``InboundPayloadProcessingLogic.java:135-159`` — Kafka poll → per-record
+thread-pool tasks → per-event gRPC) plus the enrichment forwarding
+(``OutboundPayloadEnrichmentLogic.java:54-88``) and the fan-out consumers:
+instead of processes connected by Kafka topics, ONE host thread cycles
+
+    batcher → jitted pipeline step (device) → routed host egress
+
+where egress covers everything the reference spreads over five services:
+
+- accepted rows  → event store append (event-management persistence)
+- enriched cols  → outbound connector workers (outbound-connectors) —
+  which also host rule-processor callbacks (rule-processing)
+- command rows   → command processor (command-delivery)
+- unregistered   → registration manager → replay (device-registration,
+  reprocess topic)
+- derived alerts + presence state-changes → re-injected into the batcher
+- new state      → DeviceStateManager.commit (device-state), sweep-safe
+
+Double-buffering: while the device computes step N, the host assembles
+batch N+1 and drains egress N-1 (egress handoff is queue-based; JAX
+dispatch is async until outputs are fetched).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from sitewhere_tpu.ids import NULL_ID
+from sitewhere_tpu.ingest.batcher import Batcher, BatchPlan
+from sitewhere_tpu.ingest.decoders import DecodedRequest
+from sitewhere_tpu.ingest.journal import Journal
+from sitewhere_tpu.pipeline.step import pipeline_step
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.schema import EventBatch, EventType, as_numpy
+
+logger = logging.getLogger("sitewhere_tpu.dispatcher")
+
+
+class PipelineDispatcher(LifecycleComponent):
+    """Owns the ingest→step→egress loop for one instance.
+
+    Collaborators are duck-typed providers so tenants/tests can compose
+    subsets:
+
+    - ``registry_provider()`` / ``zones_provider()`` / ``rules_provider()``
+      → current device-resident epochs (RegistryMirror / RuleManager)
+    - ``state_manager`` → DeviceStateManager (commit + sweeps)
+    - ``event_store`` → accepted-row persistence (append_columns)
+    - ``outbound`` → OutboundConnectorsManager (submit cols+mask)
+    - ``on_command_rows(cols, idx)`` → command-delivery hook
+    - ``registration`` → RegistrationManager (process_unregistered)
+    """
+
+    def __init__(
+        self,
+        batcher: Batcher,
+        registry_provider: Callable[[], object],
+        state_manager,
+        rules_provider: Callable[[], object],
+        zones_provider: Callable[[], object],
+        event_store=None,
+        outbound=None,
+        registration=None,
+        on_command_rows: Optional[Callable[[Dict[str, np.ndarray], np.ndarray], None]] = None,
+        journal: Optional[Journal] = None,
+        dead_letters: Optional[Journal] = None,
+        resolve_tenant: Optional[Callable[[str], int]] = None,
+        max_replay_depth: int = 4,
+        name: str = "pipeline-dispatcher",
+    ):
+        super().__init__(name)
+        self.batcher = batcher
+        self.registry_provider = registry_provider
+        self.rules_provider = rules_provider
+        self.zones_provider = zones_provider
+        self.state_manager = state_manager
+        self.event_store = event_store
+        self.outbound = outbound
+        self.registration = registration
+        self.on_command_rows = on_command_rows
+        self.journal = journal
+        self.dead_letters = dead_letters
+        self.resolve_tenant = resolve_tenant or (lambda token: 0)
+        self.max_replay_depth = max_replay_depth
+        # No donation of `state`: DeviceStateManager.commit's sweep-merge
+        # and concurrent readers still reference the previous epoch.
+        self._step = jax.jit(pipeline_step)
+        self._lock = threading.Lock()
+        # Serializes read-state → step → commit → egress across the loop
+        # thread, source threads, and the presence thread: two concurrent
+        # steps from the same snapshot would lose the first commit's state
+        # merges.  RLock: replay/derived re-injection recurses.
+        self._step_lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # host-aggregated counters (metrics endpoint surface)
+        self.steps = 0
+        self.totals: Dict[str, int] = {
+            "processed": 0, "accepted": 0, "unregistered": 0,
+            "unassigned": 0, "threshold_alerts": 0, "zone_alerts": 0,
+            "replayed": 0, "derived_alerts": 0, "commands": 0,
+        }
+
+    # -- ingest entry points (wired as InboundEventSource.on_event) ---------
+
+    def ingest(self, req: DecodedRequest, payload: bytes = b"") -> None:
+        """Queue one decoded request (journal it first: at-least-once)."""
+        ref = NULL_ID
+        if self.journal is not None and payload:
+            ref = self.journal.append(payload)
+        tenant_id = self.resolve_tenant(req.metadata.get("tenant", "default")
+                                        if req.metadata else "default")
+        with self._lock:
+            plan = self.batcher.add(req, tenant_id=tenant_id, payload_ref=ref)
+        if plan is not None:
+            self._run_plan(plan)
+
+    def ingest_registration(self, req: DecodedRequest, payload: bytes = b"") -> None:
+        if self.registration is not None:
+            self.registration.handle_registration(req)
+
+    def ingest_failed_decode(self, payload: bytes, source_id: str, error) -> None:
+        if self.dead_letters is not None:
+            self.dead_letters.append_json(
+                {"kind": "failed-decode", "source": source_id,
+                 "error": str(error), "payload": payload.hex()}
+            )
+
+    # -- the loop -----------------------------------------------------------
+
+    def start(self) -> None:
+        super().start()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{self.name}-loop", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.flush()
+        super().stop()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.batcher.deadline_s / 2):
+            try:
+                with self._lock:
+                    plan = self.batcher.poll()  # deadline-driven partial emit
+                if plan is not None:
+                    self._run_plan(plan)
+            except Exception:
+                logger.exception("dispatch cycle failed")
+
+    def flush(self) -> None:
+        """Force pending rows through (tests/shutdown)."""
+        with self._lock:
+            plan = self.batcher.flush()
+        if plan is not None:
+            self._run_plan(plan)
+
+    # -- one step -----------------------------------------------------------
+
+    def _run_plan(self, plan: BatchPlan, replay_depth: int = 0) -> None:
+        with self._step_lock:
+            batch = plan.batch
+            state = self.state_manager.current
+            new_state, out = self._step(
+                self.registry_provider(), state,
+                self.rules_provider(), self.zones_provider(), batch,
+            )
+            self.state_manager.commit(new_state, batch=batch,
+                                      accepted=out.accepted)
+            self._egress(batch, out, replay_depth)
+            self.steps += 1
+
+    def _egress(self, batch: EventBatch, out, replay_depth: int) -> None:
+        """Host fan-out of one step's outputs (device→host copy happens
+        here, once, for the whole struct)."""
+        host_batch = as_numpy(batch)
+        host_out = as_numpy(out)
+        accepted = host_out.accepted
+        m = host_out.metrics
+        for key in ("processed", "accepted", "unregistered", "unassigned",
+                    "threshold_alerts", "zone_alerts"):
+            self.totals[key] += int(getattr(m, key))
+
+        cols = self._columns(host_batch, host_out)
+
+        # 1. persistence (event-management analog)
+        if self.event_store is not None and accepted.any():
+            self.event_store.append_columns(cols, mask=accepted)
+
+        # 2. enriched fan-out (outbound connectors + rule processor hosts)
+        if self.outbound is not None and accepted.any():
+            self.outbound.submit(cols, accepted)
+
+        # 3. command invocations (command-delivery analog)
+        cmd_mask = accepted & (host_batch.event_type == EventType.COMMAND_INVOCATION)
+        if self.on_command_rows is not None and cmd_mask.any():
+            self.totals["commands"] += int(cmd_mask.sum())
+            self.on_command_rows(cols, cmd_mask)
+
+        # 4. auto-registration + replay (device-registration analog)
+        self._handle_unregistered(host_batch, host_out, replay_depth)
+
+        # 5. derived alerts re-injection (rule outputs become first-class
+        #    events, reference ZoneTestRuleProcessor fires alerts back
+        #    through event management)
+        self._reinject_derived(host_out, replay_depth)
+
+    def _columns(self, host_batch, host_out) -> Dict[str, np.ndarray]:
+        cols = {
+            name: getattr(host_batch, name)
+            for name in (
+                "device_id", "tenant_id", "event_type", "ts_s", "ts_ns",
+                "mtype_id", "value", "lat", "lon", "elevation",
+                "alert_code", "alert_level", "command_id", "payload_ref",
+            )
+        }
+        for name in ("device_type_id", "assignment_id", "area_id",
+                     "customer_id", "asset_id"):
+            cols[name] = getattr(host_out, name)
+        return cols
+
+    def _handle_unregistered(self, host_batch, host_out, replay_depth: int) -> None:
+        mask = host_out.unregistered
+        if not mask.any():
+            return
+        refs = host_batch.payload_ref[mask]
+        requests: List[DecodedRequest] = []
+        if self.journal is not None:
+            # resolve original requests from the journal for replay
+            from sitewhere_tpu.ingest.decoders import JsonDecoder
+
+            decoder = JsonDecoder()
+            for ref in refs:
+                if int(ref) == NULL_ID:
+                    continue
+                try:
+                    requests.extend(decoder(self.journal.read_one(int(ref))))
+                except Exception:
+                    logger.debug("unreplayable payload ref %d", int(ref))
+        if self.registration is None or not requests:
+            if self.dead_letters is not None:
+                self.dead_letters.append_json(
+                    {"kind": "unregistered", "count": int(mask.sum()),
+                     "refs": [int(r) for r in refs]}
+                )
+            return
+        replay = self.registration.process_unregistered(requests)
+        if replay and replay_depth < self.max_replay_depth:
+            self.totals["replayed"] += len(replay)
+            plans = []
+            with self._lock:
+                for req in replay:
+                    tenant_id = self.resolve_tenant(
+                        req.metadata.get("tenant", "default")
+                        if req.metadata else "default"
+                    )
+                    plan = self.batcher.add(req, tenant_id=tenant_id,
+                                            payload_ref=NULL_ID)
+                    if plan is not None:
+                        plans.append(plan)
+            for plan in plans:
+                self._run_plan(plan, replay_depth + 1)
+
+    def _reinject_derived(self, host_out, replay_depth: int) -> None:
+        derived = host_out.derived_alerts
+        mask = np.asarray(derived.valid)
+        count = int(mask.sum())
+        if count == 0 or replay_depth >= self.max_replay_depth:
+            return
+        self.totals["derived_alerts"] += count
+        self.inject_batch(derived, mask, replay_depth + 1)
+
+    def inject_batch(self, batch: EventBatch, mask: np.ndarray,
+                     replay_depth: int = 0) -> None:
+        """Re-inject an already-dense event batch (derived alerts, presence
+        STATE_CHANGEs) through the pipeline as first-class events."""
+        host = as_numpy(batch)
+        rows = np.nonzero(mask)[0]
+        plans = []
+        with self._lock:
+            for i in rows:
+                plan = self.batcher.add_dense(
+                    device_id=int(host.device_id[i]),
+                    tenant_id=int(host.tenant_id[i]),
+                    event_type=int(host.event_type[i]),
+                    ts_s=int(host.ts_s[i]),
+                    ts_ns=int(host.ts_ns[i]),
+                    mtype_id=int(host.mtype_id[i]),
+                    value=float(host.value[i]),
+                    lat=float(host.lat[i]),
+                    lon=float(host.lon[i]),
+                    elevation=float(host.elevation[i]),
+                    alert_code=int(host.alert_code[i]),
+                    alert_level=int(host.alert_level[i]),
+                    command_id=int(host.command_id[i]),
+                    payload_ref=int(host.payload_ref[i]),
+                    update_state=bool(host.update_state[i]),
+                )
+                if plan is not None:
+                    plans.append(plan)
+        for plan in plans:
+            self._run_plan(plan, replay_depth)
+
+    def metrics_snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            pending = self.batcher.pending
+        return {
+            "steps": self.steps,
+            "pending_rows": pending,
+            **self.totals,
+        }

@@ -144,6 +144,12 @@ class EventBatch:
     alert_level: jax.Array  # int32[B]  — AlertLevel
     command_id: jax.Array   # int32[B]  — command handle (COMMAND_INVOCATION/RESPONSE)
     payload_ref: jax.Array  # int32[B]  — host journal offset (opaque on device)
+    # Reference ``IDeviceEvent.isUpdateState()``: system-generated events
+    # (presence STATE_CHANGEs, derived alerts) carry False so they are
+    # persisted + fanned out WITHOUT touching last-known state or clearing
+    # the presence flag — a silent device must not look alive because the
+    # platform wrote an event about it.
+    update_state: jax.Array  # bool[B]
 
     @property
     def width(self) -> int:
@@ -167,6 +173,7 @@ class EventBatch:
             alert_level=_i32((width,)),
             command_id=_i32((width,), NULL_ID),
             payload_ref=_i32((width,), NULL_ID),
+            update_state=_bool((width,), True),
         )
 
 

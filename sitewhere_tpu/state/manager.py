@@ -78,7 +78,10 @@ class DeviceStateManager(LifecycleComponent):
             current = self._state
             if batch is not None and current is not new_state:
                 cap = new_state.capacity
-                merged_rows = batch.valid & (batch.device_id >= 0)
+                # mirror the step's merge mask: update_state=False rows
+                # never cleared presence in the step
+                merged_rows = (batch.valid & (batch.device_id >= 0)
+                               & batch.update_state)
                 if accepted is not None:
                     merged_rows = merged_rows & accepted
                 ids = jnp.where(merged_rows, batch.device_id, cap)

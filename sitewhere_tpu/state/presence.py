@@ -73,6 +73,9 @@ def state_changes_for(
         event_type=jnp.full(width, EventType.STATE_CHANGE, jnp.int32),
         ts_s=jnp.full(width, now_s, jnp.int32),
         alert_code=jnp.full(width, STATE_CHANGE_PRESENCE_MISSING, jnp.int32),
+        # System-generated: must not mark the device present or bump its
+        # last-event time (reference isUpdateState() semantics).
+        update_state=jnp.zeros(width, bool),
     )
 
 

@@ -223,3 +223,29 @@ class TestCommitMergeRace:
         # dev-0 cleared (accepted fresh event); dev-5's flag survives even
         # though a (rejected) row named it
         assert manager.missing_device_ids() == [5]
+
+
+def test_update_state_false_rows_do_not_touch_state(manager):
+    """System-generated events (presence STATE_CHANGEs, derived alerts)
+    carry update_state=False: persisted/fanned out but never merged —
+    reference IDeviceEvent.isUpdateState() semantics."""
+    import jax.numpy as jnp
+
+    run_step(manager, [measurement(0, ts=1000)])
+    manager.apply_presence_sweep(now_s=80_000, missing_after_s=10_000)
+    assert manager.missing_device_ids() == [0]
+
+    registry = make_registry(capacity=CAP, n_devices=8)
+    batch = make_batch([
+        dict(device_id=0, tenant_id=0, event_type=EventType.STATE_CHANGE,
+             ts_s=80_000, update_state=False),
+    ])
+    base = manager.current
+    new_state, out = pipeline_step(
+        registry, base, RuleTable.empty(4), ZoneTable.empty(4), batch
+    )
+    manager.commit(new_state, batch=batch, accepted=out.accepted)
+    # still missing, last_event_ts unchanged — the STATE_CHANGE about the
+    # device did not make it look alive
+    assert manager.missing_device_ids() == [0]
+    assert manager.get_device_state("dev-0")["last_event_ts_s"] == 1000

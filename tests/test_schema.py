@@ -21,7 +21,7 @@ def test_event_batch_empty_shapes():
 def test_event_batch_is_pytree():
     b = schema.EventBatch.empty(16)
     leaves = jax.tree_util.tree_leaves(b)
-    assert len(leaves) == 15
+    assert len(leaves) == 16
     b2 = jax.tree_util.tree_map(lambda x: x, b)
     assert b2.width == 16
 
