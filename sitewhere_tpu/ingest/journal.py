@@ -68,11 +68,16 @@ class Journal:
         self._segments: List[Tuple[int, str]] = self._scan_segments()
         if not self._segments:
             self._segments = [(0, self._segment_path(0))]
-        # Re-index EVERY segment on open so point reads into older segments
-        # keep their index granularity; only the final segment may carry a
-        # torn tail (rotation fsyncs + closes the others).
+        # Index EVERY segment on open so point reads into older segments
+        # keep their granularity.  Rotated segments are immutable: their
+        # index is persisted in a sidecar at rotation, so reopen cost is
+        # O(sidecar) not O(segment bytes); a missing/stale sidecar falls
+        # back to a scan (which also rebuilds it).  Only the final segment
+        # may carry a torn tail (rotation fsyncs + closes the others).
         for base, path in self._segments[:-1]:
-            self._count_records(path, base, truncate_tail=False)
+            if not self._load_sidecar(base, path):
+                self._count_records(path, base, truncate_tail=False)
+                self._write_sidecar(base, path)
         base, path = self._segments[-1]
         self._next_offset = base + self._count_records(path, base)
         self._file = open(path, "ab")
@@ -81,6 +86,33 @@ class Journal:
 
     def _segment_path(self, base_offset: int) -> str:
         return os.path.join(self.dir, f"{base_offset:020d}.log")
+
+    def _sidecar_path(self, path: str) -> str:
+        return path[:-4] + ".idx"
+
+    def _load_sidecar(self, base: int, path: str) -> bool:
+        """Load a rotated segment's persisted index; False on miss/stale."""
+        try:
+            with open(self._sidecar_path(path)) as f:
+                doc = json.load(f)
+        except (FileNotFoundError, ValueError):
+            return False
+        if doc.get("index_every") != self.index_every \
+                or doc.get("size") != os.path.getsize(path):
+            return False
+        self._index.extend((base + off, path, pos)
+                           for off, pos in doc.get("entries", []))
+        return True
+
+    def _write_sidecar(self, base: int, path: str) -> None:
+        entries = [[off - base, pos] for off, ipath, pos in self._index
+                   if ipath == path]
+        tmp = self._sidecar_path(path) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"index_every": self.index_every,
+                       "size": os.path.getsize(path),
+                       "entries": entries}, f)
+        os.replace(tmp, self._sidecar_path(path))
 
     def _scan_segments(self) -> List[Tuple[int, str]]:
         segs = []
@@ -166,6 +198,9 @@ class Journal:
         os.fsync(self._file.fileno())
         self._unsynced = 0
         self._file.close()
+        # persist the finished segment's index (it is immutable from here)
+        finished_base, finished_path = self._segments[-1]
+        self._write_sidecar(finished_base, finished_path)
         path = self._segment_path(self._next_offset)
         self._segments.append((self._next_offset, path))
         self._file = open(path, "ab")

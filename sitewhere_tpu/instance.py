@@ -25,19 +25,25 @@ import logging
 import os
 from typing import Callable, Dict, List, Optional
 
-from sitewhere_tpu.ids import IdentityMap
+from sitewhere_tpu.commands.model import CommandInvocation
+from sitewhere_tpu.commands.processing import CommandProcessor
+from sitewhere_tpu.ids import NULL_ID, IdentityMap
 from sitewhere_tpu.ingest.batcher import Batcher
 from sitewhere_tpu.ingest.journal import Journal
 from sitewhere_tpu.labels.manager import LabelGeneratorManager
+from sitewhere_tpu.outbound.manager import OutboundConnectorsManager
 from sitewhere_tpu.pipeline.rules import RuleManager
 from sitewhere_tpu.runtime.config import Config
 from sitewhere_tpu.runtime.dispatcher import PipelineDispatcher
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.security.jwt import TokenManagement
 from sitewhere_tpu.security.users import UserManagement
+from sitewhere_tpu.services.assets import AssetManagement
+from sitewhere_tpu.services.batch_ops import BatchOperationManager
 from sitewhere_tpu.services.device_management import DeviceManagement, RegistryMirror
 from sitewhere_tpu.services.event_store import EventStore
 from sitewhere_tpu.services.registration import RegistrationManager
+from sitewhere_tpu.services.schedules import ScheduleManager
 from sitewhere_tpu.services.streams import DeviceStreamManagement, DeviceStreamManager
 from sitewhere_tpu.services.tenants import TenantManagement
 from sitewhere_tpu.state.manager import DeviceStateManager
@@ -128,7 +134,24 @@ class Instance(LifecycleComponent):
         )
         self.dead_letters = Journal(self.data_dir, name="dead-letters")
 
-        # registration + dispatch
+        # domain services the dispatcher egresses into — registered as
+        # children BEFORE it so the reverse-order stop keeps them alive
+        # through the dispatcher's shutdown flush
+        self.assets = AssetManagement("default", self.identity)
+        self.commands = self.add_child(CommandProcessor(
+            self.device_management,
+            on_undelivered=self._on_undelivered_command,
+        ))
+        self.batch_ops = self.add_child(BatchOperationManager(
+            self.device_management, self.commands,
+            throttle_delay_ms=int(self.config.get(
+                "batch.throttle_delay_ms", 0)),
+        ))
+        self.schedules = self.add_child(ScheduleManager(executors={
+            "CommandInvocation": self._run_scheduled_invocation,
+            "BatchCommandInvocation": self._run_scheduled_batch,
+        }))
+        self.outbound = self.add_child(OutboundConnectorsManager())
         self.registration = self.add_child(RegistrationManager(
             self.device_management,
             default_device_type=self.config.get("registration.default_device_type"),
@@ -136,6 +159,8 @@ class Instance(LifecycleComponent):
                 self.config.get("registration.allow_new_devices", True)
             ),
         ))
+
+        # dispatch
         self.batcher = Batcher(
             width=width,
             n_shards=n_shards,
@@ -152,7 +177,9 @@ class Instance(LifecycleComponent):
             rules_provider=self.rules.publish,
             zones_provider=self.mirror.publish_zones,
             event_store=self.event_store,
+            outbound=self.outbound,
             registration=self.registration,
+            on_command_rows=self._on_command_rows,
             journal=self.ingest_journal,
             dead_letters=self.dead_letters,
             resolve_tenant=self._tenant_dense_id,
@@ -180,6 +207,84 @@ class Instance(LifecycleComponent):
         import numpy as np
 
         self.dispatcher.inject_batch(batch, np.asarray(batch.valid))
+
+    def _on_command_rows(self, cols, mask) -> None:
+        """Deliver pipeline COMMAND_INVOCATION events (reference:
+        enriched-command-invocations → command-delivery, SURVEY.md §3.4).
+
+        The tensor row carries only dense handles; the command token +
+        parameters live in the journaled source payload (``payload_ref``).
+        Rows without a resolvable command spec dead-letter.
+        """
+        from sitewhere_tpu.ingest.journal import CorruptJournal
+
+        refs = cols["payload_ref"][mask]
+        device_ids = cols["device_id"][mask]
+        for ref, dev in zip(refs, device_ids):
+            invocation = None
+            try:
+                if int(ref) != NULL_ID:
+                    doc = json.loads(self.ingest_journal.read_one(int(ref)))
+                    body = doc.get("request", doc)
+                    command = body.get("commandToken")
+                    if command:
+                        assignment = body.get("assignmentToken")
+                        if not assignment:
+                            token = self.identity.device.token_of(int(dev))
+                            active = (self.device_management
+                                      .get_active_assignment(token)
+                                      if token else None)
+                            assignment = active.token if active else None
+                        if assignment:
+                            invocation = CommandInvocation(
+                                command_token=str(command),
+                                target_assignment=str(assignment),
+                                parameter_values=dict(
+                                    body.get("parameterValues", {})),
+                                initiator="EVENT",
+                            )
+            except (ValueError, KeyError, CorruptJournal) as e:
+                logger.debug("unresolvable command payload ref %s: %s", ref, e)
+            if invocation is not None:
+                self.commands.invoke(invocation)
+            else:
+                self.dead_letters.append_json({
+                    "kind": "undeliverable-invocation",
+                    "device_id": int(dev),
+                    "payload_ref": int(ref),
+                })
+
+    def _on_undelivered_command(self, invocation, reason) -> None:
+        """Undelivered commands dead-letter (reference:
+        undelivered-command-invocations topic)."""
+        self.dead_letters.append_json({
+            "kind": "undelivered-command",
+            "invocation": invocation.token,
+            "command": invocation.command_token,
+            "assignment": invocation.target_assignment,
+            "reason": str(reason),
+        })
+
+    def _run_scheduled_invocation(self, job) -> None:
+        """Executor for CommandInvocation jobs (reference
+        ``jobs/CommandInvocationJob.java``)."""
+        self.commands.invoke(CommandInvocation(
+            command_token=str(job.config["commandToken"]),
+            target_assignment=str(job.config["assignmentToken"]),
+            parameter_values=dict(job.config.get("parameterValues", {})),
+            initiator="SCHEDULER",
+            initiator_id=job.token,
+        ))
+
+    def _run_scheduled_batch(self, job) -> None:
+        """Executor for BatchCommandInvocation jobs (reference
+        ``jobs/BatchCommandInvocationJob.java``)."""
+        self.batch_ops.create_batch_command_invocation(
+            command_token=str(job.config["commandToken"]),
+            parameter_values=dict(job.config.get("parameterValues", {})),
+            devices=list(job.config.get("devices", [])) or None,
+            group=job.config.get("group"),
+        )
 
     def add_source(self, source: LifecycleComponent) -> LifecycleComponent:
         """Attach an ingest source wired into the dispatcher."""

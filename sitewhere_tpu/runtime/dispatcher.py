@@ -240,24 +240,30 @@ class PipelineDispatcher(LifecycleComponent):
             return
         refs = host_batch.payload_ref[mask]
         requests: List[DecodedRequest] = []
-        if self.journal is not None:
+        unreplayable: List[int] = []
+        if self.journal is not None and self.registration is not None:
             # resolve original requests from the journal for replay
             from sitewhere_tpu.ingest.decoders import JsonDecoder
 
             decoder = JsonDecoder()
             for ref in refs:
                 if int(ref) == NULL_ID:
+                    unreplayable.append(int(ref))
                     continue
                 try:
                     requests.extend(decoder(self.journal.read_one(int(ref))))
                 except Exception:
                     logger.debug("unreplayable payload ref %d", int(ref))
+                    unreplayable.append(int(ref))
+        else:
+            unreplayable = [int(r) for r in refs]
+        # every unreplayable row dead-letters, even when siblings replay
+        if unreplayable and self.dead_letters is not None:
+            self.dead_letters.append_json(
+                {"kind": "unregistered", "count": len(unreplayable),
+                 "refs": unreplayable}
+            )
         if self.registration is None or not requests:
-            if self.dead_letters is not None:
-                self.dead_letters.append_json(
-                    {"kind": "unregistered", "count": int(mask.sum()),
-                     "refs": [int(r) for r in refs]}
-                )
             return
         replay = self.registration.process_unregistered(requests)
         if replay and replay_depth < self.max_replay_depth:

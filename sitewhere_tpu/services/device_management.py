@@ -1199,9 +1199,12 @@ class DeviceManagement:
         """
         return f"{self.tenant}:{token}"
 
-    def mtype_handle(self, name: str) -> int:
-        """Dense handle for a measurement name (edge decode uses this)."""
-        return self.identity.mtype.mint(self._scoped(name))
+    def handle_for(self, space: str, token: str) -> int:
+        """Dense handle of a tenant-scoped entity (assignment/area/customer/
+        asset/device_type…) — what the enrichment columns carry.  Device
+        tokens are global: use ``identity.device.lookup`` directly.
+        Returns ``NULL_ID`` if unknown."""
+        return getattr(self.identity, space).lookup(self._scoped(token))
 
     def alert_type_handle(self, name: str) -> int:
         return self.identity.alert_type.mint(self._scoped(name))

@@ -1,0 +1,413 @@
+"""REST controllers: the reference's web-rest surface over one Instance.
+
+Reference: ``service-web-rest/src/main/java/com/sitewhere/web/rest/
+controllers/`` — 25 Spring controllers (Devices, DeviceTypes, Assignments
+incl. event create/list ``Assignments.java:319-576``, Areas, AreaTypes,
+Customers, CustomerTypes, Zones, DeviceGroups, Assets, AssetTypes,
+BatchOperations, Schedules, Tenants, Users, Instance topology, External
+search…) plus JWT issuing (``web/auth/controllers/JwtService.java:75``).
+
+Route shapes follow the reference's ``/api/...`` layout.  Event creation
+goes through the dispatcher (the full validate→enrich→rules→state pipeline)
+rather than straight into storage — same as the reference where REST event
+creation flows into event management and the Kafka pipeline.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+from sitewhere_tpu.commands.model import CommandInvocation
+from sitewhere_tpu.ids import NULL_ID
+from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
+from sitewhere_tpu.schema import AlertLevel, ComparisonOp, EventType
+from sitewhere_tpu.services.common import (
+    AuthError,
+    EntityNotFound,
+    ValidationError,
+    require,
+)
+from sitewhere_tpu.web.http import RawResponse, Request, RestGateway, page_response
+
+_EVENT_TYPE_NAMES = {
+    "measurements": EventType.MEASUREMENT,
+    "locations": EventType.LOCATION,
+    "alerts": EventType.ALERT,
+    "invocations": EventType.COMMAND_INVOCATION,
+    "responses": EventType.COMMAND_RESPONSE,
+    "statechanges": EventType.STATE_CHANGE,
+}
+
+
+def register_routes(gw: RestGateway, inst) -> None:
+    """Wire every controller against ``inst`` (an Instance)."""
+    r = gw.router.add
+    dm = inst.device_management
+
+    # ---- auth (reference JwtService; unauthenticated route) ---------------
+    def issue_jwt(req: Request):
+        body = req.json()
+        username = body.get("username")
+        password = body.get("password")
+        if not username:  # Basic auth fallback, as in the reference
+            header = req.headers.get("Authorization", "")
+            if header.startswith("Basic "):
+                try:
+                    raw = base64.b64decode(header[6:]).decode()
+                    username, _, password = raw.partition(":")
+                except Exception as e:
+                    raise AuthError(f"bad basic auth: {e}") from e
+        require(bool(username), AuthError("credentials required"))
+        user = inst.users.authenticate(username, password or "")
+        token = inst.tokens.mint(user.username, user.authorities)
+        return {"token": token, "username": user.username,
+                "authorities": user.authorities}
+
+    r("POST", "/api/jwt", issue_jwt, auth_required=False)
+
+    # ---- users ------------------------------------------------------------
+    r("GET", "/api/users", lambda q: page_response(inst.users.list_users(q.criteria())))
+    r("POST", "/api/users", lambda q: inst.users.create_user(**q.json()))
+    r("GET", "/api/users/{name}", lambda q: inst.users.get_user(q.params["name"]))
+    r("PUT", "/api/users/{name}",
+      lambda q: inst.users.update_user(q.params["name"], **q.json()))
+    r("DELETE", "/api/users/{name}",
+      lambda q: inst.users.delete_user(q.params["name"]))
+    r("GET", "/api/authorities",
+      lambda q: page_response(inst.users.list_granted_authorities(q.criteria())))
+
+    # ---- tenants ----------------------------------------------------------
+    r("GET", "/api/tenants",
+      lambda q: page_response(inst.tenants.list_tenants(q.criteria())))
+    r("POST", "/api/tenants", lambda q: inst.tenants.create_tenant(**q.json()))
+    r("GET", "/api/tenants/{token}",
+      lambda q: inst.tenants.get_tenant(q.params["token"]))
+    r("PUT", "/api/tenants/{token}",
+      lambda q: inst.tenants.update_tenant(q.params["token"], **q.json()))
+    r("DELETE", "/api/tenants/{token}",
+      lambda q: inst.tenants.delete_tenant(q.params["token"]))
+
+    # ---- device types + commands + statuses -------------------------------
+    r("GET", "/api/devicetypes",
+      lambda q: page_response(dm.list_device_types(q.criteria())))
+    r("POST", "/api/devicetypes", lambda q: dm.create_device_type(**q.json()))
+    r("GET", "/api/devicetypes/{token}",
+      lambda q: dm.get_device_type(q.params["token"]))
+    r("PUT", "/api/devicetypes/{token}",
+      lambda q: dm.update_device_type(q.params["token"], **q.json()))
+    r("DELETE", "/api/devicetypes/{token}",
+      lambda q: dm.delete_device_type(q.params["token"]))
+    r("GET", "/api/devicetypes/{token}/commands",
+      lambda q: dm.list_device_commands(q.params["token"]))
+    r("POST", "/api/devicetypes/{token}/commands",
+      lambda q: dm.create_device_command(q.params["token"], **q.json()))
+    r("DELETE", "/api/devicetypes/{token}/commands/{cmd}",
+      lambda q: dm.delete_device_command(q.params["token"], q.params["cmd"]))
+    r("GET", "/api/devicetypes/{token}/statuses",
+      lambda q: dm.list_device_statuses(q.params["token"]))
+    r("POST", "/api/devicetypes/{token}/statuses",
+      lambda q: dm.create_device_status(q.params["token"], **q.json()))
+
+    # ---- devices ----------------------------------------------------------
+    def list_devices(q: Request):
+        return page_response(dm.list_devices(
+            q.criteria(),
+            device_type=q.q1("deviceType"),
+        ))
+
+    r("GET", "/api/devices", list_devices)
+    r("POST", "/api/devices", lambda q: dm.create_device(**q.json()))
+    r("GET", "/api/devices/{token}", lambda q: dm.get_device(q.params["token"]))
+    r("PUT", "/api/devices/{token}",
+      lambda q: dm.update_device(q.params["token"], **q.json()))
+    r("DELETE", "/api/devices/{token}",
+      lambda q: dm.delete_device(q.params["token"]))
+    r("GET", "/api/devices/{token}/assignments",
+      lambda q: page_response(dm.list_device_assignments(
+          q.criteria(), device=q.params["token"])))
+
+    # ---- assignments + event create/list (Assignments.java:319-576) -------
+    r("POST", "/api/assignments", lambda q: dm.create_device_assignment(**q.json()))
+    r("GET", "/api/assignments/{token}",
+      lambda q: dm.get_device_assignment(q.params["token"]))
+    r("DELETE", "/api/assignments/{token}",
+      lambda q: dm.delete_device_assignment(q.params["token"]))
+    r("POST", "/api/assignments/{token}/end",
+      lambda q: dm.release_device_assignment(q.params["token"]))
+    r("POST", "/api/assignments/{token}/missing",
+      lambda q: dm.mark_missing(q.params["token"]))
+
+    def _assignment_device(token: str):
+        a = dm.get_device_assignment(token)
+        return dm.get_device(a.device), a
+
+    def create_event(q: Request):
+        """POST /api/assignments/{token}/{kind} → pipeline ingest."""
+        kind = q.params["kind"]
+        etype = _EVENT_TYPE_NAMES.get(kind)
+        require(etype is not None, EntityNotFound(f"no event kind {kind!r}"))
+        device, _ = _assignment_device(q.params["token"])
+        body = q.json()
+        from sitewhere_tpu.services.common import now_s
+
+        common = dict(
+            device_token=device.token,
+            ts_s=int(body.get("ts", now_s())),
+            ts_ns=int(body.get("tsNs", 0)),
+            update_state=bool(body.get("updateState", True)),
+            metadata=body.get("metadata"),
+        )
+        if etype == EventType.MEASUREMENT:
+            req_ = DecodedRequest(
+                kind=RequestKind.MEASUREMENT,
+                mtype=str(body.get("name", body.get("measurementId", ""))),
+                value=float(body.get("value", 0.0)), **common)
+        elif etype == EventType.LOCATION:
+            req_ = DecodedRequest(
+                kind=RequestKind.LOCATION,
+                lat=float(body.get("latitude", 0.0)),
+                lon=float(body.get("longitude", 0.0)),
+                elevation=float(body.get("elevation", 0.0)), **common)
+        elif etype == EventType.ALERT:
+            req_ = DecodedRequest(
+                kind=RequestKind.ALERT,
+                alert_type=str(body.get("type", "alert")),
+                alert_level=int(body.get("level", AlertLevel.INFO)),
+                alert_message=body.get("message"), **common)
+        elif etype == EventType.COMMAND_INVOCATION:
+            return create_invocation(q)
+        else:
+            req_ = DecodedRequest(kind=RequestKind.STATE_CHANGE, **common)
+        inst.dispatcher.ingest(req_)
+        inst.dispatcher.flush()
+        return {"queued": True, "deviceToken": device.token,
+                "eventType": kind}
+
+    def create_invocation(q: Request):
+        """Command invocation: full command-delivery path (reference:
+        invocation events → command-delivery service)."""
+        body = q.json()
+        invocation = CommandInvocation(
+            command_token=str(body["commandToken"]),
+            target_assignment=q.params["token"],
+            parameter_values=dict(body.get("parameterValues", {})),
+            initiator="REST",
+            initiator_id=(q.claims or {}).get("sub"),
+        )
+        delivered = inst.commands.invoke(invocation)
+        # record the invocation as a pipeline event too
+        device, _ = _assignment_device(q.params["token"])
+        inst.dispatcher.ingest(DecodedRequest(
+            kind=RequestKind.COMMAND_INVOCATION,
+            device_token=device.token,
+            ts_s=invocation.created_s,
+        ))
+        inst.dispatcher.flush()
+        return {"token": invocation.token, "delivered": delivered}
+
+    r("POST", "/api/assignments/{token}/{kind}", create_event)
+
+    def list_events(q: Request):
+        kind = q.params["kind"]
+        etype = _EVENT_TYPE_NAMES.get(kind)
+        require(etype is not None, EntityNotFound(f"no event kind {kind!r}"))
+        a = dm.get_device_assignment(q.params["token"])
+        aid = dm.handle_for("assignment", a.token)
+        inst.event_store.flush()
+        return page_response(inst.event_store.query(
+            q.criteria(), assignment_id=aid, event_type=int(etype)))
+
+    r("GET", "/api/assignments/{token}/{kind}", list_events)
+
+    # ---- events (cross-entity indexes, reference DeviceEvents ctrl) -------
+    def search_events(q: Request):
+        inst.event_store.flush()
+        filters = {}
+        device = q.q1("device")
+        if device:
+            handle = inst.identity.device.lookup(device)
+            require(handle != NULL_ID, EntityNotFound(f"no device {device!r}"))
+            filters["device_id"] = handle
+        for qname, fname in (
+            ("assignment", "assignment_id"),
+            ("area", "area_id"),
+            ("customer", "customer_id"),
+            ("asset", "asset_id"),
+        ):
+            token = q.q1(qname)
+            if token:
+                handle = dm.handle_for(qname, token)
+                require(handle != NULL_ID, EntityNotFound(f"no {qname} {token!r}"))
+                filters[fname] = handle
+        kind = q.q1("eventType")
+        if kind:
+            etype = _EVENT_TYPE_NAMES.get(kind.lower())
+            require(etype is not None, EntityNotFound(f"no event kind {kind!r}"))
+            filters["event_type"] = int(etype)
+        return page_response(inst.event_store.query(q.criteria(), **filters))
+
+    r("GET", "/api/events", search_events)
+
+    # ---- areas / area types / zones ---------------------------------------
+    r("GET", "/api/areatypes",
+      lambda q: page_response(dm.list_area_types(q.criteria())))
+    r("POST", "/api/areatypes", lambda q: dm.create_area_type(**q.json()))
+    r("GET", "/api/areatypes/{token}",
+      lambda q: dm.get_area_type(q.params["token"]))
+    r("GET", "/api/areas", lambda q: page_response(dm.list_areas(q.criteria())))
+    r("GET", "/api/areas/tree", lambda q: dm.area_tree())
+    r("POST", "/api/areas", lambda q: dm.create_area(**q.json()))
+    r("GET", "/api/areas/{token}", lambda q: dm.get_area(q.params["token"]))
+    r("PUT", "/api/areas/{token}",
+      lambda q: dm.update_area(q.params["token"], **q.json()))
+    r("DELETE", "/api/areas/{token}", lambda q: dm.delete_area(q.params["token"]))
+    r("GET", "/api/zones", lambda q: page_response(
+        dm.list_zones(q.criteria(), area=q.q1("area"))))
+    r("POST", "/api/zones", lambda q: dm.create_zone(**q.json()))
+    r("GET", "/api/zones/{token}", lambda q: dm.get_zone(q.params["token"]))
+    r("PUT", "/api/zones/{token}",
+      lambda q: dm.update_zone(q.params["token"], **q.json()))
+    r("DELETE", "/api/zones/{token}", lambda q: dm.delete_zone(q.params["token"]))
+
+    # ---- customers --------------------------------------------------------
+    r("GET", "/api/customertypes",
+      lambda q: page_response(dm.list_customer_types(q.criteria())))
+    r("POST", "/api/customertypes", lambda q: dm.create_customer_type(**q.json()))
+    r("GET", "/api/customers",
+      lambda q: page_response(dm.list_customers(q.criteria())))
+    r("POST", "/api/customers", lambda q: dm.create_customer(**q.json()))
+    r("GET", "/api/customers/{token}",
+      lambda q: dm.get_customer(q.params["token"]))
+    r("DELETE", "/api/customers/{token}",
+      lambda q: dm.delete_customer(q.params["token"]))
+
+    # ---- device groups ----------------------------------------------------
+    r("GET", "/api/devicegroups",
+      lambda q: page_response(dm.list_device_groups(q.criteria())))
+    r("POST", "/api/devicegroups", lambda q: dm.create_device_group(**q.json()))
+    r("GET", "/api/devicegroups/{token}",
+      lambda q: dm.get_device_group(q.params["token"]))
+    r("DELETE", "/api/devicegroups/{token}",
+      lambda q: dm.delete_device_group(q.params["token"]))
+    r("POST", "/api/devicegroups/{token}/elements",
+      lambda q: dm.add_device_group_elements(
+          q.params["token"], q.json().get("elements", [])))
+
+    # ---- assets -----------------------------------------------------------
+    r("GET", "/api/assettypes",
+      lambda q: page_response(inst.assets.list_asset_types(q.criteria())))
+    r("POST", "/api/assettypes",
+      lambda q: inst.assets.create_asset_type(**q.json()))
+    r("GET", "/api/assets",
+      lambda q: page_response(inst.assets.list_assets(q.criteria())))
+    r("POST", "/api/assets", lambda q: inst.assets.create_asset(**q.json()))
+    r("GET", "/api/assets/{token}",
+      lambda q: inst.assets.get_asset(q.params["token"]))
+    r("DELETE", "/api/assets/{token}",
+      lambda q: inst.assets.delete_asset(q.params["token"]))
+
+    # ---- batch operations -------------------------------------------------
+    r("GET", "/api/batch",
+      lambda q: page_response(inst.batch_ops.list_operations(q.criteria())))
+    r("GET", "/api/batch/{token}",
+      lambda q: inst.batch_ops.get_operation(q.params["token"]))
+    r("GET", "/api/batch/{token}/elements",
+      lambda q: page_response(inst.batch_ops.list_elements(
+          q.params["token"], q.criteria())))
+
+    def create_batch_command(q: Request):
+        body = q.json()
+        return inst.batch_ops.create_batch_command_invocation(
+            command_token=str(body["commandToken"]),
+            parameter_values=dict(body.get("parameterValues", {})),
+            devices=body.get("deviceTokens"),
+            group=body.get("groupToken"),
+            token=body.get("token"),
+        )
+
+    r("POST", "/api/batch/command", create_batch_command)
+
+    # ---- schedules --------------------------------------------------------
+    r("GET", "/api/schedules",
+      lambda q: page_response(inst.schedules.list_schedules(q.criteria())))
+    r("POST", "/api/schedules",
+      lambda q: inst.schedules.create_schedule(**q.json()))
+    r("GET", "/api/schedules/{token}",
+      lambda q: inst.schedules.get_schedule(q.params["token"]))
+    r("DELETE", "/api/schedules/{token}",
+      lambda q: inst.schedules.delete_schedule(q.params["token"]))
+    r("POST", "/api/jobs", lambda q: inst.schedules.create_job(**q.json()))
+    r("GET", "/api/jobs", lambda q: page_response(
+        inst.schedules.list_jobs(q.criteria())))
+    r("DELETE", "/api/jobs/{token}",
+      lambda q: inst.schedules.delete_job(q.params["token"]))
+
+    # ---- rules (TPU threshold catalog; reference rule processors) ---------
+    def create_rule(q: Request):
+        body = q.json()
+        return inst.rules.create_rule(
+            mtype=body.get("mtype"),
+            op=ComparisonOp[str(body.get("op", "GT")).upper()],
+            threshold=float(body.get("threshold", 0.0)),
+            alert_type=str(body.get("alertType", "")),
+            alert_level=AlertLevel(int(body.get("alertLevel",
+                                                AlertLevel.WARNING))),
+            tenant=body.get("tenant"),
+            token=body.get("token"),
+        )
+
+    r("GET", "/api/rules", lambda q: inst.rules.list_rules(q.q1("tenant")))
+    r("POST", "/api/rules", create_rule)
+    r("DELETE", "/api/rules/{token}",
+      lambda q: inst.rules.delete_rule(q.params["token"]))
+
+    # ---- device state (reference service-device-state RPCs) ---------------
+    r("GET", "/api/devicestates/{token}",
+      lambda q: inst.device_state.get_device_state(q.params["token"]))
+    r("GET", "/api/devicestates",
+      lambda q: {"missing": [
+          inst.identity.device.token_of(i)
+          for i in inst.device_state.missing_device_ids()
+      ]})
+
+    # ---- streams (service-streaming-media REST analog) --------------------
+    def list_streams(q: Request):
+        a = dm.get_device_assignment(q.params["token"])
+        return page_response(inst.streams.list_device_streams(
+            a.token, q.criteria()))
+
+    def stream_download(q: Request):
+        a = dm.get_device_assignment(q.params["token"])
+        stream = inst.streams.get_assignment_stream(a.token, q.params["sid"])
+        require(stream is not None,
+                EntityNotFound(f"no stream {q.params['sid']!r}"))
+        return RawResponse(inst.streams.stream_content(stream.token),
+                           content_type=stream.content_type)
+
+    r("GET", "/api/assignments/{token}/streams/", list_streams)
+    r("GET", "/api/assignments/{token}/streams/{sid}", stream_download)
+
+    # ---- labels (service-label-generation REST analog) --------------------
+    def label_png(q: Request):
+        data = inst.labels.generate_png(
+            q.q1("generator", "default"), q.params["kind"], q.params["token"]
+        )
+        return RawResponse(data, content_type="image/png")
+
+    r("GET", "/api/labels/{kind}/{token}", label_png)
+
+    # ---- instance admin (topology/config/metrics; reference Instance ctrl) -
+    r("GET", "/api/instance/topology", lambda q: inst.topology())
+    r("GET", "/api/instance/configuration", lambda q: inst.config.as_dict())
+    r("GET", "/api/instance/metrics",
+      lambda q: inst.dispatcher.metrics_snapshot())
+
+    # ---- external search providers (service-event-search analog) ----------
+    def external_search(q: Request):
+        mgr = getattr(inst, "search_providers", None)
+        require(mgr is not None, EntityNotFound("no search providers configured"))
+        provider = mgr.get_provider(q.params["provider"])
+        return page_response(provider.search(q.criteria()))
+
+    r("GET", "/api/search/{provider}", external_search)

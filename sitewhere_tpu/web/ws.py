@@ -1,0 +1,217 @@
+"""Minimal RFC 6455 WebSocket server support (stdlib only).
+
+Two consumers:
+- the admin topology feed (reference: ``web/ws/components/
+  TopologyBroadcaster.java`` pushes live microservice/tenant-engine state
+  over STOMP WebSocket to the admin UI);
+- the WebSocket ingest receiver (reference: event-sources WebSocket
+  receiver) in :mod:`sitewhere_tpu.ingest.sources`.
+
+Implements the server handshake (Sec-WebSocket-Accept), frame
+encode/decode with client masking, text/binary/ping/pong/close opcodes.
+No extensions/fragmentation-reassembly beyond continuation concatenation.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import socket
+import struct
+from typing import Optional, Tuple
+
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_CONT, OP_TEXT, OP_BINARY, OP_CLOSE, OP_PING, OP_PONG = 0, 1, 2, 8, 9, 10
+
+
+def accept_key(client_key: str) -> str:
+    digest = hashlib.sha1((client_key + _GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def encode_frame(opcode: int, payload: bytes, mask: bool = False) -> bytes:
+    """Build one frame (server frames are unmasked; client frames masked)."""
+    head = bytearray([0x80 | opcode])
+    n = len(payload)
+    mask_bit = 0x80 if mask else 0
+    if n < 126:
+        head.append(mask_bit | n)
+    elif n < (1 << 16):
+        head.append(mask_bit | 126)
+        head += struct.pack(">H", n)
+    else:
+        head.append(mask_bit | 127)
+        head += struct.pack(">Q", n)
+    if mask:
+        key = struct.pack(">I", 0x12345678)
+        head += key
+        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return bytes(head) + payload
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("websocket peer closed")
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket) -> Tuple[int, bytes, bool]:
+    """Read one frame → (opcode, payload, fin)."""
+    b0, b1 = _read_exact(sock, 2)
+    fin = bool(b0 & 0x80)
+    opcode = b0 & 0x0F
+    masked = bool(b1 & 0x80)
+    length = b1 & 0x7F
+    if length == 126:
+        (length,) = struct.unpack(">H", _read_exact(sock, 2))
+    elif length == 127:
+        (length,) = struct.unpack(">Q", _read_exact(sock, 8))
+    key = _read_exact(sock, 4) if masked else None
+    payload = _read_exact(sock, length) if length else b""
+    if key is not None:
+        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return opcode, payload, fin
+
+
+class ServerWebSocket:
+    """One accepted server-side connection."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.open = True
+
+    @classmethod
+    def handshake(cls, handler) -> Optional["ServerWebSocket"]:
+        """Upgrade from a BaseHTTPRequestHandler; None if not a WS request."""
+        key = handler.headers.get("Sec-WebSocket-Key")
+        if not key or handler.headers.get("Upgrade", "").lower() != "websocket":
+            handler.send_response(400)
+            handler.end_headers()
+            return None
+        handler.send_response(101, "Switching Protocols")
+        handler.send_header("Upgrade", "websocket")
+        handler.send_header("Connection", "Upgrade")
+        handler.send_header("Sec-WebSocket-Accept", accept_key(key))
+        handler.end_headers()
+        handler.wfile.flush()
+        sock = handler.connection
+        sock.settimeout(None)
+        return cls(sock)
+
+    @classmethod
+    def handshake_raw(cls, sock: socket.socket, request_head: bytes
+                      ) -> Optional["ServerWebSocket"]:
+        """Upgrade from a raw socket given the full HTTP request head
+        (used by the standalone ingest receiver)."""
+        headers = {}
+        for line in request_head.split(b"\r\n")[1:]:
+            if b":" in line:
+                name, _, value = line.partition(b":")
+                headers[name.decode().strip().lower()] = value.decode().strip()
+        key = headers.get("sec-websocket-key")
+        if not key or headers.get("upgrade", "").lower() != "websocket":
+            sock.sendall(b"HTTP/1.1 400 Bad Request\r\nContent-Length: 0\r\n\r\n")
+            return None
+        sock.sendall(
+            b"HTTP/1.1 101 Switching Protocols\r\n"
+            b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            b"Sec-WebSocket-Accept: " + accept_key(key).encode() + b"\r\n\r\n"
+        )
+        return cls(sock)
+
+    def send_text(self, text: str) -> None:
+        self.sock.sendall(encode_frame(OP_TEXT, text.encode("utf-8")))
+
+    def send_binary(self, data: bytes) -> None:
+        self.sock.sendall(encode_frame(OP_BINARY, data))
+
+    def recv(self) -> Optional[Tuple[int, bytes]]:
+        """Next data message → (opcode, payload); None on close.
+        Transparently answers pings and concatenates continuations."""
+        opcode, payload, fin = read_frame(self.sock)
+        while True:
+            if opcode == OP_PING:
+                self.sock.sendall(encode_frame(OP_PONG, payload))
+            elif opcode == OP_CLOSE:
+                self.close()
+                return None
+            elif opcode in (OP_TEXT, OP_BINARY):
+                data = payload
+                first = opcode
+                while not fin:
+                    opcode, payload, fin = read_frame(self.sock)
+                    if opcode == OP_CONT:
+                        data += payload
+                return first, data
+            opcode, payload, fin = read_frame(self.sock)
+
+    def close(self) -> None:
+        if self.open:
+            self.open = False
+            try:
+                self.sock.sendall(encode_frame(OP_CLOSE, b""))
+            except OSError:
+                pass
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+class ClientWebSocket:
+    """Tiny client for tests + the polling/bridge paths."""
+
+    def __init__(self, host: str, port: int, path: str = "/",
+                 timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        key = base64.b64encode(b"sitewhere-tpu-cli").decode()
+        self.sock.sendall(
+            f"GET {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
+            f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+            .encode()
+        )
+        head = b""
+        while b"\r\n\r\n" not in head:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("handshake failed")
+            head += chunk
+        status = head.split(b"\r\n", 1)[0]
+        if b"101" not in status:
+            raise ConnectionError(f"handshake rejected: {status!r}")
+        expect = accept_key(key).encode()
+        if expect not in head:
+            raise ConnectionError("bad Sec-WebSocket-Accept")
+
+    def send_text(self, text: str) -> None:
+        self.sock.sendall(encode_frame(OP_TEXT, text.encode(), mask=True))
+
+    def send_binary(self, data: bytes) -> None:
+        self.sock.sendall(encode_frame(OP_BINARY, data, mask=True))
+
+    def recv(self) -> Optional[Tuple[int, bytes]]:
+        opcode, payload, fin = read_frame(self.sock)
+        while True:
+            if opcode == OP_CLOSE:
+                return None
+            if opcode in (OP_TEXT, OP_BINARY):
+                data = payload
+                first = opcode
+                while not fin:
+                    opcode, payload, fin = read_frame(self.sock)
+                    data += payload
+                return first, data
+            opcode, payload, fin = read_frame(self.sock)
+
+    def close(self) -> None:
+        try:
+            self.sock.sendall(encode_frame(OP_CLOSE, b"", mask=True))
+        except OSError:
+            pass
+        self.sock.close()

@@ -202,3 +202,65 @@ class TestDispatchLoop:
         assert topo["devices"] == 1
         names = [c["name"] for c in topo["components"]["children"]]
         assert "pipeline-dispatcher" in names and "event-store" in names
+
+
+class TestCommandDelivery:
+    def test_pipeline_invocation_reaches_destination(self, tmp_path):
+        """COMMAND_INVOCATION events from ingest resolve their journaled
+        payload and deliver through the command processor (reference:
+        enriched-command-invocations -> command-delivery, SURVEY.md 3.4)."""
+        from sitewhere_tpu.commands.destinations import (
+            CallbackDeliveryProvider,
+            CommandDestination,
+        )
+        from sitewhere_tpu.commands.encoders import JsonCommandEncoder
+
+        inst = Instance(make_config(tmp_path))
+        inst.start()
+        seed_device(inst)
+        inst.device_management.create_device_command(
+            "sensor", token="ping", name="ping")
+        delivered = []
+        inst.commands.add_destination(CommandDestination(
+            destination_id="test",
+            encoder=JsonCommandEncoder(),
+            extractor=lambda ex: {},
+            provider=CallbackDeliveryProvider(
+                lambda ex, payload, params: delivered.append(ex)),
+        ))
+
+        payload = json.dumps({
+            "deviceToken": "dev-1", "type": "commandinvocation",
+            "request": {"commandToken": "ping"},
+        }).encode()
+        from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
+
+        req = DecodedRequest(
+            kind=RequestKind.COMMAND_INVOCATION, device_token="dev-1",
+            ts_s=1000)
+        inst.dispatcher.ingest(req, payload)
+        inst.dispatcher.flush()
+        assert inst.dispatcher.metrics_snapshot()["commands"] == 1
+        assert len(delivered) == 1
+        assert delivered[0].invocation.command_token == "ping"
+        inst.stop()
+        inst.terminate()
+
+    def test_unresolvable_invocation_dead_letters(self, tmp_path):
+        inst = Instance(make_config(tmp_path))
+        inst.start()
+        seed_device(inst)
+        from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
+
+        # no journaled payload -> no command spec -> dead letter
+        req = DecodedRequest(
+            kind=RequestKind.COMMAND_INVOCATION, device_token="dev-1",
+            ts_s=1000)
+        before = inst.dead_letters.end_offset
+        inst.dispatcher.ingest(req)
+        inst.dispatcher.flush()
+        assert inst.dead_letters.end_offset == before + 1
+        record = json.loads(inst.dead_letters.read_one(before))
+        assert record["kind"] == "undeliverable-invocation"
+        inst.stop()
+        inst.terminate()

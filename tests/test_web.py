@@ -1,0 +1,200 @@
+"""REST gateway + JWT auth + topology WebSocket over a real socket.
+
+Reference behaviors covered (service-web-rest): JWT issue/verify filter
+(TokenAuthenticationFilter), device/type/assignment CRUD controllers,
+event create→pipeline→list round trip (Assignments.java:319-576), label
+PNG endpoint, instance topology, error mapping, and the topology
+WebSocket feed (TopologyBroadcaster).
+"""
+
+import base64
+import http.client
+import json
+
+import pytest
+
+from sitewhere_tpu.instance import Instance
+from sitewhere_tpu.runtime.config import Config
+from sitewhere_tpu.web import WebServer
+from sitewhere_tpu.web.ws import ClientWebSocket
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    cfg = Config({
+        "instance": {"id": "web-test",
+                     "data_dir": str(tmp_path_factory.mktemp("web") / "data")},
+        "pipeline": {"width": 64, "registry_capacity": 1024, "mtype_slots": 4,
+                     "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+    }, apply_env=False)
+    inst = Instance(cfg)
+    inst.start()
+    web = WebServer(inst, port=0, topology_interval_s=0.2)
+    web.start()
+    yield web
+    web.stop()
+    inst.stop()
+    inst.terminate()
+
+
+class Client:
+    def __init__(self, port, token=None):
+        self.port = port
+        self.token = token
+
+    def request(self, method, path, body=None, headers=None, raw=False):
+        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=10)
+        hdrs = dict(headers or {})
+        if self.token:
+            hdrs["Authorization"] = f"Bearer {self.token}"
+        payload = json.dumps(body).encode() if body is not None else None
+        conn.request(method, path, body=payload, headers=hdrs)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        if raw:
+            return resp.status, data, resp.getheader("Content-Type")
+        return resp.status, (json.loads(data) if data else None)
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = Client(server.port)
+    status, body = c.request("POST", "/api/jwt",
+                             {"username": "admin", "password": "password"})
+    assert status == 200, body
+    return Client(server.port, token=body["token"])
+
+
+class TestAuth:
+    def test_unauthenticated_rejected(self, server):
+        status, body = Client(server.port).request("GET", "/api/devices")
+        assert status == 401
+
+    def test_bad_token_rejected(self, server):
+        status, _ = Client(server.port, token="garbage").request(
+            "GET", "/api/devices")
+        assert status == 401
+
+    def test_basic_auth_jwt(self, server):
+        creds = base64.b64encode(b"admin:password").decode()
+        status, body = Client(server.port).request(
+            "POST", "/api/jwt", {}, headers={"Authorization": f"Basic {creds}"})
+        assert status == 200 and body["username"] == "admin"
+
+    def test_wrong_password(self, server):
+        status, _ = Client(server.port).request(
+            "POST", "/api/jwt", {"username": "admin", "password": "nope"})
+        assert status == 401
+
+
+class TestCrudSurface:
+    def test_device_type_device_assignment_flow(self, client):
+        status, dt = client.request("POST", "/api/devicetypes",
+                                    {"token": "thermo", "name": "Thermostat"})
+        assert status == 200 and dt["name"] == "Thermostat"
+        status, dev = client.request("POST", "/api/devices",
+                                     {"token": "t-1", "device_type": "thermo"})
+        assert status == 200
+        status, a = client.request("POST", "/api/assignments", {"device": "t-1"})
+        assert status == 200
+        status, listing = client.request("GET", "/api/devices")
+        assert status == 200 and listing["numResults"] == 1
+        status, one = client.request("GET", "/api/devices/t-1")
+        assert status == 200 and one["token"] == "t-1"
+        # 404 + 409 mapping
+        status, _ = client.request("GET", "/api/devices/ghost")
+        assert status == 404
+        status, _ = client.request("POST", "/api/devices",
+                                   {"token": "t-1", "device_type": "thermo"})
+        assert status == 409
+
+    def test_event_round_trip_through_pipeline(self, client):
+        _, a = client.request("GET", "/api/devices/t-1/assignments")
+        token = a["results"][0]["token"]
+        status, resp = client.request(
+            "POST", f"/api/assignments/{token}/measurements",
+            {"name": "temp", "value": 21.5, "ts": 5000})
+        assert status == 200 and resp["queued"]
+        status, listing = client.request(
+            "GET", f"/api/assignments/{token}/measurements")
+        assert status == 200
+        assert listing["numResults"] == 1
+        assert listing["results"][0]["value"] == 21.5
+        # device state reflects the event
+        status, state = client.request("GET", "/api/devicestates/t-1")
+        assert status == 200 and state["last_event_ts_s"] == 5000
+
+    def test_rules_and_users_and_instance(self, client):
+        status, rule = client.request("POST", "/api/rules", {
+            "mtype": "temp", "op": "GT", "threshold": 90, "alertType": "hot"})
+        assert status == 200
+        status, rules = client.request("GET", "/api/rules")
+        assert status == 200 and len(rules) == 1
+        status, _ = client.request("DELETE", f"/api/rules/{rule['token']}")
+        assert status == 200
+
+        status, users = client.request("GET", "/api/users")
+        assert status == 200 and users["numResults"] == 1
+
+        status, topo = client.request("GET", "/api/instance/topology")
+        assert status == 200 and topo["instance"] == "web-test"
+        status, metrics = client.request("GET", "/api/instance/metrics")
+        assert status == 200 and "accepted" in metrics
+
+    def test_label_png(self, client):
+        status, data, ctype = client.request(
+            "GET", "/api/labels/device/t-1", raw=True)
+        assert status == 200 and ctype == "image/png"
+        assert data[:8] == b"\x89PNG\r\n\x1a\n"
+
+    def test_areas_zones_customers(self, client):
+        client.request("POST", "/api/areatypes",
+                       {"token": "bldg", "name": "Building"})
+        status, _ = client.request("POST", "/api/areas",
+                                   {"token": "hq", "name": "HQ",
+                                    "area_type": "bldg"})
+        assert status == 200
+        status, z = client.request("POST", "/api/zones", {
+            "token": "z1", "name": "Zone 1", "area": "hq",
+            "bounds": [[0, 0], [1, 0], [1, 1], [0, 1]]})
+        assert status == 200
+        status, zones = client.request("GET", "/api/zones?area=hq")
+        assert status == 200 and zones["numResults"] == 1
+        status, tree = client.request("GET", "/api/areas/tree")
+        assert status == 200 and tree[0]["token"] == "hq"
+
+    def test_batch_and_schedules(self, client):
+        client.request("POST", "/api/devicetypes/thermo/commands",
+                       {"token": "reboot", "name": "reboot"})
+        status, op = client.request("POST", "/api/batch/command", {
+            "commandToken": "reboot", "deviceTokens": ["t-1"]})
+        assert status == 200 and len(op["elements"]) == 1
+        status, ops = client.request("GET", "/api/batch")
+        assert status == 200 and ops["numResults"] >= 1
+
+        status, sched = client.request("POST", "/api/schedules", {
+            "token": "hourly", "name": "Hourly",
+            "trigger_type": "Simple", "interval_s": 3600})
+        assert status == 200
+        status, listing = client.request("GET", "/api/schedules")
+        assert status == 200 and listing["numResults"] == 1
+
+    def test_method_not_allowed(self, client):
+        status, _ = client.request("PUT", "/api/jwt", {})
+        assert status in (401, 405)  # auth first or 405 both acceptable
+        status, _ = client.request("DELETE", "/api/instance/topology")
+        assert status == 405
+
+
+class TestTopologyWebSocket:
+    def test_snapshot_and_broadcast(self, server, client):
+        ws = ClientWebSocket("127.0.0.1", server.port, "/ws/topology")
+        op, payload = ws.recv()  # greeting snapshot
+        doc = json.loads(payload)
+        assert doc["instance"] == "web-test"
+        # periodic broadcast arrives without asking
+        op, payload2 = ws.recv()
+        assert json.loads(payload2)["instance"] == "web-test"
+        ws.close()
