@@ -96,6 +96,44 @@ def host_batches(width: int, n_active: int, n_batches: int):
     return batches
 
 
+def bench_analytics() -> None:
+    """Config 3 (BASELINE.md): windowed anomaly detection over history.
+
+    Secondary benchmark — run with ``python bench.py --config 3``; the
+    driver's default invocation stays the headline pipeline metric.
+    """
+    import jax
+
+    from sitewhere_tpu.analytics import build_window_grid, detect_anomalies
+
+    D, W, N = 16384, 168, 4_000_000  # a week of hourly windows
+    rng = np.random.default_rng(0)
+    device_id = rng.integers(0, D, N).astype(np.int32)
+    window_idx = rng.integers(0, W, N).astype(np.int32)
+    value = rng.normal(20.0, 1.0, N).astype(np.float32)
+    import jax.numpy as jnp
+
+    args = (jnp.asarray(device_id), jnp.asarray(window_idx),
+            jnp.asarray(value), jnp.ones(N, bool))
+    grid = build_window_grid(*args, n_devices=D, n_windows=W)
+    jax.block_until_ready(detect_anomalies(grid))  # compile
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        grid = build_window_grid(*args, n_devices=D, n_windows=W)
+        anomalous, _ = detect_anomalies(grid)
+    jax.block_until_ready(anomalous)
+    t1 = time.perf_counter()
+    events_per_sec = N * iters / (t1 - t0)
+    print(json.dumps({
+        "metric": "analytics_events_per_sec_per_chip",
+        "value": round(events_per_sec, 1),
+        "unit": "events/s",
+        "vs_baseline": round(events_per_sec / 1e6, 3),
+    }))
+
+
 def main() -> None:
     import jax
 
@@ -141,4 +179,13 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=int, default=1, choices=[1, 3],
+                        help="1 = headline pipeline (default); 3 = analytics")
+    args = parser.parse_args()
+    if args.config == 3:
+        bench_analytics()
+    else:
+        main()

@@ -1,0 +1,15 @@
+"""Batch + streaming analytics over event history (sitewhere-spark analog).
+
+The reference bridges events to Spark jobs via Hazelcast
+(``sitewhere-spark/.../SiteWhereReceiver.java``); here analytics are TPU
+programs over the columnar event store.
+"""
+
+from sitewhere_tpu.analytics.runner import (  # noqa: F401
+    AnalyticsJob,
+    Anomaly,
+    EventTap,
+    WindowGrid,
+    build_window_grid,
+    detect_anomalies,
+)

@@ -1,0 +1,305 @@
+"""Batch analytics over event history — the sitewhere-spark replacement.
+
+Reference: ``sitewhere-spark/src/main/java/com/sitewhere/spark/
+SiteWhereReceiver.java:31-177`` bridges live events into Spark Streaming
+via Hazelcast topics so users can run analytics jobs off-platform.  Here
+the analytics job IS a TPU program: event history (the columnar event
+store) is loaded as struct-of-array tensors and a jitted windowed pass
+computes per-(device, time-window) statistics + anomaly flags in one
+scatter/cumsum pipeline — no per-event loop, no external cluster
+(BASELINE.md config 3).
+
+Shapes: events scatter into a dense ``[D, W]`` (device × window) grid of
+count/sum/sumsq; trailing-baseline mean/std come from shifted cumulative
+sums along the window axis; an anomaly is a window whose mean deviates
+more than ``z_threshold`` standard deviations from its trailing baseline
+(minimum sample counts guard cold starts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.schema import EventType
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class WindowGrid:
+    """Dense per-(device, window) measurement statistics."""
+
+    counts: jax.Array   # int32[D, W]
+    means: jax.Array    # float32[D, W] (0 where empty)
+    variances: jax.Array  # float32[D, W]
+
+    @property
+    def n_devices(self) -> int:
+        return self.counts.shape[0]
+
+    @property
+    def n_windows(self) -> int:
+        return self.counts.shape[1]
+
+
+@partial(jax.jit, static_argnames=("n_devices", "n_windows"))
+def build_window_grid(
+    device_id: jax.Array,   # int32[N]
+    window_idx: jax.Array,  # int32[N]
+    value: jax.Array,       # float32[N]
+    valid: jax.Array,       # bool[N]
+    n_devices: int,
+    n_windows: int,
+) -> WindowGrid:
+    """Scatter N events into the [D, W] stats grid (one pass, no loops)."""
+    cells = n_devices * n_windows
+    in_range = (
+        valid
+        & (device_id >= 0) & (device_id < n_devices)
+        & (window_idx >= 0) & (window_idx < n_windows)
+    )
+    flat = jnp.where(in_range, device_id * n_windows + window_idx, cells)
+    counts = jnp.zeros(cells + 1, jnp.int32).at[flat].add(1, mode="drop")
+    sums = jnp.zeros(cells + 1, jnp.float32).at[flat].add(
+        jnp.where(in_range, value, 0.0), mode="drop")
+    safe = jnp.maximum(counts[:cells], 1).astype(jnp.float32)
+    means_flat = sums[:cells] / safe
+    # Two-pass variance: gather each event's window mean and accumulate
+    # squared residuals — avoids the float32 catastrophic cancellation of
+    # sumsq/n - mean^2 for large-magnitude values.
+    event_mean = jnp.concatenate([means_flat, jnp.zeros(1)])[
+        jnp.minimum(flat, cells)
+    ]
+    resid = jnp.where(in_range, value - event_mean, 0.0)
+    m2 = jnp.zeros(cells + 1, jnp.float32).at[flat].add(
+        resid * resid, mode="drop")
+    counts = counts[:cells].reshape(n_devices, n_windows)
+    means = means_flat.reshape(n_devices, n_windows)
+    variances = (m2[:cells] / safe).reshape(n_devices, n_windows)
+    return WindowGrid(counts=counts, means=means, variances=variances)
+
+
+@partial(jax.jit, static_argnames=("baseline_windows",))
+def detect_anomalies(
+    grid: WindowGrid,
+    baseline_windows: int = 8,
+    z_threshold: float = 3.0,
+    min_baseline_count: int = 8,
+    std_floor: float = 1e-3,
+):
+    """Flag windows deviating from their trailing per-device baseline.
+
+    For each window w the baseline covers windows [w-L, w): mean/std from
+    shifted cumulative sums — O(D*W) total, no per-window loop.
+    ``std_floor`` bounds the baseline std from below so constant or
+    quantized baselines don't turn measurement jitter into huge z-scores;
+    callers scale it to the data (AnalyticsJob uses a fraction of the
+    global std).  Returns ``(anomalous bool[D, W], z_scores float32[D, W])``.
+    """
+    counts = grid.counts.astype(jnp.float32)
+    sums = grid.means * counts
+    # within-window residual sumsq (exact, from the two-pass grid)
+    m2 = grid.variances * counts
+
+    def trailing(x):
+        c = jnp.cumsum(x, axis=1)
+        lagged = jnp.pad(c, ((0, 0), (baseline_windows, 0)))[:, :-baseline_windows]
+        # trailing-L sum ending just BEFORE each window
+        prev = jnp.pad(c, ((0, 0), (1, 0)))[:, :-1]
+        prev_lagged = jnp.pad(lagged, ((0, 0), (1, 0)))[:, :-1]
+        return prev - prev_lagged
+
+    base_n = trailing(counts)
+    safe_n = jnp.maximum(base_n, 1.0)
+    base_mean = trailing(sums) / safe_n
+    # total variance = within-window residuals + between-window spread
+    # Σ n_w·mean_w² − N·μ².  AnalyticsJob centers values by the global
+    # mean first, so window means are small deviations and this float32
+    # difference stays well-conditioned.
+    between = trailing(counts * grid.means * grid.means) \
+        - base_n * base_mean * base_mean
+    base_var = jnp.maximum((trailing(m2) + between) / safe_n, 0.0)
+    # Welch-style denominator: the candidate window's own spread counts
+    # too, so quantization jitter inside a window (small mean shift, same
+    # order as its own std) never explodes into a huge z-score.
+    base_std = jnp.maximum(jnp.sqrt(base_var + grid.variances), std_floor)
+
+    z = (grid.means - base_mean) / base_std
+    ready = (base_n >= min_baseline_count) & (grid.counts > 0)
+    anomalous = ready & (jnp.abs(z) > z_threshold)
+    return anomalous, jnp.where(ready, z, 0.0)
+
+
+@dataclasses.dataclass
+class Anomaly:
+    device_id: int
+    device_token: Optional[str]
+    window: int
+    window_start_s: int
+    z_score: float
+    mean: float
+    count: int
+
+
+class AnalyticsJob:
+    """One batch analytics run over stored event history.
+
+    The host side slices the columnar store (measurements of one
+    ``mtype``), computes window indices, and hands dense arrays to the
+    jitted kernels; multi-chip scaling shards the device axis with the
+    same mesh as the pipeline (device-major layout keeps scatters
+    shard-local).
+    """
+
+    def __init__(
+        self,
+        window_s: int = 3600,
+        baseline_windows: int = 8,
+        z_threshold: float = 3.0,
+        min_baseline_count: int = 8,
+        min_std: float = 1e-3,
+        min_std_fraction: float = 0.05,
+    ):
+        self.window_s = window_s
+        self.baseline_windows = baseline_windows
+        self.z_threshold = z_threshold
+        self.min_baseline_count = min_baseline_count
+        # baseline-std floor: max(min_std, min_std_fraction * global std) —
+        # quantized/constant baselines don't turn jitter into anomalies
+        self.min_std = min_std
+        self.min_std_fraction = min_std_fraction
+
+    def columns_from_store(self, store, mtype_id: Optional[int] = None) -> Dict[str, np.ndarray]:
+        """Measurement columns out of an EventStore (host-side gather)."""
+        device_id: List[np.ndarray] = []
+        ts_s: List[np.ndarray] = []
+        value: List[np.ndarray] = []
+        for cols in store.iter_chunks():
+            mask = cols["event_type"] == int(EventType.MEASUREMENT)
+            if mtype_id is not None:
+                mask &= cols["mtype_id"] == mtype_id
+            device_id.append(cols["device_id"][mask])
+            ts_s.append(cols["ts_s"][mask])
+            value.append(cols["value"][mask])
+        if not device_id:
+            return {"device_id": np.zeros(0, np.int32),
+                    "ts_s": np.zeros(0, np.int32),
+                    "value": np.zeros(0, np.float32)}
+        return {
+            "device_id": np.concatenate(device_id),
+            "ts_s": np.concatenate(ts_s),
+            "value": np.concatenate(value),
+        }
+
+    def run_columns(
+        self,
+        device_id: np.ndarray,
+        ts_s: np.ndarray,
+        value: np.ndarray,
+        n_devices: int,
+        t0_s: Optional[int] = None,
+        n_windows: Optional[int] = None,
+        token_of=None,
+    ) -> Dict[str, object]:
+        if len(ts_s) == 0:
+            return {"anomalies": [], "windows": 0, "events": 0,
+                    "devices_seen": 0}
+        t0 = int(ts_s.min()) if t0_s is None else t0_s
+        win = ((ts_s.astype(np.int64) - t0) // self.window_s).astype(np.int32)
+        if n_windows is None:
+            # bucket to a multiple of 64 so a growing store reuses the
+            # compiled kernels instead of retracing every run
+            n_windows = (int(win.max()) // 64 + 1) * 64
+        # center by the global mean (host float64) so the float32 device
+        # math operates on small deviations — see build_window_grid
+        values64 = value.astype(np.float64)
+        center = float(values64.mean())
+        global_std = float(values64.std())
+        centered = (values64 - center).astype(np.float32)
+        grid = build_window_grid(
+            jnp.asarray(device_id.astype(np.int32)),
+            jnp.asarray(win),
+            jnp.asarray(centered),
+            jnp.ones(len(ts_s), bool),
+            n_devices=n_devices,
+            n_windows=n_windows,
+        )
+        anomalous, z = detect_anomalies(
+            grid,
+            baseline_windows=self.baseline_windows,
+            z_threshold=self.z_threshold,
+            min_baseline_count=self.min_baseline_count,
+            std_floor=jnp.float32(
+                max(self.min_std, self.min_std_fraction * global_std)),
+        )
+        host_anom = np.asarray(anomalous)
+        host_z = np.asarray(z)
+        host_means = np.asarray(grid.means)
+        host_counts = np.asarray(grid.counts)
+        anomalies = [
+            Anomaly(
+                device_id=int(d),
+                device_token=token_of(int(d)) if token_of else None,
+                window=int(w),
+                window_start_s=t0 + int(w) * self.window_s,
+                z_score=float(host_z[d, w]),
+                mean=float(host_means[d, w]) + center,
+                count=int(host_counts[d, w]),
+            )
+            for d, w in zip(*np.nonzero(host_anom))
+        ]
+        return {
+            "anomalies": anomalies,
+            "windows": int(n_windows),
+            "events": int(len(ts_s)),
+            "devices_seen": int((host_counts.sum(axis=1) > 0).sum()),
+        }
+
+    def run(self, store, n_devices: int, mtype_id: Optional[int] = None,
+            token_of=None) -> Dict[str, object]:
+        """Full job: store → columns → windowed anomaly detection."""
+        cols = self.columns_from_store(store, mtype_id)
+        return self.run_columns(
+            cols["device_id"], cols["ts_s"], cols["value"],
+            n_devices=n_devices, token_of=token_of,
+        )
+
+
+class EventTap:
+    """Streaming bridge: accumulate enriched event batches for analytics.
+
+    The live analog of the reference's Hazelcast→Spark receiver
+    (``SiteWhereReceiver.java:57-87``): register as an outbound callback
+    connector and batches accumulate host-side until drained by the
+    analytics job.
+    """
+
+    def __init__(self, max_batches: int = 1024):
+        self.max_batches = max_batches
+        self._batches: List[Dict[str, np.ndarray]] = []
+
+    def connector(self):
+        from sitewhere_tpu.outbound.connectors import CallbackConnector
+
+        def on_batch(cols, mask):
+            if len(self._batches) >= self.max_batches:
+                self._batches.pop(0)
+            self._batches.append(
+                {k: np.asarray(v)[mask].copy() for k, v in cols.items()}
+            )
+
+        return CallbackConnector(connector_id="analytics-tap", fn=on_batch)
+
+    def drain(self) -> Dict[str, np.ndarray]:
+        batches, self._batches = self._batches, []
+        if not batches:
+            return {}
+        return {
+            key: np.concatenate([b[key] for b in batches])
+            for key in batches[0]
+        }
