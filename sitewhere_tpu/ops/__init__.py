@@ -1,6 +1,10 @@
 """Reusable device-side ops: geospatial kernels and masked time-ordered scatters."""
 
 from sitewhere_tpu.ops.geo import pad_polygon, points_in_polygons  # noqa: F401
+from sitewhere_tpu.ops.geo_pallas import (  # noqa: F401
+    points_in_polygons_auto,
+    points_in_polygons_pallas,
+)
 from sitewhere_tpu.ops.scatter import (  # noqa: F401
     bincount_fixed,
     scatter_last_by_time,

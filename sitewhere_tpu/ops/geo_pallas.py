@@ -1,0 +1,129 @@
+"""Tiled Pallas point-in-polygon kernel for large zone sets.
+
+The dense :func:`sitewhere_tpu.ops.geo.points_in_polygons` materializes a
+``[B, Z, V]`` crossing tensor; fine for the pipeline's default zone table
+(Z ≤ a few hundred) but at large B·Z·V that intermediate dominates HBM
+traffic.  This kernel tiles the ``[B, Z]`` output grid, streams each
+polygon tile's edges through VMEM once, and accumulates crossing parity
+with a ``fori_loop`` over vertices — the working set per grid cell is
+``TB·TZ`` booleans plus one ``TZ``-wide edge slice, independent of V.
+
+Same padding contract as the dense path (repeat-last-vertex, wraparound
+edge equals closing edge).  Reference behavior mirrored:
+``service-rule-processing/.../geospatial/ZoneTestRuleProcessor.java:32-70``
+(JTS ``contains`` per event × zone).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# [B, Z] output tile: sublane × lane aligned for float32/bool VPU ops.
+TILE_B = 256
+TILE_Z = 128
+
+
+def _pip_kernel(px_ref, py_ref, x1_ref, y1_ref, x2_ref, y2_ref, out_ref):
+    """One [TB, TZ] tile: parity of edge crossings over all V vertices."""
+    px = px_ref[:]  # [TB, 1]
+    py = py_ref[:]
+    n_verts = x1_ref.shape[1]
+
+    def body(v, parity):
+        x1 = x1_ref[:, v][None, :]  # [1, TZ]
+        y1 = y1_ref[:, v][None, :]
+        x2 = x2_ref[:, v][None, :]
+        y2 = y2_ref[:, v][None, :]
+        straddles = (y1 > py) != (y2 > py)
+        denom = jnp.where(y2 == y1, 1.0, y2 - y1)
+        x_cross = (x2 - x1) * (py - y1) / denom + x1
+        crossing = straddles & (px < x_cross)
+        return parity ^ crossing
+
+    parity = jax.lax.fori_loop(
+        0, n_verts, body,
+        jnp.zeros(out_ref.shape, jnp.bool_),
+    )
+    out_ref[:] = parity
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def points_in_polygons_pallas(
+    points: jax.Array, verts: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Drop-in for :func:`points_in_polygons` via the tiled kernel.
+
+    Args:
+      points: ``float32[B, 2]`` (x, y).
+      verts:  ``float32[Z, V, 2]`` padded rings.
+      interpret: run in interpreter mode (CPU tests).
+
+    Returns ``bool[B, Z]``.
+    """
+    b, _ = points.shape
+    z, v, _ = verts.shape
+    pad_b = (-b) % TILE_B
+    pad_z = (-z) % TILE_Z
+
+    # Lay out points as [B, 1] columns (sublane-major) and polygon edges
+    # as [Z, V]; pad Z with degenerate polygons (zero area -> no crossings).
+    px = jnp.pad(points[:, 0], (0, pad_b)).reshape(-1, 1)
+    py = jnp.pad(points[:, 1], (0, pad_b)).reshape(-1, 1)
+    x1 = jnp.pad(verts[:, :, 0], ((0, pad_z), (0, 0)))
+    y1 = jnp.pad(verts[:, :, 1], ((0, pad_z), (0, 0)))
+    x2 = jnp.roll(x1, -1, axis=-1)
+    y2 = jnp.roll(y1, -1, axis=-1)
+
+    bp, zp = b + pad_b, z + pad_z
+    grid = (bp // TILE_B, zp // TILE_Z)
+    out = pl.pallas_call(
+        _pip_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE_B, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_B, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_Z, v), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_Z, v), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_Z, v), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_Z, v), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((TILE_B, TILE_Z), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bp, zp), jnp.bool_),
+        interpret=interpret,
+    )(px, py, x1, y1, x2, y2)
+    return out[:b, :z]
+
+
+# Dense-path work above which the tiled kernel pays off on TPU (the [B,Z,V]
+# intermediate stops fitting comfortably in VMEM/fusion).
+PALLAS_WORK_THRESHOLD = 1 << 22
+
+# Gate: the kernel is validated in interpret mode; flip to True (or set
+# SW_TPU_GEO_PALLAS=1) once Mosaic compilation has been exercised on real
+# hardware so a compile rejection can't take down the whole pipeline step.
+PALLAS_ENABLED = bool(int(os.environ.get("SW_TPU_GEO_PALLAS", "0")))
+
+
+def points_in_polygons_auto(points: jax.Array, verts: jax.Array) -> jax.Array:
+    """Pick dense XLA vs tiled Pallas by static work size + backend."""
+    from sitewhere_tpu.ops.geo import points_in_polygons
+
+    b = points.shape[0]
+    z, v, _ = verts.shape
+    if (PALLAS_ENABLED and jax.default_backend() == "tpu"
+            and b * z * v >= PALLAS_WORK_THRESHOLD):
+        return points_in_polygons_pallas(points, verts)
+    return points_in_polygons(points, verts)

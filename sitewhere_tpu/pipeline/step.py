@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from sitewhere_tpu.ids import NULL_ID
-from sitewhere_tpu.ops.geo import points_in_polygons
+from sitewhere_tpu.ops.geo_pallas import points_in_polygons_auto
 from sitewhere_tpu.ops.scatter import bincount_fixed, scatter_last_by_time
 from sitewhere_tpu.schema import (
     AssignmentStatus,
@@ -162,7 +162,7 @@ def eval_zone_rules(
     """
     is_loc = accepted & (batch.event_type == EventType.LOCATION)
     pts = jnp.stack([batch.lon, batch.lat], axis=-1)  # (x, y)
-    inside = points_in_polygons(pts, zones.verts)  # [B, Z]
+    inside = points_in_polygons_auto(pts, zones.verts)  # [B, Z] (Pallas when large)
 
     tenant_ok = (zones.tenant_id[None, :] == NULL_ID) | (
         zones.tenant_id[None, :] == batch.tenant_id[:, None]
