@@ -19,6 +19,7 @@ more than ``z_threshold`` standard deviations from its trailing baseline
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 from typing import Dict, List, Optional
 
@@ -282,21 +283,26 @@ class EventTap:
     def __init__(self, max_batches: int = 1024):
         self.max_batches = max_batches
         self._batches: List[Dict[str, np.ndarray]] = []
+        # on_batch runs on the outbound worker thread, drain on the
+        # caller's — the cap check/pop/append sequence and the drain swap
+        # must be atomic or a concurrent append is silently lost.
+        self._lock = threading.Lock()
 
     def connector(self):
         from sitewhere_tpu.outbound.connectors import CallbackConnector
 
         def on_batch(cols, mask):
-            if len(self._batches) >= self.max_batches:
-                self._batches.pop(0)
-            self._batches.append(
-                {k: np.asarray(v)[mask].copy() for k, v in cols.items()}
-            )
+            batch = {k: np.asarray(v)[mask].copy() for k, v in cols.items()}
+            with self._lock:
+                if len(self._batches) >= self.max_batches:
+                    self._batches.pop(0)
+                self._batches.append(batch)
 
         return CallbackConnector(connector_id="analytics-tap", fn=on_batch)
 
     def drain(self) -> Dict[str, np.ndarray]:
-        batches, self._batches = self._batches, []
+        with self._lock:
+            batches, self._batches = self._batches, []
         if not batches:
             return {}
         return {

@@ -236,12 +236,17 @@ class Instance(LifecycleComponent):
                                       if token else None)
                             assignment = active.token if active else None
                         if assignment:
+                            kwargs = {}
+                            if body.get("invocationToken"):
+                                kwargs["token"] = str(body["invocationToken"])
                             invocation = CommandInvocation(
                                 command_token=str(command),
                                 target_assignment=str(assignment),
                                 parameter_values=dict(
                                     body.get("parameterValues", {})),
-                                initiator="EVENT",
+                                initiator=str(body.get("initiator", "EVENT")),
+                                initiator_id=body.get("initiatorId"),
+                                **kwargs,
                             )
             except (ValueError, KeyError, CorruptJournal) as e:
                 logger.debug("unresolvable command payload ref %s: %s", ref, e)

@@ -18,7 +18,6 @@ from __future__ import annotations
 import base64
 from typing import Optional
 
-from sitewhere_tpu.commands.model import CommandInvocation
 from sitewhere_tpu.ids import NULL_ID
 from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
 from sitewhere_tpu.schema import AlertLevel, ComparisonOp, EventType
@@ -185,26 +184,53 @@ def register_routes(gw: RestGateway, inst) -> None:
                 "eventType": kind}
 
     def create_invocation(q: Request):
-        """Command invocation: full command-delivery path (reference:
-        invocation events → command-delivery service)."""
+        """Command invocation: ONE delivery path — journal the invocation
+        body and let the pipeline's command-row egress deliver it
+        (reference: REST creates an invocation *event* which flows through
+        enriched-command-invocations → command-delivery, SURVEY.md §3.4).
+        A direct ``commands.invoke`` here would double-deliver or, with no
+        journaled payload, false-positive the dead-letter journal."""
+        import json as _json
+
+        from sitewhere_tpu.services.common import mint_token, now_s
+
         body = q.json()
-        invocation = CommandInvocation(
-            command_token=str(body["commandToken"]),
-            target_assignment=q.params["token"],
-            parameter_values=dict(body.get("parameterValues", {})),
-            initiator="REST",
-            initiator_id=(q.claims or {}).get("sub"),
-        )
-        delivered = inst.commands.invoke(invocation)
-        # record the invocation as a pipeline event too
+        require("commandToken" in body,
+                ValidationError("commandToken required"))
         device, _ = _assignment_device(q.params["token"])
+        # Pre-mint the invocation token so the caller can correlate with
+        # command responses and undelivered dead-letter records; delivery
+        # itself is asynchronous from the API's point of view, as in the
+        # reference (no delivery guarantee in the REST response).
+        inv_token = mint_token("inv")
+        payload = _json.dumps({
+            "deviceToken": device.token,
+            "type": "commandinvocation",
+            "request": {
+                "commandToken": str(body["commandToken"]),
+                "assignmentToken": q.params["token"],
+                "parameterValues": dict(body.get("parameterValues", {})),
+                "initiator": "REST",
+                "initiatorId": (q.claims or {}).get("sub"),
+                "invocationToken": inv_token,
+            },
+        }).encode()
         inst.dispatcher.ingest(DecodedRequest(
             kind=RequestKind.COMMAND_INVOCATION,
             device_token=device.token,
-            ts_s=invocation.created_s,
-        ))
+            ts_s=int(body.get("ts", now_s())),
+        ), payload)
         inst.dispatcher.flush()
-        return {"token": invocation.token, "delivered": delivered}
+        return {"queued": True, "token": inv_token,
+                "deviceToken": device.token}
+
+    # Stream routes must precede the generic {kind} event routes or
+    # GET .../streams would match {kind} and 404 as an unknown event kind
+    # (the handlers are defined below; the lambdas bind late).
+    r("GET", "/api/assignments/{token}/streams",
+      lambda q: list_streams(q))
+    r("GET", "/api/assignments/{token}/streams/",
+      lambda q: list_streams(q))
 
     r("POST", "/api/assignments/{token}/{kind}", create_event)
 
@@ -385,7 +411,6 @@ def register_routes(gw: RestGateway, inst) -> None:
         return RawResponse(inst.streams.stream_content(stream.token),
                            content_type=stream.content_type)
 
-    r("GET", "/api/assignments/{token}/streams/", list_streams)
     r("GET", "/api/assignments/{token}/streams/{sid}", stream_download)
 
     # ---- labels (service-label-generation REST analog) --------------------

@@ -136,7 +136,7 @@ class RestGateway:
                  token_management=None):
         self.router = Router()
         self.tokens = token_management
-        self._ws_routes: Dict[str, Callable] = {}
+        self._ws_routes: Dict[str, Tuple[Callable, bool]] = {}
         gateway = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -179,10 +179,15 @@ class RestGateway:
 
     # -- ws ------------------------------------------------------------------
 
-    def add_ws(self, path: str, handler: Callable) -> None:
+    def add_ws(self, path: str, handler: Callable,
+               auth_required: bool = True) -> None:
         """Register a WebSocket endpoint: ``handler(websock)`` runs on the
-        connection thread after the RFC6455 handshake."""
-        self._ws_routes[path] = handler
+        connection thread after the RFC6455 handshake.  The JWT filter
+        guards the upgrade request like any REST route (the reference's
+        STOMP topology feed is authenticated) unless ``auth_required=False``;
+        browsers can't set headers on WS connects, so a ``token`` query
+        param is accepted alongside the Authorization header."""
+        self._ws_routes[path] = (handler, auth_required)
 
     # -- request plumbing ----------------------------------------------------
 
@@ -192,11 +197,27 @@ class RestGateway:
 
         if method == "GET" and path in self._ws_routes \
                 and "upgrade" in h.headers.get("Connection", "").lower():
+            ws_handler, ws_auth = self._ws_routes[path]
+            if ws_auth:
+                # Authenticate BEFORE the handshake: an unauthenticated
+                # client must get 401, not a live socket.
+                query = parse_qs(parsed.query)
+                headers = {k: v for k, v in h.headers.items()}
+                token_q = query.get("token", [None])[0]
+                if token_q and not headers.get("Authorization"):
+                    headers["Authorization"] = f"Bearer {token_q}"
+                probe = Request(method=method, path=path, params={},
+                                query=query, headers=headers, body=b"")
+                try:
+                    self._authenticate(probe)
+                except ServiceError as e:
+                    self._send(h, e.http_status, {"error": str(e)})
+                    return
             from sitewhere_tpu.web.ws import ServerWebSocket
 
             sock = ServerWebSocket.handshake(h)
             if sock is not None:
-                self._ws_routes[path](sock)
+                ws_handler(sock)
             return
 
         try:

@@ -8,8 +8,9 @@ Two consumers:
   receiver) in :mod:`sitewhere_tpu.ingest.sources`.
 
 Implements the server handshake (Sec-WebSocket-Accept), frame
-encode/decode with client masking, text/binary/ping/pong/close opcodes.
-No extensions/fragmentation-reassembly beyond continuation concatenation.
+encode/decode with client masking, text/binary/ping/pong/close opcodes,
+and fragmented-message reassembly with interleaved control frames
+(RFC 6455 §5.4).  No extensions (permessage-deflate etc.).
 """
 
 from __future__ import annotations
@@ -143,10 +144,19 @@ class ServerWebSocket:
             elif opcode in (OP_TEXT, OP_BINARY):
                 data = payload
                 first = opcode
+                # RFC 6455 §5.4: control frames may interleave between
+                # fragments — handle them without ending reassembly, and
+                # track fin only from continuation frames.
                 while not fin:
-                    opcode, payload, fin = read_frame(self.sock)
+                    opcode, payload, cfin = read_frame(self.sock)
                     if opcode == OP_CONT:
                         data += payload
+                        fin = cfin
+                    elif opcode == OP_PING:
+                        self.sock.sendall(encode_frame(OP_PONG, payload))
+                    elif opcode == OP_CLOSE:
+                        self.close()
+                        return None
                 return first, data
             opcode, payload, fin = read_frame(self.sock)
 
@@ -167,13 +177,15 @@ class ClientWebSocket:
     """Tiny client for tests + the polling/bridge paths."""
 
     def __init__(self, host: str, port: int, path: str = "/",
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, headers=None):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         key = base64.b64encode(b"sitewhere-tpu-cli").decode()
+        extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
         self.sock.sendall(
             f"GET {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
             f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
-            f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+            f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n"
+            f"{extra}\r\n"
             .encode()
         )
         head = b""
@@ -200,12 +212,21 @@ class ClientWebSocket:
         while True:
             if opcode == OP_CLOSE:
                 return None
+            if opcode == OP_PING:
+                self.sock.sendall(encode_frame(OP_PONG, payload, mask=True))
             if opcode in (OP_TEXT, OP_BINARY):
                 data = payload
                 first = opcode
                 while not fin:
-                    opcode, payload, fin = read_frame(self.sock)
-                    data += payload
+                    opcode, payload, cfin = read_frame(self.sock)
+                    if opcode == OP_CONT:
+                        data += payload
+                        fin = cfin
+                    elif opcode == OP_PING:
+                        self.sock.sendall(
+                            encode_frame(OP_PONG, payload, mask=True))
+                    elif opcode == OP_CLOSE:
+                        return None
                 return first, data
             opcode, payload, fin = read_frame(self.sock)
 

@@ -181,6 +181,49 @@ class TestCrudSurface:
         status, listing = client.request("GET", "/api/schedules")
         assert status == 200 and listing["numResults"] == 1
 
+    def test_rest_invocation_single_delivery_no_dead_letter(self, server, client):
+        """REST command invocation flows through the pipeline's command-row
+        egress exactly once — no false 'undeliverable-invocation' dead
+        letter (the delivered invocation must not also dead-letter)."""
+        from sitewhere_tpu.commands.destinations import (
+            CallbackDeliveryProvider,
+            CommandDestination,
+        )
+        from sitewhere_tpu.commands.encoders import JsonCommandEncoder
+
+        inst = server.inst
+        delivered = []
+        inst.commands.add_destination(CommandDestination(
+            destination_id="ws-test",
+            encoder=JsonCommandEncoder(),
+            extractor=lambda ex: {},
+            provider=CallbackDeliveryProvider(
+                lambda ex, payload, params: delivered.append(ex)),
+        ))
+        _, a = client.request("GET", "/api/devices/t-1/assignments")
+        token = a["results"][0]["token"]
+        before_dl = inst.dead_letters.end_offset
+        status, resp = client.request(
+            "POST", f"/api/assignments/{token}/invocations",
+            {"commandToken": "reboot"})
+        assert status == 200 and resp["queued"]
+        assert len(delivered) == 1
+        assert delivered[0].invocation.command_token == "reboot"
+        assert delivered[0].invocation.initiator == "REST"
+        # response token correlates with the delivered invocation
+        assert delivered[0].invocation.token == resp["token"]
+        assert inst.dead_letters.end_offset == before_dl
+
+    def test_streams_list_route_without_trailing_slash(self, client):
+        _, a = client.request("GET", "/api/devices/t-1/assignments")
+        token = a["results"][0]["token"]
+        status, listing = client.request(
+            "GET", f"/api/assignments/{token}/streams")
+        assert status == 200 and listing["numResults"] == 0
+        status, listing = client.request(
+            "GET", f"/api/assignments/{token}/streams/")
+        assert status == 200 and listing["numResults"] == 0
+
     def test_method_not_allowed(self, client):
         status, _ = client.request("PUT", "/api/jwt", {})
         assert status in (401, 405)  # auth first or 405 both acceptable
@@ -188,13 +231,61 @@ class TestCrudSurface:
         assert status == 405
 
 
+class TestWebSocketFraming:
+    def test_fragmented_message_with_interleaved_ping(self):
+        """RFC 6455 §5.4: control frames between fragments must be handled
+        without truncating the reassembled message."""
+        import socket
+
+        from sitewhere_tpu.web import ws as wsmod
+
+        a, b = socket.socketpair()
+        try:
+            server = wsmod.ServerWebSocket(a)
+            # fragment 1 (FIN=0, TEXT) + PING + CONT (FIN=1)
+            frame1 = bytes([0x00 | wsmod.OP_TEXT, 5]) + b"hello"
+            ping = wsmod.encode_frame(wsmod.OP_PING, b"hb")
+            cont = bytes([0x80 | wsmod.OP_CONT, 6]) + b" world"
+            b.sendall(frame1 + ping + cont)
+            op, payload = server.recv()
+            assert op == wsmod.OP_TEXT
+            assert payload == b"hello world"
+            # the ping got answered with a pong mid-reassembly
+            op, pong, fin = wsmod.read_frame(b)
+            assert op == wsmod.OP_PONG and pong == b"hb" and fin
+        finally:
+            a.close()
+            b.close()
+
+
 class TestTopologyWebSocket:
+    def test_unauthenticated_upgrade_rejected(self, server):
+        """The WS upgrade is guarded by the JWT filter like any route
+        (reference: authenticated STOMP topology feed)."""
+        with pytest.raises(ConnectionError):
+            ClientWebSocket("127.0.0.1", server.port, "/ws/topology")
+
+    def test_bad_token_upgrade_rejected(self, server):
+        with pytest.raises(ConnectionError):
+            ClientWebSocket("127.0.0.1", server.port,
+                            "/ws/topology?token=garbage")
+
     def test_snapshot_and_broadcast(self, server, client):
-        ws = ClientWebSocket("127.0.0.1", server.port, "/ws/topology")
+        ws = ClientWebSocket(
+            "127.0.0.1", server.port, "/ws/topology",
+            headers={"Authorization": f"Bearer {client.token}"})
         op, payload = ws.recv()  # greeting snapshot
         doc = json.loads(payload)
         assert doc["instance"] == "web-test"
         # periodic broadcast arrives without asking
         op, payload2 = ws.recv()
         assert json.loads(payload2)["instance"] == "web-test"
+        ws.close()
+
+    def test_token_query_param_accepted(self, server, client):
+        """Browsers can't set headers on WS connects — token query param."""
+        ws = ClientWebSocket("127.0.0.1", server.port,
+                             f"/ws/topology?token={client.token}")
+        op, payload = ws.recv()
+        assert json.loads(payload)["instance"] == "web-test"
         ws.close()
