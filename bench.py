@@ -1,31 +1,72 @@
-"""Headline benchmark: device events/sec/chip through the inbound→rule pipeline.
+"""Benchmarks: device events/sec/chip through the TPU pipeline (+ aux configs).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline target (BASELINE.md): 1M events/sec/chip end-to-end, so
-``vs_baseline = events_per_sec / 1e6``.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Baseline target (BASELINE.md): 1M events/sec/chip end-to-end with <10ms p99,
+so ``vs_baseline = events_per_sec / 1e6`` and the headline JSON also carries
+``step_p50_ms`` / ``step_p99_ms``.
 
-Accounting: 8 distinct host-generated batches are staged to the device
-once, then the measured loop cycles through them — every step runs the
-fused pipeline step (validation, enrichment, threshold rules, geofence,
+Configs (BASELINE.md):
+  1 (default)  headline fused-pipeline events/sec/chip + per-step latency
+  2            dispatcher path: sources -> batcher -> step -> store/outbound
+  3            windowed anomaly-detection analytics job
+  4            8-tenant fan-out + presence sweep (multi-tenant demux)
+  5            streaming-media append + QR label render (host mixed workload)
+
+Robustness: TPU backend bring-up through the tunnel is flaky (it can HANG,
+not just fail), so by default this script acts as a supervisor: it re-execs
+itself as a child (SW_BENCH_CHILD=1) with a per-attempt timeout and bounded
+retry/backoff, forwards the child's JSON line, and on final failure prints a
+diagnostic JSON line (value=0) plus, when possible, a clearly-labelled CPU
+fallback number so the round still records evidence.
+
+Accounting (config 1): 8 distinct host-generated batches are staged to the
+device once, then the measured loop cycles through them — every step runs
+the fused pipeline step (validation, enrichment, threshold rules, geofence,
 state update, derived alerts, metrics) on a batch it has not seen in 8
-steps, and the host reads back the global metrics at the end.  Staging is
-excluded because this environment reaches the chip through a network
-tunnel whose host→device bandwidth is orders of magnitude below a real
-deployment's DMA path; in production the ingest journal double-buffers
-transfers behind compute (see sitewhere_tpu.ingest).
+steps.  Staging is excluded because this environment reaches the chip
+through a network tunnel whose host->device bandwidth is orders of magnitude
+below a real deployment's DMA path; the dispatcher-path number (config 2)
+covers the host edge.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+TARGET_EVENTS_PER_SEC = 1e6  # BASELINE.md north star, per chip
+ATTEMPTS = 3
+BACKOFFS_S = (5, 15, 30)
 
-def build_tables(capacity: int, n_active: int):
+
+def _force_cpu_if_requested() -> None:
+    """Honor SW_BENCH_FORCE_CPU before any backend initializes.
+
+    The axon sitecustomize forces ``jax_platforms="axon,cpu"`` via the
+    config API at interpreter start, which overrides the JAX_PLATFORMS env
+    var — so the CPU fallback must also go through the config API.
+    """
+    if os.environ.get("SW_BENCH_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# shared workload builders
+# ---------------------------------------------------------------------------
+
+def build_tables(capacity: int, n_active: int, n_tenants: int = 1,
+                 n_zones: int = 1):
     import jax.numpy as jnp
 
+    from sitewhere_tpu.ops.geo import pad_polygon
     from sitewhere_tpu.schema import (
         AssignmentStatus,
         DeviceState,
@@ -38,7 +79,7 @@ def build_tables(capacity: int, n_active: int):
     on = idx < n_active
     registry = Registry.empty(capacity).replace(
         active=on,
-        tenant_id=jnp.where(on, 0, -1),
+        tenant_id=jnp.where(on, idx % n_tenants, -1),
         device_type_id=jnp.where(on, 0, -1),
         assignment_id=jnp.where(on, idx, -1),
         assignment_status=jnp.where(on, AssignmentStatus.ACTIVE, 0),
@@ -55,29 +96,31 @@ def build_tables(capacity: int, n_active: int):
         threshold=rules.threshold.at[0].set(90.0),
         alert_code=rules.alert_code.at[0].set(7),
     )
-    from sitewhere_tpu.ops.geo import pad_polygon
-
     zones = ZoneTable.empty(64, max_verts=16)
-    padded = pad_polygon([[0, 0], [10, 0], [10, 10], [0, 10]], 16)
-    zones = zones.replace(
-        active=zones.active.at[0].set(True),
-        verts=zones.verts.at[0].set(jnp.asarray(padded)),
-        nvert=zones.nvert.at[0].set(4),
-        alert_code=zones.alert_code.at[0].set(9),
-    )
+    for z in range(n_zones):
+        lo, hi = z * 2.0, z * 2.0 + 10.0
+        padded = pad_polygon([[lo, lo], [hi, lo], [hi, hi], [lo, hi]], 16)
+        zones = zones.replace(
+            active=zones.active.at[z].set(True),
+            verts=zones.verts.at[z].set(jnp.asarray(padded)),
+            nvert=zones.nvert.at[z].set(4),
+            alert_code=zones.alert_code.at[z].set(9),
+        )
     return registry, state, rules, zones
 
 
-def host_batches(width: int, n_active: int, n_batches: int):
+def host_batches(width: int, n_active: int, n_batches: int,
+                 n_tenants: int = 1):
     """Pre-generate distinct host-side (numpy) event batches."""
     rng = np.random.default_rng(0)
     batches = []
     for _ in range(n_batches):
+        device_id = rng.integers(0, n_active, width).astype(np.int32)
         batches.append(
             dict(
                 valid=np.ones(width, bool),
-                device_id=rng.integers(0, n_active, width).astype(np.int32),
-                tenant_id=np.zeros(width, np.int32),
+                device_id=device_id,
+                tenant_id=(device_id % n_tenants).astype(np.int32),
                 event_type=(rng.random(width) < 0.5).astype(np.int32),
                 ts_s=np.full(width, 1_753_800_000, np.int32),
                 ts_ns=rng.integers(0, 1_000_000_000, width).astype(np.int32),
@@ -96,12 +139,163 @@ def host_batches(width: int, n_active: int, n_batches: int):
     return batches
 
 
-def bench_analytics() -> None:
-    """Config 3 (BASELINE.md): windowed anomaly detection over history.
+def emit(doc: dict) -> None:
+    print(json.dumps(doc), flush=True)
 
-    Secondary benchmark — run with ``python bench.py --config 3``; the
-    driver's default invocation stays the headline pipeline metric.
-    """
+
+# ---------------------------------------------------------------------------
+# config 1: headline fused pipeline step (throughput + latency)
+# ---------------------------------------------------------------------------
+
+def bench_pipeline() -> None:
+    import jax
+
+    from sitewhere_tpu.pipeline import pipeline_step
+    from sitewhere_tpu.schema import EventBatch
+
+    capacity, n_active = 16384, 10000
+    width = 131_072
+    registry, state, rules, zones = build_tables(capacity, n_active)
+    raw = host_batches(width, n_active, n_batches=8)
+
+    step = jax.jit(pipeline_step, donate_argnums=(1,))
+
+    staged = [
+        EventBatch(**{k: jax.device_put(v) for k, v in b.items()}) for b in raw
+    ]
+    jax.block_until_ready(staged)
+
+    # Warm-up: compile.
+    state, out = step(registry, state, rules, zones, staged[0])
+    jax.block_until_ready(out.metrics.processed)
+
+    # Phase A: async throughput (the deployment steady state — dispatch
+    # ahead, fetch at the end).
+    iters = 100
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, out = step(registry, state, rules, zones, staged[i % len(staged)])
+    total = jax.block_until_ready(out.metrics)
+    t1 = time.perf_counter()
+    assert int(total.processed) == width
+    events_per_sec = width * iters / (t1 - t0)
+
+    # Phase B: per-step latency (block each step; p99 must be <10ms for the
+    # BASELINE target).  Separate phase so percentile accounting doesn't
+    # serialize the throughput loop.
+    lat_iters = 50
+    times = []
+    for i in range(lat_iters):
+        t2 = time.perf_counter()
+        state, out = step(registry, state, rules, zones, staged[i % len(staged)])
+        jax.block_until_ready(out.metrics.processed)
+        times.append(time.perf_counter() - t2)
+    p50 = float(np.percentile(times, 50) * 1e3)
+    p99 = float(np.percentile(times, 99) * 1e3)
+
+    emit({
+        "metric": "pipeline_events_per_sec_per_chip",
+        "value": round(events_per_sec, 1),
+        "unit": "events/s",
+        "vs_baseline": round(events_per_sec / TARGET_EVENTS_PER_SEC, 3),
+        "step_p50_ms": round(p50, 3),
+        "step_p99_ms": round(p99, 3),
+        "latency_target_met": bool(p99 < 10.0),
+        "batch_width": width,
+        "backend": __import__("jax").default_backend(),
+        "geo_pallas": os.environ.get("SW_TPU_GEO_PALLAS", "0"),
+    })
+
+
+# ---------------------------------------------------------------------------
+# config 2: dispatcher path (host edge included)
+# ---------------------------------------------------------------------------
+
+def bench_dispatcher() -> None:
+    """End-to-end host path: decoded requests -> batcher -> jitted step ->
+    store/outbound egress, through the real PipelineDispatcher."""
+    import tempfile
+
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+
+    n_devices = 10_000
+    width = 16_384
+    tmp = tempfile.mkdtemp(prefix="swbench-")
+    cfg = Config({
+        "instance": {"id": "bench", "data_dir": os.path.join(tmp, "data")},
+        "pipeline": {"width": width, "registry_capacity": 16384,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+        "journal": {"fsync_every": 4096, "segment_bytes": 256 << 20},
+    }, apply_env=False)
+    inst = Instance(cfg)
+    inst.start()
+    try:
+        inst.device_management.create_device_type(token="sensor", name="Sensor")
+        dm = inst.device_management
+        for i in range(n_devices):
+            dm.create_device(token=f"d-{i}", device_type="sensor")
+            dm.create_device_assignment(device=f"d-{i}")
+
+        rng = np.random.default_rng(0)
+        n_events_per_round = width
+        rounds = 40
+
+        # Pre-resolve device handles the way a source's decode path would.
+        handles = np.asarray(
+            inst.identity.device.lookup_many(
+                [f"d-{i}" for i in range(n_devices)]
+            ), np.int32)
+
+        def make_arrays(r):
+            dev = handles[rng.integers(0, n_devices, n_events_per_round)]
+            return dict(
+                device_id=dev.astype(np.int32),
+                tenant_id=np.zeros(n_events_per_round, np.int32),
+                event_type=(rng.random(n_events_per_round) < 0.5).astype(np.int32),
+                ts_s=np.full(n_events_per_round, 1_753_800_000 + r, np.int32),
+                ts_ns=np.zeros(n_events_per_round, np.int32),
+                mtype_id=np.zeros(n_events_per_round, np.int32),
+                value=rng.uniform(0, 100, n_events_per_round).astype(np.float32),
+                lat=rng.uniform(-20, 20, n_events_per_round).astype(np.float32),
+                lon=rng.uniform(-20, 20, n_events_per_round).astype(np.float32),
+            )
+        prebuilt = [make_arrays(r) for r in range(rounds)]
+
+        # Warm-up compile through the dispatcher.
+        inst.dispatcher.ingest_arrays(**prebuilt[0])
+        inst.dispatcher.flush()
+
+        t0 = time.perf_counter()
+        for r in range(1, rounds):
+            inst.dispatcher.ingest_arrays(**prebuilt[r])
+        inst.dispatcher.flush()
+        t1 = time.perf_counter()
+        n = n_events_per_round * (rounds - 1)
+        events_per_sec = n / (t1 - t0)
+        snap = inst.dispatcher.metrics_snapshot()
+        emit({
+            "metric": "dispatcher_events_per_sec_per_chip",
+            "value": round(events_per_sec, 1),
+            "unit": "events/s",
+            "vs_baseline": round(events_per_sec / TARGET_EVENTS_PER_SEC, 3),
+            "accepted": int(snap["accepted"]),
+            "steps": int(snap["steps"]),
+            "backend": __import__("jax").default_backend(),
+        })
+    finally:
+        inst.stop()
+        inst.terminate()
+
+
+# ---------------------------------------------------------------------------
+# config 3: analytics job
+# ---------------------------------------------------------------------------
+
+def bench_analytics() -> None:
+    """Windowed anomaly detection over event history (sitewhere-spark
+    analog; BASELINE.md config 3)."""
     import jax
 
     from sitewhere_tpu.analytics import build_window_grid, detect_anomalies
@@ -126,66 +320,257 @@ def bench_analytics() -> None:
     jax.block_until_ready(anomalous)
     t1 = time.perf_counter()
     events_per_sec = N * iters / (t1 - t0)
-    print(json.dumps({
+    emit({
         "metric": "analytics_events_per_sec_per_chip",
         "value": round(events_per_sec, 1),
         "unit": "events/s",
-        "vs_baseline": round(events_per_sec / 1e6, 3),
-    }))
+        "vs_baseline": round(events_per_sec / TARGET_EVENTS_PER_SEC, 3),
+        "backend": __import__("jax").default_backend(),
+    })
 
 
-def main() -> None:
+# ---------------------------------------------------------------------------
+# config 4: multi-tenant fan-out + presence
+# ---------------------------------------------------------------------------
+
+def bench_multitenant() -> None:
+    """8-tenant demux + presence sweep (BASELINE.md config 4): the tenant
+    column partitions every table; a presence sweep over all device state
+    interleaves with pipeline steps the way the reference's background
+    PresenceChecker thread does (``DevicePresenceManager.java:49-88``)."""
     import jax
+    import jax.numpy as jnp
 
     from sitewhere_tpu.pipeline import pipeline_step
     from sitewhere_tpu.schema import EventBatch
+    from sitewhere_tpu.state.presence import presence_sweep
 
-    capacity, n_active = 16384, 10000
+    capacity, n_active, n_tenants = 16384, 10000, 8
     width = 131_072
-    registry, state, rules, zones = build_tables(capacity, n_active)
-    raw = host_batches(width, n_active, n_batches=8)
+    registry, state, rules, zones = build_tables(
+        capacity, n_active, n_tenants=n_tenants)
+    raw = host_batches(width, n_active, n_batches=8, n_tenants=n_tenants)
 
     step = jax.jit(pipeline_step, donate_argnums=(1,))
-
-    # Stage batches on device once (see module docstring).
     staged = [
         EventBatch(**{k: jax.device_put(v) for k, v in b.items()}) for b in raw
     ]
     jax.block_until_ready(staged)
 
-    # Warm-up: compile.
+    now = jnp.int32(1_753_800_000 + 10_000)
+    missing_after = jnp.int32(3600)
     state, out = step(registry, state, rules, zones, staged[0])
-    jax.block_until_ready(out.metrics.processed)
+    state, newly = presence_sweep(state, now, missing_after)
+    jax.block_until_ready(newly)  # compile both programs
 
     iters = 100
+    sweep_every = 10
     t0 = time.perf_counter()
     for i in range(iters):
         state, out = step(registry, state, rules, zones, staged[i % len(staged)])
+        if (i + 1) % sweep_every == 0:
+            state, newly = presence_sweep(state, now, missing_after)
     total = jax.block_until_ready(out.metrics)
     t1 = time.perf_counter()
-
     assert int(total.processed) == width
+    # per-tenant fan-out accounting on the last step's accepted rows
+    by_tenant = np.bincount(
+        np.asarray(staged[(iters - 1) % len(staged)].tenant_id)[
+            np.asarray(out.accepted)],
+        minlength=n_tenants)
     events_per_sec = width * iters / (t1 - t0)
-    print(
-        json.dumps(
-            {
-                "metric": "pipeline_events_per_sec_per_chip",
-                "value": round(events_per_sec, 1),
-                "unit": "events/s",
-                "vs_baseline": round(events_per_sec / 1e6, 3),
-            }
+    emit({
+        "metric": "multitenant_events_per_sec_per_chip",
+        "value": round(events_per_sec, 1),
+        "unit": "events/s",
+        "vs_baseline": round(events_per_sec / TARGET_EVENTS_PER_SEC, 3),
+        "tenants": n_tenants,
+        "sweep_every": sweep_every,
+        "min_tenant_share": round(float(by_tenant.min() / max(1, by_tenant.sum())), 4),
+        "backend": __import__("jax").default_backend(),
+    })
+
+
+# ---------------------------------------------------------------------------
+# config 5: streaming media + labels (host mixed workload)
+# ---------------------------------------------------------------------------
+
+def bench_media_labels() -> None:
+    """Streaming-media chunk appends + QR label renders (BASELINE.md config
+    5): the non-event compute paths, both host-side by design."""
+    import tempfile
+
+    from sitewhere_tpu.labels.png import write_png
+    from sitewhere_tpu.labels.qr import encode as qr_encode
+    from sitewhere_tpu.services.streams import DeviceStreamManagement
+
+    tmp = tempfile.mkdtemp(prefix="swbench5-")
+    streams = DeviceStreamManagement(tmp)
+    streams.start()
+    try:
+        chunk = os.urandom(4096)
+        n_streams, chunks_per_stream = 16, 256
+        t0 = time.perf_counter()
+        for s in range(n_streams):
+            st = streams.create_device_stream(
+                assignment_token=f"a-{s}", stream_id=f"s-{s}",
+                content_type="application/octet-stream")
+            for i in range(chunks_per_stream):
+                streams.add_device_stream_data(st.token, i, chunk)
+        t1 = time.perf_counter()
+        chunks_per_sec = n_streams * chunks_per_stream / (t1 - t0)
+        stream_mb_per_sec = chunks_per_sec * len(chunk) / 1e6
+
+        n_labels = 200
+        scale = 4
+        t2 = time.perf_counter()
+        for i in range(n_labels):
+            matrix = qr_encode(f"https://sitewhere-tpu.local/devices/dev-{i}")
+            img = np.where(np.kron(matrix, np.ones((scale, scale), np.uint8)),
+                           0, 255).astype(np.uint8)
+            write_png(img)
+        t3 = time.perf_counter()
+        labels_per_sec = n_labels / (t3 - t2)
+
+        # Composite ops/sec (chunk append + label render weighted equally);
+        # no reference-published number exists for either path, so
+        # vs_baseline is null and the sub-metrics carry the evidence.
+        value = round(chunks_per_sec + labels_per_sec, 1)
+        emit({
+            "metric": "media_label_ops_per_sec",
+            "value": value,
+            "unit": "ops/s",
+            "vs_baseline": None,
+            "stream_chunks_per_sec": round(chunks_per_sec, 1),
+            "stream_mb_per_sec": round(stream_mb_per_sec, 1),
+            "qr_labels_per_sec": round(labels_per_sec, 1),
+        })
+    finally:
+        streams.stop()
+
+
+# ---------------------------------------------------------------------------
+# supervisor: retry + timeout around the flaky TPU bring-up
+# ---------------------------------------------------------------------------
+
+def _run_child(argv, env, timeout_s):
+    """One attempt: run self as child, return (rc, stdout, stderr, reason)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + argv,
+            env=env, capture_output=True, text=True, timeout=timeout_s,
         )
-    )
+        return proc.returncode, proc.stdout, proc.stderr, "exit"
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or ""
+        err = e.stderr or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        return -1, out, err, f"timeout after {timeout_s}s"
+
+
+def _last_json_line(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def supervise(args, extra_argv) -> None:
+    timeout_s = float(os.environ.get("SW_BENCH_TIMEOUT_S", "600"))
+    base_env = dict(os.environ, SW_BENCH_CHILD="1")
+    if args.pallas:
+        base_env["SW_TPU_GEO_PALLAS"] = "1"
+    failures = []
+    for attempt in range(ATTEMPTS):
+        rc, out, err, reason = _run_child(extra_argv, base_env, timeout_s)
+        doc = _last_json_line(out)
+        if rc == 0 and doc is not None:
+            sys.stdout.write(json.dumps(doc) + "\n")
+            return
+        failures.append({
+            "attempt": attempt + 1,
+            "rc": rc,
+            "reason": reason,
+            "stderr_tail": (err or "")[-800:],
+        })
+        if attempt < ATTEMPTS - 1:
+            time.sleep(BACKOFFS_S[min(attempt, len(BACKOFFS_S) - 1)])
+
+    # All TPU attempts failed.  Record a clearly-labelled CPU fallback so
+    # the round still produces measurable evidence, then the diagnostic.
+    cpu_doc = None
+    cpu_env = dict(base_env, SW_BENCH_FORCE_CPU="1")
+    # The fallback gets its own generous budget: CPU runs are slow but
+    # cannot hang the way the tunnel bring-up does.
+    rc, out, err, reason = _run_child(extra_argv, cpu_env, max(timeout_s, 900))
+    if rc == 0:
+        cpu_doc = _last_json_line(out)
+        if cpu_doc is not None:
+            cpu_doc["backend"] = "cpu-fallback"
+
+    diag = {
+        "metric": {
+            1: "pipeline_events_per_sec_per_chip",
+            2: "dispatcher_events_per_sec_per_chip",
+            3: "analytics_events_per_sec_per_chip",
+            4: "multitenant_events_per_sec_per_chip",
+        }.get(args.config, "pipeline_events_per_sec_per_chip"),
+        "value": 0,
+        "unit": "events/s",
+        "vs_baseline": 0,
+        "error": "TPU backend unavailable after retries",
+        "attempts": failures,
+        "cpu_fallback": cpu_doc,
+        "note": ("cpu_fallback is NOT a per-chip TPU figure; it exists so "
+                 "the run records evidence when the tunnel is down"),
+    }
+    sys.stdout.write(json.dumps(diag) + "\n")
+    sys.exit(0 if cpu_doc is not None else 1)
+
+
+CONFIGS = {
+    1: bench_pipeline,
+    2: bench_dispatcher,
+    3: bench_analytics,
+    4: bench_multitenant,
+    5: bench_media_labels,
+}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=int, default=1,
+                        choices=sorted(CONFIGS),
+                        help="benchmark config (BASELINE.md); default 1")
+    parser.add_argument("--pallas", action="store_true",
+                        help="enable the Pallas geofence kernel "
+                             "(SW_TPU_GEO_PALLAS=1)")
+    parser.add_argument("--no-supervise", action="store_true",
+                        help="run in-process without retry wrapper")
+    args = parser.parse_args()
+
+    if os.environ.get("SW_BENCH_CHILD") == "1" or args.no_supervise:
+        if args.pallas:
+            os.environ["SW_TPU_GEO_PALLAS"] = "1"
+        _force_cpu_if_requested()
+        CONFIGS[args.config]()
+        return
+
+    # Config 5 never touches the accelerator; run it directly.
+    if args.config == 5:
+        CONFIGS[args.config]()
+        return
+
+    extra = [f"--config={args.config}"]
+    supervise(args, extra)
 
 
 if __name__ == "__main__":
-    import argparse
-
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--config", type=int, default=1, choices=[1, 3],
-                        help="1 = headline pipeline (default); 3 = analytics")
-    args = parser.parse_args()
-    if args.config == 3:
-        bench_analytics()
-    else:
-        main()
+    main()

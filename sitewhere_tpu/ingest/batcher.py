@@ -6,6 +6,12 @@ Kafka producer partitioner + consumer poll batching
 (``EventSourcesManager.java:166``, ``MicroserviceKafkaConsumer.java:123-128``):
 events keyed by device token land in per-partition record batches.  Here:
 
+- intake is COLUMNAR: rows live in per-shard queues of numpy column
+  chunks, written once at intake (vectorized ``add_arrays`` gathers one
+  slice per field per shard; the scalar ``add`` paths append into a
+  growable staging chunk) and copied exactly once more at emission, by
+  slice, into the fixed-shape batch — no per-row per-field Python loops
+  anywhere on the hot path;
 - each event row is routed to the mesh shard that owns its device registry
   block (:func:`~sitewhere_tpu.parallel.mesh.shard_for_device`), preserving
   the shard-local-gather invariant of the sharded pipeline step;
@@ -20,14 +26,15 @@ events keyed by device token land in per-partition record batches.  Here:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from sitewhere_tpu.ids import NULL_ID
-from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
+from sitewhere_tpu.ingest.decoders import DecodedRequest
 from sitewhere_tpu.parallel.mesh import shard_for_device
 from sitewhere_tpu.schema import EventBatch
 
@@ -50,39 +57,47 @@ _FIELDS = (
     ("update_state", np.bool_, True),
 )
 
+# Data columns (everything but the emission-owned `valid` flag).
+_COL_FIELDS = tuple(name for name, _, _ in _FIELDS[1:])
+_DTYPE = {name: dt for name, dt, _ in _FIELDS}
+_FILL = {name: fill for name, _, fill in _FIELDS}
+
 
 @dataclasses.dataclass
-class _Row:
-    device_id: int
-    tenant_id: int
-    event_type: int
-    ts_s: int
-    ts_ns: int
-    mtype_id: int
-    value: float
-    lat: float
-    lon: float
-    elevation: float
-    alert_code: int
-    alert_level: int
-    command_id: int
-    payload_ref: int
-    update_state: bool = True
-    arrival: float = 0.0  # host clock at intake (deadline tracking only)
+class _Chunk:
+    """A columnar run of pending rows on one shard.
 
+    ``start`` = rows already emitted; ``length`` = rows written.  A chunk
+    whose backing arrays are longer than ``length`` is a *staging* chunk —
+    the scalar add paths append into it in place (amortizing allocation);
+    vectorized chunks arrive full (``length == capacity``).
+    """
 
-_COL_FIELDS = tuple(f for f in _Row.__dataclass_fields__ if f != "arrival")
+    cols: Dict[str, np.ndarray]
+    length: int
+    arrival: float
+    start: int = 0
+
+    @property
+    def capacity(self) -> int:
+        return len(self.cols["device_id"])
 
 
 @dataclasses.dataclass
 class BatchPlan:
-    """A ready-to-dispatch batch plus its host-side bookkeeping."""
+    """A ready-to-dispatch batch plus its host-side bookkeeping.
+
+    ``host_cols`` keeps the numpy columns the device batch was built from
+    so egress never has to fetch the input batch back off the device —
+    only step *outputs* cross the host boundary after dispatch.
+    """
 
     batch: EventBatch
     n_events: int
     width: int
     created_at: float
     max_wait_s: float  # how long the oldest row waited before emit
+    host_cols: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
 
     @property
     def fill(self) -> float:
@@ -111,22 +126,29 @@ class Batcher:
     ):
         if width % n_shards != 0:
             raise ValueError(f"width={width} not divisible by n_shards={n_shards}")
+        # Validate the routing invariant up front (same check as
+        # shard_for_device, surfaced at construction).
+        shard_for_device(0, registry_capacity, n_shards)
         self.width = width
         self.n_shards = n_shards
         self.seg = width // n_shards
         self.capacity = registry_capacity
+        self.rows_per_shard = registry_capacity // n_shards
         self.resolve_device = resolve_device
         self.resolve_mtype = resolve_mtype
         self.resolve_alert = resolve_alert
         self.deadline_s = deadline_ms / 1e3
         self.clock = clock
-        self._pending: List[List[_Row]] = [[] for _ in range(n_shards)]
+        self._pending: List[Deque[_Chunk]] = [
+            collections.deque() for _ in range(n_shards)
+        ]
+        self._counts = [0] * n_shards
         self._oldest: Optional[float] = None
         self._rr = 0  # round-robin shard for unknown devices
         self.emitted_batches = 0
         self.emitted_events = 0
 
-    # -- intake -------------------------------------------------------------
+    # -- intake: scalar paths ------------------------------------------------
 
     def add(self, req: DecodedRequest, tenant_id: int, payload_ref: int) -> Optional[BatchPlan]:
         """Queue one decoded event; returns a plan if a segment filled."""
@@ -135,27 +157,23 @@ class Batcher:
             raise ValueError(
                 f"{req.kind.name} is a host-plane request, not a pipeline event"
             )
-        device_id = self.resolve_device(req.device_token)
-        mtype_id = self.resolve_mtype(req.mtype) if req.mtype else NULL_ID
-        alert_code = self.resolve_alert(req.alert_type) if req.alert_type else NULL_ID
-        return self._enqueue(
-            _Row(
-                device_id=device_id,
-                tenant_id=tenant_id,
-                event_type=int(et),
-                ts_s=req.ts_s,
-                ts_ns=req.ts_ns,
-                mtype_id=mtype_id,
-                value=req.value,
-                lat=req.lat,
-                lon=req.lon,
-                elevation=req.elevation,
-                alert_code=alert_code,
-                alert_level=int(req.alert_level),
-                command_id=NULL_ID,
-                payload_ref=payload_ref,
-                update_state=bool(req.update_state),
-            )
+        return self._enqueue_row(
+            device_id=self.resolve_device(req.device_token),
+            tenant_id=tenant_id,
+            event_type=int(et),
+            ts_s=req.ts_s,
+            ts_ns=req.ts_ns,
+            mtype_id=self.resolve_mtype(req.mtype) if req.mtype else NULL_ID,
+            value=req.value,
+            lat=req.lat,
+            lon=req.lon,
+            elevation=req.elevation,
+            alert_code=(self.resolve_alert(req.alert_type)
+                        if req.alert_type else NULL_ID),
+            alert_level=int(req.alert_level),
+            command_id=NULL_ID,
+            payload_ref=payload_ref,
+            update_state=bool(req.update_state),
         )
 
     def add_dense(
@@ -182,40 +200,160 @@ class Batcher:
         which carry dense handles instead of edge strings.  Defaults to
         ``update_state=False``: system-generated events must not touch
         last-known state or presence."""
-        return self._enqueue(
-            _Row(
-                device_id=int(device_id),
-                tenant_id=int(tenant_id),
-                event_type=int(event_type),
-                ts_s=int(ts_s),
-                ts_ns=int(ts_ns),
-                mtype_id=int(mtype_id),
-                value=float(value),
-                lat=float(lat),
-                lon=float(lon),
-                elevation=float(elevation),
-                alert_code=int(alert_code),
-                alert_level=int(alert_level),
-                command_id=int(command_id),
-                payload_ref=int(payload_ref),
-                update_state=bool(update_state),
-            )
+        return self._enqueue_row(
+            device_id=int(device_id),
+            tenant_id=int(tenant_id),
+            event_type=int(event_type),
+            ts_s=int(ts_s),
+            ts_ns=int(ts_ns),
+            mtype_id=int(mtype_id),
+            value=float(value),
+            lat=float(lat),
+            lon=float(lon),
+            elevation=float(elevation),
+            alert_code=int(alert_code),
+            alert_level=int(alert_level),
+            command_id=int(command_id),
+            payload_ref=int(payload_ref),
+            update_state=bool(update_state),
         )
 
-    def _enqueue(self, row: _Row) -> Optional[BatchPlan]:
-        """Shared routing/append/deadline/emit tail of the add paths."""
-        if 0 <= row.device_id < self.capacity:
-            shard = shard_for_device(row.device_id, self.capacity, self.n_shards)
+    def _enqueue_row(self, **values) -> Optional[BatchPlan]:
+        """Shared routing/append/deadline/emit tail of the scalar paths."""
+        device_id = values["device_id"]
+        if 0 <= device_id < self.capacity:
+            shard = device_id // self.rows_per_shard
         else:
-            row.device_id = NULL_ID
+            values["device_id"] = NULL_ID
             shard = self._rr = (self._rr + 1) % self.n_shards
-        row.arrival = self.clock()
-        self._pending[shard].append(row)
+        now = self.clock()
+        q = self._pending[shard]
+        tail = q[-1] if q else None
+        if tail is None or tail.length >= tail.capacity:
+            tail = _Chunk(
+                cols={f: np.empty(self.seg, _DTYPE[f]) for f in _COL_FIELDS},
+                length=0,
+                arrival=now,
+            )
+            q.append(tail)
+        i = tail.length
+        for f in _COL_FIELDS:
+            tail.cols[f][i] = values[f]
+        tail.length = i + 1
+        self._counts[shard] += 1
         if self._oldest is None:
-            self._oldest = row.arrival
-        if len(self._pending[shard]) >= self.seg:
+            self._oldest = now
+        if self._counts[shard] >= self.seg:
             return self._emit()
         return None
+
+    # -- intake: vectorized paths -------------------------------------------
+
+    def add_arrays(self, **columns) -> List[BatchPlan]:
+        """Columnar intake: queue N pre-resolved rows from 1-D arrays.
+
+        ``device_id`` is required; any other batch column
+        (:data:`_COL_FIELDS`) may be supplied as an array of the same
+        length or omitted to take its fill value.  Returns every plan that
+        became ready (possibly several when N spans multiple segments).
+        This is the 1M events/sec/chip intake edge: one gather per field
+        per shard, no Python per-row work.
+        """
+        device_id = np.asarray(columns["device_id"], np.int32)
+        n = len(device_id)
+        if n == 0:
+            return []
+        cols: Dict[str, np.ndarray] = {}
+        for f in _COL_FIELDS:
+            v = columns.get(f)
+            if f == "device_id":
+                cols[f] = device_id
+            elif v is None:
+                cols[f] = np.full(n, _FILL[f], _DTYPE[f])
+            else:
+                cols[f] = np.asarray(v, _DTYPE[f])
+                if len(cols[f]) != n:
+                    raise ValueError(
+                        f"column {f!r} length {len(cols[f])} != {n}")
+        unknown_keys = set(columns) - set(_COL_FIELDS)
+        if unknown_keys:
+            raise ValueError(f"unknown columns {sorted(unknown_keys)}")
+
+        in_range = (device_id >= 0) & (device_id < self.capacity)
+        if self.n_shards == 1:
+            shard = None  # everything lands on shard 0
+            if not in_range.all():
+                cols["device_id"] = np.where(in_range, device_id, NULL_ID)
+        else:
+            shard = device_id // self.rows_per_shard
+            bad = ~in_range
+            if bad.any():
+                k = int(bad.sum())
+                shard[bad] = (self._rr + np.arange(k)) % self.n_shards
+                self._rr = (self._rr + k) % self.n_shards
+                cols["device_id"] = np.where(bad, NULL_ID, device_id)
+
+        now = self.clock()
+        if self.n_shards == 1:
+            self._pending[0].append(_Chunk(cols=cols, length=n, arrival=now))
+            self._counts[0] += n
+        else:
+            for s in range(self.n_shards):
+                m = shard == s
+                c = int(m.sum())
+                if c == 0:
+                    continue
+                self._pending[s].append(_Chunk(
+                    cols={f: cols[f][m] for f in _COL_FIELDS},
+                    length=c,
+                    arrival=now,
+                ))
+                self._counts[s] += c
+        if self._oldest is None:
+            self._oldest = now
+
+        plans: List[BatchPlan] = []
+        while max(self._counts) >= self.seg:
+            plans.append(self._emit())
+        return plans
+
+    def add_requests(
+        self,
+        reqs: Sequence[DecodedRequest],
+        tenant_ids: Sequence[int],
+        payload_refs: Sequence[int],
+    ) -> List[BatchPlan]:
+        """Batch intake of decoded requests: one token-resolution pass
+        builds the column arrays, then :meth:`add_arrays`."""
+        n = len(reqs)
+        if n == 0:
+            return []
+        out = {f: np.empty(n, _DTYPE[f]) for f in _COL_FIELDS}
+        rd, rm, ra = self.resolve_device, self.resolve_mtype, self.resolve_alert
+        for i, req in enumerate(reqs):
+            et = req.event_type
+            if et is None:
+                raise ValueError(
+                    f"{req.kind.name} is a host-plane request, not a pipeline event"
+                )
+            out["device_id"][i] = rd(req.device_token)
+            out["event_type"][i] = int(et)
+            out["ts_s"][i] = req.ts_s
+            out["ts_ns"][i] = req.ts_ns
+            out["mtype_id"][i] = rm(req.mtype) if req.mtype else NULL_ID
+            out["value"][i] = req.value
+            out["lat"][i] = req.lat
+            out["lon"][i] = req.lon
+            out["elevation"][i] = req.elevation
+            out["alert_code"][i] = ra(req.alert_type) if req.alert_type else NULL_ID
+            out["alert_level"][i] = int(req.alert_level)
+            out["update_state"][i] = bool(req.update_state)
+        out["tenant_id"][:] = np.asarray(tenant_ids, np.int32)
+        out["payload_ref"][:] = np.asarray(payload_refs, np.int32)
+        out["command_id"][:] = NULL_ID
+        return self.add_arrays(**out)
+
+    # -- deadline/flush ------------------------------------------------------
 
     def poll(self) -> Optional[BatchPlan]:
         """Emit on deadline: call periodically from the dispatch loop."""
@@ -233,37 +371,47 @@ class Batcher:
 
     @property
     def pending(self) -> int:
-        return sum(len(p) for p in self._pending)
+        return sum(self._counts)
 
     # -- emission -----------------------------------------------------------
 
     def _emit(self) -> BatchPlan:
         import jax.numpy as jnp
 
-        cols = {
+        out = {
             name: np.full(self.width, fill, dtype=dt) for name, dt, fill in _FIELDS
         }
         n = 0
-        for shard in range(self.n_shards):
-            base = shard * self.seg
-            take = self._pending[shard][: self.seg]
-            self._pending[shard] = self._pending[shard][self.seg :]
-            for i, row in enumerate(take):
-                pos = base + i
-                cols["valid"][pos] = True
+        for s in range(self.n_shards):
+            base = s * self.seg
+            filled = 0
+            q = self._pending[s]
+            while filled < self.seg and q:
+                ch = q[0]
+                take = min(ch.length - ch.start, self.seg - filled)
+                lo, hi = base + filled, base + filled + take
                 for f in _COL_FIELDS:
-                    cols[f][pos] = getattr(row, f)
-            n += len(take)
+                    out[f][lo:hi] = ch.cols[f][ch.start:ch.start + take]
+                out["valid"][lo:hi] = True
+                ch.start += take
+                filled += take
+                if ch.start >= ch.length:
+                    # Fully drained (staging chunks included — dropping
+                    # them keeps a later append from resurrecting
+                    # already-emitted rows).
+                    q.popleft()
+            self._counts[s] -= filled
+            n += filled
 
         now = self.clock()
         wait = now - self._oldest if self._oldest is not None else 0.0
-        # Carried-over rows keep their true arrival time for the deadline.
-        remaining = [r.arrival for p in self._pending for r in p[:1]]
+        # Carried-over rows keep their chunk arrival time for the deadline.
+        remaining = [q[0].arrival for q in self._pending if q]
         self._oldest = min(remaining) if remaining else None
         self.emitted_batches += 1
         self.emitted_events += n
-        batch = EventBatch(**{k: jnp.asarray(v) for k, v in cols.items()})
+        batch = EventBatch(**{k: jnp.asarray(v) for k, v in out.items()})
         return BatchPlan(
             batch=batch, n_events=n, width=self.width, created_at=now,
-            max_wait_s=wait,
+            max_wait_s=wait, host_cols=out,
         )

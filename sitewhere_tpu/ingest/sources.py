@@ -59,6 +59,7 @@ class InboundEventSource(LifecycleComponent):
         on_registration: Optional[Forward] = None,
         on_failed_decode: Optional[FailedDecode] = None,
         on_host_request: Optional[Forward] = None,
+        on_events: Optional[Callable[[List[DecodedRequest], bytes], None]] = None,
     ):
         super().__init__(name=f"event-source:{source_id}")
         self.source_id = source_id
@@ -66,6 +67,10 @@ class InboundEventSource(LifecycleComponent):
         self.decoder = decoder
         self.deduplicator = deduplicator
         self.on_event = on_event
+        # Batch forward: when set, all of one payload's pipeline events go
+        # through a single columnar call (PipelineDispatcher.ingest_many)
+        # instead of per-request on_event — the 1M events/sec intake edge.
+        self.on_events = on_events
         self.on_registration = on_registration
         self.on_failed_decode = on_failed_decode
         self.on_host_request = on_host_request
@@ -91,6 +96,7 @@ class InboundEventSource(LifecycleComponent):
             if self.on_failed_decode is not None:
                 self.on_failed_decode(payload, self.source_id, e)
             return
+        events: List[DecodedRequest] = []
         for req in requests:
             if self.deduplicator is not None and self.deduplicator.is_duplicate(req):
                 self.duplicate_count += 1
@@ -107,6 +113,8 @@ class InboundEventSource(LifecycleComponent):
                         self.on_host_request(req, payload)
                     else:
                         self.dropped_host_requests += 1
+                elif self.on_events is not None:
+                    events.append(req)  # forwarded in one batch below
                 elif self.on_event is not None:
                     self.on_event(req, payload)
             except Exception:
@@ -114,6 +122,14 @@ class InboundEventSource(LifecycleComponent):
                 logger.exception(
                     "forward failed for %s from source %s",
                     req.kind.name, self.source_id,
+                )
+        if events:
+            try:
+                self.on_events(events, payload)
+            except Exception:
+                self.failed_count += 1
+                logger.exception(
+                    "batch forward failed for source %s", self.source_id,
                 )
 
 

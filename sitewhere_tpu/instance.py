@@ -294,6 +294,9 @@ class Instance(LifecycleComponent):
     def add_source(self, source: LifecycleComponent) -> LifecycleComponent:
         """Attach an ingest source wired into the dispatcher."""
         source.on_event = self.dispatcher.ingest
+        if hasattr(source, "on_events"):
+            # batch forward: one columnar call per wire payload
+            source.on_events = self.dispatcher.ingest_many
         source.on_registration = self.dispatcher.ingest_registration
         source.on_failed_decode = self.dispatcher.ingest_failed_decode
         self.sources.append(self.add_child(source))

@@ -19,9 +19,14 @@ where egress covers everything the reference spreads over five services:
 - derived alerts + presence state-changes → re-injected into the batcher
 - new state      → DeviceStateManager.commit (device-state), sweep-safe
 
-Double-buffering: while the device computes step N, the host assembles
-batch N+1 and drains egress N-1 (egress handoff is queue-based; JAX
-dispatch is async until outputs are fetched).
+Double-buffering: egress is deferred by ONE step — ``_run_plan``
+dispatches step N (async, JAX does not block until outputs are fetched)
+and only then egresses step N-1's outputs, so the device computes N while
+the host fans out N-1.  Output fetches are selective: batch columns never
+round-trip (the batcher keeps its numpy originals in ``BatchPlan``), and
+the unregistered mask / derived-alert rows are fetched only when the
+step's metric counters say they exist.  ``flush``/idle poll drain the
+in-flight step so egress latency stays bounded by the batch deadline.
 """
 
 from __future__ import annotations
@@ -100,6 +105,9 @@ class PipelineDispatcher(LifecycleComponent):
         # steps from the same snapshot would lose the first commit's state
         # merges.  RLock: replay/derived re-injection recurses.
         self._step_lock = threading.RLock()
+        # (plan, outputs, replay_depth) of the dispatched-but-not-egressed
+        # step; guarded by _step_lock.
+        self._inflight: Optional[tuple] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # host-aggregated counters (metrics endpoint surface)
@@ -122,6 +130,50 @@ class PipelineDispatcher(LifecycleComponent):
         with self._lock:
             plan = self.batcher.add(req, tenant_id=tenant_id, payload_ref=ref)
         if plan is not None:
+            self._run_plan(plan)
+
+    def ingest_many(self, reqs: List[DecodedRequest],
+                    payload: bytes = b"") -> None:
+        """Columnar intake of one wire payload's decoded events (the
+        batch-decoder fast path): one resolution pass, no per-row
+        dataclass churn, and the payload journals ONCE — every row shares
+        the offset, so replay decodes it a single time (at-least-once,
+        like the reference's record-level Kafka redelivery)."""
+        if not reqs:
+            return
+        # Validate BEFORE journaling so a host-plane request in the batch
+        # can't leave an orphaned journal record behind a raised error.
+        for r in reqs:
+            if r.event_type is None:
+                raise ValueError(
+                    f"{r.kind.name} is a host-plane request, not a pipeline event"
+                )
+        ref = NULL_ID
+        if self.journal is not None and payload:
+            ref = self.journal.append(payload)
+        tenants = [
+            self.resolve_tenant(r.metadata.get("tenant", "default")
+                                if r.metadata else "default")
+            for r in reqs
+        ]
+        with self._lock:
+            plans = self.batcher.add_requests(reqs, tenants, [ref] * len(reqs))
+        for plan in plans:
+            self._run_plan(plan)
+
+    def ingest_arrays(self, **columns) -> None:
+        """Pre-resolved columnar intake (dense handles, no string work):
+        the highest-rate edge, fed by vectorized decoders or re-injection.
+        Accepts the :mod:`sitewhere_tpu.ingest.batcher` column set; rows
+        without an explicit ``tenant_id`` land in the default tenant (the
+        scalar ``ingest`` path's behavior)."""
+        if "tenant_id" not in columns:
+            n = len(columns["device_id"])
+            columns["tenant_id"] = np.full(
+                n, self.resolve_tenant("default"), np.int32)
+        with self._lock:
+            plans = self.batcher.add_arrays(**columns)
+        for plan in plans:
             self._run_plan(plan)
 
     def ingest_registration(self, req: DecodedRequest, payload: bytes = b"") -> None:
@@ -160,6 +212,10 @@ class PipelineDispatcher(LifecycleComponent):
                     plan = self.batcher.poll()  # deadline-driven partial emit
                 if plan is not None:
                     self._run_plan(plan)
+                else:
+                    # No new batch: drain the deferred step so egress
+                    # latency stays bounded when traffic pauses.
+                    self._drain_inflight()
             except Exception:
                 logger.exception("dispatch cycle failed")
 
@@ -169,6 +225,7 @@ class PipelineDispatcher(LifecycleComponent):
             plan = self.batcher.flush()
         if plan is not None:
             self._run_plan(plan)
+        self._drain_inflight()
 
     # -- one step -----------------------------------------------------------
 
@@ -182,21 +239,38 @@ class PipelineDispatcher(LifecycleComponent):
             )
             self.state_manager.commit(new_state, batch=batch,
                                       accepted=out.accepted)
-            self._egress(batch, out, replay_depth)
             self.steps += 1
+            # Double-buffer: leave this step in flight (dispatch is async)
+            # and egress the PREVIOUS step while the device computes.
+            prev, self._inflight = self._inflight, (plan, out, replay_depth)
+            if prev is not None:
+                self._egress(*prev)
 
-    def _egress(self, batch: EventBatch, out, replay_depth: int) -> None:
-        """Host fan-out of one step's outputs (device→host copy happens
-        here, once, for the whole struct)."""
-        host_batch = as_numpy(batch)
-        host_out = as_numpy(out)
-        accepted = host_out.accepted
-        m = host_out.metrics
+    def _drain_inflight(self) -> None:
+        with self._step_lock:
+            # Egress may re-inject (replay, derived alerts), which runs a
+            # new step and leaves it in flight — loop until settled
+            # (bounded by max_replay_depth).
+            while self._inflight is not None:
+                plan, out, depth = self._inflight
+                self._inflight = None
+                self._egress(plan, out, depth)
+
+    def _egress(self, plan: BatchPlan, out, replay_depth: int) -> None:
+        """Host fan-out of one step's outputs.
+
+        The input batch never leaves the host (``plan.host_cols``); only
+        step outputs are fetched, and the rare-row masks (unregistered,
+        derived alerts) only when their metric counters are nonzero.
+        """
+        host_cols = plan.host_cols
+        m = as_numpy(out.metrics)
+        accepted = np.asarray(out.accepted)
         for key in ("processed", "accepted", "unregistered", "unassigned",
                     "threshold_alerts", "zone_alerts"):
             self.totals[key] += int(getattr(m, key))
 
-        cols = self._columns(host_batch, host_out)
+        cols = self._columns(host_cols, out)
 
         # 1. persistence (event-management analog)
         if self.event_store is not None and accepted.any():
@@ -207,22 +281,24 @@ class PipelineDispatcher(LifecycleComponent):
             self.outbound.submit(cols, accepted)
 
         # 3. command invocations (command-delivery analog)
-        cmd_mask = accepted & (host_batch.event_type == EventType.COMMAND_INVOCATION)
+        cmd_mask = accepted & (cols["event_type"] == EventType.COMMAND_INVOCATION)
         if self.on_command_rows is not None and cmd_mask.any():
             self.totals["commands"] += int(cmd_mask.sum())
             self.on_command_rows(cols, cmd_mask)
 
         # 4. auto-registration + replay (device-registration analog)
-        self._handle_unregistered(host_batch, host_out, replay_depth)
+        if int(m.unregistered) > 0:
+            self._handle_unregistered(host_cols, out, replay_depth)
 
         # 5. derived alerts re-injection (rule outputs become first-class
         #    events, reference ZoneTestRuleProcessor fires alerts back
-        #    through event management)
-        self._reinject_derived(host_out, replay_depth)
+        #    through event management) — fetched only when rules fired
+        if int(m.threshold_alerts) + int(m.zone_alerts) > 0:
+            self._reinject_derived(out, replay_depth)
 
-    def _columns(self, host_batch, host_out) -> Dict[str, np.ndarray]:
+    def _columns(self, host_cols: Dict[str, np.ndarray], out) -> Dict[str, np.ndarray]:
         cols = {
-            name: getattr(host_batch, name)
+            name: host_cols[name]
             for name in (
                 "device_id", "tenant_id", "event_type", "ts_s", "ts_ns",
                 "mtype_id", "value", "lat", "lon", "elevation",
@@ -231,30 +307,30 @@ class PipelineDispatcher(LifecycleComponent):
         }
         for name in ("device_type_id", "assignment_id", "area_id",
                      "customer_id", "asset_id"):
-            cols[name] = getattr(host_out, name)
+            cols[name] = np.asarray(getattr(out, name))
         return cols
 
-    def _handle_unregistered(self, host_batch, host_out, replay_depth: int) -> None:
-        mask = host_out.unregistered
+    def _handle_unregistered(self, host_cols, out, replay_depth: int) -> None:
+        mask = np.asarray(out.unregistered)
         if not mask.any():
             return
-        refs = host_batch.payload_ref[mask]
+        refs = host_cols["payload_ref"][mask]
         requests: List[DecodedRequest] = []
         unreplayable: List[int] = []
         if self.journal is not None and self.registration is not None:
-            # resolve original requests from the journal for replay
+            # resolve original requests from the journal for replay;
+            # rows from one multi-event payload share an offset, so decode
+            # each distinct ref once
             from sitewhere_tpu.ingest.decoders import JsonDecoder
 
             decoder = JsonDecoder()
-            for ref in refs:
-                if int(ref) == NULL_ID:
-                    unreplayable.append(int(ref))
-                    continue
+            unreplayable = [int(r) for r in refs if int(r) == NULL_ID]
+            for ref in dict.fromkeys(int(r) for r in refs if int(r) != NULL_ID):
                 try:
-                    requests.extend(decoder(self.journal.read_one(int(ref))))
+                    requests.extend(decoder(self.journal.read_one(ref)))
                 except Exception:
-                    logger.debug("unreplayable payload ref %d", int(ref))
-                    unreplayable.append(int(ref))
+                    logger.debug("unreplayable payload ref %d", ref)
+                    unreplayable.append(ref)
         else:
             unreplayable = [int(r) for r in refs]
         # every unreplayable row dead-letters, even when siblings replay
@@ -282,11 +358,13 @@ class PipelineDispatcher(LifecycleComponent):
             for plan in plans:
                 self._run_plan(plan, replay_depth + 1)
 
-    def _reinject_derived(self, host_out, replay_depth: int) -> None:
-        derived = host_out.derived_alerts
+    def _reinject_derived(self, out, replay_depth: int) -> None:
+        if replay_depth >= self.max_replay_depth:
+            return
+        derived = as_numpy(out.derived_alerts)
         mask = np.asarray(derived.valid)
         count = int(mask.sum())
-        if count == 0 or replay_depth >= self.max_replay_depth:
+        if count == 0:
             return
         self.totals["derived_alerts"] += count
         self.inject_batch(derived, mask, replay_depth + 1)
@@ -294,31 +372,17 @@ class PipelineDispatcher(LifecycleComponent):
     def inject_batch(self, batch: EventBatch, mask: np.ndarray,
                      replay_depth: int = 0) -> None:
         """Re-inject an already-dense event batch (derived alerts, presence
-        STATE_CHANGEs) through the pipeline as first-class events."""
+        STATE_CHANGEs) through the pipeline as first-class events —
+        columnar: one mask-select per field, no per-row work."""
+        from sitewhere_tpu.ingest.batcher import _COL_FIELDS
+
         host = as_numpy(batch)
-        rows = np.nonzero(mask)[0]
-        plans = []
+        rows = np.nonzero(np.asarray(mask))[0]
+        if rows.size == 0:
+            return
+        cols = {f: np.asarray(getattr(host, f))[rows] for f in _COL_FIELDS}
         with self._lock:
-            for i in rows:
-                plan = self.batcher.add_dense(
-                    device_id=int(host.device_id[i]),
-                    tenant_id=int(host.tenant_id[i]),
-                    event_type=int(host.event_type[i]),
-                    ts_s=int(host.ts_s[i]),
-                    ts_ns=int(host.ts_ns[i]),
-                    mtype_id=int(host.mtype_id[i]),
-                    value=float(host.value[i]),
-                    lat=float(host.lat[i]),
-                    lon=float(host.lon[i]),
-                    elevation=float(host.elevation[i]),
-                    alert_code=int(host.alert_code[i]),
-                    alert_level=int(host.alert_level[i]),
-                    command_id=int(host.command_id[i]),
-                    payload_ref=int(host.payload_ref[i]),
-                    update_state=bool(host.update_state[i]),
-                )
-                if plan is not None:
-                    plans.append(plan)
+            plans = self.batcher.add_arrays(**cols)
         for plan in plans:
             self._run_plan(plan, replay_depth)
 

@@ -19,6 +19,7 @@ import base64
 import hashlib
 import socket
 import struct
+import threading
 from typing import Optional, Tuple
 
 _GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
@@ -80,11 +81,21 @@ def read_frame(sock: socket.socket) -> Tuple[int, bytes, bool]:
 
 
 class ServerWebSocket:
-    """One accepted server-side connection."""
+    """One accepted server-side connection.
+
+    Sends are serialized with a per-socket lock: the broadcaster thread's
+    ``send_text`` and the recv thread's PONG replies share the socket, and
+    interleaved partial writes would desync the client's frame parser.
+    """
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.open = True
+        self._send_lock = threading.Lock()
+
+    def _send_frame(self, frame: bytes) -> None:
+        with self._send_lock:
+            self.sock.sendall(frame)
 
     @classmethod
     def handshake(cls, handler) -> Optional["ServerWebSocket"]:
@@ -126,10 +137,10 @@ class ServerWebSocket:
         return cls(sock)
 
     def send_text(self, text: str) -> None:
-        self.sock.sendall(encode_frame(OP_TEXT, text.encode("utf-8")))
+        self._send_frame(encode_frame(OP_TEXT, text.encode("utf-8")))
 
     def send_binary(self, data: bytes) -> None:
-        self.sock.sendall(encode_frame(OP_BINARY, data))
+        self._send_frame(encode_frame(OP_BINARY, data))
 
     def recv(self) -> Optional[Tuple[int, bytes]]:
         """Next data message → (opcode, payload); None on close.
@@ -137,7 +148,7 @@ class ServerWebSocket:
         opcode, payload, fin = read_frame(self.sock)
         while True:
             if opcode == OP_PING:
-                self.sock.sendall(encode_frame(OP_PONG, payload))
+                self._send_frame(encode_frame(OP_PONG, payload))
             elif opcode == OP_CLOSE:
                 self.close()
                 return None
@@ -153,7 +164,7 @@ class ServerWebSocket:
                         data += payload
                         fin = cfin
                     elif opcode == OP_PING:
-                        self.sock.sendall(encode_frame(OP_PONG, payload))
+                        self._send_frame(encode_frame(OP_PONG, payload))
                     elif opcode == OP_CLOSE:
                         self.close()
                         return None
@@ -164,7 +175,7 @@ class ServerWebSocket:
         if self.open:
             self.open = False
             try:
-                self.sock.sendall(encode_frame(OP_CLOSE, b""))
+                self._send_frame(encode_frame(OP_CLOSE, b""))
             except OSError:
                 pass
             try:

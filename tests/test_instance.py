@@ -264,3 +264,39 @@ class TestCommandDelivery:
         assert record["kind"] == "undeliverable-invocation"
         inst.stop()
         inst.terminate()
+
+
+class TestBatchIngest:
+    def test_source_batch_payload_journals_once_and_processes_all(self, instance):
+        """A multi-event wire payload forwards through ingest_many: one
+        journal record, every event processed (batch-decoder fast path)."""
+        from sitewhere_tpu.ingest.decoders import JsonBatchDecoder
+        from sitewhere_tpu.ingest.sources import InboundEventSource
+
+        seed_device(instance)
+        src = instance.add_source(InboundEventSource(
+            source_id="batch", receivers=[], decoder=JsonBatchDecoder()))
+        payload = json.dumps({
+            "deviceToken": "dev-1",
+            "events": [
+                {"type": "measurement", "name": "temp", "value": float(v),
+                 "ts": 2000 + v}
+                for v in range(5)
+            ],
+        }).encode()
+        before = instance.ingest_journal.end_offset
+        src.on_encoded_payload(payload)
+        instance.dispatcher.flush()
+        assert instance.ingest_journal.end_offset == before + 1
+        snap = instance.dispatcher.metrics_snapshot()
+        assert snap["accepted"] >= 5
+
+    def test_ingest_many_rejects_host_plane_before_journaling(self, instance):
+        seed_device(instance)
+        before = instance.ingest_journal.end_offset
+        bad = DecodedRequest(
+            kind=RequestKind.STREAM_DATA, device_token="dev-1", ts_s=1000)
+        with pytest.raises(ValueError):
+            instance.dispatcher.ingest_many(
+                [measurement("dev-1", 1.0), bad], b'{"x":1}')
+        assert instance.ingest_journal.end_offset == before
