@@ -99,6 +99,16 @@ class Instance(LifecycleComponent):
         width = int(self.config["pipeline.width"])
         n_shards = int(self.config["pipeline.n_shards"])
 
+        # Multi-chip: one (shard, model) mesh over the visible devices; the
+        # dispatcher runs the shard_map step and the batcher routes rows to
+        # the owning shard (Kafka partitioning analog, SURVEY.md §2.4).
+        if n_shards > 1:
+            from sitewhere_tpu.parallel.mesh import make_mesh
+
+            self.mesh = make_mesh(n_devices=n_shards)
+        else:
+            self.mesh = None
+
         # identity + security
         self.identity = IdentityMap(capacity=cap)
         self.users = UserManagement()
@@ -183,6 +193,7 @@ class Instance(LifecycleComponent):
             journal=self.ingest_journal,
             dead_letters=self.dead_letters,
             resolve_tenant=self._tenant_dense_id,
+            mesh=self.mesh,
         ))
         self.presence = self.add_child(PresenceManager(
             self.device_state,

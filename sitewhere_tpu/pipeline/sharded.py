@@ -53,12 +53,16 @@ def _specs_replicated(tree) -> object:
     return jax.tree_util.tree_map(lambda x: P(), tree)
 
 
-def build_sharded_step(mesh: Mesh):
+def build_sharded_step(mesh: Mesh, donate: bool = True):
     """Build the jitted multi-chip pipeline step for ``mesh``.
 
     Returns ``step(registry, state, rules, zones, batch) -> (state, outputs)``
     operating on globally-sharded arrays (place inputs with
     :func:`place_inputs` or equivalent ``device_put``).
+
+    ``donate=False`` keeps the input state buffers alive — required by the
+    dispatcher, whose :class:`DeviceStateManager` still hands the previous
+    epoch to concurrent readers and the sweep-merge in ``commit``.
     """
     reg_t = Registry.empty(8)
     state_t = DeviceState.empty(8)
@@ -121,7 +125,7 @@ def build_sharded_step(mesh: Mesh):
         local_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     )
-    return jax.jit(mapped, donate_argnums=(1,))
+    return jax.jit(mapped, donate_argnums=(1,) if donate else ())
 
 
 def place_inputs(

@@ -80,6 +80,7 @@ class PipelineDispatcher(LifecycleComponent):
         dead_letters: Optional[Journal] = None,
         resolve_tenant: Optional[Callable[[str], int]] = None,
         max_replay_depth: int = 4,
+        mesh=None,
         name: str = "pipeline-dispatcher",
     ):
         super().__init__(name)
@@ -98,7 +99,20 @@ class PipelineDispatcher(LifecycleComponent):
         self.max_replay_depth = max_replay_depth
         # No donation of `state`: DeviceStateManager.commit's sweep-merge
         # and concurrent readers still reference the previous epoch.
-        self._step = jax.jit(pipeline_step)
+        self.mesh = mesh
+        if mesh is not None:
+            # Multi-chip: shard_map step over the mesh (Kafka-partitioning
+            # analog, SURVEY.md §2.4) — the batcher already routes each row
+            # to the sub-batch of the shard owning its registry block.
+            from sitewhere_tpu.pipeline.sharded import build_sharded_step
+
+            self._step = build_sharded_step(mesh, donate=False)
+        else:
+            self._step = jax.jit(pipeline_step)
+        # Identity-keyed cache of mesh-placed epochs: providers return the
+        # same object while clean, so steady-state steps reuse the resident
+        # sharded arrays instead of re-placing every step.
+        self._placed_epochs: Dict[str, tuple] = {}
         self._lock = threading.Lock()
         # Serializes read-state → step → commit → egress across the loop
         # thread, source threads, and the presence thread: two concurrent
@@ -229,14 +243,53 @@ class PipelineDispatcher(LifecycleComponent):
 
     # -- one step -----------------------------------------------------------
 
+    def _placed(self, kind: str, obj, replicated: bool = False):
+        """Place a provider epoch on the mesh, cached by object identity."""
+        cached = self._placed_epochs.get(kind)
+        if cached is not None and cached[0] is obj:
+            return cached[1]
+        from sitewhere_tpu.pipeline.sharded import (
+            _specs_replicated,
+            _specs_sharded,
+        )
+        from jax.sharding import NamedSharding
+
+        specs = _specs_replicated(obj) if replicated else _specs_sharded(obj)
+        placed = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            obj, specs,
+        )
+        self._placed_epochs[kind] = (obj, placed)
+        return placed
+
     def _run_plan(self, plan: BatchPlan, replay_depth: int = 0) -> None:
         with self._step_lock:
             batch = plan.batch
             state = self.state_manager.current
-            new_state, out = self._step(
-                self.registry_provider(), state,
-                self.rules_provider(), self.zones_provider(), batch,
-            )
+            if self.mesh is not None:
+                from sitewhere_tpu.pipeline.sharded import place_batch
+
+                registry = self._placed("registry", self.registry_provider())
+                rules = self._placed("rules", self.rules_provider(),
+                                     replicated=True)
+                zones = self._placed("zones", self.zones_provider(),
+                                     replicated=True)
+                # State changes identity every commit, so caching would
+                # never hit; device_put is a no-op once the epoch already
+                # carries the mesh sharding (i.e. after the first step).
+                from sitewhere_tpu.pipeline.sharded import _specs_sharded
+                from jax.sharding import NamedSharding
+
+                state = jax.tree_util.tree_map(
+                    lambda x, s: jax.device_put(
+                        x, NamedSharding(self.mesh, s)),
+                    state, _specs_sharded(state))
+                batch = place_batch(self.mesh, batch)
+            else:
+                registry = self.registry_provider()
+                rules = self.rules_provider()
+                zones = self.zones_provider()
+            new_state, out = self._step(registry, state, rules, zones, batch)
             self.state_manager.commit(new_state, batch=batch,
                                       accepted=out.accepted)
             self.steps += 1
