@@ -119,13 +119,21 @@ class HandleSpace:
     @classmethod
     def from_dict(cls, data: dict) -> "HandleSpace":
         space = cls(data["name"], data["capacity"])
-        space._id_to_token = list(data["id_to_token"])
-        for hid, token in enumerate(space._id_to_token):
-            if token is None:
-                space._free.append(hid)
-            else:
-                space._token_to_id[token] = hid
+        space.load_state(data["id_to_token"])
         return space
+
+    def load_state(self, id_to_token) -> None:
+        """Restore IN PLACE — components capture bound ``lookup``/``mint``
+        methods at construction (e.g. the batcher's resolvers), so resume
+        must mutate the existing space, never swap the object."""
+        with self._lock:
+            self._id_to_token = list(id_to_token)
+            self._token_to_id = {
+                t: hid for hid, t in enumerate(self._id_to_token)
+                if t is not None
+            }
+            self._free = [hid for hid, t in enumerate(self._id_to_token)
+                          if t is None]
 
 
 class IdentityMap:
@@ -172,6 +180,10 @@ class IdentityMap:
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())  # durable before the rename commits it —
+            # a checkpoint manifest fsynced later must never point at
+            # identity data still sitting in the page cache
         os.replace(tmp, path)  # atomic: a crash mid-dump can't corrupt the map
 
     @classmethod
@@ -182,3 +194,15 @@ class IdentityMap:
         for name, data in payload.items():
             im.spaces[name] = HandleSpace.from_dict(data)
         return im
+
+    def load_into(self, path: str) -> None:
+        """Restore every space IN PLACE (see ``HandleSpace.load_state``)."""
+        with open(path) as f:
+            payload = json.load(f)
+        for name, data in payload.items():
+            space = self.spaces.get(name)
+            if space is None:
+                self.spaces[name] = HandleSpace.from_dict(data)
+            else:
+                space.capacity = data["capacity"]
+                space.load_state(data["id_to_token"])

@@ -287,7 +287,10 @@ class JournalReader:
         self.journal = journal
         self.group = group
         self._offset_path = os.path.join(journal.dir, f"{group}.offset")
-        self.position = self._load_committed()
+        # Cached: the file changes only through this object's commit(), and
+        # callers poll `committed` on every idle dispatch cycle.
+        self._committed = self._load_committed()
+        self.position = self._committed
 
     def _load_committed(self) -> int:
         try:
@@ -298,7 +301,7 @@ class JournalReader:
 
     @property
     def committed(self) -> int:
-        return self._load_committed()
+        return self._committed
 
     @property
     def lag(self) -> int:
@@ -322,6 +325,7 @@ class JournalReader:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._offset_path)
+        self._committed = value
 
     def seek(self, offset: int) -> None:
         """Rewind/replay from an arbitrary offset (reprocess-topic analog,

@@ -29,7 +29,7 @@ from sitewhere_tpu.commands.model import CommandInvocation
 from sitewhere_tpu.commands.processing import CommandProcessor
 from sitewhere_tpu.ids import NULL_ID, IdentityMap
 from sitewhere_tpu.ingest.batcher import Batcher
-from sitewhere_tpu.ingest.journal import Journal
+from sitewhere_tpu.ingest.journal import Journal, JournalReader
 from sitewhere_tpu.labels.manager import LabelGeneratorManager
 from sitewhere_tpu.outbound.manager import OutboundConnectorsManager
 from sitewhere_tpu.pipeline.rules import RuleManager
@@ -87,7 +87,8 @@ class Instance(LifecycleComponent):
     """The composition root: one configured SiteWhere-TPU instance."""
 
     def __init__(self, config: Optional[Config] = None,
-                 template: Optional[InstanceTemplate] = None):
+                 template: Optional[InstanceTemplate] = None,
+                 recovery_decoder=None):
         super().__init__("instance")
         self.config = config or Config()
         self.template = template or InstanceTemplate()
@@ -194,6 +195,8 @@ class Instance(LifecycleComponent):
             dead_letters=self.dead_letters,
             resolve_tenant=self._tenant_dense_id,
             mesh=self.mesh,
+            journal_reader=JournalReader(self.ingest_journal, "pipeline"),
+            recovery_decoder=recovery_decoder,
         ))
         self.presence = self.add_child(PresenceManager(
             self.device_state,
@@ -202,6 +205,18 @@ class Instance(LifecycleComponent):
             on_state_changes=self._on_presence_changes,
         ))
         self.sources: List[LifecycleComponent] = []
+
+        # checkpoint/resume (SURVEY.md §5): restore the newest complete
+        # snapshot BEFORE start so devices/assignments/users/tenants/rules
+        # and DeviceState survive a restart; the journal replay in start()
+        # then re-derives anything journaled after the committed offset.
+        from sitewhere_tpu.runtime.checkpoint import Checkpointer
+
+        self.checkpointer = self.add_child(Checkpointer(
+            self,
+            interval_s=float(self.config.get("checkpoint.interval_s", 30.0)),
+        ))
+        self.restored = self.checkpointer.restore()
 
     # -- wiring helpers -----------------------------------------------------
 
@@ -361,7 +376,21 @@ class Instance(LifecycleComponent):
 
     def start(self) -> None:
         self.bootstrap()
+        # Capture the journal end BEFORE sources start so crash recovery
+        # never double-ingests a fresh append racing the replay.
+        recover_upto = self.ingest_journal.end_offset
         super().start()
+        # Crash recovery: re-ingest journal records past the committed
+        # offset (at-least-once; MicroserviceKafkaConsumer.java:116-139).
+        replayed = self.dispatcher.replay_journal(upto=recover_upto)
+        if replayed:
+            logger.info("recovered %d journaled events on start", replayed)
+
+    def stop(self) -> None:
+        super().stop()  # dispatcher stop flushes + commits the offset
+        # Final snapshot AFTER the flush so the checkpoint captures the
+        # last committed state (components are stopped but data is live).
+        self.checkpointer.save()
 
     def terminate(self) -> None:
         super().terminate()

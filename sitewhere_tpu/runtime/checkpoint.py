@@ -1,0 +1,276 @@
+"""Checkpoint/resume: periodic durable snapshots of all memory-resident state.
+
+The reference never snapshots because nothing lives in memory: the whole
+model is durable in MongoDB (``service-device-management/.../mongodb/
+MongoDeviceManagement.java``) and stream position lives in Kafka committed
+offsets (``MicroserviceKafkaConsumer.java:94``).  Here the model lives in
+host dicts + device tensors for speed, so durability is explicit:
+
+- a :class:`Checkpointer` snapshots the identity map, registry-mirror
+  columns, DeviceState tensors, and every management store into
+  ``data_dir/checkpoint/`` on an interval and at shutdown;
+- stream position is the ingest :class:`~sitewhere_tpu.ingest.journal.
+  JournalReader` committed offset (commit-after-egress, owned by the
+  dispatcher);
+- restart = restore the newest complete snapshot, then replay journal
+  records past the committed offset (at-least-once, exactly the
+  reference's crash contract: "events stack up in Kafka… resume where it
+  left off").
+
+Atomicity: every file is written ``tmp → fsync → os.replace`` and a
+``MANIFEST.json`` naming the snapshot generation is replaced LAST — a crash
+mid-save leaves the previous manifest pointing at the previous complete
+file set.  Snapshot files are generation-numbered; stale generations are
+garbage-collected after the manifest moves forward.
+
+Consistency: each component is snapshotted under its own lock, not one
+global freeze, so a write racing the save can land in one component's
+snapshot and not another's.  The skew is harmless under the at-least-once
+contract: journal replay re-derives pipeline effects, and the snapshot
+order (stores → tensors → identity LAST) ensures a token minted mid-save
+resolves to a handle whose registry row is simply still inactive —
+reported unregistered and replayed, never silently dropped.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import json
+import logging
+import os
+import pickle
+import threading
+import time
+from dataclasses import fields as dataclass_fields
+from typing import Dict, Optional
+
+import numpy as np
+
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+
+logger = logging.getLogger("sitewhere_tpu.checkpoint")
+
+# Host-dict state per Instance attribute: (attr name on Instance, attrs to
+# snapshot).  Entities are plain dataclasses — pickled by value.
+_STORE_ATTRS = {
+    "device_management": (
+        "device_types", "devices", "assignments", "area_types", "areas",
+        "customer_types", "customers", "zones", "device_groups", "alarms",
+    ),
+    "users": ("_users", "_authorities"),
+    "tenants": ("_tenants", "_templates", "_datasets"),
+    "assets": ("_types", "_assets"),
+    "schedules": ("schedules", "jobs", "_fires"),
+    "batch_ops": ("operations",),
+    "rules": ("_rules", "_slots", "_free"),
+}
+
+_MIRROR_ARRAYS = (
+    "active", "tenant_id", "device_type_id", "assignment_id",
+    "assignment_status", "area_id", "customer_id", "asset_id",
+    "z_active", "z_tenant", "z_area", "z_verts", "z_nvert",
+    "z_condition", "z_alert_code", "z_alert_level",
+)
+
+
+def _atomic_write(path: str, write_fn) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        write_fn(f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class Checkpointer(LifecycleComponent):
+    """Periodic + shutdown snapshots of one :class:`Instance`'s state."""
+
+    def __init__(self, instance, interval_s: float = 30.0):
+        super().__init__(name="checkpointer")
+        self.instance = instance
+        self.interval_s = float(interval_s)
+        self.dir = os.path.join(instance.data_dir, "checkpoint")
+        os.makedirs(self.dir, exist_ok=True)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._save_lock = threading.Lock()
+        self.last_saved_at: Optional[float] = None
+        self.generation = self._manifest().get("generation", -1)
+
+    # -- manifest -----------------------------------------------------------
+
+    @property
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, "MANIFEST.json")
+
+    def _manifest(self) -> dict:
+        try:
+            with open(self._manifest_path) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return {}
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self) -> Optional[str]:
+        """Write one snapshot generation; returns the manifest path."""
+        with self._save_lock:
+            inst = self.instance
+            gen = self.generation + 1
+            names: Dict[str, str] = {}
+
+            # 1. management stores (host dicts, each under its own lock)
+            stores: Dict[str, Dict[str, object]] = {}
+            for attr, keys in _STORE_ATTRS.items():
+                obj = getattr(inst, attr)
+                lock = getattr(obj, "_lock", None)
+                with lock if lock is not None else contextlib.nullcontext():
+                    stores[attr] = {k: getattr(obj, k) for k in keys}
+            names["stores"] = f"stores-{gen:08d}.pkl"
+            _atomic_write(
+                os.path.join(self.dir, names["stores"]),
+                lambda f: pickle.dump(stores, f, protocol=4),
+            )
+
+            # 2. registry mirror columns (+ zone tables + epoch)
+            mirror = inst.mirror
+            with mirror._lock:
+                mirror_arrays = {
+                    k: np.array(getattr(mirror, k)) for k in _MIRROR_ARRAYS
+                }
+                mirror_arrays["epoch"] = np.asarray(mirror.epoch)
+            names["mirror"] = f"mirror-{gen:08d}.npz"
+            _atomic_write(
+                os.path.join(self.dir, names["mirror"]),
+                lambda f: np.savez(f, **mirror_arrays),
+            )
+
+            # 3. device-state tensors (one device→host copy per field)
+            state = inst.device_state.current
+            state_arrays = {
+                fld.name: np.asarray(getattr(state, fld.name))
+                for fld in dataclass_fields(state)
+            }
+            names["state"] = f"state-{gen:08d}.npz"
+            _atomic_write(
+                os.path.join(self.dir, names["state"]),
+                lambda f: np.savez(f, **state_arrays),
+            )
+
+            # 4. identity map LAST (see module docstring: a token minted
+            # mid-save must never be dangling in the restored identity)
+            names["identity"] = f"identity-{gen:08d}.json"
+            inst.identity.save(os.path.join(self.dir, names["identity"]))
+
+            # 5. manifest swap commits the generation
+            manifest = {"generation": gen, "files": names,
+                        "saved_at": time.time()}
+            _atomic_write(
+                self._manifest_path,
+                lambda f: f.write(json.dumps(manifest).encode()),
+            )
+            self.generation = gen
+            self.last_saved_at = time.time()
+            self._gc(keep=gen)
+            logger.info("checkpoint generation %d saved", gen)
+            return self._manifest_path
+
+    def _gc(self, keep: int) -> None:
+        for path in glob.glob(os.path.join(self.dir, "*-*.np[zy]")) + \
+                glob.glob(os.path.join(self.dir, "*-*.pkl")) + \
+                glob.glob(os.path.join(self.dir, "*-*.json")):
+            base = os.path.basename(path)
+            try:
+                gen = int(base.rsplit("-", 1)[1].split(".")[0])
+            except (IndexError, ValueError):
+                continue
+            if gen < keep:
+                with contextlib.suppress(OSError):
+                    os.remove(path)
+
+    # -- restore ------------------------------------------------------------
+
+    def restore(self) -> bool:
+        """Restore the newest complete snapshot into the live components.
+
+        Called from ``Instance.__init__`` after construction, before start.
+        Returns True if a snapshot was restored.
+        """
+        import jax.numpy as jnp
+
+        from sitewhere_tpu.schema import DeviceState
+
+        manifest = self._manifest()
+        names = manifest.get("files")
+        if not names:
+            return False
+        inst = self.instance
+
+        # identity — strictly in place: the batcher captured bound
+        # lookup/mint methods of the existing HandleSpace objects
+        inst.identity.load_into(os.path.join(self.dir, names["identity"]))
+
+        # management stores
+        with open(os.path.join(self.dir, names["stores"]), "rb") as f:
+            stores = pickle.load(f)
+        for attr, values in stores.items():
+            obj = getattr(inst, attr)
+            for k, v in values.items():
+                current = getattr(obj, k)
+                if isinstance(current, dict) and isinstance(v, dict):
+                    current.clear()
+                    current.update(v)
+                else:
+                    setattr(obj, k, v)
+        # restored rules must rebuild their device table
+        if hasattr(inst.rules, "_dirty"):
+            inst.rules._dirty = True
+
+        # registry mirror
+        with np.load(os.path.join(self.dir, names["mirror"])) as z:
+            with inst.mirror._lock:
+                for k in _MIRROR_ARRAYS:
+                    getattr(inst.mirror, k)[:] = z[k]
+                inst.mirror.epoch = int(z["epoch"])
+                inst.mirror._dirty = True
+                inst.mirror._zones_dirty = True
+
+        # device state
+        with np.load(os.path.join(self.dir, names["state"])) as z:
+            state = DeviceState(
+                **{k: jnp.asarray(z[k]) for k in z.files}
+            )
+        inst.device_state.commit(state)
+
+        logger.info(
+            "restored checkpoint generation %s (%d devices, %d users)",
+            manifest.get("generation"),
+            len(inst.identity.device), len(inst.users.list_users()),
+        )
+        return True
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        super().start()
+        self._stop.clear()
+        if self.interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, name="checkpointer-loop", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        super().stop()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.save()
+            except Exception:
+                logger.exception("periodic checkpoint failed")

@@ -42,7 +42,7 @@ import numpy as np
 from sitewhere_tpu.ids import NULL_ID
 from sitewhere_tpu.ingest.batcher import Batcher, BatchPlan
 from sitewhere_tpu.ingest.decoders import DecodedRequest
-from sitewhere_tpu.ingest.journal import Journal
+from sitewhere_tpu.ingest.journal import Journal, JournalReader
 from sitewhere_tpu.pipeline.step import pipeline_step
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.schema import EventBatch, EventType, as_numpy
@@ -81,6 +81,8 @@ class PipelineDispatcher(LifecycleComponent):
         resolve_tenant: Optional[Callable[[str], int]] = None,
         max_replay_depth: int = 4,
         mesh=None,
+        journal_reader: Optional[JournalReader] = None,
+        recovery_decoder: Optional[Callable[[bytes], List[DecodedRequest]]] = None,
         name: str = "pipeline-dispatcher",
     ):
         super().__init__(name)
@@ -113,6 +115,22 @@ class PipelineDispatcher(LifecycleComponent):
         # same object while clean, so steady-state steps reuse the resident
         # sharded arrays instead of re-placing every step.
         self._placed_epochs: Dict[str, tuple] = {}
+        # Commit-after-egress stream position (Kafka manual-commit analog,
+        # MicroserviceKafkaConsumer.java:94): the highest journal offset
+        # whose row has completed egress.  Committed only at quiescent
+        # points (no pending rows, no in-flight step) so an earlier offset
+        # still queued in another shard segment can never be skipped.
+        self.journal_reader = journal_reader
+        # Decoder for journaled wire payloads on crash recovery — MUST
+        # match what the instance's sources journal (JSON by default; a
+        # deployment with binary/composite sources passes its own).
+        self.recovery_decoder = recovery_decoder
+        self._max_egressed_ref = -1
+        # Plans emitted by the batcher whose egress has not completed.
+        # Guarded by _lock; the commit gate requires it to be zero so a
+        # plan sitting between emission and _run_plan (outside both
+        # batcher.pending and _inflight) can never be committed past.
+        self._plans_outstanding = 0
         self._lock = threading.Lock()
         # Serializes read-state → step → commit → egress across the loop
         # thread, source threads, and the presence thread: two concurrent
@@ -134,6 +152,21 @@ class PipelineDispatcher(LifecycleComponent):
 
     # -- ingest entry points (wired as InboundEventSource.on_event) ---------
 
+    def _take(self, intake: Callable[[], object]) -> List[BatchPlan]:
+        """Run a batcher intake under the lock, counting every emitted plan
+        as outstanding until its egress completes — the commit gate's
+        accounting (see ``_maybe_commit_offset``)."""
+        with self._lock:
+            out = intake()
+            if out is None:
+                plans: List[BatchPlan] = []
+            elif isinstance(out, list):
+                plans = [p for p in out if p is not None]
+            else:
+                plans = [out]
+            self._plans_outstanding += len(plans)
+        return plans
+
     def ingest(self, req: DecodedRequest, payload: bytes = b"") -> None:
         """Queue one decoded request (journal it first: at-least-once)."""
         ref = NULL_ID
@@ -141,9 +174,9 @@ class PipelineDispatcher(LifecycleComponent):
             ref = self.journal.append(payload)
         tenant_id = self.resolve_tenant(req.metadata.get("tenant", "default")
                                         if req.metadata else "default")
-        with self._lock:
-            plan = self.batcher.add(req, tenant_id=tenant_id, payload_ref=ref)
-        if plan is not None:
+        for plan in self._take(
+                lambda: self.batcher.add(req, tenant_id=tenant_id,
+                                         payload_ref=ref)):
             self._run_plan(plan)
 
     def ingest_many(self, reqs: List[DecodedRequest],
@@ -170,9 +203,9 @@ class PipelineDispatcher(LifecycleComponent):
                                 if r.metadata else "default")
             for r in reqs
         ]
-        with self._lock:
-            plans = self.batcher.add_requests(reqs, tenants, [ref] * len(reqs))
-        for plan in plans:
+        for plan in self._take(
+                lambda: self.batcher.add_requests(reqs, tenants,
+                                                  [ref] * len(reqs))):
             self._run_plan(plan)
 
     def ingest_arrays(self, **columns) -> None:
@@ -185,9 +218,7 @@ class PipelineDispatcher(LifecycleComponent):
             n = len(columns["device_id"])
             columns["tenant_id"] = np.full(
                 n, self.resolve_tenant("default"), np.int32)
-        with self._lock:
-            plans = self.batcher.add_arrays(**columns)
-        for plan in plans:
+        for plan in self._take(lambda: self.batcher.add_arrays(**columns)):
             self._run_plan(plan)
 
     def ingest_registration(self, req: DecodedRequest, payload: bytes = b"") -> None:
@@ -222,24 +253,100 @@ class PipelineDispatcher(LifecycleComponent):
     def _loop(self) -> None:
         while not self._stop.wait(self.batcher.deadline_s / 2):
             try:
-                with self._lock:
-                    plan = self.batcher.poll()  # deadline-driven partial emit
-                if plan is not None:
-                    self._run_plan(plan)
+                plans = self._take(self.batcher.poll)  # deadline emit
+                if plans:
+                    for plan in plans:
+                        self._run_plan(plan)
                 else:
                     # No new batch: drain the deferred step so egress
                     # latency stays bounded when traffic pauses.
                     self._drain_inflight()
+                    self._maybe_commit_offset()
             except Exception:
                 logger.exception("dispatch cycle failed")
 
     def flush(self) -> None:
         """Force pending rows through (tests/shutdown)."""
-        with self._lock:
-            plan = self.batcher.flush()
-        if plan is not None:
+        for plan in self._take(self.batcher.flush):
             self._run_plan(plan)
         self._drain_inflight()
+        self._maybe_commit_offset()
+
+    def _maybe_commit_offset(self) -> None:
+        """Durably commit journal progress at a quiescent point.
+
+        Commit order matches the reference (Mongo buffer flush, THEN Kafka
+        offset): the event store's in-memory buffer is sealed to disk
+        first, so a crash after commit can never have dropped a row the
+        offset claims is done.
+        """
+        reader = self.journal_reader
+        if reader is None or self._max_egressed_ref < 0:
+            return
+        with self._step_lock:
+            if self._inflight is not None:
+                return
+            with self._lock:
+                if self.batcher.pending > 0 or self._plans_outstanding > 0:
+                    return
+                upto = self._max_egressed_ref + 1
+                if upto > reader.committed:
+                    if self.event_store is not None:
+                        self.event_store.flush()
+                    reader.commit(upto)
+
+    def replay_journal(self, decoder=None, max_records: int = 4096,
+                       upto: Optional[int] = None) -> int:
+        """Re-ingest journal records past the committed offset (crash
+        recovery, at-least-once — ``MicroserviceKafkaConsumer.java:116-139``).
+
+        Records were journaled as raw wire payloads; they replay through
+        ``decoder`` (default JSON) without re-journaling, keeping their
+        original offsets as ``payload_ref``.  Undecodable records
+        dead-letter.  ``upto`` (exclusive) bounds the replay — pass the
+        journal end captured before live sources start so a racing fresh
+        append is never double-ingested.  Returns replayed event rows.
+        """
+        reader = self.journal_reader
+        if reader is None:
+            return 0
+        from sitewhere_tpu.ingest.decoders import DecodeError, JsonDecoder
+
+        decoder = decoder or self.recovery_decoder or JsonDecoder()
+        reader.seek(reader.committed)
+        n = 0
+        done = False
+        while not done:
+            records = reader.poll(max_records)
+            if not records:
+                break
+            for offset, payload in records:
+                if upto is not None and offset >= upto:
+                    done = True
+                    break
+                try:
+                    reqs = decoder(payload)
+                except DecodeError as e:
+                    self.ingest_failed_decode(payload, "journal-replay", e)
+                    continue
+                events = [r for r in reqs if r.event_type is not None]
+                if not events:
+                    continue
+                tenants = [
+                    self.resolve_tenant(r.metadata.get("tenant", "default")
+                                        if r.metadata else "default")
+                    for r in events
+                ]
+                for plan in self._take(
+                        lambda: self.batcher.add_requests(
+                            events, tenants, [offset] * len(events))):
+                    self._run_plan(plan)
+                n += len(events)
+        if n:
+            logger.info("replayed %d journaled events past offset %d",
+                        n, reader.committed)
+        self.flush()
+        return n
 
     # -- one step -----------------------------------------------------------
 
@@ -323,6 +430,12 @@ class PipelineDispatcher(LifecycleComponent):
                     "threshold_alerts", "zone_alerts"):
             self.totals[key] += int(getattr(m, key))
 
+        refs = host_cols["payload_ref"]
+        journaled = refs != NULL_ID
+        if journaled.any():
+            self._max_egressed_ref = max(
+                self._max_egressed_ref, int(refs[journaled].max()))
+
         cols = self._columns(host_cols, out)
 
         # 1. persistence (event-management analog)
@@ -348,6 +461,12 @@ class PipelineDispatcher(LifecycleComponent):
         #    through event management) — fetched only when rules fired
         if int(m.threshold_alerts) + int(m.zone_alerts) > 0:
             self._reinject_derived(out, replay_depth)
+
+        # Egress complete: release the plan from the commit gate.  On an
+        # exception above the count stays elevated — commits stop (fail
+        # closed) rather than risk committing past an un-egressed record.
+        with self._lock:
+            self._plans_outstanding -= 1
 
     def _columns(self, host_cols: Dict[str, np.ndarray], out) -> Dict[str, np.ndarray]:
         cols = {
@@ -397,8 +516,9 @@ class PipelineDispatcher(LifecycleComponent):
         replay = self.registration.process_unregistered(requests)
         if replay and replay_depth < self.max_replay_depth:
             self.totals["replayed"] += len(replay)
-            plans = []
-            with self._lock:
+
+            def intake():
+                out = []
                 for req in replay:
                     tenant_id = self.resolve_tenant(
                         req.metadata.get("tenant", "default")
@@ -407,8 +527,10 @@ class PipelineDispatcher(LifecycleComponent):
                     plan = self.batcher.add(req, tenant_id=tenant_id,
                                             payload_ref=NULL_ID)
                     if plan is not None:
-                        plans.append(plan)
-            for plan in plans:
+                        out.append(plan)
+                return out
+
+            for plan in self._take(intake):
                 self._run_plan(plan, replay_depth + 1)
 
     def _reinject_derived(self, out, replay_depth: int) -> None:
@@ -434,9 +556,7 @@ class PipelineDispatcher(LifecycleComponent):
         if rows.size == 0:
             return
         cols = {f: np.asarray(getattr(host, f))[rows] for f in _COL_FIELDS}
-        with self._lock:
-            plans = self.batcher.add_arrays(**cols)
-        for plan in plans:
+        for plan in self._take(lambda: self.batcher.add_arrays(**cols)):
             self._run_plan(plan, replay_depth)
 
     def metrics_snapshot(self) -> Dict[str, object]:

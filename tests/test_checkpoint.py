@@ -1,0 +1,198 @@
+"""Checkpoint/resume + journal replay: the crash-recovery contract.
+
+Round-2 verdict item #3.  The reference keeps its model durable in MongoDB
+(``MongoDeviceManagement.java``) and stream position in Kafka committed
+offsets (``MicroserviceKafkaConsumer.java:94,116-139``); a restarted
+service resumes where it left off and redelivers uncommitted records
+(at-least-once).  These tests kill an instance (no clean stop) and prove a
+fresh instance on the same data_dir restores devices/assignments/users/
+tenants/rules/zones/DeviceState and replays uncommitted journal records.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.instance import Instance
+from sitewhere_tpu.runtime.config import Config
+
+
+def _cfg(tmp_path, **over):
+    doc = {
+        "instance": {"id": "ckpt-test", "data_dir": str(tmp_path / "data")},
+        "pipeline": {"width": 128, "registry_capacity": 256,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+        "checkpoint": {"interval_s": 0},  # explicit saves only
+        "registration": {"default_device_type": "sensor"},
+    }
+    doc.update(over)
+    return Config(doc, apply_env=False)
+
+
+def _payload(token, value, ts):
+    return json.dumps({
+        "deviceToken": token,
+        "type": "Measurement",
+        "request": {"name": "temp", "value": value, "eventDate": ts},
+    }).encode()
+
+
+def _ingest_json(inst, token, value, ts):
+    from sitewhere_tpu.ingest.decoders import JsonDecoder
+
+    payload = _payload(token, value, ts)
+    inst.dispatcher.ingest(JsonDecoder()(payload)[0], payload=payload)
+
+
+def test_kill_and_restart_restores_model_and_replays(tmp_path):
+    # --- first life -------------------------------------------------------
+    a = Instance(_cfg(tmp_path))
+    a.start()
+    dm = a.device_management
+    dm.create_device_type(token="sensor", name="Sensor")
+    for i in range(20):
+        dm.create_device(token=f"d-{i}", device_type="sensor")
+        dm.create_device_assignment(device=f"d-{i}")
+    a.users.create_user(username="operator", password="pw12345",
+                        first_name="Op", last_name="Erator")
+    a.tenants.create_tenant(token="acme", name="Acme",
+                            auth_token="acme-auth-token")
+    a.rules.create_rule(mtype="temp", op=0, threshold=90.0,
+                        alert_type="overheat", token="r-hot")
+    dm.create_area_type(token="site", name="Site")
+    dm.create_area(token="plant", area_type="site", name="Plant")
+    dm.create_zone(token="z-1", area="plant", bounds=[
+        [0.0, 0.0], [10.0, 0.0], [10.0, 10.0], [0.0, 10.0]],
+        alert_type="breach")
+
+    # processed + committed traffic
+    _ingest_json(a, "d-3", 21.5, 1_753_800_100)
+    a.dispatcher.flush()
+    a.dispatcher.flush()
+    events_before = a.event_store.total_events
+    assert events_before >= 1
+    committed = a.dispatcher.journal_reader.committed
+    assert committed == a.ingest_journal.end_offset  # quiescent commit ran
+
+    # snapshot, then CRASH: journal two more payloads that never reach the
+    # pipeline (the crash window between Journal.append and egress)
+    a.checkpointer.save()
+    a.ingest_journal.append(_payload("d-4", 99.5, 1_753_800_200))
+    a.ingest_journal.append(_payload("d-5", 12.0, 1_753_800_201))
+    a.ingest_journal.close()
+    a.dead_letters.close()
+    del a  # no stop(), no final checkpoint — simulated kill
+
+    # --- second life ------------------------------------------------------
+    b = Instance(_cfg(tmp_path))
+    assert b.restored
+    b.start()
+    try:
+        # model survived
+        assert b.device_management.get_device("d-3") is not None
+        assert b.device_management.get_active_assignment("d-3") is not None
+        assert any(u.username == "operator" for u in b.users.list_users())
+        assert any(t.token == "acme" for t in b.tenants.list_tenants())
+        assert b.rules.get_rule("r-hot").threshold == 90.0
+        assert b.device_management.get_zone("z-1") is not None
+
+        # identity handles stayed dense + aligned with the restored mirror
+        import numpy as np
+
+        reg = b.mirror.publish_registry()
+        d3 = b.identity.device.lookup("d-3")
+        assert d3 >= 0 and bool(np.asarray(reg.active)[d3])
+
+        # DeviceState survived (d-3's event from the first life)
+        row = b.device_state.get_device_state("d-3")
+        assert row["last_event_ts_s"] == 1_753_800_100
+
+        # uncommitted journal records replayed (at-least-once): d-4 fired
+        # the threshold rule, d-5 was a normal measurement
+        b.dispatcher.flush()
+        b.dispatcher.flush()
+        assert b.event_store.total_events >= events_before + 2
+        assert b.device_state.get_device_state("d-4")["last_event_ts_s"] == \
+            1_753_800_200
+        snap = b.dispatcher.metrics_snapshot()
+        assert snap["threshold_alerts"] >= 1  # replayed d-4 @ 99.5 > 90
+
+        # replay advanced + committed the offset at quiescence
+        assert b.dispatcher.journal_reader.committed == \
+            b.ingest_journal.end_offset
+    finally:
+        b.stop()
+        b.terminate()
+
+
+def test_clean_stop_checkpoints_and_restart_is_lossless(tmp_path):
+    a = Instance(_cfg(tmp_path))
+    a.start()
+    a.device_management.create_device_type(token="sensor", name="Sensor")
+    a.device_management.create_device(token="dev-a", device_type="sensor")
+    a.device_management.create_device_assignment(device="dev-a")
+    _ingest_json(a, "dev-a", 33.0, 1_753_800_300)
+    a.stop()  # flush + final checkpoint
+    a.terminate()
+    stored = a.event_store.total_events
+
+    b = Instance(_cfg(tmp_path))
+    assert b.restored
+    b.start()
+    try:
+        assert b.device_management.get_device("dev-a") is not None
+        assert b.device_state.get_device_state("dev-a")["last_event_ts_s"] \
+            == 1_753_800_300
+        # nothing to replay after a clean stop — no duplicate events
+        b.dispatcher.flush()
+        assert b.event_store.total_events == stored
+    finally:
+        b.stop()
+        b.terminate()
+
+
+def test_periodic_checkpointer_runs(tmp_path):
+    import time
+
+    cfg = _cfg(tmp_path, checkpoint={"interval_s": 0.1})
+    a = Instance(cfg)
+    a.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while a.checkpointer.last_saved_at is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert a.checkpointer.last_saved_at is not None
+        assert a.checkpointer.generation >= 0
+    finally:
+        a.stop()
+        a.terminate()
+
+
+def test_torn_save_keeps_previous_generation(tmp_path):
+    """A crash mid-save must leave the previous manifest usable."""
+    import os
+
+    a = Instance(_cfg(tmp_path))
+    a.start()
+    a.device_management.create_device_type(token="sensor", name="Sensor")
+    a.device_management.create_device(token="dev-x", device_type="sensor")
+    a.checkpointer.save()
+    gen = a.checkpointer.generation
+
+    # simulate a torn next save: stray tmp + newer-generation files with no
+    # manifest swap
+    ckdir = a.checkpointer.dir
+    open(os.path.join(ckdir, f"stores-{gen + 1:08d}.pkl.tmp.999"), "wb").close()
+    open(os.path.join(ckdir, f"stores-{gen + 1:08d}.pkl"), "wb").close()
+    a.ingest_journal.close()
+    a.dead_letters.close()
+    del a
+
+    b = Instance(_cfg(tmp_path))
+    assert b.restored
+    assert b.checkpointer.generation == gen
+    assert b.device_management.get_device("dev-x") is not None
+    b.terminate()
