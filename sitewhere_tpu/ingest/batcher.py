@@ -249,7 +249,7 @@ class Batcher:
 
     # -- intake: vectorized paths -------------------------------------------
 
-    def add_arrays(self, **columns) -> List[BatchPlan]:
+    def add_arrays(self, _copy: bool = True, **columns) -> List[BatchPlan]:
         """Columnar intake: queue N pre-resolved rows from 1-D arrays.
 
         ``device_id`` is required; any other batch column
@@ -258,6 +258,10 @@ class Batcher:
         became ready (possibly several when N spans multiple segments).
         This is the 1M events/sec/chip intake edge: one gather per field
         per shard, no Python per-row work.
+
+        ``_copy=False`` is for internal callers that hand over freshly
+        built arrays they will never touch again; external callers keep
+        the default so refilling their buffers cannot corrupt queued rows.
         """
         device_id = np.asarray(columns["device_id"], np.int32)
         n = len(device_id)
@@ -295,6 +299,17 @@ class Batcher:
 
         now = self.clock()
         if self.n_shards == 1:
+            # Copy caller-backed columns: np.asarray above is zero-copy for
+            # matching dtypes, and rows can sit queued past this call (up
+            # to the deadline) — a caller refilling its buffers must not
+            # corrupt queued events.  (The multi-shard path copies via its
+            # boolean-mask gather already.)
+            if _copy:
+                cols = {
+                    f: (np.array(c, copy=True)
+                        if c is columns.get(f) or c.base is not None else c)
+                    for f, c in cols.items()
+                }
             self._pending[0].append(_Chunk(cols=cols, length=n, arrival=now))
             self._counts[0] += n
         else:
@@ -351,7 +366,7 @@ class Batcher:
         out["tenant_id"][:] = np.asarray(tenant_ids, np.int32)
         out["payload_ref"][:] = np.asarray(payload_refs, np.int32)
         out["command_id"][:] = NULL_ID
-        return self.add_arrays(**out)
+        return self.add_arrays(_copy=False, **out)  # freshly built here
 
     # -- deadline/flush ------------------------------------------------------
 

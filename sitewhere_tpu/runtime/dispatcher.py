@@ -513,6 +513,30 @@ class PipelineDispatcher(LifecycleComponent):
             )
         if self.registration is None or not requests:
             return
+        # A multi-event payload shares one journal ref across rows, so the
+        # re-decode above returns EVERY event in the payload — drop only
+        # the siblings THIS plan processed normally (their dense id
+        # appears on a non-unregistered row of the same payload).  A
+        # token that raced to registration between intake and egress
+        # resolves to an id outside this plan's processed set and is
+        # still replayed — filtering must never lose an event.
+        replayed_refs = np.isin(
+            host_cols["payload_ref"],
+            [int(r) for r in dict.fromkeys(int(r) for r in refs)
+             if int(r) != NULL_ID])
+        sibling_processed = {
+            int(i)
+            for i in host_cols["device_id"][replayed_refs & ~mask]
+            if int(i) != NULL_ID
+        }
+        if sibling_processed:
+            requests = [
+                r for r in requests
+                if self.batcher.resolve_device(r.device_token)
+                not in sibling_processed
+            ]
+        if not requests:
+            return
         replay = self.registration.process_unregistered(requests)
         if replay and replay_depth < self.max_replay_depth:
             self.totals["replayed"] += len(replay)
@@ -556,7 +580,9 @@ class PipelineDispatcher(LifecycleComponent):
         if rows.size == 0:
             return
         cols = {f: np.asarray(getattr(host, f))[rows] for f in _COL_FIELDS}
-        for plan in self._take(lambda: self.batcher.add_arrays(**cols)):
+        # fancy-indexed gathers above are fresh arrays — skip the copy
+        for plan in self._take(
+                lambda: self.batcher.add_arrays(_copy=False, **cols)):
             self._run_plan(plan, replay_depth)
 
     def metrics_snapshot(self) -> Dict[str, object]:

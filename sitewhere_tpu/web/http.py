@@ -203,6 +203,11 @@ class RestGateway:
                 # client must get 401, not a live socket.
                 query = parse_qs(parsed.query)
                 headers = {k: v for k, v in h.headers.items()}
+                # ?token= exists for browser WebSocket clients that cannot
+                # set headers.  SECURITY: bearer tokens in URLs can leak
+                # into access logs and proxies — if access logging is ever
+                # added, redact the query string; prefer the Authorization
+                # header (or short-lived one-time tickets) elsewhere.
                 token_q = query.get("token", [None])[0]
                 if token_q and not headers.get("Authorization"):
                     headers["Authorization"] = f"Bearer {token_q}"

@@ -152,6 +152,10 @@ class ServerWebSocket:
             elif opcode == OP_CLOSE:
                 self.close()
                 return None
+            elif opcode == OP_CONT:
+                # continuation with no message in progress: protocol error
+                self.close(code=1002)
+                return None
             elif opcode in (OP_TEXT, OP_BINARY):
                 data = payload
                 first = opcode
@@ -168,14 +172,22 @@ class ServerWebSocket:
                     elif opcode == OP_CLOSE:
                         self.close()
                         return None
+                    elif opcode in (OP_TEXT, OP_BINARY):
+                        # RFC 6455 §5.4: a new data frame before the prior
+                        # message's FIN is a protocol error — fail fast
+                        # (1002) instead of silently desynchronizing.
+                        self.close(code=1002)
+                        return None
                 return first, data
             opcode, payload, fin = read_frame(self.sock)
 
-    def close(self) -> None:
+    def close(self, code: Optional[int] = None) -> None:
+        """Close the connection; a ``code`` fails it (RFC 6455 §7.1.7)."""
         if self.open:
             self.open = False
+            payload = struct.pack("!H", code) if code is not None else b""
             try:
-                self._send_frame(encode_frame(OP_CLOSE, b""))
+                self._send_frame(encode_frame(OP_CLOSE, payload))
             except OSError:
                 pass
             try:

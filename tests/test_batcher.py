@@ -255,3 +255,23 @@ def test_staging_chunk_carryover_does_not_resurrect_rows():
     assert rest.n_events == 1
     assert np.asarray(rest.batch.value)[0] == float(seg)
     assert b.pending == 0 and b.flush() is None
+
+
+def test_add_arrays_single_shard_copies_caller_arrays():
+    """ingest_arrays advertises vectorized/ring-buffer feeders; a caller
+    refilling its buffers while rows sit queued must not corrupt queued
+    events (round-2 advisor finding)."""
+    b = Batcher(
+        width=8, n_shards=1, registry_capacity=CAP,
+        resolve_device=lambda t: NULL_ID, resolve_mtype=lambda n: 0,
+        resolve_alert=lambda n: 0, deadline_ms=5.0, clock=FakeClock())
+    dev = np.array([0, 1, 2], np.int32)
+    val = np.array([1.0, 2.0, 3.0], np.float32)
+    assert b.add_arrays(device_id=dev, value=val) == []
+    dev[:] = 99  # caller reuses its buffers
+    val[:] = -1.0
+    plan = b.flush()
+    got_dev = plan.host_cols["device_id"][:3].tolist()
+    got_val = plan.host_cols["value"][:3].tolist()
+    assert got_dev == [0, 1, 2]
+    assert got_val == [1.0, 2.0, 3.0]

@@ -289,3 +289,29 @@ class TestTopologyWebSocket:
         op, payload = ws.recv()
         assert json.loads(payload)["instance"] == "web-test"
         ws.close()
+
+
+class TestWebSocketProtocolErrors:
+    def test_new_data_frame_mid_reassembly_fails_1002(self):
+        """RFC 6455 §5.4: a TEXT/BINARY frame before the prior message's
+        FIN is a protocol error — the server must CLOSE(1002), not
+        silently drop the frame and desynchronize."""
+        import socket
+        import struct
+
+        from sitewhere_tpu.web import ws as wsmod
+
+        a, b = socket.socketpair()
+        try:
+            server = wsmod.ServerWebSocket(a)
+            frame1 = bytes([0x00 | wsmod.OP_TEXT, 5]) + b"hello"
+            rogue = bytes([0x80 | wsmod.OP_TEXT, 3]) + b"bad"
+            b.sendall(frame1 + rogue)
+            assert server.recv() is None
+            assert not server.open
+            op, payload, fin = wsmod.read_frame(b)
+            assert op == wsmod.OP_CLOSE
+            assert struct.unpack("!H", payload[:2])[0] == 1002
+        finally:
+            a.close()
+            b.close()
