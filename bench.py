@@ -283,8 +283,11 @@ def bench_pipeline() -> None:
 # ---------------------------------------------------------------------------
 
 def bench_dispatcher() -> None:
-    """End-to-end host path: decoded requests -> batcher -> jitted step ->
-    store/outbound egress, through the real PipelineDispatcher."""
+    """The TRUE wire path: raw NDJSON bytes -> columnar decode -> batcher
+    -> jitted step -> store/outbound egress, through the real
+    PipelineDispatcher — bytes-in to egress-out, with p50/p99 event
+    latency from the dispatcher's per-plan samples (BASELINE.md's
+    <10ms p99 applies to THIS path)."""
     import tempfile
 
     from sitewhere_tpu.instance import Instance
@@ -293,6 +296,8 @@ def bench_dispatcher() -> None:
     reduced = os.environ.get("SW_BENCH_FORCE_CPU") == "1"
     n_devices = 2_000 if reduced else 10_000
     width = 4_096 if reduced else 16_384
+    lines_per_payload = 512 if reduced else 1024
+    n_payloads = 16 if reduced else 128
     tmp = tempfile.mkdtemp(prefix="swbench-")
     cfg = Config({
         "instance": {"id": "bench", "data_dir": os.path.join(tmp, "data")},
@@ -311,47 +316,50 @@ def bench_dispatcher() -> None:
             dm.create_device_assignment(device=f"d-{i}")
 
         rng = np.random.default_rng(0)
-        n_events_per_round = width
-        rounds = 8 if reduced else 40
 
-        # Pre-resolve device handles the way a source's decode path would.
-        handles = np.asarray(
-            inst.identity.device.lookup_many(
-                [f"d-{i}" for i in range(n_devices)]
-            ), np.int32)
+        # Pre-build raw NDJSON wire payloads — the bytes a fleet would
+        # actually send (JsonDecoder envelope per line, MqttTests.java
+        # conformance shape).  Building them is the DEVICE's cost, so it
+        # stays outside the timed region; everything after the bytes —
+        # parse, resolve, batch, step, egress — is measured.
+        def make_payload(r):
+            lines = []
+            for i in rng.integers(0, n_devices, lines_per_payload):
+                lines.append(json.dumps({
+                    "deviceToken": f"d-{i}",
+                    "type": "Measurement",
+                    "request": {"name": "temp",
+                                "value": float(rng.uniform(0, 100)),
+                                "eventDate": 1_753_800_000 + r},
+                }, separators=(",", ":")))
+            return "\n".join(lines).encode()
 
-        def make_arrays(r):
-            dev = handles[rng.integers(0, n_devices, n_events_per_round)]
-            return dict(
-                device_id=dev.astype(np.int32),
-                tenant_id=np.zeros(n_events_per_round, np.int32),
-                event_type=(rng.random(n_events_per_round) < 0.5).astype(np.int32),
-                ts_s=np.full(n_events_per_round, 1_753_800_000 + r, np.int32),
-                ts_ns=np.zeros(n_events_per_round, np.int32),
-                mtype_id=np.zeros(n_events_per_round, np.int32),
-                value=rng.uniform(0, 100, n_events_per_round).astype(np.float32),
-                lat=rng.uniform(-20, 20, n_events_per_round).astype(np.float32),
-                lon=rng.uniform(-20, 20, n_events_per_round).astype(np.float32),
-            )
-        prebuilt = [make_arrays(r) for r in range(rounds)]
+        payloads = [make_payload(r) for r in range(n_payloads)]
 
         # Warm-up compile through the dispatcher.
-        inst.dispatcher.ingest_arrays(**prebuilt[0])
+        inst.dispatcher.ingest_wire_lines(payloads[0])
         inst.dispatcher.flush()
+        inst.dispatcher.latencies_s.clear()
 
         t0 = time.perf_counter()
-        for r in range(1, rounds):
-            inst.dispatcher.ingest_arrays(**prebuilt[r])
+        for r in range(1, n_payloads):
+            inst.dispatcher.ingest_wire_lines(payloads[r])
         inst.dispatcher.flush()
         t1 = time.perf_counter()
-        n = n_events_per_round * (rounds - 1)
+        n = lines_per_payload * (n_payloads - 1)
         events_per_sec = n / (t1 - t0)
         snap = inst.dispatcher.metrics_snapshot()
+        p99 = snap.get("latency_p99_ms")
         emit({
             "metric": "dispatcher_events_per_sec_per_chip",
             "value": round(events_per_sec, 1),
             "unit": "events/s",
             "vs_baseline": round(events_per_sec / TARGET_EVENTS_PER_SEC, 3),
+            "wire_path": "ndjson-bytes -> columnar decode -> step -> egress",
+            "latency_p50_ms": snap.get("latency_p50_ms"),
+            "latency_p99_ms": p99,
+            "latency_target_met": (bool(p99 < 10.0)
+                                   if p99 is not None else None),
             "accepted": int(snap["accepted"]),
             "steps": int(snap["steps"]),
             "backend": __import__("jax").default_backend(),

@@ -253,6 +253,60 @@ class JsonBatchDecoder:
         return out
 
 
+def parse_envelopes(payload: bytes) -> List[dict]:
+    """Parse wire bytes — one JSON envelope, a JSON array of envelopes, or
+    NDJSON — into a list of envelope dicts.  Shared by the scalar
+    :class:`JsonLinesDecoder` and the columnar wire edge
+    (:func:`sitewhere_tpu.ingest.columnar.decode_json_lines`)."""
+    text = payload.strip()
+    if not text:
+        raise DecodeError("empty payload")
+    try:
+        if text.startswith(b"["):
+            docs = json.loads(text)
+        elif b"\n" in text:
+            # one synthesized array parse instead of N json.loads calls
+            docs = json.loads(b"[" + b",".join(text.split(b"\n")) + b"]")
+        else:
+            docs = [json.loads(text)]
+    except (ValueError, UnicodeDecodeError) as e:
+        raise DecodeError(f"bad json: {e}") from e
+    if not isinstance(docs, list):
+        raise DecodeError("wire batch must be envelope(s)")
+    return docs
+
+
+def envelope_fields(doc) -> Tuple[str, str, dict]:
+    """Validate one envelope → ``(device_token, type_name, request)``."""
+    if not isinstance(doc, dict):
+        raise DecodeError("each line must be a JSON object")
+    token = doc.get("deviceToken", doc.get("hardwareId"))
+    kind = doc.get("type")
+    if not token or not kind:
+        raise DecodeError("line missing deviceToken/type")
+    req = doc.get("request", {})
+    if not isinstance(req, dict):
+        raise DecodeError("request must be an object")
+    return str(token), str(kind), req
+
+
+class JsonLinesDecoder:
+    """Scalar fallback for NDJSON wire batches (and plain envelopes).
+
+    Used where individual :class:`DecodedRequest` objects are needed for
+    payloads that may have arrived through the columnar wire edge
+    (journal replay, unregistered-row re-decode); the hot path decodes
+    the same bytes columnar-ly via
+    :func:`sitewhere_tpu.ingest.columnar.decode_json_lines`.
+    """
+
+    def __call__(self, payload: bytes) -> List[DecodedRequest]:
+        return [
+            _decode_one(*envelope_fields(doc))
+            for doc in parse_envelopes(payload)
+        ]
+
+
 # Compact binary framing:  magic "SW" | u8 kind | u8 token_len | token |
 # f64 ts | kind-specific payload.  The schema-compiled-protobuf analog.
 _BIN_MAGIC = b"SW"
