@@ -18,6 +18,9 @@ from sitewhere_tpu.ingest.decoders import (  # noqa: F401
     BinaryDecoder,
     CompositeDecoder,
     DecodeError,
+    JsonLinesDecoder,
 )
 from sitewhere_tpu.ingest.dedup import AlternateIdDeduplicator  # noqa: F401
+from sitewhere_tpu.ingest.coap import CoapServerReceiver  # noqa: F401
+from sitewhere_tpu.ingest.columnar import decode_json_lines  # noqa: F401
 from sitewhere_tpu.ingest.batcher import Batcher, BatchPlan  # noqa: F401

@@ -221,13 +221,17 @@ def _decode_mixed(tokens, kinds, reqs, ts_s, ts_ns, event_type,
             mtypes.append(None)
             alert_types.append(None)
         elif kind == RequestKind.ALERT:
-            at = r.get("type", r.get("alertType"))
-            if not at:
-                raise DecodeError("alert needs type")
-            alert_types.append(str(at))
+            # same semantics as the scalar decoder: missing type defaults
+            # to "alert", an unknown string level is a decode error —
+            # replay of a journaled payload must never diverge from what
+            # the hot path accepted
+            alert_types.append(str(r.get("type", r.get("alertType", "alert"))))
             level = r.get("level", "info")
             if isinstance(level, str):
-                level = _LEVEL_ALIASES.get(level.lower(), AlertLevel.INFO)
+                lv = _LEVEL_ALIASES.get(level.lower())
+                if lv is None:
+                    raise DecodeError(f"bad alert level {level!r}")
+                level = lv
             alert_levels[i] = int(level)
             mtypes.append(None)
             if "latitude" in r and "longitude" in r:

@@ -265,8 +265,15 @@ def parse_envelopes(payload: bytes) -> List[dict]:
         if text.startswith(b"["):
             docs = json.loads(text)
         elif b"\n" in text:
-            # one synthesized array parse instead of N json.loads calls
-            docs = json.loads(b"[" + b",".join(text.split(b"\n")) + b"]")
+            # one synthesized array parse instead of N json.loads calls;
+            # blank interior lines are legal NDJSON and are skipped
+            lines = [ln for ln in text.split(b"\n") if ln.strip()]
+            try:
+                docs = json.loads(b"[" + b",".join(lines) + b"]")
+            except ValueError:
+                # not NDJSON after all — a pretty-printed single envelope
+                # (journaled by the scalar path) also contains newlines
+                docs = [json.loads(text)]
         else:
             docs = [json.loads(text)]
     except (ValueError, UnicodeDecodeError) as e:

@@ -12,13 +12,17 @@ runtime), and receivers are threads owning sockets:
 
 - :class:`TcpReceiver` — raw TCP with pluggable framing (reference:
   ``socket/SocketInboundEventReceiver.java`` + interaction handlers).
-- :class:`UdpReceiver` — datagram-per-event (the CoAP receiver's transport;
-  full CoAP option parsing is handled by the ``coap`` frontend).
+- :class:`UdpReceiver` — one datagram = one raw payload.
 - :class:`HttpReceiver` — HTTP POST endpoint (reference REST receivers).
 - :class:`MqttReceiver` — broker subscription via the stdlib MQTT client
   (reference ``mqtt/MqttInboundEventReceiver.java``).
 - :class:`PollingRestReceiver` — periodic HTTP GET poll (reference
   ``rest/PollingRestInboundEventReceiver.java``).
+- :class:`WebSocketReceiver` — client pulling payloads from a remote WS
+  endpoint with auto-reconnect (reference
+  ``websocket/WebSocketEventReceiver.java``).
+- :class:`sitewhere_tpu.ingest.coap.CoapServerReceiver` — RFC 7252 CoAP
+  server (reference ``coap/CoapServerEventReceiver.java``).
 
 AMQP brokers (ActiveMQ/RabbitMQ/EventHub in the reference) are gated: no
 client libraries exist in this image; their role (durable broker buffering)
@@ -179,6 +183,87 @@ def newline_frames(conn: socket.socket, emit: Callable[[bytes], None]) -> None:
             line, buf = buf.split(b"\n", 1)
             if line.strip():
                 emit(line.strip())
+
+
+class WebSocketReceiver(Receiver):
+    """Client pulling payloads from a remote WebSocket endpoint.
+
+    Reference: ``websocket/WebSocketEventReceiver.java`` — a
+    ``javax.websocket`` client session against a configured URL with
+    optional headers; every received message's bytes feed the source's
+    decoder.  Reconnects with capped exponential backoff when the remote
+    closes or the connect fails (the reference restarts its session via
+    the lifecycle).
+    """
+
+    def __init__(self, host: str, port: int, path: str = "/",
+                 headers: Optional[dict] = None,
+                 reconnect_delay_s: float = 0.5,
+                 max_reconnect_delay_s: float = 30.0):
+        super().__init__(name=f"ws-receiver:{host}:{port}{path}")
+        self.host, self.port, self.path = host, port, path
+        self.headers = dict(headers or {})
+        self.reconnect_delay_s = reconnect_delay_s
+        self.max_reconnect_delay_s = max_reconnect_delay_s
+        self._alive = False
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._client = None
+        self.connects = 0
+
+    def start(self) -> None:
+        self._alive = True
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=self.name
+        )
+        self._thread.start()
+        super().start()
+
+    def stop(self) -> None:
+        self._alive = False
+        self._stop_evt.set()
+        client = self._client
+        if client is not None:
+            try:
+                client.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        super().stop()
+
+    def _loop(self) -> None:
+        from sitewhere_tpu.web.ws import ClientWebSocket
+
+        delay = self.reconnect_delay_s
+        while self._alive:
+            try:
+                self._client = ClientWebSocket(
+                    self.host, self.port, self.path, headers=self.headers
+                )
+                self.connects += 1
+                delay = self.reconnect_delay_s  # reset backoff on success
+                while self._alive:
+                    msg = self._client.recv()
+                    if msg is None:
+                        break  # remote closed — reconnect
+                    _, payload = msg
+                    if payload:
+                        self._emit(payload)
+            except (OSError, ConnectionError) as e:
+                logger.debug("ws receiver %s: %s", self.name, e)
+            finally:
+                client, self._client = self._client, None
+                if client is not None:
+                    try:
+                        client.close()
+                    except OSError:
+                        pass
+            if self._alive:
+                self._stop_evt.wait(delay)  # interruptible backoff
+                delay = min(delay * 2, self.max_reconnect_delay_s)
 
 
 class TcpReceiver(Receiver):

@@ -4,8 +4,8 @@ Two consumers:
 - the admin topology feed (reference: ``web/ws/components/
   TopologyBroadcaster.java`` pushes live microservice/tenant-engine state
   over STOMP WebSocket to the admin UI);
-- the WebSocket ingest receiver (reference: event-sources WebSocket
-  receiver) in :mod:`sitewhere_tpu.ingest.sources`.
+- :class:`ClientWebSocket` backs the ingest
+  :class:`~sitewhere_tpu.ingest.sources.WebSocketReceiver`.
 
 Implements the server handshake (Sec-WebSocket-Accept), frame
 encode/decode with client masking, text/binary/ping/pong/close opcodes,
@@ -196,15 +196,38 @@ class ServerWebSocket:
                 pass
 
 
+class _BufferedSock:
+    """Socket adapter replaying bytes over-read during the handshake —
+    a server pushing its first frame in the same TCP segment as the 101
+    response must not lose it."""
+
+    def __init__(self, sock: socket.socket, initial: bytes = b""):
+        self._sock = sock
+        self._buf = initial
+
+    def recv(self, n: int) -> bytes:
+        if self._buf:
+            out, self._buf = self._buf[:n], self._buf[n:]
+            return out
+        return self._sock.recv(n)
+
+    def sendall(self, data: bytes) -> None:
+        self._sock.sendall(data)
+
+    def close(self) -> None:
+        self._sock.close()
+
+
 class ClientWebSocket:
-    """Tiny client for tests + the polling/bridge paths."""
+    """Tiny client for the ingest WebSocket receiver, tests, and the
+    polling/bridge paths."""
 
     def __init__(self, host: str, port: int, path: str = "/",
                  timeout: float = 10.0, headers=None):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
+        raw = socket.create_connection((host, port), timeout=timeout)
         key = base64.b64encode(b"sitewhere-tpu-cli").decode()
         extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
-        self.sock.sendall(
+        raw.sendall(
             f"GET {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
             f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
             f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n"
@@ -213,16 +236,22 @@ class ClientWebSocket:
         )
         head = b""
         while b"\r\n\r\n" not in head:
-            chunk = self.sock.recv(4096)
+            chunk = raw.recv(4096)
             if not chunk:
                 raise ConnectionError("handshake failed")
             head += chunk
+        head, _, remainder = head.partition(b"\r\n\r\n")
         status = head.split(b"\r\n", 1)[0]
         if b"101" not in status:
             raise ConnectionError(f"handshake rejected: {status!r}")
         expect = accept_key(key).encode()
         if expect not in head:
             raise ConnectionError("bad Sec-WebSocket-Accept")
+        # `timeout` bounds connect+handshake only; a long-lived feed may
+        # legitimately sit idle, so recv must block until data or close()
+        # (which unblocks it with an OSError).
+        raw.settimeout(None)
+        self.sock = _BufferedSock(raw, remainder)
 
     def send_text(self, text: str) -> None:
         self.sock.sendall(encode_frame(OP_TEXT, text.encode(), mask=True))

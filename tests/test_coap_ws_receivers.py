@@ -1,0 +1,325 @@
+"""CoAP (RFC 7252) + WebSocket ingest receivers — round-2 verdict item #5.
+
+Reference: ``sources/coap/CoapServerEventReceiver.java`` (Californium CoAP
+server feeding the source decoder) and
+``sources/websocket/WebSocketEventReceiver.java`` (WS client session
+pulling payloads from a remote endpoint).
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from sitewhere_tpu.ingest import coap
+from sitewhere_tpu.ingest.sources import InboundEventSource, WebSocketReceiver
+from sitewhere_tpu.ingest.decoders import JsonDecoder
+
+
+# --------------------------------------------------------------------------
+# CoAP codec
+# --------------------------------------------------------------------------
+
+def test_codec_roundtrip_with_options_and_token():
+    msg = coap.CoapMessage(
+        mtype=coap.CON, code=coap.POST, message_id=0x1234,
+        token=b"\x01\x02",
+        options=[(coap.OPT_URI_PATH, b"events"),
+                 (coap.OPT_URI_PATH, b"json"),
+                 (coap.OPT_CONTENT_FORMAT, b"\x32")],
+        payload=b'{"x":1}',
+    )
+    parsed = coap.parse_message(coap.encode_message(msg))
+    assert parsed.mtype == coap.CON
+    assert parsed.code == coap.POST
+    assert parsed.message_id == 0x1234
+    assert parsed.token == b"\x01\x02"
+    assert parsed.uri_path == "/events/json"
+    assert parsed.option(coap.OPT_CONTENT_FORMAT) == b"\x32"
+    assert parsed.payload == b'{"x":1}'
+
+
+def test_codec_extended_option_deltas():
+    # option number 275 needs the 14 (two-byte) extended delta form
+    msg = coap.CoapMessage(
+        mtype=coap.NON, code=coap.POST, message_id=7,
+        options=[(275, b"v" * 300)],  # extended length too
+        payload=b"p",
+    )
+    parsed = coap.parse_message(coap.encode_message(msg))
+    assert parsed.options == [(275, b"v" * 300)]
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(coap.CoapError):
+        coap.parse_message(b"\x00\x00")
+    with pytest.raises(coap.CoapError):
+        coap.parse_message(b"\xff\xff\xff\xff")  # version 3
+    # payload marker with no payload
+    with pytest.raises(coap.CoapError):
+        coap.parse_message(bytes([0x40, 0x02, 0, 1, 0xFF]))
+
+
+# --------------------------------------------------------------------------
+# CoAP server receiver
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def coap_server():
+    got = []
+    recv = coap.CoapServerReceiver()
+    recv.sink = got.append
+    recv.start()
+    yield recv, got
+    recv.stop()
+
+
+def _udp_exchange(port, datagram, expect_reply=True, timeout=3.0):
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(timeout)
+    try:
+        s.sendto(datagram, ("127.0.0.1", port))
+        if not expect_reply:
+            return None
+        data, _ = s.recvfrom(65536)
+        return coap.parse_message(data)
+    finally:
+        s.close()
+
+
+def test_con_post_acked_and_payload_emitted(coap_server):
+    recv, got = coap_server
+    req = coap.encode_post("/events", b'{"v":1}', message_id=42,
+                           token=b"\xaa")
+    reply = _udp_exchange(recv.port, req)
+    assert reply.mtype == coap.ACK
+    assert reply.code == coap.CHANGED_204
+    assert reply.message_id == 42
+    assert reply.token == b"\xaa"
+    assert got == [b'{"v":1}']
+
+
+def test_non_post_emits_without_reply(coap_server):
+    recv, got = coap_server
+    req = coap.encode_post("/events", b'{"v":2}', message_id=43,
+                           confirmable=False)
+    _udp_exchange(recv.port, req, expect_reply=False)
+    deadline = time.monotonic() + 3
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert got == [b'{"v":2}']
+
+
+def test_get_gets_405(coap_server):
+    recv, got = coap_server
+    msg = coap.CoapMessage(mtype=coap.CON, code=coap.GET, message_id=44)
+    reply = _udp_exchange(recv.port, coap.encode_message(msg))
+    assert reply.code == coap.NOT_ALLOWED_405
+    assert got == []
+
+
+def test_malformed_gets_rst(coap_server):
+    recv, got = coap_server
+    # valid header, reserved nibble 15 in an option byte
+    bad = bytes([0x40, 0x02, 0x00, 0x45, 0xF3, 0x00])
+    reply = _udp_exchange(recv.port, bad)
+    assert reply.mtype == coap.RST
+    assert reply.message_id == 0x45
+    assert got == []
+
+
+def test_empty_post_bad_request(coap_server):
+    recv, got = coap_server
+    msg = coap.CoapMessage(mtype=coap.CON, code=coap.POST, message_id=46)
+    reply = _udp_exchange(recv.port, coap.encode_message(msg))
+    assert reply.code == coap.BAD_REQUEST_400
+    assert got == []
+
+
+def test_coap_source_end_to_end_pipeline(tmp_path):
+    """CoAP POST → source decode → dispatcher → event store."""
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+
+    cfg = Config({
+        "instance": {"id": "coap-e2e", "data_dir": str(tmp_path / "d")},
+        "pipeline": {"width": 32, "registry_capacity": 64,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+    }, apply_env=False)
+    inst = Instance(cfg)
+    recv = coap.CoapServerReceiver()
+    inst.add_source(InboundEventSource(
+        "coap-src", receivers=[recv], decoder=JsonDecoder()))
+    inst.start()
+    try:
+        dm = inst.device_management
+        dm.create_device_type(token="sensor", name="S")
+        dm.create_device(token="c-1", device_type="sensor")
+        dm.create_device_assignment(device="c-1")
+        payload = json.dumps({
+            "deviceToken": "c-1", "type": "Measurement",
+            "request": {"name": "t", "value": 3.5,
+                        "eventDate": 1_753_800_000},
+        }).encode()
+        reply = _udp_exchange(
+            recv.port, coap.encode_post("/events", payload, message_id=1))
+        assert reply.code == coap.CHANGED_204
+        deadline = time.monotonic() + 5
+        while inst.event_store.total_events < 1 \
+                and time.monotonic() < deadline:
+            inst.dispatcher.flush()
+            time.sleep(0.05)
+        assert inst.event_store.total_events == 1
+    finally:
+        inst.stop()
+        inst.terminate()
+
+
+# --------------------------------------------------------------------------
+# WebSocket receiver
+# --------------------------------------------------------------------------
+
+class _TinyWsServer:
+    """Accepts WS clients and pushes given payloads, then closes."""
+
+    def __init__(self, payloads, close_after=True):
+        self.payloads = payloads
+        self.close_after = close_after
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.sessions = 0
+        self._alive = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        from sitewhere_tpu.web.ws import ServerWebSocket
+
+        while self._alive:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            head = b""
+            while b"\r\n\r\n" not in head:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                head += chunk
+            ws = ServerWebSocket.handshake_raw(conn, head)
+            if ws is None:
+                conn.close()
+                continue
+            self.sessions += 1
+            for p in self.payloads:
+                ws.send_binary(p)
+            if self.close_after:
+                ws.close()
+
+    def stop(self):
+        self._alive = False
+        self.sock.close()
+
+
+def test_ws_receiver_pulls_payloads_and_reconnects():
+    payloads = [b'{"a":1}', b'{"a":2}']
+    server = _TinyWsServer(payloads)
+    got = []
+    recv = WebSocketReceiver("127.0.0.1", server.port,
+                             reconnect_delay_s=0.05)
+    recv.sink = got.append
+    recv.start()
+    try:
+        deadline = time.monotonic() + 5
+        # server closes after each session; the receiver reconnects and
+        # pulls the payloads again — expect at least two sessions' worth
+        while (len(got) < 4 or server.sessions < 2) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server.sessions >= 2
+        assert got[:2] == payloads
+        assert recv.connects >= 2
+    finally:
+        recv.stop()
+        server.stop()
+
+
+def test_ws_receiver_source_end_to_end(tmp_path):
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+
+    payload = json.dumps({
+        "deviceToken": "w-1", "type": "Measurement",
+        "request": {"name": "t", "value": 9.0, "eventDate": 1_753_800_100},
+    }).encode()
+    server = _TinyWsServer([payload], close_after=False)
+
+    cfg = Config({
+        "instance": {"id": "ws-e2e", "data_dir": str(tmp_path / "d")},
+        "pipeline": {"width": 32, "registry_capacity": 64,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+    }, apply_env=False)
+    inst = Instance(cfg)
+    inst.add_source(InboundEventSource(
+        "ws-src",
+        receivers=[WebSocketReceiver("127.0.0.1", server.port,
+                                     reconnect_delay_s=0.05)],
+        decoder=JsonDecoder()))
+    # register the device BEFORE sources start: the server pushes on connect
+    dm = inst.device_management
+    dm.create_device_type(token="sensor", name="S")
+    dm.create_device(token="w-1", device_type="sensor")
+    dm.create_device_assignment(device="w-1")
+    inst.start()
+    try:
+        deadline = time.monotonic() + 5
+        while inst.event_store.total_events < 1 \
+                and time.monotonic() < deadline:
+            inst.dispatcher.flush()
+            time.sleep(0.05)
+        assert inst.event_store.total_events >= 1
+    finally:
+        inst.stop()
+        inst.terminate()
+        server.stop()
+
+
+def test_con_retransmission_dedup(coap_server):
+    """RFC 7252 §4.5: a retried CON (lost ACK) must get the same ACK back
+    without re-emitting the payload."""
+    recv, got = coap_server
+    req = coap.encode_post("/events", b'{"v":9}', message_id=77)
+    # a real retransmission comes from the SAME endpoint (host, port)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(3.0)
+    try:
+        s.sendto(req, ("127.0.0.1", recv.port))
+        r1 = coap.parse_message(s.recvfrom(65536)[0])
+        s.sendto(req, ("127.0.0.1", recv.port))  # retransmission
+        r2 = coap.parse_message(s.recvfrom(65536)[0])
+    finally:
+        s.close()
+    assert r1.code == r2.code == coap.CHANGED_204
+    assert r1.message_id == r2.message_id == 77
+    assert got == [b'{"v":9}']  # emitted exactly once
+    assert recv.duplicates == 1
+
+
+def test_parse_envelopes_pretty_printed_and_blank_lines():
+    from sitewhere_tpu.ingest.decoders import parse_envelopes
+
+    pretty = json.dumps({"deviceToken": "d", "type": "Measurement",
+                         "request": {"name": "t", "value": 1}},
+                        indent=2).encode()
+    assert len(parse_envelopes(pretty)) == 1
+    nd = (b'{"deviceToken":"a","type":"Measurement","request":{"name":"t","value":1}}'
+          b"\n\n"
+          b'{"deviceToken":"b","type":"Measurement","request":{"name":"t","value":2}}')
+    assert len(parse_envelopes(nd)) == 2
