@@ -45,7 +45,11 @@ from sitewhere_tpu.services.event_store import EventStore
 from sitewhere_tpu.services.registration import RegistrationManager
 from sitewhere_tpu.services.schedules import ScheduleManager
 from sitewhere_tpu.services.streams import DeviceStreamManagement, DeviceStreamManager
-from sitewhere_tpu.services.tenants import TenantManagement
+from sitewhere_tpu.services.tenants import (
+    MultitenantEngineManager,
+    TenantEngine,
+    TenantManagement,
+)
 from sitewhere_tpu.state.manager import DeviceStateManager
 from sitewhere_tpu.state.presence import PresenceManager
 
@@ -162,6 +166,15 @@ class Instance(LifecycleComponent):
             "CommandInvocation": self._run_scheduled_invocation,
             "BatchCommandInvocation": self._run_scheduled_batch,
         }))
+        # per-tenant engine lifecycle over the SHARED tensors (reference:
+        # MultitenantMicroservice.java:242-260,358-380 — engine per tenant,
+        # independent restart); engines share the instance identity map so
+        # their dense tenant ids match the pipeline's tenant column
+        self.engines = self.add_child(MultitenantEngineManager(
+            self.tenants,
+            engine_factory=self._make_tenant_engine,
+            tenant_ids=self.identity,
+        ))
         self.outbound = self.add_child(OutboundConnectorsManager())
         self.registration = self.add_child(RegistrationManager(
             self.device_management,
@@ -212,6 +225,7 @@ class Instance(LifecycleComponent):
         # then re-derives anything journaled after the committed offset.
         from sitewhere_tpu.runtime.checkpoint import Checkpointer
 
+        self._engine_snapshots: Dict[str, dict] = {}
         self.checkpointer = self.add_child(Checkpointer(
             self,
             interval_s=float(self.config.get("checkpoint.interval_s", 30.0)),
@@ -222,6 +236,40 @@ class Instance(LifecycleComponent):
 
     def _tenant_dense_id(self, token: str) -> int:
         return self.identity.tenant.mint(token)
+
+    def _make_tenant_engine(self, tenant, tenant_id: int,
+                            config: Dict[str, object]) -> TenantEngine:
+        """Engine factory: per-tenant service façades over the instance's
+        shared identity map + registry mirror, with per-tenant config
+        overlays from ``tenants.<token>`` in the instance config."""
+        overlay = dict(config)
+        per_tenant = self.config.get(f"tenants.{tenant.token}", None)
+        if isinstance(per_tenant, dict):
+            overlay.update(per_tenant)
+        if tenant.token == "default":
+            # the instance-level services ARE the default tenant's engine
+            return TenantEngine(
+                tenant, tenant_id, overlay,
+                identity=self.identity, mirror=self.mirror,
+                device_management=self.device_management,
+                asset_management=self.assets,
+            )
+        engine = TenantEngine(
+            tenant, tenant_id, overlay,
+            identity=self.identity, mirror=self.mirror,
+        )
+        # checkpoint resume: hydrate the engine's host dicts (its rows in
+        # the shared tensors were restored with the mirror snapshot).
+        # `.get`, not `.pop` — the snapshot must survive for a later
+        # rebuild-restart or a failed-then-retried engine start.
+        snap = getattr(self, "_engine_snapshots", {}).get(tenant.token)
+        if snap:
+            from sitewhere_tpu.runtime.checkpoint import merge_store
+
+            merge_store(engine.device_management,
+                        snap.get("device_management", {}))
+            merge_store(engine.asset_management, snap.get("assets", {}))
+        return engine
 
     def _tenant_ids_of_devices(self, device_ids):
         import numpy as np

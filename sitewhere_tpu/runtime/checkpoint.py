@@ -74,6 +74,30 @@ _MIRROR_ARRAYS = (
 )
 
 
+def _copy_val(v):
+    """Shallow-copy mutable store containers so pickling after the lock
+    is released can't race live mutations (entities are frozen-ish
+    dataclasses; the containers are what mutate)."""
+    if isinstance(v, dict):
+        return dict(v)
+    if isinstance(v, list):
+        return list(v)
+    return v
+
+
+def merge_store(obj, values: Dict[str, object]) -> None:
+    """Restore snapshotted attributes into a live store IN PLACE where
+    possible (dict containers are cleared+updated so components holding
+    references keep seeing the store)."""
+    for k, v in values.items():
+        current = getattr(obj, k)
+        if isinstance(current, dict) and isinstance(v, dict):
+            current.clear()
+            current.update(v)
+        else:
+            setattr(obj, k, v)
+
+
 def _atomic_write(path: str, write_fn) -> None:
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
@@ -120,13 +144,33 @@ class Checkpointer(LifecycleComponent):
             gen = self.generation + 1
             names: Dict[str, str] = {}
 
-            # 1. management stores (host dicts, each under its own lock)
-            stores: Dict[str, Dict[str, object]] = {}
-            for attr, keys in _STORE_ATTRS.items():
-                obj = getattr(inst, attr)
+            # 1. management stores — containers are COPIED under each
+            # store's lock so the pickle below (lock released) can't race
+            # a concurrent mutation
+            def snap_store(obj, keys) -> Dict[str, object]:
                 lock = getattr(obj, "_lock", None)
                 with lock if lock is not None else contextlib.nullcontext():
-                    stores[attr] = {k: getattr(obj, k) for k in keys}
+                    return {k: _copy_val(getattr(obj, k)) for k in keys}
+
+            stores: Dict[str, Dict[str, object]] = {
+                attr: snap_store(getattr(inst, attr), keys)
+                for attr, keys in _STORE_ATTRS.items()
+            }
+            # non-default tenant engines' service façades (the default
+            # tenant's ARE the instance-level stores above)
+            engines = getattr(inst, "engines", None)
+            if engines is not None:
+                stores["__engines__"] = {
+                    eng.tenant.token: {
+                        "device_management": snap_store(
+                            eng.device_management,
+                            _STORE_ATTRS["device_management"]),
+                        "assets": snap_store(
+                            eng.asset_management, _STORE_ATTRS["assets"]),
+                    }
+                    for eng in engines.list_engines()
+                    if eng.tenant.token != "default"
+                }
             names["stores"] = f"stores-{gen:08d}.pkl"
             _atomic_write(
                 os.path.join(self.dir, names["stores"]),
@@ -214,15 +258,11 @@ class Checkpointer(LifecycleComponent):
         # management stores
         with open(os.path.join(self.dir, names["stores"]), "rb") as f:
             stores = pickle.load(f)
+        # non-default engine stores hydrate lazily when the engine manager
+        # (re)creates each engine (Instance._make_tenant_engine)
+        inst._engine_snapshots = stores.pop("__engines__", {})
         for attr, values in stores.items():
-            obj = getattr(inst, attr)
-            for k, v in values.items():
-                current = getattr(obj, k)
-                if isinstance(current, dict) and isinstance(v, dict):
-                    current.clear()
-                    current.update(v)
-                else:
-                    setattr(obj, k, v)
+            merge_store(getattr(inst, attr), values)
         # restored rules must rebuild their device table
         if hasattr(inst.rules, "_dirty"):
             inst.rules._dirty = True

@@ -216,21 +216,33 @@ class TenantEngine(LifecycleComponent):
     when they are :class:`LifecycleComponent`.
     """
 
-    def __init__(self, tenant: Tenant, tenant_id: int, config: Dict[str, object]):
+    def __init__(self, tenant: Tenant, tenant_id: int, config: Dict[str, object],
+                 identity: Optional[IdentityMap] = None,
+                 mirror: Optional[RegistryMirror] = None,
+                 device_management: Optional[DeviceManagement] = None,
+                 asset_management: Optional[AssetManagement] = None):
+        """Standalone by default; pass ``identity``/``mirror`` to run the
+        engine over the INSTANCE's shared tensors (the TPU-first layout:
+        one registry with a tenant column, per-tenant service façades —
+        :class:`DeviceManagement` was built for this: global device
+        tokens, tenant-scoped other namespaces, cross-tenant creation
+        lock)."""
         super().__init__(name=f"tenant-engine:{tenant.token}")
         self.tenant = tenant
         self.tenant_id = tenant_id  # dense id — the device-side tenant column value
         self.config = dict(ENGINE_DEFAULTS)
         self.config.update(config)
         cap = int(self.config["registry_capacity"])
-        self.identity = IdentityMap(capacity=cap)
-        self.mirror = RegistryMirror(
+        self.identity = identity or IdentityMap(capacity=cap)
+        self.mirror = mirror or RegistryMirror(
             cap,
             max_zones=int(self.config["max_zones"]),
             max_verts=int(self.config["max_verts"]),
         )
-        self.device_management = DeviceManagement(tenant.token, self.identity, self.mirror)
-        self.asset_management = AssetManagement(tenant.token, self.identity)
+        self.device_management = device_management or DeviceManagement(
+            tenant.token, self.identity, self.mirror)
+        self.asset_management = asset_management or AssetManagement(
+            tenant.token, self.identity)
         self.extras: Dict[str, object] = {}
 
     def attach(self, name: str, component: object) -> object:
@@ -255,14 +267,16 @@ class MultitenantEngineManager(LifecycleComponent):
         self,
         tenants: TenantManagement,
         engine_factory: Optional[Callable[[Tenant, int, Dict[str, object]], TenantEngine]] = None,
+        tenant_ids: Optional[IdentityMap] = None,
     ):
         super().__init__(name="tenant-engine-manager")
         self.tenants = tenants
         self.engine_factory = engine_factory or TenantEngine
         self._engines: Dict[str, TenantEngine] = {}
         # Dense tenant ids are global (they key the device-side tenant
-        # column) and survive engine restarts.
-        self._tenant_ids = IdentityMap(capacity=1 << 16)
+        # column) and survive engine restarts.  The instance passes ITS
+        # identity map so engine tenant ids match the pipeline's column.
+        self._tenant_ids = tenant_ids or IdentityMap(capacity=1 << 16)
         self._lock = threading.RLock()
         tenants.add_listener(self._on_tenant_event)
 
@@ -298,8 +312,22 @@ class MultitenantEngineManager(LifecycleComponent):
         with self._lock:
             return list(self._engines.values())
 
-    def restart_engine(self, token: str) -> TenantEngine:
-        """Independent engine restart (reference: restartTenantEngine)."""
+    def restart_engine(self, token: str, rebuild: bool = False) -> TenantEngine:
+        """Independent engine restart (reference: restartTenantEngine,
+        ``MultitenantMicroservice.java:358-380``) — other tenants keep
+        flowing.
+
+        Default: stop→start the SAME engine (its host stores are the
+        system of record and must survive; the reference reloads from
+        Mongo, which we don't have per-engine).  ``rebuild=True`` tears
+        the engine down and builds a fresh one through the factory —
+        for engines whose factory rehydrates state externally."""
+        if not rebuild:
+            engine = self.get_engine(token)
+            if engine.state == LifecycleState.STARTED:
+                engine.stop()
+            engine.start()
+            return engine
         old = self.get_engine(token)
         if old.state == LifecycleState.STARTED:
             old.stop()

@@ -87,6 +87,21 @@ def register_routes(gw: RestGateway, inst) -> None:
     r("DELETE", "/api/tenants/{token}",
       lambda q: inst.tenants.delete_tenant(q.params["token"]))
 
+    # ---- tenant engines (MultitenantMicroservice.java:242-260,358-380) ----
+    def engine_state(q):
+        e = inst.engines.get_engine(q.params["token"])
+        # state casing matches status_tree()/topology (enum .value)
+        return {"tenant": e.tenant.token, "tenant_id": e.tenant_id,
+                "state": e.state.value,
+                "components": e.status_tree()}
+    r("GET", "/api/tenants/{token}/engine", engine_state)
+
+    def engine_restart(q):
+        e = inst.engines.restart_engine(q.params["token"])
+        return {"tenant": e.tenant.token, "state": e.state.value,
+                "restarted": True}
+    r("POST", "/api/tenants/{token}/engine/restart", engine_restart)
+
     # ---- device types + commands + statuses -------------------------------
     r("GET", "/api/devicetypes",
       lambda q: page_response(dm.list_device_types(q.criteria())))

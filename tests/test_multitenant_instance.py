@@ -1,0 +1,196 @@
+"""MultitenantEngineManager wired into Instance — round-2 verdict item #6.
+
+Reference: ``MultitenantMicroservice.java:242-260`` (engine per tenant)
+and ``:358-380`` (independent restart).  Engines here are per-tenant
+service façades over the instance's SHARED identity map + registry mirror
+(tenant column on every row), so engine lifecycle is independent of the
+pipeline.
+"""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.instance import Instance
+from sitewhere_tpu.runtime.config import Config
+from sitewhere_tpu.runtime.lifecycle import LifecycleState
+
+
+def _cfg(tmp_path, **over):
+    doc = {
+        "instance": {"id": "mt-test", "data_dir": str(tmp_path / "data")},
+        "pipeline": {"width": 64, "registry_capacity": 256,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+        "checkpoint": {"interval_s": 0},
+    }
+    doc.update(over)
+    return Config(doc, apply_env=False)
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    i = Instance(_cfg(tmp_path))
+    i.start()
+    try:
+        yield i
+    finally:
+        i.stop()
+        i.terminate()
+
+
+def _setup_tenant(inst, token, n_devices=3):
+    inst.tenants.create_tenant(token=token, name=token.title(),
+                               auth_token=f"{token}-auth-token-123")
+    eng = inst.engines.get_engine(token)
+    dm = eng.device_management
+    dm.create_device_type(token="sensor", name="Sensor")
+    for i in range(n_devices):
+        dm.create_device(token=f"{token}-d{i}", device_type="sensor")
+        dm.create_device_assignment(device=f"{token}-d{i}")
+    return eng
+
+
+def _ingest_for(inst, token, n=10, ts=1_753_800_000):
+    eng = inst.engines.get_engine(token)
+    handles = np.asarray(inst.identity.device.lookup_many(
+        [f"{token}-d{i % 3}" for i in range(n)]), np.int32)
+    inst.dispatcher.ingest_arrays(
+        device_id=handles,
+        tenant_id=np.full(n, eng.tenant_id, np.int32),
+        event_type=np.zeros(n, np.int32),
+        ts_s=np.full(n, ts, np.int32),
+        mtype_id=np.zeros(n, np.int32),
+        value=np.full(n, 1.0, np.float32),
+    )
+    inst.dispatcher.flush()
+
+
+def test_default_engine_is_instance_services(inst):
+    eng = inst.engines.get_engine("default")
+    assert eng.device_management is inst.device_management
+    assert eng.asset_management is inst.assets
+    assert eng.identity is inst.identity
+
+
+def test_engine_created_on_tenant_create_with_shared_tensors(inst):
+    eng = _setup_tenant(inst, "acme")
+    assert eng.state == LifecycleState.STARTED
+    assert eng.identity is inst.identity
+    assert eng.mirror is inst.mirror
+    # dense tenant id matches the pipeline's resolver
+    assert eng.tenant_id == inst.identity.tenant.lookup("acme")
+    # the tenant's device rows live in the SHARED registry with its stamp
+    reg = inst.mirror.publish_registry()
+    h = inst.identity.device.lookup("acme-d0")
+    assert int(np.asarray(reg.tenant_id)[h]) == eng.tenant_id
+
+
+def test_tenant_namespaces_isolated(inst):
+    a = _setup_tenant(inst, "acme")
+    g = _setup_tenant(inst, "globex")
+    # same device-type token per tenant — scoped namespaces keep them apart
+    assert a.device_management.get_device_type("sensor") is not \
+        g.device_management.get_device_type("sensor")
+    # device tokens are global: acme cannot reuse globex's
+    from sitewhere_tpu.services.common import DuplicateToken
+    with pytest.raises(DuplicateToken):
+        a.device_management.create_device(token="globex-d0",
+                                          device_type="sensor")
+
+
+def test_restart_tenant_a_while_tenant_b_flows(inst):
+    """The verdict's done-criterion: restart A's engine; B's events keep
+    flowing through the pipeline the whole time."""
+    _setup_tenant(inst, "acme")
+    _setup_tenant(inst, "globex")
+    _ingest_for(inst, "acme", 10)
+    _ingest_for(inst, "globex", 10)
+    base = inst.dispatcher.metrics_snapshot()["accepted"]
+    assert base == 20
+
+    eng = inst.engines.restart_engine("acme")
+    assert eng.state == LifecycleState.STARTED
+    # restart preserved acme's model (host dicts are the system of record)
+    assert eng.device_management.get_device("acme-d0") is not None
+
+    # globex traffic flowed during/after the restart
+    _ingest_for(inst, "globex", 10, ts=1_753_800_100)
+    snap = inst.dispatcher.metrics_snapshot()
+    assert snap["accepted"] == base + 10
+    # and acme still works post-restart too
+    _ingest_for(inst, "acme", 10, ts=1_753_800_200)
+    assert inst.dispatcher.metrics_snapshot()["accepted"] == base + 20
+
+
+def test_tenant_mismatch_rejected_by_pipeline(inst):
+    """An event stamped with tenant B for tenant A's device is rejected
+    (the tenant column is enforced on device, not by host bookkeeping)."""
+    a = _setup_tenant(inst, "acme")
+    g = _setup_tenant(inst, "globex")
+    h = np.asarray([inst.identity.device.lookup("acme-d0")], np.int32)
+    inst.dispatcher.ingest_arrays(
+        device_id=h,
+        tenant_id=np.full(1, g.tenant_id, np.int32),  # wrong tenant
+        event_type=np.zeros(1, np.int32),
+        ts_s=np.full(1, 1_753_800_000, np.int32),
+        mtype_id=np.zeros(1, np.int32),
+        value=np.ones(1, np.float32),
+    )
+    inst.dispatcher.flush()
+    snap = inst.dispatcher.metrics_snapshot()
+    assert snap["accepted"] == 0
+    assert snap["processed"] == 1
+
+
+def test_engine_stores_survive_checkpoint_restart(tmp_path):
+    a = Instance(_cfg(tmp_path))
+    a.start()
+    _setup_tenant(a, "acme")
+    a.stop()  # final checkpoint
+    a.terminate()
+
+    b = Instance(_cfg(tmp_path))
+    assert b.restored
+    b.start()
+    try:
+        eng = b.engines.get_engine("acme")
+        assert eng.device_management.get_device("acme-d0") is not None
+        assert eng.device_management.get_active_assignment("acme-d0") \
+            is not None
+        # tenant id stable across restart (keys the restored tensor rows)
+        assert eng.tenant_id == b.identity.tenant.lookup("acme")
+    finally:
+        b.stop()
+        b.terminate()
+
+
+def test_engine_rest_endpoints(inst):
+    import http.client
+    import json as _json
+
+    from sitewhere_tpu.web import WebServer
+
+    _setup_tenant(inst, "acme")
+    web = WebServer(inst, port=0)
+    web.start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", web.port, timeout=5)
+        c.request("POST", "/api/jwt", _json.dumps(
+            {"username": "admin", "password": "password"}),
+            {"Content-Type": "application/json"})
+        r = c.getresponse()
+        tok = _json.loads(r.read())["token"]
+        hdr = {"Authorization": f"Bearer {tok}"}
+
+        c.request("GET", "/api/tenants/acme/engine", headers=hdr)
+        r = c.getresponse()
+        doc = _json.loads(r.read())
+        assert r.status == 200 and doc["state"] == "started"
+
+        c.request("POST", "/api/tenants/acme/engine/restart", b"",
+                  headers=hdr)
+        r = c.getresponse()
+        doc = _json.loads(r.read())
+        assert r.status == 200 and doc["restarted"]
+    finally:
+        web.stop()
