@@ -125,11 +125,15 @@ class Instance(LifecycleComponent):
         self.device_management = DeviceManagement(
             "default", self.identity, self.mirror
         )
-        self.rules = RuleManager(self.identity)
+        ewma_halflives = tuple(self.config.get(
+            "pipeline.ewma_halflives_s", (60.0, 600.0, 3600.0)))
+        self.rules = RuleManager(self.identity,
+                                 ewma_halflives_s=ewma_halflives)
         self.device_state = self.add_child(DeviceStateManager(
             cap, self.identity,
             num_mtype_slots=int(self.config["pipeline.mtype_slots"]),
             tenant_id_of_device=self._tenant_ids_of_devices,
+            num_ewma_scales=len(ewma_halflives),
         ))
 
         # durable stores

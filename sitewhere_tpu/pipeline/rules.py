@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sitewhere_tpu.ids import NULL_ID, IdentityMap
-from sitewhere_tpu.schema import AlertLevel, ComparisonOp, RuleTable
+from sitewhere_tpu.schema import AlertLevel, ComparisonOp, RuleKind, RuleTable
 from sitewhere_tpu.services.common import (
     DuplicateToken,
     EntityNotFound,
@@ -47,15 +47,22 @@ class ThresholdRule:
     alert_type: str               # alert code to fire
     alert_level: AlertLevel = AlertLevel.WARNING
     tenant: Optional[str] = None  # None = all tenants
+    # what quantity to compare (instantaneous / trailing EWMA / rate)
+    kind: RuleKind = RuleKind.INSTANT
+    # requested averaging window for WINDOW_MEAN — snapped to the nearest
+    # shared EWMA time-scale (window_idx) at publish
+    window_s: Optional[float] = None
     created_s: int = dataclasses.field(default_factory=now_s)
 
 
 class RuleManager:
     """Threshold-rule catalog publishing :class:`RuleTable` epochs."""
 
-    def __init__(self, identity: IdentityMap, capacity: int = 256):
+    def __init__(self, identity: IdentityMap, capacity: int = 256,
+                 ewma_halflives_s: tuple = (60.0, 600.0, 3600.0)):
         self.identity = identity
         self.capacity = capacity
+        self.ewma_halflives_s = tuple(float(t) for t in ewma_halflives_s)
         self._lock = threading.RLock()
         self._rules: Dict[str, ThresholdRule] = {}
         self._slots: Dict[str, int] = {}
@@ -75,8 +82,14 @@ class RuleManager:
         alert_level: AlertLevel = AlertLevel.WARNING,
         tenant: Optional[str] = None,
         token: Optional[str] = None,
+        kind: RuleKind = RuleKind.INSTANT,
+        window_s: Optional[float] = None,
     ) -> ThresholdRule:
         require(bool(alert_type), ValidationError("alert_type required"))
+        kind = RuleKind(kind)
+        if kind == RuleKind.WINDOW_MEAN:
+            require(window_s is not None and window_s > 0,
+                    ValidationError("WINDOW_MEAN rule needs window_s > 0"))
         with self._lock:
             token = token or mint_token("rule")
             require(token not in self._rules, DuplicateToken(f"rule {token!r}"))
@@ -89,6 +102,8 @@ class RuleManager:
                 alert_type=alert_type,
                 alert_level=AlertLevel(alert_level),
                 tenant=tenant,
+                kind=kind,
+                window_s=float(window_s) if window_s is not None else None,
             )
             self._rules[token] = rule
             self._slots[token] = self._free.pop()
@@ -136,6 +151,13 @@ class RuleManager:
             threshold = np.zeros(self.capacity, np.float32)
             alert_code = np.full(self.capacity, NULL_ID, np.int32)
             alert_level = np.zeros(self.capacity, np.int32)
+            kind = np.zeros(self.capacity, np.int32)
+            window_idx = np.zeros(self.capacity, np.int32)
+            halflives = np.asarray(self.ewma_halflives_s, np.float32)
+            # operator-facing half-lives → e-folding taus (alpha uses
+            # exp(-dt/tau); after one half-life the old average must
+            # retain exactly 50%)
+            taus = halflives / np.log(2.0)
             for token, rule in self._rules.items():
                 slot = self._slots[token]
                 active[slot] = True
@@ -147,6 +169,11 @@ class RuleManager:
                 threshold[slot] = rule.threshold
                 alert_code[slot] = self.identity.alert_type.mint(rule.alert_type)
                 alert_level[slot] = int(rule.alert_level)
+                kind[slot] = int(rule.kind)
+                if rule.window_s is not None:
+                    # snap to the nearest shared half-life
+                    window_idx[slot] = int(np.argmin(
+                        np.abs(halflives - float(rule.window_s))))
             self._table = RuleTable(
                 active=jnp.asarray(active),
                 tenant_id=jnp.asarray(tenant_id),
@@ -155,6 +182,9 @@ class RuleManager:
                 threshold=jnp.asarray(threshold),
                 alert_code=jnp.asarray(alert_code),
                 alert_level=jnp.asarray(alert_level),
+                kind=jnp.asarray(kind),
+                window_idx=jnp.asarray(window_idx),
+                ewma_tau_s=jnp.asarray(taus),
             )
             self._dirty = False
             self._epoch += 1

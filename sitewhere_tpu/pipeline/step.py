@@ -22,7 +22,7 @@ come out as a same-width :class:`EventBatch` ready for re-injection.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,11 +32,13 @@ from sitewhere_tpu.ids import NULL_ID
 from sitewhere_tpu.ops.geo_pallas import points_in_polygons_auto
 from sitewhere_tpu.ops.scatter import bincount_fixed, scatter_last_by_time
 from sitewhere_tpu.schema import (
+    DEFAULT_EWMA_TAUS,
     AssignmentStatus,
     DeviceState,
     EventBatch,
     EventType,
     Registry,
+    RuleKind,
     RuleTable,
     ZoneCondition,
     ZoneTable,
@@ -122,19 +124,79 @@ def validate_and_enrich(
     return accepted, unregistered, unassigned, enrich
 
 
-def eval_threshold_rules(
-    rules: RuleTable, batch: EventBatch, accepted: jax.Array
-) -> Tuple[jax.Array, jax.Array]:
-    """Dense [B, R] threshold evaluation over measurement events.
+def fold_ewma(
+    state: DeviceState, batch: EventBatch, taus: jax.Array
+) -> jax.Array:
+    """Per-row candidate EWMAs after folding this row's sample.
 
-    Returns ``(fired_any, first_rule_id)`` per event.
+    Irregular-sampling EWMA: ``alpha = 1 - exp(-dt / tau)`` with ``dt``
+    the gap since the device's previous sample in that measurement slot;
+    the first sample seeds the average (no zero bias).  Returns
+    ``float32[B, K]`` — rows are CANDIDATES; the time-ordered scatter in
+    :func:`update_device_state` picks each slot's winner.
+    """
+    cap = state.capacity
+    M = state.num_mtype_slots
+    ids_safe = jnp.clip(batch.device_id, 0, cap - 1)
+    slot = jnp.where(batch.mtype_id >= 0, batch.mtype_id % M, 0)
+    prev_ts = state.last_value_ts_s[ids_safe, slot]
+    seeded = prev_ts > 0
+    dt = jnp.maximum(batch.ts_s - prev_ts, 0).astype(jnp.float32)
+    ewma_prev = state.ewma_values[ids_safe, slot]  # [B, K]
+    alpha = 1.0 - jnp.exp(-dt[:, None] / jnp.maximum(taus[None, :], 1e-9))
+    v = batch.value[:, None]
+    return jnp.where(seeded[:, None], ewma_prev + alpha * (v - ewma_prev), v)
+
+
+def eval_threshold_rules(
+    rules: RuleTable, state: DeviceState, batch: EventBatch,
+    accepted: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dense [B, R] rule evaluation over measurement events.
+
+    Each rule compares the quantity its ``kind`` selects — the current
+    sample, a trailing EWMA (per-rule time scale), or the rate of change
+    since the device's previous sample — against its threshold, in ONE
+    fused pass (reference SPI is per-event callbacks,
+    ``spi/IRuleProcessor.java:50-97``; windowed logic there would be
+    host-side processor state).
+
+    Returns ``(fired_any, first_rule_id, ewma_candidates)`` — the
+    candidates feed :func:`update_device_state` so the trailing stats
+    are folded exactly once.
     """
     is_meas = accepted & (batch.event_type == EventType.MEASUREMENT)
-    v = batch.value[:, None]  # [B, 1]
+    cap = state.capacity
+    M = state.num_mtype_slots
+    ids_safe = jnp.clip(batch.device_id, 0, cap - 1)
+    slot = jnp.where(batch.mtype_id >= 0, batch.mtype_id % M, 0)
+    v = batch.value
+
+    prev_ts = state.last_value_ts_s[ids_safe, slot]
+    prev_v = state.last_values[ids_safe, slot]
+    seeded = prev_ts > 0
+    dt = jnp.maximum(batch.ts_s - prev_ts, 0).astype(jnp.float32)
+    rate_valid = seeded & (dt > 0)
+    rate = jnp.where(rate_valid, (v - prev_v) / jnp.maximum(dt, 1e-9), 0.0)
+
+    ewma_new = fold_ewma(state, batch, rules.ewma_tau_s)  # [B, K]
+    widx = jnp.clip(rules.window_idx, 0, rules.num_ewma_scales - 1)
+    e_sel = jnp.take(ewma_new, widx, axis=1)  # [B, R]
+
+    kind = rules.kind[None, :]
+    val = jnp.where(
+        kind == RuleKind.INSTANT, v[:, None],
+        jnp.where(kind == RuleKind.WINDOW_MEAN, e_sel, rate[:, None]),
+    )
+    # a rate rule needs a previous sample with a positive gap
+    kind_ok = jnp.where(kind == RuleKind.RATE_PER_S,
+                        rate_valid[:, None], True)
+
     thr = rules.threshold[None, :]  # [1, R]
     op = rules.op[None, :]
     cmp = jnp.stack(
-        [v > thr, v < thr, v >= thr, v <= thr, v == thr, v != thr], axis=0
+        [val > thr, val < thr, val >= thr, val <= thr, val == thr,
+         val != thr], axis=0
     )  # [6, B, R]
     hit = jnp.take_along_axis(cmp, op[None], axis=0)[0]  # [B, R]
 
@@ -144,10 +206,11 @@ def eval_threshold_rules(
     mtype_ok = (rules.mtype_id[None, :] == NULL_ID) | (
         rules.mtype_id[None, :] == batch.mtype_id[:, None]
     )
-    fired = hit & tenant_ok & mtype_ok & rules.active[None, :] & is_meas[:, None]
+    fired = (hit & kind_ok & tenant_ok & mtype_ok
+             & rules.active[None, :] & is_meas[:, None])
     fired_any = fired.any(axis=1)
     first = jnp.argmax(fired, axis=1).astype(jnp.int32)
-    return fired_any, jnp.where(fired_any, first, NULL_ID)
+    return fired_any, jnp.where(fired_any, first, NULL_ID), ewma_new
 
 
 def eval_zone_rules(
@@ -179,7 +242,8 @@ def eval_zone_rules(
 
 
 def update_device_state(
-    state: DeviceState, batch: EventBatch, accepted: jax.Array
+    state: DeviceState, batch: EventBatch, accepted: jax.Array,
+    ewma_candidates: Optional[jax.Array] = None,
 ) -> DeviceState:
     """Merge accepted events into last-known state (time-ordered scatters).
 
@@ -246,14 +310,27 @@ def update_device_state(
         batch.mtype_id >= 0
     )
     flat_ids = ids * M + batch.mtype_id % M
-    val_s, val_ns, (values,) = scatter_last_by_time(
+    # EWMA candidates fold each row's sample against PRE-batch state; the
+    # scatter's newest-wins pick applies them consistently with values.
+    # (Multiple same-slot events in one batch collapse to the newest —
+    # sub-deadline granularity, documented EWMA approximation.)  Callers
+    # outside pipeline_step (direct state updates in tests/tools) get the
+    # default time-scales; pass the RuleTable's taus to stay in sync with
+    # rule evaluation.
+    if ewma_candidates is None:
+        base = list(DEFAULT_EWMA_TAUS)
+        k = state.num_ewma_scales
+        taus = jnp.asarray((base + [base[-1]] * k)[:k], jnp.float32)
+        ewma_candidates = fold_ewma(state, batch, taus)
+    val_s, val_ns, (values, ewma) = scatter_last_by_time(
         state.last_value_ts_s.reshape(-1),
         state.last_value_ts_ns.reshape(-1),
-        (state.last_values.reshape(-1),),
+        (state.last_values.reshape(-1),
+         state.ewma_values.reshape(-1, state.num_ewma_scales)),
         flat_ids,
         batch.ts_s,
         batch.ts_ns,
-        (batch.value,),
+        (batch.value, ewma_candidates),
         is_meas,
     )
 
@@ -274,6 +351,7 @@ def update_device_state(
         last_value_ts_s=val_s.reshape(mshape),
         last_value_ts_ns=val_ns.reshape(mshape),
         last_values=values.reshape(state.last_values.shape),
+        ewma_values=ewma.reshape(state.ewma_values.shape),
     )
 
 
@@ -334,9 +412,10 @@ def pipeline_step(
     Pure function of its inputs — jit/pjit it once and feed batches forever.
     """
     accepted, unregistered, unassigned, enrich = validate_and_enrich(registry, batch)
-    rule_fired, rule_id = eval_threshold_rules(rules, batch, accepted)
+    rule_fired, rule_id, ewma_candidates = eval_threshold_rules(
+        rules, state, batch, accepted)
     zone_fired, zone_id = eval_zone_rules(zones, batch, accepted, enrich["area_id"])
-    new_state = update_device_state(state, batch, accepted)
+    new_state = update_device_state(state, batch, accepted, ewma_candidates)
     derived = _build_derived_alerts(batch, rules, zones, rule_id, zone_id)
 
     metrics = StepMetrics(

@@ -35,6 +35,7 @@ reported unregistered and replayed, never silently dropped.
 from __future__ import annotations
 
 import contextlib
+import copy
 import glob
 import json
 import logging
@@ -75,13 +76,12 @@ _MIRROR_ARRAYS = (
 
 
 def _copy_val(v):
-    """Shallow-copy mutable store containers so pickling after the lock
-    is released can't race live mutations (entities are frozen-ish
-    dataclasses; the containers are what mutate)."""
-    if isinstance(v, dict):
-        return dict(v)
-    if isinstance(v, list):
-        return list(v)
+    """Deep-copy store containers under the owning lock: entities are
+    mutated IN PLACE (``update_fields``) and carry mutable sub-containers
+    (metadata, authority lists), so the later pickle — running after the
+    lock is released — must walk a private copy, never live objects."""
+    if isinstance(v, (dict, list)):
+        return copy.deepcopy(v)
     return v
 
 
@@ -276,11 +276,27 @@ class Checkpointer(LifecycleComponent):
                 inst.mirror._dirty = True
                 inst.mirror._zones_dirty = True
 
-        # device state
+        # device state — tolerant of fields added since the snapshot was
+        # taken (e.g. ewma_values) AND of shape changes (e.g. a different
+        # EWMA scale count): mismatched fields keep their empty init
+        # rather than crashing every subsequent pipeline step
         with np.load(os.path.join(self.dir, names["state"])) as z:
-            state = DeviceState(
-                **{k: jnp.asarray(z[k]) for k in z.files}
-            )
+            current = inst.device_state.current
+            known = {
+                fld.name: getattr(current, fld.name).shape
+                for fld in dataclass_fields(current)
+            }
+            updates = {}
+            for k in z.files:
+                if k not in known:
+                    continue
+                if z[k].shape != known[k]:
+                    logger.warning(
+                        "checkpoint field %s shape %s != current %s; "
+                        "keeping empty init", k, z[k].shape, known[k])
+                    continue
+                updates[k] = jnp.asarray(z[k])
+            state = current.replace(**updates)
         inst.device_state.commit(state)
 
         logger.info(

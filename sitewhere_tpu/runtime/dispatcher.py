@@ -326,11 +326,25 @@ class PipelineDispatcher(LifecycleComponent):
             except Exception:
                 logger.exception("dispatch cycle failed")
 
-    def flush(self) -> None:
-        """Force pending rows through (tests/shutdown)."""
+    def flush(self, timeout_s: float = 10.0) -> None:
+        """Force pending rows through; on return every row ingested
+        BEFORE the call has completed egress (tests/shutdown contract).
+
+        A plan the loop thread has taken but not yet run is in neither
+        ``batcher.pending`` nor ``_inflight`` — only the plans-outstanding
+        gate sees it — so flush waits for gate quiescence (bounded:
+        concurrent sources can keep refilling under sustained traffic).
+        """
         for plan in self._take(self.batcher.flush):
             self._run_plan(plan)
         self._drain_inflight()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._plans_outstanding == 0 and self.batcher.pending == 0:
+                    break
+            self._drain_inflight()
+            time.sleep(0.001)
         self._maybe_commit_offset()
 
     def _maybe_commit_offset(self) -> None:

@@ -88,6 +88,29 @@ class ComparisonOp(enum.IntEnum):
     NEQ = 5
 
 
+# Default EWMA e-folding time constants (seconds) — single source for
+# RuleTable.empty, RuleManager, Instance config default, and the
+# update_device_state fallback.
+DEFAULT_EWMA_TAUS = (60.0, 600.0, 3600.0)
+
+
+class RuleKind(enum.IntEnum):
+    """What quantity a threshold rule compares.
+
+    The reference rule SPI is per-event callbacks
+    (``spi/IRuleProcessor.java:50-97``) — windowed logic there means
+    host-side state in each processor.  On TPU the trailing statistics
+    live in the :class:`DeviceState` tensors, so windowed and
+    rate-of-change rules evaluate in the same fused [B, R] pass as
+    instantaneous ones — this is where the tensor design *beats* the
+    reference's per-event callbacks rather than matching them.
+    """
+
+    INSTANT = 0       # current sample vs threshold
+    WINDOW_MEAN = 1   # irregular-sampling EWMA (per-rule time-scale slot)
+    RATE_PER_S = 2    # (v - prev_v) / dt vs threshold
+
+
 class ZoneCondition(enum.IntEnum):
     """Geofence firing condition.
 
@@ -254,6 +277,10 @@ class DeviceState:
     last_alert_ts_s: jax.Array   # int32[D]
     last_alert_ts_ns: jax.Array  # int32[D]
     presence_missing: jax.Array  # bool[D]
+    # Irregular-sampling EWMAs per (device, measurement slot, time-scale) —
+    # the trailing statistics windowed/rate rules evaluate against
+    # (RuleTable.ewma_tau_s holds the K time-scales).
+    ewma_values: jax.Array       # float32[D, M, K]
 
     @property
     def capacity(self) -> int:
@@ -263,8 +290,13 @@ class DeviceState:
     def num_mtype_slots(self) -> int:
         return self.last_values.shape[-1]
 
+    @property
+    def num_ewma_scales(self) -> int:
+        return self.ewma_values.shape[-1]
+
     @classmethod
-    def empty(cls, capacity: int, num_mtype_slots: int = 8) -> "DeviceState":
+    def empty(cls, capacity: int, num_mtype_slots: int = 8,
+              num_ewma_scales: int = 3) -> "DeviceState":
         return cls(
             last_event_ts_s=_i32((capacity,)),
             last_event_ts_ns=_i32((capacity,)),
@@ -281,6 +313,7 @@ class DeviceState:
             last_alert_ts_s=_i32((capacity,)),
             last_alert_ts_ns=_i32((capacity,)),
             presence_missing=_bool((capacity,)),
+            ewma_values=_f32((capacity, num_mtype_slots, num_ewma_scales)),
         )
 
 
@@ -303,13 +336,23 @@ class RuleTable:
     threshold: jax.Array    # float32[R]
     alert_code: jax.Array   # int32[R] — alert to fire
     alert_level: jax.Array  # int32[R]
+    kind: jax.Array         # int32[R] — RuleKind
+    window_idx: jax.Array   # int32[R] — EWMA time-scale slot (WINDOW_MEAN)
+    # Shared EWMA time-scales (seconds) — the K trailing statistics every
+    # device/measurement slot maintains; windowed rules pick the nearest.
+    ewma_tau_s: jax.Array   # float32[K]
 
     @property
     def capacity(self) -> int:
         return self.active.shape[-1]
 
+    @property
+    def num_ewma_scales(self) -> int:
+        return self.ewma_tau_s.shape[-1]
+
     @classmethod
-    def empty(cls, capacity: int) -> "RuleTable":
+    def empty(cls, capacity: int,
+              ewma_taus: tuple = DEFAULT_EWMA_TAUS) -> "RuleTable":
         return cls(
             active=_bool((capacity,)),
             tenant_id=_i32((capacity,), NULL_ID),
@@ -318,6 +361,9 @@ class RuleTable:
             threshold=_f32((capacity,)),
             alert_code=_i32((capacity,), NULL_ID),
             alert_level=_i32((capacity,)),
+            kind=_i32((capacity,)),
+            window_idx=_i32((capacity,)),
+            ewma_tau_s=jnp.asarray(ewma_taus, jnp.float32),
         )
 
 

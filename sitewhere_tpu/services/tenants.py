@@ -323,17 +323,26 @@ class MultitenantEngineManager(LifecycleComponent):
         the engine down and builds a fresh one through the factory —
         for engines whose factory rehydrates state externally."""
         if not rebuild:
-            engine = self.get_engine(token)
-            if engine.state == LifecycleState.STARTED:
-                engine.stop()
-            engine.start()
-            return engine
-        old = self.get_engine(token)
-        if old.state == LifecycleState.STARTED:
-            old.stop()
+            # Under the lock: a racing tenant.deleted must not re-start an
+            # engine that was just unregistered (it would leak, running,
+            # with nothing left to ever stop it).  Also the recovery
+            # lever for a tenant whose engine failed to start/bootstrap:
+            # no registered engine → retry _ensure_engine from scratch.
+            with self._lock:
+                engine = self._engines.get(token)
+                if engine is None:
+                    return self._ensure_engine(self.tenants.get_tenant(token))
+                if engine.state == LifecycleState.STARTED:
+                    engine.stop()
+                engine.start()
+                return engine
         with self._lock:
-            del self._engines[token]
-        return self._ensure_engine(self.tenants.get_tenant(token))
+            old = self._engines.get(token)
+            if old is not None:
+                if old.state == LifecycleState.STARTED:
+                    old.stop()
+                del self._engines[token]
+            return self._ensure_engine(self.tenants.get_tenant(token))
 
     def _ensure_engine(self, tenant: Tenant) -> TenantEngine:
         # The whole ensure runs under the lock so a concurrent get_engine

@@ -44,11 +44,13 @@ class DeviceStateManager(LifecycleComponent):
         identity: IdentityMap,
         num_mtype_slots: int = 8,
         tenant_id_of_device=None,  # Callable[[np.ndarray], np.ndarray]
+        num_ewma_scales: int = 3,
     ):
         super().__init__(name="device-state-manager")
         self.identity = identity
         self._lock = threading.RLock()
-        self._state = DeviceState.empty(capacity, num_mtype_slots)
+        self._state = DeviceState.empty(capacity, num_mtype_slots,
+                                        num_ewma_scales)
         self._tenant_id_of_device = tenant_id_of_device
 
     # -- epoch plumbing ----------------------------------------------------
