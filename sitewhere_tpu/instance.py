@@ -125,8 +125,10 @@ class Instance(LifecycleComponent):
         self.device_management = DeviceManagement(
             "default", self.identity, self.mirror
         )
+        from sitewhere_tpu.schema import DEFAULT_EWMA_HALFLIVES_S
+
         ewma_halflives = tuple(self.config.get(
-            "pipeline.ewma_halflives_s", (60.0, 600.0, 3600.0)))
+            "pipeline.ewma_halflives_s", DEFAULT_EWMA_HALFLIVES_S))
         self.rules = RuleManager(self.identity,
                                  ewma_halflives_s=ewma_halflives)
         self.device_state = self.add_child(DeviceStateManager(
@@ -152,6 +154,17 @@ class Instance(LifecycleComponent):
             segment_bytes=int(self.config["journal.segment_bytes"]),
         )
         self.dead_letters = Journal(self.data_dir, name="dead-letters")
+
+        # span tracing (reference: Jaeger probabilistic 1% sampling,
+        # MicroserviceConfiguration.java:53-57)
+        from sitewhere_tpu.runtime.tracing import Tracer
+
+        self.tracer = Tracer(
+            sample_rate=float(self.config.get("tracing.sample_rate", 0.01)))
+        # runtime-uploadable scripts (ScriptSynchronizer analog)
+        from sitewhere_tpu.runtime.scripting import ScriptManager
+
+        self.scripts = ScriptManager(self.data_dir)
 
         # domain services the dispatcher egresses into — registered as
         # children BEFORE it so the reverse-order stop keeps them alive
@@ -214,6 +227,7 @@ class Instance(LifecycleComponent):
             mesh=self.mesh,
             journal_reader=JournalReader(self.ingest_journal, "pipeline"),
             recovery_decoder=recovery_decoder,
+            tracer=self.tracer,
         ))
         self.presence = self.add_child(PresenceManager(
             self.device_state,
@@ -461,4 +475,5 @@ class Instance(LifecycleComponent):
             "pipeline": self.dispatcher.metrics_snapshot(),
             "devices": len(self.identity.device),
             "events_stored": self.event_store.total_events,
+            "tracing": self.tracer.stats(),
         }

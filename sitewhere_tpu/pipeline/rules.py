@@ -59,7 +59,11 @@ class RuleManager:
     """Threshold-rule catalog publishing :class:`RuleTable` epochs."""
 
     def __init__(self, identity: IdentityMap, capacity: int = 256,
-                 ewma_halflives_s: tuple = (60.0, 600.0, 3600.0)):
+                 ewma_halflives_s: tuple = None):
+        from sitewhere_tpu.schema import DEFAULT_EWMA_HALFLIVES_S
+
+        if ewma_halflives_s is None:
+            ewma_halflives_s = DEFAULT_EWMA_HALFLIVES_S
         self.identity = identity
         self.capacity = capacity
         self.ewma_halflives_s = tuple(float(t) for t in ewma_halflives_s)

@@ -140,8 +140,12 @@ def fold_ewma(
     ids_safe = jnp.clip(batch.device_id, 0, cap - 1)
     slot = jnp.where(batch.mtype_id >= 0, batch.mtype_id % M, 0)
     prev_ts = state.last_value_ts_s[ids_safe, slot]
+    prev_ns = state.last_value_ts_ns[ids_safe, slot]
     seeded = prev_ts > 0
-    dt = jnp.maximum(batch.ts_s - prev_ts, 0).astype(jnp.float32)
+    # sub-second resolution: fast sensors sample at > 1 Hz
+    dt = jnp.maximum(
+        (batch.ts_s - prev_ts).astype(jnp.float32)
+        + (batch.ts_ns - prev_ns).astype(jnp.float32) * 1e-9, 0.0)
     ewma_prev = state.ewma_values[ids_safe, slot]  # [B, K]
     alpha = 1.0 - jnp.exp(-dt[:, None] / jnp.maximum(taus[None, :], 1e-9))
     v = batch.value[:, None]
@@ -173,9 +177,13 @@ def eval_threshold_rules(
     v = batch.value
 
     prev_ts = state.last_value_ts_s[ids_safe, slot]
+    prev_ns = state.last_value_ts_ns[ids_safe, slot]
     prev_v = state.last_values[ids_safe, slot]
     seeded = prev_ts > 0
-    dt = jnp.maximum(batch.ts_s - prev_ts, 0).astype(jnp.float32)
+    # sub-second resolution (rate rules must fire for > 1 Hz sensors)
+    dt = jnp.maximum(
+        (batch.ts_s - prev_ts).astype(jnp.float32)
+        + (batch.ts_ns - prev_ns).astype(jnp.float32) * 1e-9, 0.0)
     rate_valid = seeded & (dt > 0)
     rate = jnp.where(rate_valid, (v - prev_v) / jnp.maximum(dt, 1e-9), 0.0)
 

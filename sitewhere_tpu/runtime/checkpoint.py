@@ -287,6 +287,7 @@ class Checkpointer(LifecycleComponent):
                 for fld in dataclass_fields(current)
             }
             updates = {}
+            skipped = set()
             for k in z.files:
                 if k not in known:
                     continue
@@ -294,8 +295,17 @@ class Checkpointer(LifecycleComponent):
                     logger.warning(
                         "checkpoint field %s shape %s != current %s; "
                         "keeping empty init", k, z[k].shape, known[k])
+                    skipped.add(k)
                     continue
                 updates[k] = jnp.asarray(z[k])
+            if "ewma_values" in skipped or "ewma_values" not in z.files:
+                # fold_ewma seeds on last_value_ts_s > 0 — restoring the
+                # timestamps without the EWMAs would treat zeroed averages
+                # as seeded and drag windowed rules toward 0; drop the
+                # measurement stats together so seeding re-occurs
+                for k in ("last_value_ts_s", "last_value_ts_ns",
+                          "last_values"):
+                    updates.pop(k, None)
             state = current.replace(**updates)
         inst.device_state.commit(state)
 

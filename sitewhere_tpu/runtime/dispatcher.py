@@ -84,6 +84,7 @@ class PipelineDispatcher(LifecycleComponent):
         mesh=None,
         journal_reader: Optional[JournalReader] = None,
         recovery_decoder: Optional[Callable[[bytes], List[DecodedRequest]]] = None,
+        tracer=None,
         name: str = "pipeline-dispatcher",
     ):
         super().__init__(name)
@@ -146,6 +147,12 @@ class PipelineDispatcher(LifecycleComponent):
         # Per-plan end-to-end latency samples (oldest-row wait in the
         # batcher + emit→egress-complete), the <10ms p99 target's metric.
         self.latencies_s: collections.deque = collections.deque(maxlen=4096)
+        # Span tracing (reference: Jaeger 1% sampling) — no-op when unset.
+        if tracer is None:
+            from sitewhere_tpu.runtime.tracing import Tracer
+
+            tracer = Tracer(sample_rate=0.0)  # disabled unless configured
+        self.tracer = tracer
         # host-aggregated counters (metrics endpoint surface)
         self.steps = 0
         self.totals: Dict[str, int] = {
@@ -343,6 +350,10 @@ class PipelineDispatcher(LifecycleComponent):
             with self._lock:
                 if self._plans_outstanding == 0 and self.batcher.pending == 0:
                     break
+            # re-take: rows ingested since the first take must not rely on
+            # the loop thread (stop() joins it BEFORE this flush)
+            for plan in self._take(self.batcher.flush):
+                self._run_plan(plan)
             self._drain_inflight()
             time.sleep(0.001)
         self._maybe_commit_offset()
@@ -448,6 +459,10 @@ class PipelineDispatcher(LifecycleComponent):
         return placed
 
     def _run_plan(self, plan: BatchPlan, replay_depth: int = 0) -> None:
+        trace = self.tracer.trace("pipeline.plan")
+        # the batcher wait of the oldest row = the "batch assemble" stage
+        trace.record("batch.assemble", plan.max_wait_s,
+                     rows=plan.n_events, fill=round(plan.fill, 3))
         with self._step_lock:
             batch = plan.batch
             state = self.state_manager.current
@@ -474,13 +489,16 @@ class PipelineDispatcher(LifecycleComponent):
                 registry = self.registry_provider()
                 rules = self.rules_provider()
                 zones = self.zones_provider()
-            new_state, out = self._step(registry, state, rules, zones, batch)
-            self.state_manager.commit(new_state, batch=batch,
-                                      accepted=out.accepted)
+            with trace.span("step.dispatch").tag("rows", plan.n_events):
+                new_state, out = self._step(registry, state, rules, zones,
+                                            batch)
+                self.state_manager.commit(new_state, batch=batch,
+                                          accepted=out.accepted)
             self.steps += 1
             # Double-buffer: leave this step in flight (dispatch is async)
             # and egress the PREVIOUS step while the device computes.
-            prev, self._inflight = self._inflight, (plan, out, replay_depth)
+            prev, self._inflight = (
+                self._inflight, (plan, out, replay_depth, trace))
             if prev is not None:
                 self._egress(*prev)
 
@@ -490,20 +508,27 @@ class PipelineDispatcher(LifecycleComponent):
             # new step and leaves it in flight — loop until settled
             # (bounded by max_replay_depth).
             while self._inflight is not None:
-                plan, out, depth = self._inflight
+                plan, out, depth, trace = self._inflight
                 self._inflight = None
-                self._egress(plan, out, depth)
+                self._egress(plan, out, depth, trace)
 
-    def _egress(self, plan: BatchPlan, out, replay_depth: int) -> None:
+    def _egress(self, plan: BatchPlan, out, replay_depth: int,
+                trace=None) -> None:
         """Host fan-out of one step's outputs.
 
         The input batch never leaves the host (``plan.host_cols``); only
         step outputs are fetched, and the rare-row masks (unregistered,
         derived alerts) only when their metric counters are nonzero.
         """
+        from sitewhere_tpu.runtime.tracing import _NOOP_TRACE
+
+        if trace is None:
+            trace = _NOOP_TRACE
         host_cols = plan.host_cols
-        m = as_numpy(out.metrics)
-        accepted = np.asarray(out.accepted)
+        with trace.span("egress.fetch-outputs"):
+            m = as_numpy(out.metrics)
+            accepted = np.asarray(out.accepted)
+            cols = self._columns(host_cols, out)
         for key in ("processed", "accepted", "unregistered", "unassigned",
                     "threshold_alerts", "zone_alerts"):
             self.totals[key] += int(getattr(m, key))
@@ -514,31 +539,35 @@ class PipelineDispatcher(LifecycleComponent):
             self._max_egressed_ref = max(
                 self._max_egressed_ref, int(refs[journaled].max()))
 
-        cols = self._columns(host_cols, out)
-
         # 1. persistence (event-management analog)
         if self.event_store is not None and accepted.any():
-            self.event_store.append_columns(cols, mask=accepted)
+            with trace.span("egress.persist").tag(
+                    "rows", int(getattr(m, "accepted"))):
+                self.event_store.append_columns(cols, mask=accepted)
 
         # 2. enriched fan-out (outbound connectors + rule processor hosts)
         if self.outbound is not None and accepted.any():
-            self.outbound.submit(cols, accepted)
+            with trace.span("egress.outbound"):
+                self.outbound.submit(cols, accepted)
 
         # 3. command invocations (command-delivery analog)
         cmd_mask = accepted & (cols["event_type"] == EventType.COMMAND_INVOCATION)
         if self.on_command_rows is not None and cmd_mask.any():
             self.totals["commands"] += int(cmd_mask.sum())
-            self.on_command_rows(cols, cmd_mask)
+            with trace.span("egress.commands"):
+                self.on_command_rows(cols, cmd_mask)
 
         # 4. auto-registration + replay (device-registration analog)
         if int(m.unregistered) > 0:
-            self._handle_unregistered(host_cols, out, replay_depth)
+            with trace.span("egress.registration"):
+                self._handle_unregistered(host_cols, out, replay_depth)
 
         # 5. derived alerts re-injection (rule outputs become first-class
         #    events, reference ZoneTestRuleProcessor fires alerts back
         #    through event management) — fetched only when rules fired
         if int(m.threshold_alerts) + int(m.zone_alerts) > 0:
-            self._reinject_derived(out, replay_depth)
+            with trace.span("egress.derived-alerts"):
+                self._reinject_derived(out, replay_depth)
 
         # Egress complete: record the plan's end-to-end latency (batcher
         # wait of its oldest row + emit→egress) and release it from the

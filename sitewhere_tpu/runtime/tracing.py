@@ -1,0 +1,174 @@
+"""Minimal span tracing with probabilistic sampling.
+
+Reference: OpenTracing + Jaeger with a 1% probabilistic sampler
+(``microservice/MicroserviceConfiguration.java:53-57``), spans around
+lifecycle ops and gRPC client/server interceptors
+(``grpc/client/common/tracing/ClientTracingInterceptor.java``).  The
+pipeline here is one process, so "distributed" tracing collapses to
+per-plan traces whose spans are the host stages wrapped around the one
+device program: batch assemble (batcher wait), step dispatch, and each
+egress leg.  Finished spans land in a bounded ring the REST surface
+exposes; the sampling decision is made ONCE per trace so a sampled trace
+is always complete.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+_ids = random.Random()
+_ids_lock = threading.Lock()
+
+
+def _new_id() -> str:
+    with _ids_lock:
+        return f"{_ids.getrandbits(64):016x}"
+
+
+class _NoopSpan:
+    """Unsampled: every operation is a no-op (hot-path cost ≈ one branch)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def tag(self, key: str, value) -> "_NoopSpan":
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    __slots__ = ("tracer", "trace_id", "span_id", "parent_id", "name",
+                 "start_s", "duration_s", "tags", "error", "_t0")
+
+    def __init__(self, tracer: "Tracer", trace_id: str, name: str,
+                 parent_id: Optional[str] = None):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.name = name
+        self.start_s = time.time()
+        self.duration_s: Optional[float] = None
+        self.tags: Dict[str, object] = {}
+        self.error: Optional[str] = None
+
+    def tag(self, key: str, value) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def __enter__(self) -> "Span":
+        self._t0 = time.perf_counter()  # type: ignore[attr-defined]
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.duration_s = time.perf_counter() - self._t0  # type: ignore
+        if exc is not None:
+            self.error = f"{exc_type.__name__}: {exc}"
+        self.tracer._finish(self)
+        return False
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_s": round(self.start_s, 6),
+            "duration_ms": (round(self.duration_s * 1e3, 3)
+                            if self.duration_s is not None else None),
+            "tags": self.tags,
+            "error": self.error,
+        }
+
+
+class Trace:
+    """A sampled trace handle: spawn child spans under one trace id."""
+
+    __slots__ = ("tracer", "trace_id", "root_id")
+
+    def __init__(self, tracer: "Tracer", trace_id: str,
+                 root_id: Optional[str]):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.root_id = root_id
+
+    def span(self, name: str, parent: Optional[Span] = None):
+        return Span(self.tracer, self.trace_id, name,
+                    parent_id=(parent.span_id if isinstance(parent, Span)
+                               else self.root_id))
+
+    def record(self, name: str, duration_s: float, **tags) -> None:
+        """Record an already-measured stage (e.g. batcher wait) as a span."""
+        span = Span(self.tracer, self.trace_id, name, parent_id=self.root_id)
+        span.start_s = time.time() - duration_s
+        span.duration_s = duration_s
+        span.tags.update(tags)
+        self.tracer._finish(span)
+
+
+class _NoopTrace:
+    __slots__ = ()
+
+    def span(self, name: str, parent=None):
+        return _NOOP
+
+    def record(self, name: str, duration_s: float, **tags) -> None:
+        pass
+
+
+_NOOP_TRACE = _NoopTrace()
+
+
+class Tracer:
+    """Probabilistic head-sampling tracer with a bounded finished-span ring."""
+
+    def __init__(self, sample_rate: float = 0.01, capacity: int = 2048):
+        self.sample_rate = float(sample_rate)
+        self._spans: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._rng = random.Random(0xC0FFEE)
+        self.started = 0
+        self.sampled = 0
+
+    def trace(self, name: str):
+        """Head-sampled trace root: returns a live or no-op trace handle.
+
+        The decision is per-trace (reference: Jaeger probabilistic 1%,
+        ``MicroserviceConfiguration.java:55``) so sampled traces carry
+        every stage span.
+        """
+        self.started += 1
+        if self._rng.random() >= self.sample_rate:
+            return _NOOP_TRACE
+        self.sampled += 1
+        return Trace(self, _new_id(), None)
+
+    def _finish(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def recent(self, limit: int = 100) -> List[dict]:
+        with self._lock:
+            spans = list(self._spans)[-limit:]
+        return [s.to_dict() for s in spans]
+
+    def stats(self) -> dict:
+        with self._lock:
+            buffered = len(self._spans)
+        return {
+            "sample_rate": self.sample_rate,
+            "traces_started": self.started,
+            "traces_sampled": self.sampled,
+            "spans_buffered": buffered,
+        }

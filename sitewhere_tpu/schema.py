@@ -88,10 +88,13 @@ class ComparisonOp(enum.IntEnum):
     NEQ = 5
 
 
-# Default EWMA e-folding time constants (seconds) — single source for
-# RuleTable.empty, RuleManager, Instance config default, and the
-# update_device_state fallback.
-DEFAULT_EWMA_TAUS = (60.0, 600.0, 3600.0)
+# Default EWMA half-lives (seconds) — single source for RuleTable.empty,
+# RuleManager, the Instance config default, and the update_device_state
+# fallback.  Everything device-side works in e-folding taus; convert ONCE
+# here so every default path agrees (tau = halflife / ln 2).
+DEFAULT_EWMA_HALFLIVES_S = (60.0, 600.0, 3600.0)
+_LN2 = 0.6931471805599453
+DEFAULT_EWMA_TAUS = tuple(h / _LN2 for h in DEFAULT_EWMA_HALFLIVES_S)
 
 
 class RuleKind(enum.IntEnum):

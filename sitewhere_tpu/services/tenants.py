@@ -278,7 +278,18 @@ class MultitenantEngineManager(LifecycleComponent):
         # identity map so engine tenant ids match the pipeline's column.
         self._tenant_ids = tenant_ids or IdentityMap(capacity=1 << 16)
         self._lock = threading.RLock()
+        # Per-token locks serialize restart vs delete for ONE tenant
+        # without holding the global lock across a (slow) stop/start —
+        # get_engine for other tenants must never block on a restart.
+        self._token_locks: Dict[str, threading.Lock] = {}
         tenants.add_listener(self._on_tenant_event)
+
+    def _token_lock(self, token: str) -> threading.Lock:
+        with self._lock:
+            lock = self._token_locks.get(token)
+            if lock is None:
+                lock = self._token_locks[token] = threading.Lock()
+            return lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -322,26 +333,26 @@ class MultitenantEngineManager(LifecycleComponent):
         Mongo, which we don't have per-engine).  ``rebuild=True`` tears
         the engine down and builds a fresh one through the factory —
         for engines whose factory rehydrates state externally."""
-        if not rebuild:
-            # Under the lock: a racing tenant.deleted must not re-start an
-            # engine that was just unregistered (it would leak, running,
-            # with nothing left to ever stop it).  Also the recovery
-            # lever for a tenant whose engine failed to start/bootstrap:
-            # no registered engine → retry _ensure_engine from scratch.
-            with self._lock:
-                engine = self._engines.get(token)
+        # The per-token lock serializes restart against tenant.deleted (a
+        # racing delete must not see its engine resurrected) WITHOUT
+        # holding the global lock across a slow stop/start — other
+        # tenants' get_engine/traffic keeps flowing during the restart.
+        with self._token_lock(token):
+            if not rebuild:
+                with self._lock:
+                    engine = self._engines.get(token)
                 if engine is None:
+                    # recovery lever for a tenant whose engine failed to
+                    # start/bootstrap: retry from scratch
                     return self._ensure_engine(self.tenants.get_tenant(token))
                 if engine.state == LifecycleState.STARTED:
                     engine.stop()
                 engine.start()
                 return engine
-        with self._lock:
-            old = self._engines.get(token)
-            if old is not None:
-                if old.state == LifecycleState.STARTED:
-                    old.stop()
-                del self._engines[token]
+            with self._lock:
+                old = self._engines.pop(token, None)
+            if old is not None and old.state == LifecycleState.STARTED:
+                old.stop()
             return self._ensure_engine(self.tenants.get_tenant(token))
 
     def _ensure_engine(self, tenant: Tenant) -> TenantEngine:
@@ -384,7 +395,9 @@ class MultitenantEngineManager(LifecycleComponent):
         if kind == "tenant.created":
             self._ensure_engine(tenant)
         elif kind == "tenant.deleted":
-            with self._lock:
-                engine = self._engines.pop(tenant.token, None)
-            if engine is not None and engine.state == LifecycleState.STARTED:
-                engine.stop()
+            with self._token_lock(tenant.token):
+                with self._lock:
+                    engine = self._engines.pop(tenant.token, None)
+                if engine is not None \
+                        and engine.state == LifecycleState.STARTED:
+                    engine.stop()

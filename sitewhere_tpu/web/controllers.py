@@ -102,6 +102,33 @@ def register_routes(gw: RestGateway, inst) -> None:
                 "restarted": True}
     r("POST", "/api/tenants/{token}/engine/restart", engine_restart)
 
+    # ---- tracing (Jaeger-sampling analog; spans over REST) ----------------
+    r("GET", "/api/traces",
+      lambda q: {"stats": inst.tracer.stats(),
+                 "spans": inst.tracer.recent(
+                     int(q.query.get("limit", ["100"])[0]))})
+
+    # ---- runtime scripts (ScriptSynchronizer analog) ----------------------
+    r("GET", "/api/scripts", lambda q: inst.scripts.list_scripts())
+    r("GET", "/api/scripts/{name}",
+      lambda q: {**inst.scripts.describe(q.params["name"]),
+                 "source": inst.scripts.get_source(q.params["name"])})
+
+    def upload_script(q):
+        body = q.json()
+        return inst.scripts.upload(
+            q.params["name"], str(body.get("kind", "decoder")),
+            str(body["source"]),
+            activate=bool(body.get("activate", True)))
+    # script upload is arbitrary code execution — admin only
+    r("PUT", "/api/scripts/{name}", upload_script, authority="ROLE_ADMIN")
+
+    def activate_script(q):
+        return inst.scripts.activate(
+            q.params["name"], int(q.json()["version"]))
+    r("POST", "/api/scripts/{name}/activate", activate_script,
+      authority="ROLE_ADMIN")
+
     # ---- device types + commands + statuses -------------------------------
     r("GET", "/api/devicetypes",
       lambda q: page_response(dm.list_device_types(q.criteria())))

@@ -100,24 +100,29 @@ class Router:
     """Pattern router: ``GET /api/devices/{token}`` → handler(req)."""
 
     def __init__(self):
-        self._routes: List[Tuple[str, re.Pattern, Handler, bool]] = []
+        self._routes: List[Tuple[str, re.Pattern, Handler, bool, Optional[str]]] = []
 
     def add(self, method: str, pattern: str, handler: Handler,
-            auth_required: bool = True) -> None:
+            auth_required: bool = True,
+            authority: Optional[str] = None) -> None:
+        """``authority`` additionally requires that granted authority in
+        the caller's JWT claims (403 otherwise) — e.g. script upload is
+        arbitrary code execution and demands ROLE_ADMIN."""
         regex = re.compile(
             "^" + _CAPTURE.sub(r"(?P<\1>[^/]+)", pattern) + "$"
         )
-        self._routes.append((method.upper(), regex, handler, auth_required))
+        self._routes.append(
+            (method.upper(), regex, handler, auth_required, authority))
 
     def route(self, method: str, path: str):
-        """Returns (handler, params, auth_required) or raises KeyError."""
+        """Returns (handler, params, auth_required, authority)."""
         path_exists = False
-        for m, regex, handler, auth in self._routes:
+        for m, regex, handler, auth, authority in self._routes:
             match = regex.match(path)
             if match:
                 path_exists = True
                 if m == method.upper():
-                    return handler, match.groupdict(), auth
+                    return handler, match.groupdict(), auth, authority
         if path_exists:
             raise MethodNotAllowed(method)
         raise KeyError(path)
@@ -226,7 +231,8 @@ class RestGateway:
             return
 
         try:
-            handler, params, auth_required = self.router.route(method, path)
+            handler, params, auth_required, authority = self.router.route(
+                method, path)
         except MethodNotAllowed:
             self._send(h, 405, {"error": f"method {method} not allowed"})
             return
@@ -248,6 +254,16 @@ class RestGateway:
         try:
             if auth_required:
                 req.claims = self._authenticate(req)
+                if authority is not None:
+                    from sitewhere_tpu.security.jwt import (
+                        GRANTED_AUTHORITIES_CLAIM,
+                    )
+                    from sitewhere_tpu.services.common import ForbiddenError
+
+                    granted = req.claims.get(GRANTED_AUTHORITIES_CLAIM, [])
+                    if authority not in granted:
+                        raise ForbiddenError(
+                            f"requires authority {authority}")
             result = handler(req)
         except ServiceError as e:
             self._send(h, e.http_status, {"error": str(e)})
