@@ -84,6 +84,9 @@ def decode_json_lines(
     malformed individual line raises too (the whole payload dead-letters,
     matching the reference's per-payload failed-decode contract).
     """
+    native = _native_decode(payload)
+    if native is not None:
+        return native, []
     try:
         return _decode_lines_inner(parse_envelopes(payload))
     except DecodeError:
@@ -93,6 +96,45 @@ def decode_json_lines(
         # must dead-letter like any other decode failure, never escape
         # into the receiver thread (scalar-path contract, decoders.py).
         raise DecodeError(f"bad wire batch: {e}") from e
+
+
+def _native_decode(payload: bytes) -> Optional[Dict[str, object]]:
+    """The C fast path for homogeneous NDJSON measurement payloads.
+
+    Strictness contract (swwire.c): ANY deviation from the common shape
+    returns None and the pure-Python decoder takes over — the native
+    tier only accelerates, it never changes behavior.
+    """
+    from sitewhere_tpu.native import load_swwire
+
+    mod = load_swwire()
+    if mod is None or not isinstance(payload, bytes) \
+            or payload[:1] == b"[":
+        return None
+    out = mod.decode_measurement_lines(payload)
+    if out is None:
+        return None
+    tokens, names, values_b, ts_b, us_b = out
+    n = len(tokens)
+    if n == 0:
+        return None  # preserve the Python path's empty-payload error
+    raw_ts = np.frombuffer(ts_b, np.float64)
+    raw_ts = np.where(raw_ts > 1e11, raw_ts / 1e3, raw_ts)  # epoch ms
+    ts_s = raw_ts.astype(np.int64)
+    ts_ns = np.round((raw_ts - ts_s) * 1e9).astype(np.int64)
+    zeros = np.zeros(n, np.float32)
+    return {
+        "device_token": tokens,
+        "event_type": np.zeros(n, np.int32),  # all MEASUREMENT
+        "ts_s": ts_s.astype(np.int32),
+        "ts_ns": ts_ns.astype(np.int32),
+        "mtype": names,
+        "value": np.frombuffer(values_b, np.float64).astype(np.float32),
+        "lat": zeros, "lon": zeros, "elevation": zeros,
+        "alert_type": [None] * n,
+        "alert_level": np.zeros(n, np.int32),
+        "update_state": np.frombuffer(us_b, np.uint8).astype(np.bool_),
+    }
 
 
 def _decode_lines_inner(
@@ -291,7 +333,15 @@ def resolve_columns(
     resolve_mtype,
     resolve_alert,
 ) -> Dict[str, np.ndarray]:
-    """Map token/name columns to dense handles → batcher-ready arrays."""
+    """Map token/name columns to dense handles → batcher-ready arrays.
+
+    Hot-path shape: device tokens resolve through the HandleSpace's bulk
+    lookup when available (one C-level listcomp instead of a Python
+    callable per token), and name columns memoize per payload (a fleet
+    payload typically carries a handful of measurement names).
+    """
+    from sitewhere_tpu.ids import HandleSpace
+
     tokens = columns["device_token"]
     n = len(tokens)
     out: Dict[str, np.ndarray] = {
@@ -299,12 +349,23 @@ def resolve_columns(
         for k in ("event_type", "ts_s", "ts_ns", "value", "lat", "lon",
                   "elevation", "alert_level", "update_state")
     }
-    out["device_id"] = np.fromiter(
-        (resolve_device(t) for t in tokens), np.int32, n)
-    out["mtype_id"] = np.fromiter(
-        (NULL_ID if m is None else resolve_mtype(m)
-         for m in columns["mtype"]), np.int32, n)
-    out["alert_code"] = np.fromiter(
-        (NULL_ID if a is None else resolve_alert(a)
-         for a in columns["alert_type"]), np.int32, n)
+    owner = getattr(resolve_device, "__self__", None)
+    if isinstance(owner, HandleSpace) \
+            and getattr(resolve_device, "__func__", None) \
+            is HandleSpace.lookup:
+        # only substitute the bulk form for lookup itself — a caller
+        # passing e.g. HandleSpace.mint must keep its semantics
+        out["device_id"] = np.asarray(owner.lookup_many(tokens), np.int32)
+    else:
+        out["device_id"] = np.fromiter(
+            (resolve_device(t) for t in tokens), np.int32, n)
+
+    def memoized(names, resolve) -> np.ndarray:
+        mapping = {
+            m: (NULL_ID if m is None else resolve(m)) for m in set(names)
+        }
+        return np.asarray([mapping[m] for m in names], np.int32)
+
+    out["mtype_id"] = memoized(columns["mtype"], resolve_mtype)
+    out["alert_code"] = memoized(columns["alert_type"], resolve_alert)
     return out

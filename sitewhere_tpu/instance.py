@@ -442,6 +442,15 @@ class Instance(LifecycleComponent):
 
     def start(self) -> None:
         self.bootstrap()
+        # Warm the native wire decoder OFF the data path: its first-use
+        # build (cc subprocess) must never stall a receiver thread's
+        # decode into the <10ms p99 budget.
+        import threading as _threading
+
+        from sitewhere_tpu.native import load_swwire
+
+        _threading.Thread(target=load_swwire, daemon=True,
+                          name="native-warmup").start()
         # Capture the journal end BEFORE sources start so crash recovery
         # never double-ingests a fresh append racing the replay.
         recover_upto = self.ingest_journal.end_offset

@@ -1,0 +1,95 @@
+"""Native runtime tier: lazily-built C accelerators with Python fallback.
+
+The reference's performance tier is JVM infrastructure (Netty, Kafka
+clients); here the compute tier is XLA/Pallas and the HOST tier gets C
+where CPython is the ceiling — first the NDJSON wire decoder
+(SURVEY.md §0: a "C++ host-side ingest shim … justified by capability").
+
+Build model: no pip, no wheels — the extension compiles ON FIRST USE
+with the toolchain baked into the image (cc + CPython headers via
+sysconfig), cached next to the source keyed by the source hash and
+Python ABI.  Any failure (no compiler, sandboxed fs, bad flags) just
+leaves the pure-Python path in charge; correctness never depends on the
+native tier being present.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+from typing import Optional
+
+logger = logging.getLogger("sitewhere_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "swwire.c")
+
+_swwire = None
+_tried = False
+_load_lock = __import__("threading").Lock()
+
+
+def _build_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.blake2b(f.read(), digest_size=8).hexdigest()
+    abi = sysconfig.get_config_var("SOABI") or "abi"
+    return os.path.join(_DIR, f"_swwire-{digest}-{abi}.so")
+
+
+def _compile(out: str) -> bool:
+    cc = os.environ.get("CC", "cc")
+    include = sysconfig.get_paths()["include"]
+    tmp = f"{out}.tmp.{os.getpid()}.so"
+    cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.info("native build unavailable (%s); using Python path", e)
+        return False
+    if proc.returncode != 0:
+        logger.warning("native build failed; using Python path:\n%s",
+                       proc.stderr[-1000:])
+        return False
+    os.replace(tmp, out)
+    return True
+
+
+def load_swwire():
+    """The _swwire module, building it on first use; None if unavailable.
+
+    Disable explicitly with SW_NATIVE=0 (e.g. for A/B benchmarks)."""
+    global _swwire, _tried
+    if _swwire is not None or _tried:
+        return _swwire
+    with _load_lock:
+        if _swwire is not None or _tried:
+            return _swwire
+        return _load_locked()
+
+
+def _load_locked():
+    global _swwire, _tried
+    _tried = True
+    if os.environ.get("SW_NATIVE", "1") == "0":
+        return None
+    try:
+        path = _build_path()
+        if not os.path.exists(path) and not _compile(path):
+            return None
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_swwire", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)  # type: ignore[union-attr]
+        _swwire = mod
+        logger.info("native wire decoder loaded (%s)",
+                    os.path.basename(path))
+    except Exception:
+        logger.exception("native wire decoder unavailable; Python path")
+        _swwire = None
+    return _swwire

@@ -1,0 +1,346 @@
+/* _swwire — native NDJSON wire decoder for the measurement fast path.
+ *
+ * The TPU framework's ingest ceiling is the host edge: CPython tops out
+ * around 0.4M envelope lines/s even with columnar sweeps (one C-level
+ * json.loads still materializes a dict per line).  This module scans the
+ * dominant wire shape directly into column buffers with zero per-line
+ * Python objects beyond the token/name strings:
+ *
+ *   {"deviceToken":"...","type":"Measurement",
+ *    "request":{"name":"...","value":N,"eventDate":N[,"updateState":B]}}
+ *
+ * one envelope per newline-delimited line, keys in any order, arbitrary
+ * inter-token whitespace.  STRICTNESS CONTRACT: anything outside this
+ * shape — escape sequences in strings, unknown keys, non-measurement
+ * types, nested extras — makes the function return None and the caller
+ * falls back to the pure-Python columnar decoder, so behavior NEVER
+ * diverges from the Python path; the native layer is purely an
+ * accelerator for the common case.
+ *
+ * Returns (tokens: list[str], names: list[str], values: bytes[f64],
+ *          ts: bytes[f64], update_state: bytes[u8]) or None.
+ *
+ * Reference justification: SURVEY.md §0 — "the native/performance tier
+ * of the new framework is the TPU kernels themselves plus any C++
+ * host-side ingest shim we choose to write — justified by capability
+ * (decode+route 1M events/sec/chip)".
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct {
+    const char *p;
+    const char *end;
+} cursor;
+
+static inline void skip_ws(cursor *c) {
+    while (c->p < c->end) {
+        char ch = *c->p;
+        if (ch == ' ' || ch == '\t' || ch == '\r') c->p++;
+        else break;
+    }
+}
+
+/* Parse a JSON string WITHOUT escapes; returns 0 on success and sets
+ * [start, len).  A backslash (or any control char) fails the parse. */
+static int parse_plain_string(cursor *c, const char **start, Py_ssize_t *len) {
+    if (c->p >= c->end || *c->p != '"') return -1;
+    c->p++;
+    *start = c->p;
+    while (c->p < c->end) {
+        unsigned char ch = (unsigned char)*c->p;
+        if (ch == '"') {
+            *len = c->p - *start;
+            c->p++;
+            return 0;
+        }
+        if (ch == '\\' || ch < 0x20) return -1; /* escapes → Python path */
+        c->p++;
+    }
+    return -1;
+}
+
+static int parse_number(cursor *c, double *out) {
+    /* Strict JSON number grammar FIRST (strtod alone would also accept
+     * hex, leading '+', '.5', inf/nan — payloads the Python path
+     * dead-letters; the native tier must never accept more). */
+    const char *q = c->p, *end = c->end;
+    if (q < end && *q == '-') q++;
+    if (q >= end || *q < '0' || *q > '9') return -1;
+    if (*q == '0') q++;
+    else while (q < end && *q >= '0' && *q <= '9') q++;
+    if (q < end && *q == '.') {
+        q++;
+        if (q >= end || *q < '0' || *q > '9') return -1;
+        while (q < end && *q >= '0' && *q <= '9') q++;
+    }
+    if (q < end && (*q == 'e' || *q == 'E')) {
+        q++;
+        if (q < end && (*q == '+' || *q == '-')) q++;
+        if (q >= end || *q < '0' || *q > '9') return -1;
+        while (q < end && *q >= '0' && *q <= '9') q++;
+    }
+    char *endp;
+    *out = strtod(c->p, &endp);
+    if (endp != q) return -1; /* also guards a comma-decimal locale */
+    c->p = q;
+    return 0;
+}
+
+static int expect(cursor *c, char ch) {
+    skip_ws(c);
+    if (c->p >= c->end || *c->p != ch) return -1;
+    c->p++;
+    return 0;
+}
+
+static int key_is(const char *k, Py_ssize_t klen, const char *lit) {
+    size_t n = strlen(lit);
+    return (Py_ssize_t)n == klen && memcmp(k, lit, n) == 0;
+}
+
+/* growable double buffer */
+typedef struct {
+    double *data;
+    Py_ssize_t len, cap;
+} dbuf;
+
+static int dbuf_push(dbuf *b, double v) {
+    if (b->len == b->cap) {
+        Py_ssize_t ncap = b->cap ? b->cap * 2 : 1024;
+        double *nd = (double *)realloc(b->data, (size_t)ncap * sizeof(double));
+        if (!nd) return -1;
+        b->data = nd;
+        b->cap = ncap;
+    }
+    b->data[b->len++] = v;
+    return 0;
+}
+
+typedef struct {
+    uint8_t *data;
+    Py_ssize_t len, cap;
+} bbuf;
+
+static int bbuf_push(bbuf *b, uint8_t v) {
+    if (b->len == b->cap) {
+        Py_ssize_t ncap = b->cap ? b->cap * 2 : 1024;
+        uint8_t *nd = (uint8_t *)realloc(b->data, (size_t)ncap);
+        if (!nd) return -1;
+        b->data = nd;
+        b->cap = ncap;
+    }
+    b->data[b->len++] = v;
+    return 0;
+}
+
+/* result codes for one line: 0 ok, 1 bail (shape mismatch), -1 error */
+static int parse_line(cursor *c,
+                      const char **token, Py_ssize_t *token_len,
+                      const char **name, Py_ssize_t *name_len,
+                      double *value, int *has_value,
+                      double *ts, uint8_t *update_state) {
+    /* Alias precedence must MATCH the Python decoder exactly
+     * (columnar.py / decoders.py): deviceToken over hardwareId,
+     * name over measurementId (falsy falls through), eventDate over
+     * timestamp (0 falls through) — independent of key order. */
+    const char *tok1 = NULL, *tok2 = NULL, *nm1 = NULL, *nm2 = NULL;
+    Py_ssize_t tok1_len = 0, tok2_len = 0, nm1_len = 0, nm2_len = 0;
+    int has_tok1 = 0, has_type = 0, has_request = 0;
+    double ed1 = 0.0, ed2 = 0.0;
+    *has_value = 0;
+    *update_state = 1;
+
+    if (expect(c, '{') != 0) return 1;
+    skip_ws(c);
+    if (c->p < c->end && *c->p == '}') { return 1; } /* empty envelope */
+    for (;;) {
+        const char *k; Py_ssize_t klen;
+        skip_ws(c);
+        if (parse_plain_string(c, &k, &klen) != 0) return 1;
+        if (expect(c, ':') != 0) return 1;
+        skip_ws(c);
+        if (key_is(k, klen, "deviceToken")) {
+            if (parse_plain_string(c, &tok1, &tok1_len) != 0) return 1;
+            has_tok1 = 1;
+        } else if (key_is(k, klen, "hardwareId")) {
+            if (parse_plain_string(c, &tok2, &tok2_len) != 0) return 1;
+        } else if (key_is(k, klen, "type")) {
+            const char *t; Py_ssize_t tlen;
+            if (parse_plain_string(c, &t, &tlen) != 0) return 1;
+            if (!(key_is(t, tlen, "Measurement") ||
+                  key_is(t, tlen, "Measurements") ||
+                  key_is(t, tlen, "DeviceMeasurements") ||
+                  key_is(t, tlen, "measurement") ||
+                  key_is(t, tlen, "measurements")))
+                return 1; /* non-measurement payload → Python path */
+            has_type = 1;
+        } else if (key_is(k, klen, "request")) {
+            if (expect(c, '{') != 0) return 1;
+            skip_ws(c);
+            if (c->p < c->end && *c->p == '}') { c->p++; }
+            else {
+                for (;;) {
+                    const char *rk; Py_ssize_t rklen;
+                    skip_ws(c);
+                    if (parse_plain_string(c, &rk, &rklen) != 0) return 1;
+                    if (expect(c, ':') != 0) return 1;
+                    skip_ws(c);
+                    if (key_is(rk, rklen, "name")) {
+                        if (parse_plain_string(c, &nm1, &nm1_len) != 0)
+                            return 1;
+                    } else if (key_is(rk, rklen, "measurementId")) {
+                        if (parse_plain_string(c, &nm2, &nm2_len) != 0)
+                            return 1;
+                    } else if (key_is(rk, rklen, "value")) {
+                        if (parse_number(c, value) != 0) return 1;
+                        *has_value = 1;
+                    } else if (key_is(rk, rklen, "eventDate")) {
+                        if (parse_number(c, &ed1) != 0) return 1;
+                    } else if (key_is(rk, rklen, "timestamp")) {
+                        if (parse_number(c, &ed2) != 0) return 1;
+                    } else if (key_is(rk, rklen, "updateState")) {
+                        if (c->end - c->p >= 4 &&
+                            memcmp(c->p, "true", 4) == 0) {
+                            *update_state = 1; c->p += 4;
+                        } else if (c->end - c->p >= 5 &&
+                                   memcmp(c->p, "false", 5) == 0) {
+                            *update_state = 0; c->p += 5;
+                        } else return 1;
+                    } else {
+                        return 1; /* unknown request key → Python path */
+                    }
+                    skip_ws(c);
+                    if (c->p < c->end && *c->p == ',') { c->p++; continue; }
+                    if (c->p < c->end && *c->p == '}') { c->p++; break; }
+                    return 1;
+                }
+            }
+            has_request = 1;
+        } else {
+            return 1; /* unknown top-level key → Python path */
+        }
+        skip_ws(c);
+        if (c->p < c->end && *c->p == ',') { c->p++; continue; }
+        if (c->p < c->end && *c->p == '}') { c->p++; break; }
+        return 1;
+    }
+    skip_ws(c);
+    if (c->p < c->end) return 1; /* trailing garbage on the line */
+    if (!has_type || !has_request) return 1;
+    /* Python: doc.get("deviceToken", doc.get("hardwareId")) — present
+     * deviceToken wins even when empty (empty → error; bail). */
+    if (has_tok1) { *token = tok1; *token_len = tok1_len; }
+    else { *token = tok2; *token_len = tok2_len; }
+    if (*token == NULL || *token_len == 0) return 1;
+    /* Python: r.get("name") or r.get("measurementId") — falsy "" falls
+     * through to the alias. */
+    if (nm1 != NULL && nm1_len > 0) { *name = nm1; *name_len = nm1_len; }
+    else if (nm2 != NULL) { *name = nm2; *name_len = nm2_len; }
+    else { *name = NULL; *name_len = 0; }
+    /* Python: r.get("eventDate") or r.get("timestamp") or 0. */
+    *ts = (ed1 != 0.0) ? ed1 : ed2;
+    if (*name == NULL || *name_len == 0 || !*has_value) return 1;
+    return 0;
+}
+
+static PyObject *decode_measurement_lines(PyObject *self, PyObject *arg) {
+    /* bytes only: strtod relies on the NUL terminator PyBytes guarantees */
+    if (!PyBytes_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "payload must be bytes");
+        return NULL;
+    }
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) return NULL;
+    const char *buf = (const char *)view.buf;
+    Py_ssize_t n = view.len;
+
+    PyObject *tokens = PyList_New(0);
+    PyObject *names = PyList_New(0);
+    dbuf values = {0}, tss = {0};
+    bbuf us = {0};
+    if (!tokens || !names) goto fail;
+
+    const char *p = buf, *end = buf + n;
+    while (p < end) {
+        const char *nl = memchr(p, '\n', (size_t)(end - p));
+        const char *line_end = nl ? nl : end;
+        /* skip blank lines */
+        const char *q = p;
+        while (q < line_end &&
+               (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+        if (q == line_end) { p = nl ? nl + 1 : end; continue; }
+
+        cursor c = { q, line_end };
+        const char *token, *name;
+        Py_ssize_t token_len, name_len;
+        double value, ts;
+        int has_value;
+        uint8_t update_state;
+        int rc = parse_line(&c, &token, &token_len, &name, &name_len,
+                            &value, &has_value, &ts, &update_state);
+        if (rc != 0) goto bail;
+
+        PyObject *t = PyUnicode_DecodeUTF8(token, token_len, NULL);
+        if (!t) { PyErr_Clear(); goto bail; }
+        if (PyList_Append(tokens, t) != 0) { Py_DECREF(t); goto fail; }
+        Py_DECREF(t);
+        PyObject *nm = PyUnicode_DecodeUTF8(name, name_len, NULL);
+        if (!nm) { PyErr_Clear(); goto bail; }
+        if (PyList_Append(names, nm) != 0) { Py_DECREF(nm); goto fail; }
+        Py_DECREF(nm);
+        if (dbuf_push(&values, value) != 0 || dbuf_push(&tss, ts) != 0 ||
+            bbuf_push(&us, update_state) != 0) {
+            PyErr_NoMemory();
+            goto fail;
+        }
+        p = nl ? nl + 1 : end;
+    }
+
+    {
+        PyObject *v = PyBytes_FromStringAndSize(
+            (const char *)values.data, values.len * (Py_ssize_t)sizeof(double));
+        PyObject *t = PyBytes_FromStringAndSize(
+            (const char *)tss.data, tss.len * (Py_ssize_t)sizeof(double));
+        PyObject *u = PyBytes_FromStringAndSize(
+            (const char *)us.data, us.len);
+        PyObject *out = NULL;
+        if (v && t && u)
+            out = PyTuple_Pack(5, tokens, names, v, t, u);
+        Py_XDECREF(v); Py_XDECREF(t); Py_XDECREF(u);
+        Py_DECREF(tokens); Py_DECREF(names);
+        free(values.data); free(tss.data); free(us.data);
+        PyBuffer_Release(&view);
+        return out; /* NULL propagates the MemoryError */
+    }
+
+bail:
+    Py_XDECREF(tokens); Py_XDECREF(names);
+    free(values.data); free(tss.data); free(us.data);
+    PyBuffer_Release(&view);
+    Py_RETURN_NONE;
+
+fail:
+    Py_XDECREF(tokens); Py_XDECREF(names);
+    free(values.data); free(tss.data); free(us.data);
+    PyBuffer_Release(&view);
+    return NULL;
+}
+
+static PyMethodDef methods[] = {
+    {"decode_measurement_lines", decode_measurement_lines, METH_O,
+     "Scan NDJSON measurement envelopes into column buffers; None = "
+     "shape mismatch, caller must fall back to the Python decoder."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_swwire",
+    "Native NDJSON wire decoder (measurement fast path).", -1, methods,
+};
+
+PyMODINIT_FUNC PyInit__swwire(void) { return PyModule_Create(&module); }

@@ -93,7 +93,13 @@ class ScriptManager:
             try:
                 with open(mpath) as f:
                     manifest = json.load(f)
-            except (FileNotFoundError, ValueError):
+            except (OSError, ValueError):
+                # stray files, unreadable dirs, bad JSON: skip — a broken
+                # entry must never abort instance startup
+                continue
+            if manifest.get("kind") not in KINDS:
+                logger.warning("script %s manifest lacks a valid kind; "
+                               "skipped", name)
                 continue
             record = ScriptRecord(name, manifest["kind"])
             for v in manifest.get("versions", []):

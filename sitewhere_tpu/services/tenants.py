@@ -401,3 +401,7 @@ class MultitenantEngineManager(LifecycleComponent):
                 if engine is not None \
                         and engine.state == LifecycleState.STARTED:
                     engine.stop()
+            with self._lock:
+                # bound _token_locks under tenant churn; recreated on
+                # demand if the token ever comes back
+                self._token_locks.pop(tenant.token, None)

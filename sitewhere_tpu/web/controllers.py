@@ -103,10 +103,14 @@ def register_routes(gw: RestGateway, inst) -> None:
     r("POST", "/api/tenants/{token}/engine/restart", engine_restart)
 
     # ---- tracing (Jaeger-sampling analog; spans over REST) ----------------
-    r("GET", "/api/traces",
-      lambda q: {"stats": inst.tracer.stats(),
-                 "spans": inst.tracer.recent(
-                     int(q.query.get("limit", ["100"])[0]))})
+    def get_traces(q):
+        try:
+            limit = int(q.query.get("limit", ["100"])[0])
+        except ValueError:
+            limit = 100
+        return {"stats": inst.tracer.stats(),
+                "spans": inst.tracer.recent(limit)}
+    r("GET", "/api/traces", get_traces)
 
     # ---- runtime scripts (ScriptSynchronizer analog) ----------------------
     r("GET", "/api/scripts", lambda q: inst.scripts.list_scripts())
@@ -116,6 +120,8 @@ def register_routes(gw: RestGateway, inst) -> None:
 
     def upload_script(q):
         body = q.json()
+        require("source" in body,
+                ValidationError("body must carry 'source'"))
         return inst.scripts.upload(
             q.params["name"], str(body.get("kind", "decoder")),
             str(body["source"]),
@@ -124,8 +130,12 @@ def register_routes(gw: RestGateway, inst) -> None:
     r("PUT", "/api/scripts/{name}", upload_script, authority="ROLE_ADMIN")
 
     def activate_script(q):
-        return inst.scripts.activate(
-            q.params["name"], int(q.json()["version"]))
+        body = q.json()
+        try:
+            version = int(body["version"])
+        except (KeyError, TypeError, ValueError):
+            raise ValidationError("body must carry an integer 'version'")
+        return inst.scripts.activate(q.params["name"], version)
     r("POST", "/api/scripts/{name}/activate", activate_script,
       authority="ROLE_ADMIN")
 

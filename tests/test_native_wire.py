@@ -1,0 +1,127 @@
+"""Native C wire decoder (_swwire): equivalence + strict-bail contract.
+
+The native tier is PURELY an accelerator: for any payload it accepts, the
+result must be identical to the pure-Python columnar decoder; anything
+else must bail to Python (never diverge, never crash).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.ingest import columnar
+from sitewhere_tpu.native import load_swwire
+
+pytestmark = pytest.mark.skipif(
+    load_swwire() is None, reason="native toolchain unavailable")
+
+
+def _line(token, value, ts=1_753_800_000, name="temp", extra=None):
+    req = {"name": name, "value": value, "eventDate": ts}
+    req.update(extra or {})
+    return json.dumps({"deviceToken": token, "type": "Measurement",
+                       "request": req}, separators=(",", ":"))
+
+
+def _python_decode(payload):
+    return columnar._decode_lines_inner(
+        __import__("sitewhere_tpu.ingest.decoders",
+                   fromlist=["parse_envelopes"]).parse_envelopes(payload))
+
+
+def test_native_matches_python_columnar():
+    rng = np.random.default_rng(0)
+    lines = [
+        _line(f"dev-{i}", float(rng.uniform(-50, 150)),
+              ts=1_753_800_000 + i, name=("temp" if i % 3 else "rpm"))
+        for i in range(200)
+    ]
+    # sprinkle updateState and epoch-millis timestamps
+    lines.append(_line("dev-x", 1.0, extra={"updateState": False}))
+    lines.append(_line("dev-y", 2.0, ts=1_753_800_000_123))
+    payload = "\n".join(lines).encode()
+
+    native, host_n = columnar.decode_json_lines(payload)
+    py, host_p = _python_decode(payload)
+    assert host_n == host_p == []
+    assert native["device_token"] == py["device_token"]
+    assert native["mtype"] == py["mtype"]
+    for k in ("event_type", "ts_s", "ts_ns", "alert_level"):
+        np.testing.assert_array_equal(native[k], py[k], err_msg=k)
+    np.testing.assert_allclose(native["value"], py["value"], rtol=1e-6)
+    np.testing.assert_array_equal(native["update_state"],
+                                  py["update_state"])
+
+
+@pytest.mark.parametrize("payload", [
+    b'{"deviceToken":"d","type":"Alert","request":{"type":"x"}}',
+    b'{"deviceToken":"d\\u0041","type":"Measurement","request":{"name":"t","value":1}}',
+    b'{"deviceToken":"d","type":"Measurement","request":{"name":"t","value":1,"metadata":{}}}',
+    b'{"deviceToken":"d","type":"Measurement","unknown":1,"request":{"name":"t","value":1}}',
+])
+def test_native_bails_to_python(payload):
+    mod = load_swwire()
+    assert mod.decode_measurement_lines(payload) is None
+
+
+def test_native_bail_still_decodes_through_python():
+    """A payload the native scanner rejects (escape sequence) must still
+    decode via the Python fallback with identical semantics."""
+    payload = (b'{"deviceToken":"d\\u0041","type":"Measurement",'
+               b'"request":{"name":"t","value":3.5}}')
+    cols, _ = columnar.decode_json_lines(payload)
+    assert cols["device_token"] == ["dA"]
+    assert cols["value"].tolist() == pytest.approx([3.5])
+
+
+def test_native_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("SW_NATIVE", "0")
+    import importlib
+
+    import sitewhere_tpu.native as nat
+    importlib.reload(nat)
+    try:
+        assert nat.load_swwire() is None
+    finally:
+        monkeypatch.delenv("SW_NATIVE")
+        importlib.reload(nat)
+
+
+def test_malformed_numbers_and_truncation_bail():
+    mod = load_swwire()
+    assert mod.decode_measurement_lines(
+        b'{"deviceToken":"d","type":"Measurement","request":{"name":"t","value":"hot"}}') is None
+    assert mod.decode_measurement_lines(
+        b'{"deviceToken":"d","type":"Measurement","request":{"name":"t"') is None
+    assert mod.decode_measurement_lines(b'not json at all') is None
+
+
+def test_alias_precedence_matches_python():
+    """name/measurementId, eventDate/timestamp, deviceToken/hardwareId
+    precedence must be identical on both paths regardless of key order."""
+    mod = load_swwire()
+    line = (b'{"type":"Measurement","hardwareId":"hw","deviceToken":"dt",'
+            b'"request":{"measurementId":"alt","name":"main","value":1,'
+            b'"timestamp":111,"eventDate":222}}')
+    out = mod.decode_measurement_lines(line)
+    assert out is not None
+    tokens, names, _, ts_b, _ = out
+    assert tokens == ["dt"]       # deviceToken wins over hardwareId
+    assert names == ["main"]      # name wins over measurementId
+    assert np.frombuffer(ts_b, np.float64).tolist() == [222.0]
+    # reversed order — same result
+    line2 = (b'{"deviceToken":"dt","hardwareId":"hw","type":"Measurement",'
+             b'"request":{"name":"main","measurementId":"alt","value":1,'
+             b'"eventDate":222,"timestamp":111}}')
+    out2 = mod.decode_measurement_lines(line2)
+    assert out2[0] == ["dt"] and out2[1] == ["main"]
+    assert np.frombuffer(out2[3], np.float64).tolist() == [222.0]
+
+
+def test_non_json_numbers_bail():
+    mod = load_swwire()
+    for bad in (b'.5', b'+1', b'0x10', b'nan', b'Infinity', b'1.', b'01'):
+        line = (b'{"deviceToken":"d","type":"Measurement",'
+                b'"request":{"name":"t","value":' + bad + b'}}')
+        assert mod.decode_measurement_lines(line) is None, bad
