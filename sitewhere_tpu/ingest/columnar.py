@@ -118,10 +118,7 @@ def _native_decode(payload: bytes) -> Optional[Dict[str, object]]:
     n = len(tokens)
     if n == 0:
         return None  # preserve the Python path's empty-payload error
-    raw_ts = np.frombuffer(ts_b, np.float64)
-    raw_ts = np.where(raw_ts > 1e11, raw_ts / 1e3, raw_ts)  # epoch ms
-    ts_s = raw_ts.astype(np.int64)
-    ts_ns = np.round((raw_ts - ts_s) * 1e9).astype(np.int64)
+    ts_s, ts_ns = _split_epoch(np.frombuffer(ts_b, np.float64))
     zeros = np.zeros(n, np.float32)
     return {
         "device_token": tokens,
@@ -228,6 +225,12 @@ def _ts_columns(reqs: List[dict]) -> Tuple[np.ndarray, np.ndarray]:
                  for r in reqs]
         return (np.fromiter((p[0] for p in pairs), np.int32, n),
                 np.fromiter((p[1] for p in pairs), np.int32, n))
+    return _split_epoch(raw)
+
+
+def _split_epoch(raw: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Shared float64-epoch → (ts_s, ts_ns) split (millis heuristic) —
+    ONE implementation so the native and Python paths can't drift."""
     raw = np.where(raw > 1e11, raw / 1e3, raw)  # epoch millis
     ts_s = raw.astype(np.int64)
     ts_ns = np.round((raw - ts_s) * 1e9).astype(np.int64)
@@ -247,8 +250,10 @@ def _decode_mixed(tokens, kinds, reqs, ts_s, ts_ns, event_type,
     for i, (kind, r) in enumerate(zip(kinds, reqs)):
         # touches only the fields the kind carries; no object construction
         if kind == RequestKind.MEASUREMENT:
-            name = r.get("name", r.get("measurementId"))
-            if name is None or "value" not in r:
+            # `or` (not get-with-default): an empty name falls through to
+            # the alias — same rule as the fast path and the C decoder
+            name = r.get("name") or r.get("measurementId")
+            if not name or "value" not in r:
                 raise DecodeError("measurement needs name+value")
             mtypes.append(str(name))
             values[i] = float(r["value"])

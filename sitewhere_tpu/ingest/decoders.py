@@ -161,8 +161,10 @@ def _decode_one_inner(token: str, kind_name: str, req: dict) -> DecodedRequest:
         update_state=bool(req.get("updateState", True)),
     )
     if kind == RequestKind.MEASUREMENT:
-        name = req.get("name", req.get("measurementId"))
-        if name is None or "value" not in req:
+        # `or`: an empty name falls through to the alias (same rule on
+        # the columnar and native paths — they must never diverge)
+        name = req.get("name") or req.get("measurementId")
+        if not name or "value" not in req:
             raise DecodeError("measurement needs name+value")
         return DecodedRequest(mtype=str(name), value=float(req["value"]), **common)
     if kind == RequestKind.LOCATION:

@@ -66,10 +66,17 @@ def load_swwire():
     global _swwire, _tried
     if _swwire is not None or _tried:
         return _swwire
-    with _load_lock:
+    # Non-blocking: while the (possibly seconds-long) first-use build is
+    # in flight on the warmup thread, decode callers get None and take
+    # the Python path instead of parking on the lock.
+    if not _load_lock.acquire(blocking=False):
+        return None
+    try:
         if _swwire is not None or _tried:
             return _swwire
         return _load_locked()
+    finally:
+        _load_lock.release()
 
 
 def _load_locked():

@@ -180,6 +180,9 @@ static int parse_line(cursor *c,
                 return 1; /* non-measurement payload → Python path */
             has_type = 1;
         } else if (key_is(k, klen, "request")) {
+            /* a duplicate "request" key would MERGE fields here while
+             * json.loads keeps only the last object — bail to Python */
+            if (has_request) return 1;
             if (expect(c, '{') != 0) return 1;
             skip_ws(c);
             if (c->p < c->end && *c->p == '}') { c->p++; }

@@ -375,8 +375,14 @@ class EventStore(LifecycleComponent):
         if buffered is not None:
             chunks.append(buffered)
 
-        hits: List[tuple] = []  # (ts_s, ts_ns, chunk, row) newest-first
-        for chunk in chunks:
+        # Fully vectorized hit collection + ordering: per-hit Python
+        # tuples and a Python sort were the 1M/s-scale weak spot (round-2
+        # verdict); only the RESULT PAGE materializes records.
+        sel_ts: List[np.ndarray] = []
+        sel_ns: List[np.ndarray] = []
+        sel_chunk: List[np.ndarray] = []
+        sel_row: List[np.ndarray] = []
+        for ci, chunk in enumerate(chunks):
             if criteria.start_s is not None and chunk.max_ts < criteria.start_s:
                 continue  # chunk prune (the hour-bucket skip analog)
             if criteria.end_s is not None and chunk.min_ts > criteria.end_s:
@@ -390,15 +396,28 @@ class EventStore(LifecycleComponent):
             if criteria.end_s is not None:
                 mask &= chunk.cols["ts_s"] <= criteria.end_s
             rows = np.nonzero(mask)[0]
-            ts_s = chunk.cols["ts_s"]
-            ts_ns = chunk.cols["ts_ns"]
-            hits.extend((int(ts_s[r]), int(ts_ns[r]), chunk, int(r)) for r in rows)
+            if rows.size:
+                sel_ts.append(chunk.cols["ts_s"][rows].astype(np.int64))
+                sel_ns.append(chunk.cols["ts_ns"][rows].astype(np.int64))
+                sel_chunk.append(np.full(rows.size, ci, np.int32))
+                sel_row.append(rows.astype(np.int32))
 
-        hits.sort(key=lambda h: (-h[0], -h[1]))
-        total = len(hits)
-        page = criteria.slice(hits)
+        if not sel_ts:
+            return SearchResults(results=[], total=0)
+        ts = np.concatenate(sel_ts)
+        ns = np.concatenate(sel_ns)
+        cidx = np.concatenate(sel_chunk)
+        rix = np.concatenate(sel_row)
+        # one int64 key: ts_s fits 2^31, ns < 1e9 → ts*1e9+ns < 2^63
+        key = ts * 1_000_000_000 + ns
+        # newest-first; ties keep chunk/insertion order (stable, matching
+        # the previous Python sort)
+        order = np.lexsort((np.arange(key.size), -key))
+        total = int(key.size)
+        page = criteria.slice(order)
         return SearchResults(
-            results=[self._record(chunk, row) for (_, _, chunk, row) in page],
+            results=[self._record(chunks[int(cidx[i])], int(rix[i]))
+                     for i in page],
             total=total,
         )
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import contextlib
 import threading
 from typing import Callable, Dict, List, Optional
 
@@ -281,15 +282,29 @@ class MultitenantEngineManager(LifecycleComponent):
         # Per-token locks serialize restart vs delete for ONE tenant
         # without holding the global lock across a (slow) stop/start —
         # get_engine for other tenants must never block on a restart.
-        self._token_locks: Dict[str, threading.Lock] = {}
+        # Entries are refcounted: evicted when the last holder releases,
+        # so the map stays bounded under tenant churn and a waiter can
+        # never be stranded on an evicted lock object.
+        self._token_locks: Dict[str, list] = {}  # token → [Lock, refcount]
         tenants.add_listener(self._on_tenant_event)
 
-    def _token_lock(self, token: str) -> threading.Lock:
+    @contextlib.contextmanager
+    def _token_guard(self, token: str):
         with self._lock:
-            lock = self._token_locks.get(token)
-            if lock is None:
-                lock = self._token_locks[token] = threading.Lock()
-            return lock
+            entry = self._token_locks.get(token)
+            if entry is None:
+                entry = self._token_locks[token] = [threading.Lock(), 0]
+            entry[1] += 1
+        entry[0].acquire()
+        try:
+            yield
+        finally:
+            entry[0].release()
+            with self._lock:
+                entry[1] -= 1
+                if entry[1] == 0 \
+                        and self._token_locks.get(token) is entry:
+                    del self._token_locks[token]
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -337,7 +352,7 @@ class MultitenantEngineManager(LifecycleComponent):
         # racing delete must not see its engine resurrected) WITHOUT
         # holding the global lock across a slow stop/start — other
         # tenants' get_engine/traffic keeps flowing during the restart.
-        with self._token_lock(token):
+        with self._token_guard(token):
             if not rebuild:
                 with self._lock:
                     engine = self._engines.get(token)
@@ -395,13 +410,9 @@ class MultitenantEngineManager(LifecycleComponent):
         if kind == "tenant.created":
             self._ensure_engine(tenant)
         elif kind == "tenant.deleted":
-            with self._token_lock(tenant.token):
+            with self._token_guard(tenant.token):
                 with self._lock:
                     engine = self._engines.pop(tenant.token, None)
                 if engine is not None \
                         and engine.state == LifecycleState.STARTED:
                     engine.stop()
-            with self._lock:
-                # bound _token_locks under tenant churn; recreated on
-                # demand if the token ever comes back
-                self._token_locks.pop(tenant.token, None)
