@@ -136,6 +136,125 @@ def detect_anomalies(
     return anomalous, jnp.where(ready, z, 0.0)
 
 
+def route_events_by_shard(
+    device_id: np.ndarray,
+    window_idx: np.ndarray,
+    value: np.ndarray,
+    n_devices: int,
+    n_shards: int,
+):
+    """Host-side routing for the sharded grid build: order events by the
+    mesh shard owning their device block (same block-sharding as the
+    pipeline registry) and pad every shard segment to a common length.
+
+    Returns ``(dev, win, val, ok)`` arrays of shape ``[S * L]`` whose
+    leading axis block-shards cleanly over the mesh.
+    """
+    if n_devices % n_shards != 0:
+        raise ValueError(
+            f"n_devices={n_devices} not divisible by n_shards={n_shards}")
+    rows_per_shard = n_devices // n_shards
+    keep = (device_id >= 0) & (device_id < n_devices)
+    device_id = device_id[keep]
+    window_idx = window_idx[keep]
+    value = value[keep]
+    shard = device_id // rows_per_shard
+    order = np.argsort(shard, kind="stable")
+    counts = np.bincount(shard, minlength=n_shards)
+    # Padding to the hottest shard's load: under heavy device skew the
+    # padded layout approaches S × max-load (mostly padding rows) — at
+    # that point re-balance devices across blocks rather than scaling S.
+    seg = max(int(counts.max()), 1)
+    if counts.sum() and seg * n_shards > 4 * int(counts.sum()):
+        import logging
+
+        logging.getLogger("sitewhere_tpu.analytics").debug(
+            "shard skew: hottest segment %d vs mean %.0f — sharded grid "
+            "build is mostly padding", seg, counts.mean())
+    dev = np.full(n_shards * seg, 0, np.int32)
+    win = np.zeros(n_shards * seg, np.int32)
+    val = np.zeros(n_shards * seg, np.float32)
+    ok = np.zeros(n_shards * seg, np.bool_)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    for s in range(n_shards):
+        lo, n = int(starts[s]), int(counts[s])
+        rows = order[lo:lo + n]
+        base = s * seg
+        dev[base:base + n] = device_id[rows]
+        win[base:base + n] = window_idx[rows]
+        val[base:base + n] = value[rows]
+        ok[base:base + n] = True
+    return dev, win, val, ok
+
+
+def build_window_grid_sharded(
+    mesh,
+    device_id: np.ndarray,
+    window_idx: np.ndarray,
+    value: np.ndarray,
+    n_devices: int,
+    n_windows: int,
+) -> WindowGrid:
+    """Multi-chip grid build: events shard-routed by device block, grids
+    built shard-locally (zero cross-chip traffic on the scatter), result
+    left block-sharded on the device axis.  :func:`detect_anomalies` is
+    row-independent, so it runs on the sharded grid as-is — the whole
+    analytics job scales over the mesh (BASELINE config 3, multi-chip).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sitewhere_tpu.parallel.mesh import SHARD_AXIS
+
+    n_shards = mesh.shape[SHARD_AXIS]
+    rows_local = n_devices // n_shards
+    dev, win, val, ok = route_events_by_shard(
+        device_id, window_idx, value, n_devices, n_shards)
+
+    sharded = NamedSharding(mesh, P(SHARD_AXIS))
+    args = [
+        jax.device_put(jnp.asarray(a), sharded)
+        for a in (dev, win, val, ok)
+    ]
+    builder = _sharded_grid_builder(mesh, rows_local, n_windows)
+    counts, means, variances = builder(*args)
+    return WindowGrid(counts=counts, means=means, variances=variances)
+
+
+# Compiled sharded builders, keyed so periodic jobs reuse the XLA cache
+# instead of retracing every run (the build-once pattern of
+# pipeline/sharded.build_sharded_step).
+_SHARDED_BUILDERS: Dict[tuple, object] = {}
+
+
+def _sharded_grid_builder(mesh, rows_local: int, n_windows: int):
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from sitewhere_tpu.parallel.mesh import SHARD_AXIS
+
+    key = (id(mesh), rows_local, n_windows)
+    builder = _SHARDED_BUILDERS.get(key)
+    if builder is not None:
+        return builder
+
+    def local(dev, win, val, ok):
+        offset = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int32) * rows_local
+        grid = build_window_grid(
+            dev - offset, win, val, ok,
+            n_devices=rows_local, n_windows=n_windows,
+        )
+        return grid.counts, grid.means, grid.variances
+
+    builder = jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(P(SHARD_AXIS),) * 4,
+        out_specs=(P(SHARD_AXIS, None),) * 3,
+        check_vma=False,
+    ))
+    _SHARDED_BUILDERS[key] = builder
+    return builder
+
+
 @dataclasses.dataclass
 class Anomaly:
     device_id: int
@@ -206,6 +325,7 @@ class AnalyticsJob:
         t0_s: Optional[int] = None,
         n_windows: Optional[int] = None,
         token_of=None,
+        mesh=None,
     ) -> Dict[str, object]:
         if len(ts_s) == 0:
             return {"anomalies": [], "windows": 0, "events": 0,
@@ -222,14 +342,20 @@ class AnalyticsJob:
         center = float(values64.mean())
         global_std = float(values64.std())
         centered = (values64 - center).astype(np.float32)
-        grid = build_window_grid(
-            jnp.asarray(device_id.astype(np.int32)),
-            jnp.asarray(win),
-            jnp.asarray(centered),
-            jnp.ones(len(ts_s), bool),
-            n_devices=n_devices,
-            n_windows=n_windows,
-        )
+        if mesh is not None:
+            grid = build_window_grid_sharded(
+                mesh, device_id.astype(np.int32), win, centered,
+                n_devices=n_devices, n_windows=n_windows,
+            )
+        else:
+            grid = build_window_grid(
+                jnp.asarray(device_id.astype(np.int32)),
+                jnp.asarray(win),
+                jnp.asarray(centered),
+                jnp.ones(len(ts_s), bool),
+                n_devices=n_devices,
+                n_windows=n_windows,
+            )
         anomalous, z = detect_anomalies(
             grid,
             baseline_windows=self.baseline_windows,
@@ -262,12 +388,15 @@ class AnalyticsJob:
         }
 
     def run(self, store, n_devices: int, mtype_id: Optional[int] = None,
-            token_of=None) -> Dict[str, object]:
-        """Full job: store → columns → windowed anomaly detection."""
+            token_of=None, mesh=None) -> Dict[str, object]:
+        """Full job: store → columns → windowed anomaly detection.
+
+        ``mesh`` shards the device axis over the pipeline's mesh
+        (shard-local scatters; row-independent detection stays sharded)."""
         cols = self.columns_from_store(store, mtype_id)
         return self.run_columns(
             cols["device_id"], cols["ts_s"], cols["value"],
-            n_devices=n_devices, token_of=token_of,
+            n_devices=n_devices, token_of=token_of, mesh=mesh,
         )
 
 
