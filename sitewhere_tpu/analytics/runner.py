@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import functools
 from functools import partial
 from typing import Dict, List, Optional
 
@@ -211,31 +212,25 @@ def build_window_grid_sharded(
         device_id, window_idx, value, n_devices, n_shards)
 
     sharded = NamedSharding(mesh, P(SHARD_AXIS))
-    args = [
-        jax.device_put(jnp.asarray(a), sharded)
-        for a in (dev, win, val, ok)
-    ]
+    # numpy straight to the sharded layout: JAX slices host-side and
+    # sends each shard only to its owning device (an intermediate
+    # jnp.asarray would commit the full array to device 0 first)
+    args = [jax.device_put(a, sharded) for a in (dev, win, val, ok)]
     builder = _sharded_grid_builder(mesh, rows_local, n_windows)
     counts, means, variances = builder(*args)
     return WindowGrid(counts=counts, means=means, variances=variances)
 
 
-# Compiled sharded builders, keyed so periodic jobs reuse the XLA cache
+# Compiled sharded builders cached so periodic jobs reuse the XLA cache
 # instead of retracing every run (the build-once pattern of
-# pipeline/sharded.build_sharded_step).
-_SHARDED_BUILDERS: Dict[tuple, object] = {}
-
-
+# pipeline/sharded.build_sharded_step).  Mesh is hashable, so equal
+# meshes share an entry; lru bounds growth under reconfiguration.
+@functools.lru_cache(maxsize=16)
 def _sharded_grid_builder(mesh, rows_local: int, n_windows: int):
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
     from sitewhere_tpu.parallel.mesh import SHARD_AXIS
-
-    key = (id(mesh), rows_local, n_windows)
-    builder = _SHARDED_BUILDERS.get(key)
-    if builder is not None:
-        return builder
 
     def local(dev, win, val, ok):
         offset = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int32) * rows_local
@@ -245,14 +240,12 @@ def _sharded_grid_builder(mesh, rows_local: int, n_windows: int):
         )
         return grid.counts, grid.means, grid.variances
 
-    builder = jax.jit(shard_map(
+    return jax.jit(shard_map(
         local, mesh=mesh,
         in_specs=(P(SHARD_AXIS),) * 4,
         out_specs=(P(SHARD_AXIS, None),) * 3,
         check_vma=False,
     ))
-    _SHARDED_BUILDERS[key] = builder
-    return builder
 
 
 @dataclasses.dataclass

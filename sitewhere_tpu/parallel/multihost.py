@@ -1,0 +1,135 @@
+"""Multi-host execution: one pipeline SPMD program over ICI + DCN.
+
+Reference scaling story: Kafka partitions spread over brokers and every
+microservice scales by adding consumer-group members on more Kubernetes
+nodes (SURVEY.md §2.4).  The TPU equivalent is one ``shard_map`` program
+over a mesh that spans hosts: intra-slice traffic rides ICI, cross-slice
+rides DCN, and each HOST terminates device protocols for the shards it
+physically holds — the per-host ingest frontend is the analog of a
+broker's partition leadership.
+
+Topology model (mirrors "How to Scale Your Model"'s recipe):
+
+1. every process calls :func:`initialize_from_env` (coordinator address,
+   process count/id from env or args) before touching the backend;
+2. :func:`make_mesh` then sees the GLOBAL device list — the ``shard``
+   axis spans all hosts;
+3. each host's sources feed only the device blocks it owns
+   (:func:`process_local_shards` → :func:`owned_device_range`), exactly
+   like the single-host batcher's shard routing but restricted to local
+   shards;
+4. per-host batches assemble into one global array with
+   :func:`make_global_batch` (jax.make_array_from_process_local_data —
+   no host ever materializes the full batch);
+5. the jitted sharded step runs as one program; XLA inserts ICI/DCN
+   collectives for the psum'd metrics.
+
+Durability stays per-host: each process journals ITS ingest locally and
+commits its own offsets (Kafka's per-partition offsets, exactly);
+checkpoints of the sharded tensors go through jax process-local shards.
+
+Validation status: the shard-ownership math and global-batch assembly
+are unit-tested in-process (a 1-process "cluster" is a degenerate but
+real configuration); true multi-process DCN runs need hardware this
+environment does not provide and MUST be smoke-tested before production
+use.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from sitewhere_tpu.parallel.mesh import SHARD_AXIS
+
+logger = logging.getLogger("sitewhere_tpu.multihost")
+
+
+def initialize_from_env(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """``jax.distributed.initialize`` from args or environment.
+
+    Env (the InstanceSettings-style flag surface,
+    ``microservice/instance/InstanceSettings.java:22-78``):
+    ``SW_COORDINATOR`` (host:port), ``SW_NUM_PROCESSES``,
+    ``SW_PROCESS_ID``.  Returns True if distributed mode was initialized;
+    False for the single-process default (no env set).  Must run before
+    any JAX backend initializes.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "SW_COORDINATOR")
+    if coordinator_address is None:
+        return False
+    num_processes = int(num_processes
+                        or os.environ.get("SW_NUM_PROCESSES", "1"))
+    process_id = int(process_id
+                     if process_id is not None
+                     else os.environ.get("SW_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info("distributed jax: process %d/%d via %s",
+                process_id, num_processes, coordinator_address)
+    return True
+
+
+def process_local_shards(mesh) -> List[int]:
+    """Indices along the ``shard`` axis whose devices this process holds.
+
+    The host's ingest frontends subscribe only to these shards' device
+    populations (per-host MQTT topics / load-balancer partitions), so a
+    row never crosses DCN on the host side — like Kafka partition
+    leadership pinning a partition's producer traffic to one broker.
+    """
+    local = set(jax.local_devices())
+    axis = list(mesh.shape).index(SHARD_AXIS)
+    out: List[int] = []
+    # mesh.devices is an ndarray [shard, model]; a shard index is local
+    # when ALL its devices are (model-parallel groups never span hosts
+    # in supported topologies).
+    dev_grid = np.asarray(mesh.devices)
+    for s in range(dev_grid.shape[axis]):
+        row = np.take(dev_grid, s, axis=axis).ravel()
+        if all(d in local for d in row):
+            out.append(s)
+    return out
+
+
+def owned_device_range(shard: int, registry_capacity: int,
+                       n_shards: int) -> Tuple[int, int]:
+    """[lo, hi) of dense device handles shard ``shard`` owns (block
+    sharding — must match ``parallel.mesh.shard_for_device``)."""
+    if registry_capacity % n_shards != 0:
+        raise ValueError(
+            f"capacity={registry_capacity} not divisible by {n_shards}")
+    rows = registry_capacity // n_shards
+    return shard * rows, (shard + 1) * rows
+
+
+def make_global_batch(mesh, local_cols: Dict[str, np.ndarray],
+                      global_width: int):
+    """Assemble this process's batch segment into the global sharded
+    batch without materializing the full array anywhere.
+
+    ``local_cols`` carries this host's rows for ITS shard segments, laid
+    out contiguously (the batcher's per-shard segment layout restricted
+    to local shards); ``global_width`` is the full batch width across
+    all processes.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    return {
+        name: jax.make_array_from_process_local_data(
+            sharding, arr, (global_width,) + arr.shape[1:])
+        for name, arr in local_cols.items()
+    }

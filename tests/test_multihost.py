@@ -1,0 +1,50 @@
+"""Multi-host scaffolding: shard ownership + global-batch assembly.
+
+A 1-process cluster is a degenerate but real configuration: all shards
+are process-local and make_array_from_process_local_data must accept the
+full batch.  True DCN runs need multi-process hardware (documented in
+parallel/multihost.py).
+"""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.parallel import mesh as meshmod
+from sitewhere_tpu.parallel.multihost import (
+    initialize_from_env,
+    make_global_batch,
+    owned_device_range,
+    process_local_shards,
+)
+
+
+def test_initialize_noop_without_env(monkeypatch):
+    monkeypatch.delenv("SW_COORDINATOR", raising=False)
+    assert initialize_from_env() is False
+
+
+def test_all_shards_local_in_single_process(mesh8):
+    assert process_local_shards(mesh8) == list(range(8))
+
+
+def test_owned_device_range_matches_router():
+    for shard in range(8):
+        lo, hi = owned_device_range(shard, 1024, 8)
+        assert meshmod.shard_for_device(lo, 1024, 8) == shard
+        assert meshmod.shard_for_device(hi - 1, 1024, 8) == shard
+    with pytest.raises(ValueError):
+        owned_device_range(0, 1001, 8)
+
+
+def test_make_global_batch_round_trips(mesh8):
+    width = 64
+    cols = {
+        "device_id": np.arange(width, dtype=np.int32),
+        "value": np.linspace(0, 1, width, dtype=np.float32),
+    }
+    out = make_global_batch(mesh8, cols, global_width=width)
+    assert out["device_id"].shape == (width,)
+    assert len(out["device_id"].sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(out["device_id"]),
+                                  cols["device_id"])
+    np.testing.assert_allclose(np.asarray(out["value"]), cols["value"])
