@@ -15,6 +15,8 @@ from sitewhere_tpu.commands.encoders import (
 )
 from sitewhere_tpu.commands.destinations import (
     CallbackDeliveryProvider,
+    CoapDeliveryProvider,
+    CoapParameterExtractor,
     CommandDestination,
     MqttDeliveryProvider,
     TopicParameterExtractor,

@@ -27,6 +27,17 @@ class DeliveryError(ServiceError):
     """Transport-level delivery failure → undelivered dead-letter."""
 
 
+def _placeholder_fields(execution: CommandExecution) -> Dict[str, str]:
+    """The shared ``{device}``/``{tenant}``/``{type}`` pattern vocabulary
+    (one place — the MQTT and CoAP extractors must not diverge)."""
+    inv = execution.invocation
+    return {
+        "device": inv.device_token or "",
+        "tenant": inv.tenant or "",
+        "type": inv.device_type_token or "",
+    }
+
+
 class TopicParameterExtractor:
     """Per-device delivery parameters from a topic pattern.
 
@@ -44,12 +55,7 @@ class TopicParameterExtractor:
         self.system_topic = system_topic
 
     def __call__(self, execution: CommandExecution) -> Dict[str, str]:
-        inv = execution.invocation
-        fields = {
-            "device": inv.device_token or "",
-            "tenant": inv.tenant or "",
-            "type": inv.device_type_token or "",
-        }
+        fields = _placeholder_fields(execution)
         return {
             "topic": self.command_topic.format(**fields),
             "system_topic": self.system_topic.format(**fields),
@@ -99,9 +105,165 @@ class MqttDeliveryProvider(LifecycleComponent):
             raise DeliveryError(f"mqtt publish failed: {e}") from e
 
 
+class CoapParameterExtractor:
+    """Per-device CoAP endpoint parameters.
+
+    Reference: ``destination/coap/MetadataCoapParameterExtractor.java`` —
+    host/port come from device metadata with configured defaults; the
+    URI path is a pattern (``{device}``/``{tenant}``/``{type}``).
+    """
+
+    def __init__(self, default_host: str = "127.0.0.1",
+                 default_port: int = 5683,
+                 path: str = "commands/{device}",
+                 metadata_host_key: str = "coap_host",
+                 metadata_port_key: str = "coap_port"):
+        self.default_host = default_host
+        self.default_port = default_port
+        self.path = path
+        self.metadata_host_key = metadata_host_key
+        self.metadata_port_key = metadata_port_key
+
+    def __call__(self, execution: CommandExecution) -> Dict[str, str]:
+        meta = dict(execution.device_metadata or {})
+        return {
+            "host": str(meta.get(self.metadata_host_key, self.default_host)),
+            "port": str(meta.get(self.metadata_port_key, self.default_port)),
+            "path": self.path.format(**_placeholder_fields(execution)),
+        }
+
+
+class CoapDeliveryProvider(LifecycleComponent):
+    """POST encoded executions to the device's CoAP endpoint (RFC 7252
+    confirmable exchange with client-side retransmission).
+
+    Reference: ``destination/coap/CoapCommandDeliveryProvider.java``
+    (Californium client).  Here the from-scratch codec in
+    :mod:`sitewhere_tpu.ingest.coap` does the framing; CON requests
+    retransmit on the RFC schedule (ACK_TIMEOUT 2s doubling,
+    MAX_RETRANSMIT 4) and an RST or 4.xx/5.xx response is a delivery
+    failure → undelivered dead-letter.
+    """
+
+    def __init__(self, ack_timeout_s: float = 2.0, max_retransmit: int = 4,
+                 max_wait_s: float = 30.0):
+        super().__init__("coap-delivery")
+        self.ack_timeout_s = ack_timeout_s
+        self.max_retransmit = max_retransmit
+        # total exchange budget (caps the RFC 2+4+8+16+32s worst case so
+        # one dead endpoint can't stall a command batch for a minute;
+        # MAX_TRANSMIT_WAIT-style bound)
+        self.max_wait_s = max_wait_s
+        self._lock = threading.Lock()
+        import random as _random
+
+        # RFC 7252 §4.4: start message ids unpredictably
+        self._message_id = _random.SystemRandom().getrandbits(16)
+
+    def _next_mid(self) -> int:
+        with self._lock:
+            self._message_id = (self._message_id + 1) & 0xFFFF
+            return self._message_id
+
+    @staticmethod
+    def _check_code(reply) -> None:
+        code_class = reply.code >> 5
+        if code_class in (4, 5):
+            raise DeliveryError(
+                f"coap error {code_class}.{reply.code & 0x1F:02d}")
+
+    def deliver(self, execution: CommandExecution, payload: bytes,
+                params: Dict[str, str]) -> None:
+        import os
+        import socket
+        import time as _time
+
+        from sitewhere_tpu.ingest import coap
+
+        host = params["host"]
+        port = int(params["port"])
+        mid = self._next_mid()
+        token = os.urandom(4)
+        datagram = coap.encode_post(params.get("path", ""), payload,
+                                    message_id=mid, confirmable=True,
+                                    token=token)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            # connect() makes the kernel drop datagrams from other
+            # sources — a stray peer can't fail or fake the exchange
+            sock.connect((host, port))
+            deadline_total = _time.monotonic() + self.max_wait_s
+            timeout = self.ack_timeout_s
+            for _ in range(self.max_retransmit + 1):
+                try:
+                    sock.send(datagram)
+                except OSError as e:
+                    raise DeliveryError(f"coap send failed: {e}") from e
+                attempt_deadline = min(
+                    _time.monotonic() + timeout, deadline_total)
+                separate = False  # empty ACK seen; response comes later
+                while True:
+                    remaining = attempt_deadline - _time.monotonic()
+                    if remaining <= 0:
+                        break
+                    sock.settimeout(remaining)
+                    try:
+                        data = sock.recv(65536)
+                    except socket.timeout:
+                        break
+                    except OSError as e:
+                        raise DeliveryError(f"coap recv failed: {e}") from e
+                    try:
+                        reply = coap.parse_message(data)
+                    except coap.CoapError:
+                        continue  # garbled datagram: keep waiting
+                    if reply.mtype == coap.RST and reply.message_id == mid:
+                        raise DeliveryError(
+                            "coap endpoint reset the exchange")
+                    if reply.mtype == coap.ACK and reply.message_id == mid:
+                        if reply.code == 0:
+                            # §5.2.2 separate response: the real reply
+                            # arrives as a CON/NON with our token — wait
+                            # out the remaining total budget
+                            separate = True
+                            attempt_deadline = deadline_total
+                            continue
+                        self._check_code(reply)
+                        return
+                    if reply.mtype in (coap.CON, coap.NON) \
+                            and reply.token == token:
+                        if reply.mtype == coap.CON:
+                            # acknowledge the separate response so the
+                            # device stops retransmitting it
+                            try:
+                                sock.send(coap.encode_message(
+                                    coap.CoapMessage(
+                                        mtype=coap.ACK, code=0,
+                                        message_id=reply.message_id)))
+                            except OSError:
+                                pass
+                        self._check_code(reply)
+                        return
+                    # unrelated datagram: ignore without consuming the
+                    # retransmit budget
+                if separate:
+                    # request WAS acknowledged — retransmitting would be
+                    # a protocol violation; the response just never came
+                    raise DeliveryError(
+                        "coap separate response never arrived")
+                if _time.monotonic() >= deadline_total:
+                    break
+                timeout *= 2  # RFC 7252 §4.2 exponential backoff
+            raise DeliveryError(
+                f"coap delivery timed out (budget {self.max_wait_s}s, "
+                f"{self.max_retransmit + 1} attempts)")
+        finally:
+            sock.close()
+
+
 class CallbackDeliveryProvider:
     """Deliver through any callable — the plug-in point for transports
-    whose client libraries aren't in this image (Twilio SMS, CoAP POST)."""
+    whose client libraries aren't in this image (Twilio SMS)."""
 
     def __init__(self, fn: Callable[[CommandExecution, bytes, Dict[str, str]], None]):
         self.fn = fn

@@ -45,3 +45,6 @@ class CommandExecution:
     # ProtobufMessageBuilder semantic (sitewhere-communication/.../
     # protobuf/DeviceTypeProtoBuilder.java:27).
     parameters: list = dataclasses.field(default_factory=list)
+    # the target device's metadata — per-device delivery parameters
+    # (e.g. coap_host/coap_port, MetadataCoapParameterExtractor.java)
+    device_metadata: dict = dataclasses.field(default_factory=dict)

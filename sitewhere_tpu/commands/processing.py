@@ -120,11 +120,19 @@ class CommandProcessor(LifecycleComponent):
                 )
             elif required:
                 raise ServiceError(f"missing required parameter {name}")
+        device_metadata: dict = {}
+        if invocation.device_token:
+            try:
+                device_metadata = dict(
+                    self.dm.get_device(invocation.device_token).metadata)
+            except Exception:  # metadata is best-effort delivery hints
+                device_metadata = {}
         return CommandExecution(
             invocation=invocation,
             command_name=cmd.name,
             namespace=cmd.namespace,
             parameters=params,
+            device_metadata=device_metadata,
         )
 
     # -- routing + delivery --------------------------------------------------

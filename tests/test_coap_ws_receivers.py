@@ -323,3 +323,157 @@ def test_parse_envelopes_pretty_printed_and_blank_lines():
           b"\n\n"
           b'{"deviceToken":"b","type":"Measurement","request":{"name":"t","value":2}}')
     assert len(parse_envelopes(nd)) == 2
+
+
+# --------------------------------------------------------------------------
+# CoAP command destination (reference: destination/coap/*)
+# --------------------------------------------------------------------------
+
+def test_coap_command_delivery_end_to_end(coap_server):
+    """Command POSTs to the device's CoAP endpoint; the device (our CoAP
+    server here) ACKs and receives the encoded payload."""
+    from sitewhere_tpu.commands.destinations import (
+        CoapDeliveryProvider,
+        CoapParameterExtractor,
+    )
+    from sitewhere_tpu.commands.model import CommandExecution, CommandInvocation
+
+    recv, got = coap_server
+    execution = CommandExecution(
+        invocation=CommandInvocation(
+            command_token="reboot", target_assignment="a-1",
+            device_token="dev-7"),
+        command_name="reboot", namespace="sw",
+        parameters=[("delay", "int32", 5)],
+    )
+    extractor = CoapParameterExtractor(default_port=recv.port,
+                                       path="commands/{device}")
+    params = extractor(execution)
+    assert params["path"] == "commands/dev-7"
+    provider = CoapDeliveryProvider(ack_timeout_s=1.0)
+    provider.deliver(execution, b'{"command":"reboot"}', params)
+    assert got == [b'{"command":"reboot"}']
+
+
+def test_coap_command_delivery_times_out_to_error():
+    import socket as _socket
+
+    from sitewhere_tpu.commands.destinations import (
+        CoapDeliveryProvider,
+        DeliveryError,
+    )
+
+    # a bound-but-silent UDP port: CON never ACKed → DeliveryError
+    s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    try:
+        provider = CoapDeliveryProvider(ack_timeout_s=0.05, max_retransmit=1)
+        with pytest.raises(DeliveryError):
+            provider.deliver(None, b"x", {"host": "127.0.0.1",
+                                          "port": str(port),
+                                          "path": "c"})
+    finally:
+        s.close()
+
+
+def test_coap_separate_response_exchange():
+    """RFC 7252 §5.2.2: empty ACK then a CON response with our token —
+    provider must wait, ACK the response, and evaluate its code."""
+    import socket as _socket
+    import threading as _threading
+
+    from sitewhere_tpu.commands.destinations import CoapDeliveryProvider
+
+    server = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    server.bind(("127.0.0.1", 0))
+    port = server.getsockname()[1]
+    acked = []
+
+    def device():
+        data, addr = server.recvfrom(65536)
+        req = coap.parse_message(data)
+        # 1. empty ACK (separate-response promise)
+        server.sendto(coap.encode_message(coap.CoapMessage(
+            mtype=coap.ACK, code=0, message_id=req.message_id)), addr)
+        # 2. the real response as a CON with the request token
+        server.sendto(coap.encode_message(coap.CoapMessage(
+            mtype=coap.CON, code=coap.CHANGED_204, message_id=0x7777,
+            token=req.token)), addr)
+        # 3. expect the provider to ACK our CON
+        data2, _ = server.recvfrom(65536)
+        ack = coap.parse_message(data2)
+        acked.append((ack.mtype, ack.message_id))
+
+    t = _threading.Thread(target=device, daemon=True)
+    t.start()
+    provider = CoapDeliveryProvider(ack_timeout_s=1.0, max_wait_s=5.0)
+    provider.deliver(None, b"cmd", {"host": "127.0.0.1",
+                                    "port": str(port), "path": "c"})
+    t.join(timeout=5)
+    assert acked == [(coap.ACK, 0x7777)]
+    server.close()
+
+
+def test_coap_stray_datagrams_do_not_consume_attempts():
+    """Garbled datagrams from the endpoint must not burn the retransmit
+    budget (the device's real ACK can arrive late in the window)."""
+    import socket as _socket
+    import threading as _threading
+    import time as _time
+
+    from sitewhere_tpu.commands.destinations import CoapDeliveryProvider
+
+    server = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    server.bind(("127.0.0.1", 0))
+    port = server.getsockname()[1]
+
+    def device():
+        data, addr = server.recvfrom(65536)
+        req = coap.parse_message(data)
+        for _ in range(6):  # more garbage than max_retransmit+1
+            server.sendto(b"\x00garbage", addr)
+        _time.sleep(0.2)
+        server.sendto(coap.encode_message(coap.CoapMessage(
+            mtype=coap.ACK, code=coap.CHANGED_204,
+            message_id=req.message_id, token=req.token)), addr)
+
+    t = _threading.Thread(target=device, daemon=True)
+    t.start()
+    provider = CoapDeliveryProvider(ack_timeout_s=2.0, max_retransmit=1)
+    provider.deliver(None, b"cmd", {"host": "127.0.0.1",
+                                    "port": str(port), "path": "c"})
+    t.join(timeout=5)
+    server.close()
+
+
+def test_command_execution_carries_device_metadata(tmp_path):
+    """build_execution attaches device metadata so CoapParameterExtractor
+    can route to per-device endpoints."""
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+    from sitewhere_tpu.commands.destinations import CoapParameterExtractor
+    from sitewhere_tpu.commands.model import CommandInvocation
+
+    cfg = Config({
+        "instance": {"id": "md", "data_dir": str(tmp_path / "d")},
+        "pipeline": {"width": 32, "registry_capacity": 64,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+    }, apply_env=False)
+    inst = Instance(cfg)
+    try:
+        dm = inst.device_management
+        dt = dm.create_device_type(token="sensor", name="S")
+        dm.create_device_command("sensor", token="reboot", name="reboot")
+        dm.create_device(token="dev-md", device_type="sensor",
+                         metadata={"coap_host": "10.1.2.3",
+                                   "coap_port": "6000"})
+        a = dm.create_device_assignment(device="dev-md")
+        execution = inst.commands.build_execution(CommandInvocation(
+            command_token="reboot", target_assignment=a.token))
+        assert execution.device_metadata["coap_host"] == "10.1.2.3"
+        params = CoapParameterExtractor()(execution)
+        assert params["host"] == "10.1.2.3" and params["port"] == "6000"
+    finally:
+        inst.terminate()
