@@ -13,6 +13,10 @@ kind:
   :class:`~sitewhere_tpu.ingest.decoders.DecodedRequest` objects.
 - ``processor``: ``process(cols: dict, mask) -> None`` — an outbound
   callback body (enriched-batch consumer, the Groovy-processor analog).
+- ``router``:    ``route(execution) -> str`` — a command-destination id
+  (reference ``GroovyCommandRouter.java``).
+- ``encoder``:   ``encode(execution) -> bytes`` — a command payload
+  encoder (reference ``GroovyStringCommandExecutionEncoder.java``).
 
 Versions are immutable and durable (``data_dir/scripts/<name>/v<NNN>.py``
 + a manifest naming the active version), so upload/activate/rollback
@@ -43,8 +47,9 @@ from sitewhere_tpu.services.common import (
 
 logger = logging.getLogger("sitewhere_tpu.scripting")
 
-KINDS = ("decoder", "processor")
-_ENTRY_POINT = {"decoder": "decode", "processor": "process"}
+KINDS = ("decoder", "processor", "router", "encoder")
+_ENTRY_POINT = {"decoder": "decode", "processor": "process",
+                "router": "route", "encoder": "encode"}
 
 
 @dataclass
@@ -281,3 +286,30 @@ class ScriptManager:
             self._active_entry(name, "processor")(cols, mask)
 
         return scripted_process
+
+    def as_router(self, name: str) -> Callable:
+        """A command router (execution → destination id) resolving the
+        active version (reference ``GroovyCommandRouter.java``)."""
+
+        def scripted_route(execution) -> str:
+            return str(self._active_entry(name, "router")(execution))
+
+        return scripted_route
+
+    def as_encoder(self, name: str) -> Callable:
+        """A command payload encoder resolving the active version
+        (reference ``GroovyStringCommandExecutionEncoder.java``)."""
+
+        def scripted_encode(execution) -> bytes:
+            out = self._active_entry(name, "encoder")(execution)
+            if isinstance(out, str):
+                return out.encode()
+            if isinstance(out, (bytes, bytearray)):
+                return bytes(out)
+            # bytes(int) would deliver NUL padding as a command payload;
+            # fail so the invocation dead-letters instead
+            raise ValidationError(
+                f"encoder script {name!r} returned "
+                f"{type(out).__name__}, expected str/bytes")
+
+        return scripted_encode

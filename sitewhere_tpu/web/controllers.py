@@ -284,6 +284,44 @@ def register_routes(gw: RestGateway, inst) -> None:
     r("GET", "/api/assignments/{token}/streams/",
       lambda q: list_streams(q))
 
+    # chart series (reference: Assignments measurements/series endpoints
+    # over ChartBuilder) — also before the generic {kind} route
+    def chart_series(q: Request):
+        from sitewhere_tpu.analytics.charts import build_chart_series
+
+        a = dm.get_device_assignment(q.params["token"])
+        aid = dm.handle_for("assignment", a.token)
+        # repeated params AND comma-separated lists accepted
+        names = [
+            n for raw in q.query.get("measurementIds", [])
+            for n in raw.split(",") if n
+        ]
+        mtype_ids = None
+        if names:
+            mtype_ids = [
+                h for h in (inst.identity.mtype.lookup(n) for n in names)
+                if h != NULL_ID
+            ]
+            if not mtype_ids:
+                return []  # requested names don't exist: empty, not ALL
+
+        def _int_q(key):
+            raw = q.query.get(key, [None])[0]
+            try:
+                return int(raw) if raw is not None else None
+            except ValueError:
+                return None
+
+        return build_chart_series(
+            inst.event_store,
+            assignment_id=aid,
+            mtype_ids=mtype_ids,
+            start_s=_int_q("startDate"),
+            end_s=_int_q("endDate"),
+            mtype_name_of=inst.identity.mtype.token_of,
+        )
+    r("GET", "/api/assignments/{token}/measurements/series", chart_series)
+
     r("POST", "/api/assignments/{token}/{kind}", create_event)
 
     def list_events(q: Request):

@@ -1,0 +1,167 @@
+"""Chart series (ChartBuilder analog) + scripted router/encoder kinds."""
+
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.instance import Instance
+from sitewhere_tpu.runtime.config import Config
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    cfg = Config({
+        "instance": {"id": "charts", "data_dir": str(tmp_path / "d")},
+        "pipeline": {"width": 64, "registry_capacity": 128,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+        "checkpoint": {"interval_s": 0},
+    }, apply_env=False)
+    i = Instance(cfg)
+    i.start()
+    try:
+        yield i
+    finally:
+        i.stop()
+        i.terminate()
+
+
+def _feed(inst, n=30):
+    dm = inst.device_management
+    dm.create_device_type(token="sensor", name="S")
+    dm.create_device(token="c-1", device_type="sensor")
+    a = dm.create_device_assignment(device="c-1")
+    h = inst.identity.device.lookup("c-1")
+    temp = inst.identity.mtype.mint("temp")
+    rpm = inst.identity.mtype.mint("rpm")
+    # interleave two measurement names with DESCENDING timestamps so the
+    # series sort actually does something
+    mt = np.asarray([temp if i % 2 == 0 else rpm for i in range(n)], np.int32)
+    inst.dispatcher.ingest_arrays(
+        device_id=np.full(n, h, np.int32),
+        event_type=np.zeros(n, np.int32),
+        ts_s=(1_753_800_000 + np.arange(n)[::-1]).astype(np.int32),
+        mtype_id=mt,
+        value=np.arange(n, dtype=np.float32),
+    )
+    inst.dispatcher.flush()
+    return a
+
+
+def test_chart_series_grouped_and_sorted(inst):
+    from sitewhere_tpu.analytics.charts import build_chart_series
+
+    a = _feed(inst)
+    aid = inst.device_management.handle_for("assignment", a.token)
+    inst.event_store.flush()
+    series = build_chart_series(
+        inst.event_store, assignment_id=aid,
+        mtype_name_of=inst.identity.mtype.token_of)
+    assert {s["measurement_name"] for s in series} == {"temp", "rpm"}
+    for s in series:
+        t = [e["ts_s"] for e in s["entries"]]
+        assert t == sorted(t)
+        assert len(t) == 15
+
+
+def test_chart_series_rest_endpoint(inst):
+    import http.client
+
+    from sitewhere_tpu.web import WebServer
+
+    a = _feed(inst)
+    web = WebServer(inst, port=0)
+    web.start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", web.port, timeout=5)
+        c.request("POST", "/api/jwt", json.dumps(
+            {"username": "admin", "password": "password"}),
+            {"Content-Type": "application/json"})
+        tok = json.loads(c.getresponse().read())["token"]
+        hdr = {"Authorization": f"Bearer {tok}"}
+        c.request("GET",
+                  f"/api/assignments/{a.token}/measurements/series"
+                  f"?measurementIds=temp", headers=hdr)
+        r = c.getresponse()
+        doc = json.loads(r.read())
+        assert r.status == 200
+        assert len(doc) == 1 and doc[0]["measurement_name"] == "temp"
+        assert len(doc[0]["entries"]) == 15
+    finally:
+        web.stop()
+
+
+def test_scripted_router_and_encoder(inst):
+    from sitewhere_tpu.commands import (
+        CallbackDeliveryProvider,
+        CommandDestination,
+    )
+    from sitewhere_tpu.commands.model import CommandInvocation
+
+    inst.scripts.upload("route-by-type", "router", """
+def route(execution):
+    return "coap" if execution.invocation.device_type_token == "sensor" \
+        else "mqtt"
+""")
+    inst.scripts.upload("json-enc", "encoder", """
+import json
+def encode(execution):
+    return json.dumps({"cmd": execution.command_name})
+""")
+    dm = inst.device_management
+    dm.create_device_type(token="sensor", name="S")
+    dm.create_device_command("sensor", token="reboot", name="reboot")
+    dm.create_device(token="rt-1", device_type="sensor")
+    a = dm.create_device_assignment(device="rt-1")
+
+    delivered = []
+    inst.commands.add_destination(CommandDestination(
+        "coap",
+        encoder=inst.scripts.as_encoder("json-enc"),
+        extractor=lambda ex: {},
+        provider=CallbackDeliveryProvider(
+            lambda ex, payload, params: delivered.append(payload)),
+    ))
+    inst.commands.router = inst.scripts.as_router("route-by-type")
+    inst.commands.invoke(CommandInvocation(
+        command_token="reboot", target_assignment=a.token))
+    assert delivered == [b'{"cmd": "reboot"}']
+
+
+def test_chart_series_unknown_measurement_returns_empty(inst):
+    import http.client
+
+    from sitewhere_tpu.web import WebServer
+
+    a = _feed(inst)
+    web = WebServer(inst, port=0)
+    web.start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", web.port, timeout=5)
+        c.request("POST", "/api/jwt", json.dumps(
+            {"username": "admin", "password": "password"}),
+            {"Content-Type": "application/json"})
+        tok = json.loads(c.getresponse().read())["token"]
+        hdr = {"Authorization": f"Bearer {tok}"}
+        c.request("GET",
+                  f"/api/assignments/{a.token}/measurements/series"
+                  f"?measurementIds=bogus", headers=hdr)
+        r = c.getresponse()
+        assert r.status == 200 and json.loads(r.read()) == []
+        # comma-separated form resolves both names
+        c.request("GET",
+                  f"/api/assignments/{a.token}/measurements/series"
+                  f"?measurementIds=temp,rpm", headers=hdr)
+        doc = json.loads(c.getresponse().read())
+        assert {s["measurement_name"] for s in doc} == {"temp", "rpm"}
+    finally:
+        web.stop()
+
+
+def test_encoder_script_bad_return_type_rejected(inst):
+    from sitewhere_tpu.services.common import ValidationError
+
+    inst.scripts.upload("bad-enc", "encoder", "def encode(ex):\n    return 5\n")
+    with pytest.raises(ValidationError):
+        inst.scripts.as_encoder("bad-enc")(None)
