@@ -4,7 +4,7 @@ Reference: ``ICommandRouter`` impls — ``DeviceTypeMappingCommandRouter``
 (device-type token → destination id with a default fallback) and the
 scripted router (``service-command-delivery/.../routing/``).  The scripted
 variant is any callable registered through
-:mod:`sitewhere_tpu.scripting`.
+:mod:`sitewhere_tpu.runtime.scripting`.
 """
 
 from __future__ import annotations

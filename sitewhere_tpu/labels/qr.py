@@ -10,7 +10,7 @@ format/version info.
 
 The output is a numpy ``uint8[N, N]`` module matrix (1 = dark).  Rendering
 to PNG lives in :mod:`sitewhere_tpu.labels.png`; batched rendering for the
-mixed-workload benchmark in :mod:`sitewhere_tpu.labels.render`.
+mixed-workload benchmark in :mod:`sitewhere_tpu.labels.png`.
 
 A structural decoder (:func:`decode_matrix`) is included so tests can
 round-trip: it re-extracts codewords from the matrix, verifies the

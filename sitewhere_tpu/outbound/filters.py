@@ -6,7 +6,7 @@ filter, applied per event by ``FilteredOutboundConnector``.  Here a filter
 maps a *column batch* to a boolean mask in one numpy expression, so
 filtering N events costs one vector op instead of N callbacks; the script
 filter takes a callable over the columns (the
-:mod:`sitewhere_tpu.scripting` extension point).
+:mod:`sitewhere_tpu.runtime.scripting` extension point).
 
 Operation modes follow the reference: ``include=True`` passes only matching
 events, ``include=False`` (exclude) blocks matching events.  A connector's

@@ -11,8 +11,9 @@ Axes:
 - ``shard`` — data axis: event batches (along B) and registry/state tensors
   (along D) are block-sharded over it.  This is the analog of Kafka
   partition count + consumer-group scale-out (SURVEY.md §2.4).
-- ``model`` — tensor-parallel axis for the analytics model family
-  (:mod:`sitewhere_tpu.models`); size 1 for the pure event pipeline.
+- ``model`` — reserved second axis for model-parallel analytics
+  workloads; size 1 for the event pipeline (every current program
+  shards only the ``shard`` axis).
 """
 
 from __future__ import annotations
