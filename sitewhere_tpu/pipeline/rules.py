@@ -128,6 +128,49 @@ class RuleManager:
                 if tenant is None or r.tenant in (None, tenant)
             ]
 
+    def update_rule(self, token: str, **fields) -> ThresholdRule:
+        """Mutate a rule; the next publish rebuilds the table (reference:
+        rule processors are reconfigured through tenant config updates +
+        engine restart — here it's one epoch swap).
+
+        Stage-validate-then-apply: a bad field leaves the rule (and the
+        publishable catalog) completely untouched.
+        """
+        allowed = {"mtype", "op", "threshold", "alert_type", "alert_level",
+                   "tenant", "kind", "window_s"}
+        unknown = set(fields) - allowed
+        require(not unknown, ValidationError(f"unknown fields {sorted(unknown)}"))
+        staged = {}
+        try:
+            for k, v in fields.items():
+                if k == "op":
+                    v = ComparisonOp(v)
+                elif k == "alert_level":
+                    v = AlertLevel(v)
+                elif k == "kind":
+                    v = RuleKind(v)
+                elif k == "threshold":
+                    v = float(v)  # None rejected: publish needs a number
+                elif k == "window_s" and v is not None:
+                    v = float(v)
+                staged[k] = v
+        except (TypeError, ValueError) as e:
+            raise ValidationError(f"bad value for {k!r}: {e}") from e
+        if "alert_type" in staged:
+            require(bool(staged["alert_type"]),
+                    ValidationError("alert_type required"))
+        with self._lock:
+            rule = self.get_rule(token)
+            kind = staged.get("kind", rule.kind)
+            window_s = staged.get("window_s", rule.window_s)
+            if kind == RuleKind.WINDOW_MEAN:
+                require(window_s is not None and window_s > 0,
+                        ValidationError("WINDOW_MEAN rule needs window_s > 0"))
+            for k, v in staged.items():
+                setattr(rule, k, v)
+            self._dirty = True
+            return rule
+
     def delete_rule(self, token: str) -> ThresholdRule:
         with self._lock:
             rule = self.get_rule(token)

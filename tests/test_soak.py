@@ -1,0 +1,192 @@
+"""Concurrency soak: every subsystem churning at once on the 8-shard mesh.
+
+The reference's concurrency model was executor confinement validated
+manually against Helm deployments (SURVEY.md §4); this drives ingest
+threads, REST-style reads, presence sweeps, engine restarts, rule
+mutations, and periodic checkpoints CONCURRENTLY and then asserts the
+books balance — the closest thing to a race detector the test suite has.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.instance import Instance
+from sitewhere_tpu.runtime.config import Config
+
+
+@pytest.mark.slow
+def test_everything_at_once_stays_consistent(tmp_path):
+    cfg = Config({
+        "instance": {"id": "soak", "data_dir": str(tmp_path / "d")},
+        "pipeline": {"width": 256, "registry_capacity": 1024,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 8},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+        "checkpoint": {"interval_s": 0.3},
+        "tracing": {"sample_rate": 0.1},
+    }, apply_env=False)
+    inst = Instance(cfg)
+    inst.start()
+    errors = []
+    sent = [0, 0]  # per ingest thread
+    stop = threading.Event()
+
+    try:
+        inst.tenants.create_tenant(token="acme", name="Acme",
+                                   auth_token="acme-auth-123456")
+        eng = inst.engines.get_engine("acme")
+        for dm, prefix in ((inst.device_management, "d"),
+                           (eng.device_management, "a")):
+            dm.create_device_type(token="sensor", name="S")
+            for i in range(100):
+                dm.create_device(token=f"{prefix}-{i}", device_type="sensor")
+                dm.create_device_assignment(device=f"{prefix}-{i}")
+        inst.rules.create_rule(mtype="temp", op=0, threshold=90.0,
+                               alert_type="hot", token="r0")
+        temp = inst.identity.mtype.mint("temp")
+
+        def ingest(slot, prefix, tenant_id):
+            rng = np.random.default_rng(slot)
+            handles = np.asarray(inst.identity.device.lookup_many(
+                [f"{prefix}-{i}" for i in range(100)]), np.int32)
+            try:
+                while not stop.is_set():
+                    n = 64
+                    inst.dispatcher.ingest_arrays(
+                        device_id=handles[rng.integers(0, 100, n)],
+                        tenant_id=np.full(n, tenant_id, np.int32),
+                        event_type=np.zeros(n, np.int32),
+                        ts_s=np.full(n, 1_753_800_000 + sent[slot], np.int32),
+                        mtype_id=np.full(n, temp, np.int32),
+                        value=rng.uniform(0, 80, n).astype(np.float32),
+                    )
+                    sent[slot] += n
+            except Exception as e:  # pragma: no cover
+                errors.append(("ingest", e))
+
+        def churn():
+            rng = np.random.default_rng(99)
+            try:
+                k = 0
+                while not stop.is_set():
+                    k += 1
+                    inst.engines.restart_engine("acme")
+                    inst.mirror.publish_registry()
+                    inst.device_state.summary()
+                    inst.dispatcher.metrics_snapshot()
+                    inst.topology()
+                    if k % 3 == 0:
+                        inst.rules.update_rule(
+                            "r0", threshold=float(rng.uniform(50, 99)))
+                    time.sleep(0.02)
+            except Exception as e:  # pragma: no cover
+                errors.append(("churn", e))
+
+        default_id = inst.identity.tenant.lookup("default")
+        acme_id = eng.tenant_id
+        threads = [
+            threading.Thread(target=ingest, args=(0, "d", default_id)),
+            threading.Thread(target=ingest, args=(1, "a", acme_id)),
+            threading.Thread(target=churn),
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(6.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        inst.dispatcher.flush()
+
+        assert not errors, errors
+        snap = inst.dispatcher.metrics_snapshot()
+        total = sent[0] + sent[1]
+        # books balance: every ingested row + every derived alert was
+        # processed + accepted exactly once, and everything persisted
+        derived = snap["derived_alerts"]
+        assert snap["processed"] == total + derived
+        assert snap["accepted"] == total + derived
+        assert snap["unregistered"] == 0
+        assert inst.event_store.total_events == total + derived
+        # a checkpoint landed while everything churned
+        assert inst.checkpointer.generation >= 0
+        # engine survived its restarts with model intact
+        assert eng.device_management.get_device("a-0") is not None
+    finally:
+        stop.set()
+        inst.stop()
+        inst.terminate()
+
+
+def test_presence_sweep_on_sharded_state(tmp_path):
+    """apply_presence_sweep over the mesh-sharded state epoch keeps the
+    sharding and flags exactly the stale devices."""
+    cfg = Config({
+        "instance": {"id": "presence8", "data_dir": str(tmp_path / "d")},
+        "pipeline": {"width": 64, "registry_capacity": 256,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 8},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 100},
+        "checkpoint": {"interval_s": 0},
+    }, apply_env=False)
+    inst = Instance(cfg)
+    inst.start()
+    try:
+        dm = inst.device_management
+        dm.create_device_type(token="sensor", name="S")
+        for i in range(16):
+            dm.create_device(token=f"p-{i}", device_type="sensor")
+            dm.create_device_assignment(device=f"p-{i}")
+        handles = np.asarray(inst.identity.device.lookup_many(
+            [f"p-{i}" for i in range(16)]), np.int32)
+        # half the devices report at t0, half at t0+500
+        ts = np.where(np.arange(16) % 2 == 0,
+                      1_753_800_000, 1_753_800_500).astype(np.int32)
+        inst.dispatcher.ingest_arrays(
+            device_id=handles, event_type=np.zeros(16, np.int32),
+            ts_s=ts, mtype_id=np.zeros(16, np.int32),
+            value=np.ones(16, np.float32))
+        inst.dispatcher.flush()
+        assert len(inst.device_state.current
+                   .last_event_ts_s.sharding.device_set) == 8
+
+        batch = inst.device_state.apply_presence_sweep(
+            now_s=1_753_800_201, missing_after_s=100)
+        missing = set(inst.device_state.missing_device_ids())
+        expect = {int(h) for h, i in zip(handles, range(16)) if i % 2 == 0}
+        assert missing == expect
+        assert batch is not None  # STATE_CHANGE batch for the stale half
+        # state stays sharded after the sweep
+        assert len(inst.device_state.current
+                   .last_event_ts_s.sharding.device_set) == 8
+    finally:
+        inst.stop()
+        inst.terminate()
+
+
+def test_update_rule_validates_atomically(tmp_path):
+    from sitewhere_tpu.ids import IdentityMap
+    from sitewhere_tpu.pipeline.rules import RuleManager
+    from sitewhere_tpu.schema import ComparisonOp, RuleKind
+    from sitewhere_tpu.services.common import ValidationError
+
+    rm = RuleManager(IdentityMap(64))
+    rm.create_rule(mtype="temp", op=ComparisonOp.GT, threshold=90.0,
+                   alert_type="hot", token="r")
+
+    # WINDOW_MEAN without window_s: rejected, rule untouched
+    with pytest.raises(ValidationError):
+        rm.update_rule("r", kind=RuleKind.WINDOW_MEAN)
+    assert rm.get_rule("r").kind == RuleKind.INSTANT
+
+    # None threshold / bad enum / empty alert_type all rejected cleanly
+    for bad in ({"threshold": None}, {"op": "bogus"}, {"alert_type": ""}):
+        with pytest.raises(ValidationError):
+            rm.update_rule("r", **bad)
+    assert rm.get_rule("r").threshold == 90.0
+
+    rm.update_rule("r", threshold=70.0, kind=RuleKind.WINDOW_MEAN,
+                   window_s=600.0)
+    table = rm.publish()  # publish still works after mutations
+    import numpy as np
+    assert float(np.asarray(table.threshold)[rm._slots["r"]]) == 70.0
