@@ -20,7 +20,7 @@ from typing import Optional
 
 from sitewhere_tpu.ids import NULL_ID
 from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
-from sitewhere_tpu.schema import AlertLevel, ComparisonOp, EventType
+from sitewhere_tpu.schema import AlertLevel, ComparisonOp, EventType, RuleKind
 from sitewhere_tpu.services.common import (
     AuthError,
     EntityNotFound,
@@ -28,6 +28,24 @@ from sitewhere_tpu.services.common import (
     require,
 )
 from sitewhere_tpu.web.http import RawResponse, Request, RestGateway, page_response
+
+def _enum_arg(enum_cls, raw, field: str):
+    """Name ('GT'/'window_mean') or value (0) → enum member, 400 on junk
+    (GET serializes enums as ints, so round-tripping a doc must work)."""
+    try:
+        if isinstance(raw, str) and not raw.isdigit():
+            return enum_cls[raw.upper()]
+        return enum_cls(int(raw))
+    except (KeyError, ValueError, TypeError):
+        raise ValidationError(f"bad {field}: {raw!r}")
+
+
+def _float_arg(raw, field: str) -> float:
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        raise ValidationError(f"bad {field}: {raw!r}")
+
 
 _EVENT_TYPE_NAMES = {
     "measurements": EventType.MEASUREMENT,
@@ -464,17 +482,45 @@ def register_routes(gw: RestGateway, inst) -> None:
         body = q.json()
         return inst.rules.create_rule(
             mtype=body.get("mtype"),
-            op=ComparisonOp[str(body.get("op", "GT")).upper()],
-            threshold=float(body.get("threshold", 0.0)),
+            op=_enum_arg(ComparisonOp, body.get("op", "GT"), "op"),
+            threshold=_float_arg(body.get("threshold", 0.0), "threshold"),
             alert_type=str(body.get("alertType", "")),
-            alert_level=AlertLevel(int(body.get("alertLevel",
-                                                AlertLevel.WARNING))),
+            alert_level=_enum_arg(AlertLevel,
+                                  body.get("alertLevel", AlertLevel.WARNING),
+                                  "alertLevel"),
             tenant=body.get("tenant"),
             token=body.get("token"),
+            kind=_enum_arg(RuleKind, body.get("kind", "INSTANT"), "kind"),
+            window_s=(_float_arg(body["windowS"], "windowS")
+                      if body.get("windowS") is not None else None),
         )
+
+    def update_rule(q):
+        body = q.json()
+        fields = {}
+        if "mtype" in body:
+            fields["mtype"] = body["mtype"]
+        if "op" in body:
+            fields["op"] = _enum_arg(ComparisonOp, body["op"], "op")
+        if "threshold" in body:
+            fields["threshold"] = body["threshold"]
+        if "alertType" in body:
+            fields["alert_type"] = body["alertType"]
+        if "alertLevel" in body:
+            fields["alert_level"] = _enum_arg(AlertLevel,
+                                              body["alertLevel"],
+                                              "alertLevel")
+        if "kind" in body:
+            fields["kind"] = _enum_arg(RuleKind, body["kind"], "kind")
+        if "windowS" in body:
+            fields["window_s"] = body["windowS"]
+        return inst.rules.update_rule(q.params["token"], **fields)
 
     r("GET", "/api/rules", lambda q: inst.rules.list_rules(q.q1("tenant")))
     r("POST", "/api/rules", create_rule)
+    r("GET", "/api/rules/{token}",
+      lambda q: inst.rules.get_rule(q.params["token"]))
+    r("PUT", "/api/rules/{token}", update_rule)
     r("DELETE", "/api/rules/{token}",
       lambda q: inst.rules.delete_rule(q.params["token"]))
 

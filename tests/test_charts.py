@@ -165,3 +165,85 @@ def test_encoder_script_bad_return_type_rejected(inst):
     inst.scripts.upload("bad-enc", "encoder", "def encode(ex):\n    return 5\n")
     with pytest.raises(ValidationError):
         inst.scripts.as_encoder("bad-enc")(None)
+
+
+def test_rule_rest_crud_with_kinds(inst):
+    import http.client
+
+    from sitewhere_tpu.web import WebServer
+
+    web = WebServer(inst, port=0)
+    web.start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", web.port, timeout=5)
+        c.request("POST", "/api/jwt", json.dumps(
+            {"username": "admin", "password": "password"}),
+            {"Content-Type": "application/json"})
+        tok = json.loads(c.getresponse().read())["token"]
+        hdr = {"Authorization": f"Bearer {tok}",
+               "Content-Type": "application/json"}
+
+        c.request("POST", "/api/rules", json.dumps({
+            "token": "w1", "mtype": "temp", "op": "GT", "threshold": 50,
+            "alertType": "hot", "kind": "WINDOW_MEAN", "windowS": 600,
+        }), hdr)
+        r = c.getresponse()
+        doc = json.loads(r.read())
+        assert r.status == 200 and doc["kind"] == 1  # WINDOW_MEAN
+
+        c.request("PUT", "/api/rules/w1", json.dumps(
+            {"threshold": 75, "kind": "RATE_PER_S"}), hdr)
+        r = c.getresponse()
+        doc = json.loads(r.read())
+        assert r.status == 200 and doc["threshold"] == 75.0
+
+        c.request("GET", "/api/rules/w1", headers=hdr)
+        doc = json.loads(c.getresponse().read())
+        assert doc["kind"] == 2  # RATE_PER_S
+
+        # bad update → 400, rule intact
+        c.request("PUT", "/api/rules/w1", json.dumps(
+            {"threshold": None}), hdr)
+        r = c.getresponse()
+        r.read()
+        assert r.status == 400
+    finally:
+        web.stop()
+
+
+def test_rule_rest_roundtrip_and_bad_enums(inst):
+    """GET serializes enums as ints; PUTting the doc back must work, and
+    junk enum values must 400 (not 500)."""
+    import http.client
+
+    from sitewhere_tpu.web import WebServer
+
+    web = WebServer(inst, port=0)
+    web.start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", web.port, timeout=5)
+        c.request("POST", "/api/jwt", json.dumps(
+            {"username": "admin", "password": "password"}),
+            {"Content-Type": "application/json"})
+        tok = json.loads(c.getresponse().read())["token"]
+        hdr = {"Authorization": f"Bearer {tok}",
+               "Content-Type": "application/json"}
+        c.request("POST", "/api/rules", json.dumps({
+            "token": "rt", "mtype": "t", "op": "GT", "threshold": 10,
+            "alertType": "a"}), hdr)
+        c.getresponse().read()
+        c.request("GET", "/api/rules/rt", headers=hdr)
+        doc = json.loads(c.getresponse().read())
+        doc["threshold"] = 20
+        c.request("PUT", "/api/rules/rt", json.dumps(doc), hdr)
+        r = c.getresponse()
+        out = json.loads(r.read())
+        assert r.status == 200 and out["threshold"] == 20.0
+        for bad in ({"kind": "weekly"}, {"op": "~="},
+                    {"windowS": "ten minutes", "kind": "WINDOW_MEAN"}):
+            c.request("PUT", "/api/rules/rt", json.dumps(bad), hdr)
+            r = c.getresponse()
+            r.read()
+            assert r.status == 400, bad
+    finally:
+        web.stop()
