@@ -1,0 +1,36 @@
+"""Cross-host RPC fabric (the ``sitewhere-grpc-client`` analog).
+
+The reference moves every cross-service call over gRPC channels with
+round-robin demux, JWT/tenant metadata, and near-caches
+(SURVEY.md §1 L3).  On TPU, in-slice "calls" are tensor gathers inside
+the fused step; RPC survives only at the host boundary (§2.4) — this
+package is that boundary: framed-TCP wire (`wire`), multiplexing
+channels + replica demux with backoff/failover (`channel`), the
+lifecycle server with JWT/tenant/tracing interceptors (`server`), the
+instance's domain surface + cached client facades (`services`), and
+keyed cross-host event forwarding (`forward`).
+"""
+
+from sitewhere_tpu.rpc.channel import (
+    ChannelUnavailable,
+    RpcChannel,
+    RpcDemux,
+    RpcError,
+)
+from sitewhere_tpu.rpc.forward import HostForwarder, owning_process, split_lines
+from sitewhere_tpu.rpc.server import CallContext, RpcServer
+from sitewhere_tpu.rpc.services import RemoteDeviceManagement, bind_instance
+
+__all__ = [
+    "CallContext",
+    "ChannelUnavailable",
+    "HostForwarder",
+    "RemoteDeviceManagement",
+    "RpcChannel",
+    "RpcDemux",
+    "RpcError",
+    "RpcServer",
+    "bind_instance",
+    "owning_process",
+    "split_lines",
+]

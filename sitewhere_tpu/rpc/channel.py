@@ -1,0 +1,326 @@
+"""Client side of the RPC fabric: channels + replica demux.
+
+Reference: ``ApiDemux.java:42-110`` keeps one ``ApiChannel`` per
+discovered replica hostname, routes calls round-robin
+(``RoundRobinDemuxRoutingStrategy.java``), re-resolves topology every 5s,
+and ``waitForApiChannel`` backs off 100ms→60s until a replica is
+reachable.  ``MultitenantGrpcChannel`` stamps JWT + tenant tokens onto
+every call (``JwtClientInterceptor.java``,
+``TenantTokenClientInterceptor.java:53-57``).
+
+This module keeps those *semantics* — per-replica channels, round-robin
+with failover, exponential reconnect backoff, header stamping — over the
+plain framed-TCP wire (`wire.py`) instead of gRPC/HTTP2.  One channel
+multiplexes concurrent calls by request id (a reader thread correlates
+responses), so callers never queue behind each other's round trips.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from sitewhere_tpu.rpc import wire
+
+logger = logging.getLogger("sitewhere_tpu.rpc")
+
+BACKOFF_INITIAL_S = 0.1
+BACKOFF_MAX_S = 60.0   # ApiDemux.java:47-52
+
+
+class RpcError(Exception):
+    """Server-side failure surfaced to the caller."""
+
+    def __init__(self, error: str, message: str):
+        super().__init__(f"{error}: {message}")
+        self.error = error
+        self.message = message
+
+
+class ChannelUnavailable(Exception):
+    """No connection could be established / the connection died mid-call."""
+
+
+class _Pending:
+    __slots__ = ("event", "frame")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.frame: Optional[wire.Frame] = None
+
+
+class RpcChannel:
+    """One connection to one replica, multiplexing concurrent calls.
+
+    ``token_provider`` supplies the JWT stamped into the
+    ``authorization`` header per call (the ``JwtClientInterceptor``
+    analog — a provider, not a fixed string, so token refresh needs no
+    channel restart); ``tenant`` rides the ``tenant`` header
+    (``TenantTokenClientInterceptor`` analog).
+    """
+
+    def __init__(self, endpoint: str,
+                 token_provider: Optional[Callable[[], str]] = None,
+                 tenant: Optional[str] = None,
+                 connect_timeout_s: float = 5.0):
+        self.endpoint = endpoint
+        self._addr = wire.parse_endpoint(endpoint)
+        self._token_provider = token_provider
+        self._tenant = tenant
+        self._connect_timeout_s = connect_timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._reader: Optional[threading.Thread] = None
+        self._lock = threading.Lock()          # connection state transitions
+        self._write_lock = threading.Lock()    # frame sendall only
+        self._pending: Dict[int, _Pending] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = itertools.count(1)
+        self._closed = False
+        # reconnect backoff state (exponential, 100ms → 60s)
+        self._backoff_s = BACKOFF_INITIAL_S
+        self._retry_at = 0.0
+
+    # -- connection management ---------------------------------------------
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def in_backoff(self) -> bool:
+        return not self.connected and time.monotonic() < self._retry_at
+
+    def _connect_locked(self) -> None:
+        if self._sock is not None or self._closed:
+            return
+        now = time.monotonic()
+        if now < self._retry_at:
+            raise ChannelUnavailable(
+                f"{self.endpoint} in backoff for {self._retry_at - now:.1f}s")
+        try:
+            sock = socket.create_connection(
+                self._addr, timeout=self._connect_timeout_s)
+        except OSError as e:
+            self._retry_at = now + self._backoff_s
+            self._backoff_s = min(self._backoff_s * 2, BACKOFF_MAX_S)
+            raise ChannelUnavailable(f"{self.endpoint}: {e}") from e
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._backoff_s = BACKOFF_INITIAL_S
+        self._retry_at = 0.0
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(sock,),
+            name=f"rpc-reader-{self.endpoint}", daemon=True)
+        self._reader.start()
+
+    def ensure_connected(self) -> None:
+        with self._lock:
+            self._connect_locked()
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                frame = wire.read_frame(sock)
+                if not frame.is_response:
+                    logger.warning("%s: unexpected request frame from server",
+                                   self.endpoint)
+                    continue
+                with self._pending_lock:
+                    pending = self._pending.pop(frame.request_id, None)
+                if pending is not None:
+                    pending.frame = frame
+                    pending.event.set()
+        except (ConnectionError, OSError, wire.WireError) as e:
+            self._drop(sock, e)
+
+    def _drop(self, sock: socket.socket, exc: Exception) -> None:
+        """Connection died: fail every in-flight call so callers can
+        fail over to another replica instead of hanging."""
+        with self._lock:
+            if self._sock is sock:
+                self._sock = None
+        try:
+            sock.close()
+        except OSError:
+            pass
+        with self._pending_lock:
+            stranded, self._pending = self._pending, {}
+        for p in stranded.values():
+            p.event.set()   # frame stays None → ChannelUnavailable
+        if stranded and not self._closed:
+            logger.info("%s: connection dropped (%s); %d calls failed over",
+                        self.endpoint, exc, len(stranded))
+
+    # -- calls ---------------------------------------------------------------
+
+    def call(self, method: str, body: object = None,
+             attachment: bytes = b"",
+             headers: Optional[Dict[str, str]] = None,
+             timeout_s: float = 30.0) -> Tuple[object, bytes]:
+        """One request/reply round trip.  Returns ``(body, attachment)``.
+
+        Raises :class:`RpcError` for server-reported failures,
+        :class:`ChannelUnavailable` for transport failures (the demux
+        catches the latter and fails over).
+        """
+        hdrs = dict(headers or {})
+        if self._token_provider is not None and "authorization" not in hdrs:
+            hdrs["authorization"] = self._token_provider()
+        if self._tenant is not None and "tenant" not in hdrs:
+            hdrs["tenant"] = self._tenant
+        # Encode BEFORE taking any lock, and connect under the state lock
+        # only (bounded by connect_timeout); the write lock serializes just
+        # the sendall so a slow large-attachment writer never stalls other
+        # callers' connect/registration — their own timeout_s governs.
+        pending = _Pending()
+        with self._lock:
+            self._connect_locked()
+            sock = self._sock
+        if sock is None:
+            raise ChannelUnavailable(f"{self.endpoint}: not connected")
+        request_id = next(self._next_id)
+        frame_bytes = wire.encode(wire.request_frame(
+            request_id, method, body, hdrs, attachment))
+        with self._pending_lock:
+            self._pending[request_id] = pending
+        try:
+            with self._write_lock:
+                sock.sendall(frame_bytes)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(request_id, None)
+            self._drop(sock, e)
+            raise ChannelUnavailable(f"{self.endpoint}: {e}") from e
+        if not pending.event.wait(timeout_s):
+            with self._pending_lock:
+                self._pending.pop(request_id, None)
+            raise ChannelUnavailable(
+                f"{self.endpoint}: timeout after {timeout_s}s on {method}")
+        frame = pending.frame
+        if frame is None:
+            raise ChannelUnavailable(f"{self.endpoint}: connection lost")
+        if frame.is_error:
+            err = frame.body if isinstance(frame.body, dict) else {}
+            raise RpcError(err.get("error", "internal"),
+                           err.get("message", "unknown error"))
+        return frame.body, frame.attachment
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        with self._pending_lock:
+            stranded, self._pending = self._pending, {}
+        for p in stranded.values():
+            p.event.set()
+
+
+class RpcDemux:
+    """Round-robin demux over replica channels with failover.
+
+    Reference semantics preserved from ``ApiDemux.java``: a channel per
+    replica, round-robin routing, calls fail over to the next replica on
+    transport errors, ``wait_for_channel`` blocks until any replica is
+    reachable, and ``set_endpoints`` is the discovery-update hook (the
+    Consul watch analog — topology is pushed in, not polled here).
+    """
+
+    def __init__(self, endpoints: List[str],
+                 token_provider: Optional[Callable[[], str]] = None,
+                 tenant: Optional[str] = None,
+                 connect_timeout_s: float = 5.0):
+        self._token_provider = token_provider
+        self._tenant = tenant
+        self._connect_timeout_s = connect_timeout_s
+        self._lock = threading.Lock()
+        self._channels: Dict[str, RpcChannel] = {}
+        self._rr = 0
+        self.set_endpoints(endpoints)
+
+    def _make_channel(self, endpoint: str) -> RpcChannel:
+        return RpcChannel(endpoint, token_provider=self._token_provider,
+                          tenant=self._tenant,
+                          connect_timeout_s=self._connect_timeout_s)
+
+    def set_endpoints(self, endpoints: List[str]) -> None:
+        """Reconcile the channel set against a new replica list
+        (add/remove, existing connections kept — ApiDemux discovery
+        monitor semantics)."""
+        with self._lock:
+            for ep in endpoints:
+                if ep not in self._channels:
+                    self._channels[ep] = self._make_channel(ep)
+            for ep in list(self._channels):
+                if ep not in endpoints:
+                    self._channels.pop(ep).close()
+
+    @property
+    def endpoints(self) -> List[str]:
+        with self._lock:
+            return list(self._channels)
+
+    def _rotation(self) -> List[RpcChannel]:
+        with self._lock:
+            chans = list(self._channels.values())
+            if not chans:
+                return []
+            start = self._rr % len(chans)
+            self._rr += 1
+        return chans[start:] + chans[:start]
+
+    def call(self, method: str, body: object = None,
+             attachment: bytes = b"",
+             headers: Optional[Dict[str, str]] = None,
+             timeout_s: float = 30.0) -> Tuple[object, bytes]:
+        """Round-robin call with failover: transport failures rotate to
+        the next replica; server-reported errors (RpcError) do NOT fail
+        over — the reference likewise retries only channel faults, not
+        application faults."""
+        rotation = self._rotation()
+        if not rotation:
+            raise ChannelUnavailable("no endpoints configured")
+        last: Optional[Exception] = None
+        for chan in rotation:
+            if chan.in_backoff() and len(rotation) > 1:
+                last = last or ChannelUnavailable(
+                    f"{chan.endpoint} in backoff")
+                continue
+            try:
+                return chan.call(method, body, attachment, headers, timeout_s)
+            except ChannelUnavailable as e:
+                last = e
+        raise last if last is not None else ChannelUnavailable("no replicas")
+
+    def wait_for_channel(self, timeout_s: float = 60.0) -> RpcChannel:
+        """Block until any replica is connectable
+        (``ApiDemux.waitForApiChannel`` — backoff handled per-channel)."""
+        deadline = time.monotonic() + timeout_s
+        sleep = BACKOFF_INITIAL_S
+        while True:
+            for chan in self._rotation():
+                try:
+                    chan.ensure_connected()
+                    return chan
+                except ChannelUnavailable:
+                    continue
+            if time.monotonic() >= deadline:
+                raise ChannelUnavailable(
+                    f"no replica reachable within {timeout_s}s")
+            time.sleep(min(sleep, max(0.0, deadline - time.monotonic())))
+            sleep = min(sleep * 2, BACKOFF_MAX_S)
+
+    def close(self) -> None:
+        with self._lock:
+            chans = list(self._channels.values())
+            self._channels.clear()
+        for chan in chans:
+            chan.close()

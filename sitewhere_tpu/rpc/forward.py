@@ -1,0 +1,261 @@
+"""Cross-host event routing at the ingest boundary.
+
+Reference scaling story (SURVEY.md §2.4): producers key every Kafka
+record by device token (``MicroserviceKafkaProducer.java:106``,
+``EventSourcesManager.java:166``), the key hash picks a partition, and
+partition leadership pins that device's stream to one broker — giving
+per-device ordering and horizontal scale-out.
+
+TPU translation: each HOST in the multi-host mesh owns the shards its
+local devices live on (``parallel/multihost.py``).  A device protocol
+frontend, however, terminates wherever the device connected — so rows
+that belong to another host's shards must cross DCN exactly once, at the
+host plane, before entering the owning host's batcher.  That hop is this
+module: a stable token hash picks the owning process (the partition-key
+analog), local rows go straight to the local dispatcher's columnar wire
+intake, and remote rows batch up per peer and ship over the RPC fabric's
+binary lane (``events.ingest``) — journaled and processed by the OWNER,
+preserving the reference's per-device ordering and at-least-once
+placement (the journal lives where the offsets live, exactly like a
+partition's log living on its leader).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.rpc.channel import ChannelUnavailable, RpcDemux, RpcError
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+
+logger = logging.getLogger("sitewhere_tpu.rpc")
+
+
+def owning_process(device_token: str, n_processes: int) -> int:
+    """Stable token → process mapping (Kafka's murmur2-keyed partition
+    analog).  crc32 is stable across processes and Python runs — the
+    builtin ``hash`` is salted per process and MUST NOT be used here."""
+    return zlib.crc32(device_token.encode("utf-8")) % n_processes
+
+
+def split_lines(payload: bytes, n_processes: int) -> Dict[int, List[bytes]]:
+    """Split one NDJSON wire payload into per-owner line lists.
+
+    Lines that don't parse or carry no device token stay with the LOCAL
+    intake (owner -1): the local dispatcher's decode path is the one
+    that dead-letters them with full diagnostics, matching the
+    failed-decode topic contract (``EventSourcesManager.java:189``).
+    """
+    out: Dict[int, List[bytes]] = {}
+    for line in payload.splitlines():
+        if not line.strip():
+            continue
+        owner = -1
+        try:
+            env = json.loads(line)
+            token = (env.get("deviceToken") or env.get("hardwareId")
+                     if isinstance(env, dict) else None)
+            if token:
+                owner = owning_process(str(token), n_processes)
+        except (ValueError, UnicodeDecodeError):
+            pass
+        out.setdefault(owner, []).append(line)
+    return out
+
+
+class HostForwarder(LifecycleComponent):
+    """Per-host ingest boundary: local rows in-process, remote rows over
+    the fabric, batched per peer under a flush deadline.
+
+    ``peer_demuxes[p]`` is the :class:`RpcDemux` for process ``p``
+    (``None`` at the local index).  Buffered remote rows flush when the
+    buffer reaches ``max_buffer_bytes`` or ``deadline_ms`` elapses —
+    the producer-side linger/batch knobs every Kafka producer has.  A
+    peer that stays unreachable past ``max_retries`` flushes dead-letters
+    the batch locally (at-least-once preserved: rows are never dropped
+    silently, the dead-letter journal is replayable).
+    """
+
+    def __init__(self, dispatcher, process_id: int,
+                 peer_demuxes: Dict[int, Optional[RpcDemux]],
+                 dead_letters=None,
+                 deadline_ms: float = 25.0,
+                 max_buffer_bytes: int = 1 << 20,
+                 max_retries: int = 3,
+                 name: str = "host-forwarder"):
+        super().__init__(name)
+        self.dispatcher = dispatcher
+        self.process_id = process_id
+        self.n_processes = len(peer_demuxes)
+        self.peers = peer_demuxes
+        self.dead_letters = dead_letters
+        self.deadline_s = deadline_ms / 1000.0
+        self.max_buffer_bytes = max_buffer_bytes
+        self.max_retries = max_retries
+        self._buffers: Dict[int, List[bytes]] = {}
+        self._buffer_bytes: Dict[int, int] = {}
+        self._buffer_since: Dict[int, float] = {}
+        self._lock = threading.Lock()
+        self._senders: set = set()
+        self._flusher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.forwarded_rows = 0
+        self.local_rows = 0
+        self.dead_lettered = 0
+
+    # -- intake --------------------------------------------------------------
+
+    def ingest_payload(self, payload: bytes, source_id: str = "wire") -> int:
+        """Route one NDJSON payload.  Returns rows accepted LOCALLY
+        (remote rows are accepted by their owner asynchronously)."""
+        by_owner = split_lines(payload, self.n_processes)
+        accepted = 0
+        local: List[bytes] = []
+        for owner, lines in by_owner.items():
+            if owner in (-1, self.process_id):
+                local.extend(lines)
+            else:
+                self._buffer(owner, lines)
+        if local:
+            accepted = self.dispatcher.ingest_wire_lines(
+                b"\n".join(local), source_id=source_id)
+            self.local_rows += accepted
+        return accepted
+
+    def _buffer(self, owner: int, lines: List[bytes]) -> None:
+        flush_now: Optional[bytes] = None
+        with self._lock:
+            buf = self._buffers.setdefault(owner, [])
+            if not buf:
+                self._buffer_since[owner] = time.monotonic()
+            buf.extend(lines)
+            self._buffer_bytes[owner] = (
+                self._buffer_bytes.get(owner, 0)
+                + sum(len(l) + 1 for l in lines))
+            if self._buffer_bytes[owner] >= self.max_buffer_bytes:
+                flush_now = self._drain_locked(owner)
+        if flush_now is not None:
+            # off the ingest caller's thread: a slow/down peer must not
+            # stall the frontend that happened to fill this buffer
+            self._send_async(owner, flush_now)
+
+    def _drain_locked(self, owner: int) -> Optional[bytes]:
+        lines = self._buffers.pop(owner, None)
+        self._buffer_bytes.pop(owner, None)
+        self._buffer_since.pop(owner, None)
+        if not lines:
+            return None
+        return b"\n".join(lines)
+
+    # -- egress --------------------------------------------------------------
+
+    def _send_async(self, owner: int, payload: bytes) -> threading.Thread:
+        """Each peer's batch ships on its own thread: a down peer's
+        connect timeouts + retry backoffs delay only ITS rows, never a
+        healthy peer's (Kafka producers isolate brokers the same way)."""
+
+        def run():
+            try:
+                self._send(owner, payload)
+            finally:
+                with self._lock:
+                    self._senders.discard(threading.current_thread())
+
+        t = threading.Thread(target=run,
+                             name=f"{self.name}-send-{owner}", daemon=True)
+        with self._lock:
+            self._senders.add(t)
+        t.start()
+        return t
+
+    def _send(self, owner: int, payload: bytes) -> None:
+        demux = self.peers.get(owner)
+        if demux is None:
+            self._dead_letter(owner, payload, "no demux for peer")
+            return
+        rows = payload.count(b"\n") + 1
+        for attempt in range(self.max_retries):
+            try:
+                body, _ = demux.call(
+                    "events.ingest",
+                    {"sourceId": f"fwd:{self.process_id}"},
+                    attachment=payload)
+                self.forwarded_rows += int(body.get("accepted", rows))
+                return
+            except ChannelUnavailable as e:
+                logger.info("forward to %d failed (%d/%d): %s", owner,
+                            attempt + 1, self.max_retries, e)
+                time.sleep(min(0.1 * (2 ** attempt), 2.0))
+            except RpcError as e:
+                self._dead_letter(owner, payload, f"peer rejected: {e}")
+                return
+        self._dead_letter(owner, payload,
+                          f"peer {owner} unreachable after "
+                          f"{self.max_retries} attempts")
+
+    def _dead_letter(self, owner: int, payload: bytes, reason: str) -> None:
+        self.dead_lettered += payload.count(b"\n") + 1
+        logger.warning("dead-lettering forward batch for peer %d: %s",
+                       owner, reason)
+        if self.dead_letters is not None:
+            self.dead_letters.append_json({
+                "kind": "undeliverable-forward",
+                "peer": owner,
+                "reason": reason,
+                "payload": payload.decode("utf-8", "replace"),
+            })
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(self.deadline_s / 2):
+            self.flush(only_expired=True)
+
+    def flush(self, only_expired: bool = False, wait: bool = False) -> None:
+        now = time.monotonic()
+        to_send: List = []
+        with self._lock:
+            for owner in list(self._buffers):
+                if only_expired and (
+                        now - self._buffer_since.get(owner, now)
+                        < self.deadline_s):
+                    continue
+                payload = self._drain_locked(owner)
+                if payload is not None:
+                    to_send.append((owner, payload))
+        threads = [self._send_async(owner, payload)
+                   for owner, payload in to_send]
+        if wait:
+            with self._lock:
+                threads = list(self._senders)
+            for t in threads:
+                t.join(timeout=self.max_retries * 5.0 + 5.0)
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name=f"{self.name}-flush", daemon=True)
+        self._flusher.start()
+        super().start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=5)
+            self._flusher = None
+        self.flush(wait=True)
+        super().stop()
+
+    def metrics(self) -> Dict[str, int]:
+        with self._lock:
+            pending = sum(len(v) for v in self._buffers.values())
+        return {
+            "local_rows": self.local_rows,
+            "forwarded_rows": self.forwarded_rows,
+            "dead_lettered": self.dead_lettered,
+            "pending": pending,
+        }

@@ -31,11 +31,16 @@ from sitewhere_tpu.web.http import RawResponse, Request, RestGateway, page_respo
 
 def _enum_arg(enum_cls, raw, field: str):
     """Name ('GT'/'window_mean') or value (0) → enum member, 400 on junk
-    (GET serializes enums as ints, so round-tripping a doc must work)."""
+    (GET serializes enums as ints, so round-tripping a doc must work).
+    Non-integral numbers are junk, not a truncation candidate: 2.7 must
+    400, never silently become severity 2."""
     try:
         if isinstance(raw, str) and not raw.isdigit():
             return enum_cls[raw.upper()]
-        return enum_cls(int(raw))
+        value = int(raw)
+        if float(raw) != value:
+            raise ValueError(raw)
+        return enum_cls(value)
     except (KeyError, ValueError, TypeError):
         raise ValidationError(f"bad {field}: {raw!r}")
 
@@ -478,42 +483,51 @@ def register_routes(gw: RestGateway, inst) -> None:
       lambda q: inst.schedules.delete_job(q.params["token"]))
 
     # ---- rules (TPU threshold catalog; reference rule processors) ---------
+    # Both wire casings are accepted for every field ("alertType" and
+    # "alert_type") because GET serves the dataclass's snake_case keys —
+    # a GET→edit→PUT round trip must apply the edit, and a typo'd field
+    # must 400, never 200-and-ignore.
+    _RULE_KEYS = {
+        "mtype": "mtype", "op": "op", "threshold": "threshold",
+        "alertType": "alert_type", "alert_type": "alert_type",
+        "alertLevel": "alert_level", "alert_level": "alert_level",
+        "kind": "kind", "windowS": "window_s", "window_s": "window_s",
+        "tenant": "tenant", "token": "token",
+    }
+    _RULE_READONLY = {"created_s"}   # present in GET docs; ignored on write
+
+    def _rule_fields(body: dict) -> dict:
+        fields = {}
+        for key, raw in body.items():
+            canon = _RULE_KEYS.get(key)
+            if canon is None:
+                if key in _RULE_READONLY:
+                    continue
+                raise ValidationError(f"unknown rule field {key!r}")
+            if canon == "op":
+                raw = _enum_arg(ComparisonOp, raw, "op")
+            elif canon == "alert_level":
+                raw = _enum_arg(AlertLevel, raw, "alertLevel")
+            elif canon == "kind":
+                raw = _enum_arg(RuleKind, raw, "kind")
+            elif canon == "threshold":
+                raw = _float_arg(raw, "threshold")
+            elif canon == "window_s" and raw is not None:
+                raw = _float_arg(raw, "windowS")
+            fields[canon] = raw
+        return fields
+
     def create_rule(q: Request):
-        body = q.json()
-        return inst.rules.create_rule(
-            mtype=body.get("mtype"),
-            op=_enum_arg(ComparisonOp, body.get("op", "GT"), "op"),
-            threshold=_float_arg(body.get("threshold", 0.0), "threshold"),
-            alert_type=str(body.get("alertType", "")),
-            alert_level=_enum_arg(AlertLevel,
-                                  body.get("alertLevel", AlertLevel.WARNING),
-                                  "alertLevel"),
-            tenant=body.get("tenant"),
-            token=body.get("token"),
-            kind=_enum_arg(RuleKind, body.get("kind", "INSTANT"), "kind"),
-            window_s=(_float_arg(body["windowS"], "windowS")
-                      if body.get("windowS") is not None else None),
-        )
+        fields = _rule_fields(q.json())
+        fields.setdefault("mtype", None)
+        fields.setdefault("op", ComparisonOp.GT)
+        fields.setdefault("threshold", 0.0)
+        fields.setdefault("alert_type", "")
+        return inst.rules.create_rule(**fields)
 
     def update_rule(q):
-        body = q.json()
-        fields = {}
-        if "mtype" in body:
-            fields["mtype"] = body["mtype"]
-        if "op" in body:
-            fields["op"] = _enum_arg(ComparisonOp, body["op"], "op")
-        if "threshold" in body:
-            fields["threshold"] = body["threshold"]
-        if "alertType" in body:
-            fields["alert_type"] = body["alertType"]
-        if "alertLevel" in body:
-            fields["alert_level"] = _enum_arg(AlertLevel,
-                                              body["alertLevel"],
-                                              "alertLevel")
-        if "kind" in body:
-            fields["kind"] = _enum_arg(RuleKind, body["kind"], "kind")
-        if "windowS" in body:
-            fields["window_s"] = body["windowS"]
+        fields = _rule_fields(q.json())
+        fields.pop("token", None)   # path param is authoritative
         return inst.rules.update_rule(q.params["token"], **fields)
 
     r("GET", "/api/rules", lambda q: inst.rules.list_rules(q.q1("tenant")))

@@ -1,0 +1,473 @@
+"""Cross-host RPC fabric: wire framing, channels, demux failover,
+interceptors, domain services, and keyed event forwarding.
+
+Reference behaviors pinned here: ApiDemux round-robin + failover +
+waitForChannel backoff (ApiDemux.java:42-110), JWT/tenant interceptors
+(JwtServerInterceptor, TenantTokenServerInterceptor.java:53-57), the
+near-cached device lookups (CachedDeviceManagementApiChannel.java), and
+Kafka's keyed-partition placement at the host boundary
+(MicroserviceKafkaProducer.java:106) — two real Instances in one
+process, rows crossing "DCN" (localhost TCP) to their owning host.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from sitewhere_tpu.rpc import (
+    ChannelUnavailable,
+    HostForwarder,
+    RemoteDeviceManagement,
+    RpcChannel,
+    RpcDemux,
+    RpcError,
+    RpcServer,
+    bind_instance,
+    owning_process,
+    split_lines,
+    wire,
+)
+from sitewhere_tpu.security.jwt import TokenManagement
+
+
+# ---------------------------------------------------------------------------
+# wire framing
+# ---------------------------------------------------------------------------
+
+class TestWire:
+    def test_round_trip(self):
+        frame = wire.request_frame(
+            7, "device.get", {"token": "dev-1"},
+            {"authorization": "abc", "tenant": "t1"}, b"\x00\x01binary")
+        a, b = socket.socketpair()
+        try:
+            a.sendall(wire.encode(frame))
+            got = wire.read_frame(b)
+        finally:
+            a.close()
+            b.close()
+        assert got.request_id == 7
+        assert got.method == "device.get"
+        assert got.body == {"token": "dev-1"}
+        assert got.headers["tenant"] == "t1"
+        assert got.attachment == b"\x00\x01binary"
+        assert not got.is_response and not got.is_error
+
+    def test_response_and_error_flags(self):
+        ok = wire.response_frame(1, {"x": 1})
+        err = wire.response_frame(2, {"error": "boom"}, error=True)
+        assert ok.is_response and not ok.is_error
+        assert err.is_response and err.is_error
+
+    def test_bad_magic_rejected(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(b"XXXX" + b"\x00" * 24)
+            with pytest.raises(wire.WireError):
+                wire.read_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_undecodable_body_is_wire_error(self):
+        # invalid JSON body must surface as WireError (protocol fault →
+        # connection drop + failover), never escape as ValueError and
+        # kill the reader thread silently
+        import struct
+        raw = (wire._HEADER.pack(wire.MAGIC, wire.FLAG_RESPONSE, 0, 1)
+               + struct.pack(">H", 0)
+               + struct.pack(">I", 2) + b"{}"
+               + struct.pack(">I", 5) + b"{oops"
+               + struct.pack(">I", 0))
+        a, b = socket.socketpair()
+        try:
+            a.sendall(raw)
+            with pytest.raises(wire.WireError):
+                wire.read_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_eof_mid_frame(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(wire.encode(wire.request_frame(1, "m", None))[:10])
+            a.close()
+            with pytest.raises(ConnectionError):
+                wire.read_frame(b)
+        finally:
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# server + channel
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def server():
+    srv = RpcServer(port=0)
+    srv.register("echo", lambda ctx, body: body, auth_required=False)
+    srv.register("attach",
+                 lambda ctx, body: ({"n": len(ctx.attachment)},
+                                    ctx.attachment[::-1]),
+                 auth_required=False)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+class TestServerChannel:
+    def test_echo_and_attachment(self, server):
+        chan = RpcChannel(server.endpoint)
+        body, _ = chan.call("echo", {"hello": "world"})
+        assert body == {"hello": "world"}
+        body, attach = chan.call("attach", None, attachment=b"abc")
+        assert body == {"n": 3}
+        assert attach == b"cba"
+        chan.close()
+
+    def test_unknown_method_is_rpc_error(self, server):
+        chan = RpcChannel(server.endpoint)
+        with pytest.raises(RpcError) as exc:
+            chan.call("nope", {})
+        assert exc.value.error == "not_found"
+        chan.close()
+
+    def test_concurrent_calls_multiplex(self, server):
+        chan = RpcChannel(server.endpoint)
+        results = {}
+
+        def worker(i):
+            body, _ = chan.call("echo", {"i": i})
+            results[i] = body["i"]
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == {i: i for i in range(16)}
+        chan.close()
+
+    def test_connection_refused_backoff(self):
+        chan = RpcChannel("127.0.0.1:1")   # nothing listens on port 1
+        with pytest.raises(ChannelUnavailable):
+            chan.call("echo", {})
+        # immediately retrying hits the backoff window, not the socket
+        with pytest.raises(ChannelUnavailable) as exc:
+            chan.call("echo", {})
+        assert "backoff" in str(exc.value)
+        chan.close()
+
+
+class TestInterceptors:
+    @pytest.fixture()
+    def secured(self):
+        tokens = TokenManagement()
+        srv = RpcServer(port=0, tokens=tokens)
+        srv.register("who", lambda ctx, body: {"user": ctx.username,
+                                               "tenant": ctx.tenant})
+        srv.register("admin.only", lambda ctx, body: {"ok": True},
+                     authority="ROLE_ADMIN")
+        srv.register("open", lambda ctx, body: {"ok": True},
+                     auth_required=False)
+        srv.start()
+        yield srv, tokens
+        srv.stop()
+
+    def test_jwt_required(self, secured):
+        srv, tokens = secured
+        chan = RpcChannel(srv.endpoint)
+        with pytest.raises(RpcError) as exc:
+            chan.call("who", {})
+        assert exc.value.error == "unauthorized"
+        # open methods skip the interceptor (instance.ping analog)
+        body, _ = chan.call("open", {})
+        assert body == {"ok": True}
+        chan.close()
+
+    def test_jwt_and_tenant_headers_flow(self, secured):
+        srv, tokens = secured
+        jwt = tokens.mint("alice", ["ROLE_USER"])
+        chan = RpcChannel(srv.endpoint, token_provider=lambda: jwt,
+                          tenant="acme")
+        body, _ = chan.call("who", {})
+        assert body == {"user": "alice", "tenant": "acme"}
+        chan.close()
+
+    def test_authority_enforced(self, secured):
+        srv, tokens = secured
+        user = tokens.mint("bob", ["ROLE_USER"])
+        admin = tokens.mint("root", ["ROLE_ADMIN"])
+        chan = RpcChannel(srv.endpoint, token_provider=lambda: user)
+        with pytest.raises(RpcError) as exc:
+            chan.call("admin.only", {})
+        assert exc.value.error == "forbidden"
+        chan.close()
+        chan = RpcChannel(srv.endpoint, token_provider=lambda: admin)
+        body, _ = chan.call("admin.only", {})
+        assert body == {"ok": True}
+        chan.close()
+
+
+# ---------------------------------------------------------------------------
+# demux: round-robin, failover, recovery
+# ---------------------------------------------------------------------------
+
+class TestDemux:
+    def _server(self, tag):
+        srv = RpcServer(port=0)
+        srv.register("which", lambda ctx, body: {"server": tag},
+                     auth_required=False)
+        srv.start()
+        return srv
+
+    def test_round_robin(self):
+        a, b = self._server("a"), self._server("b")
+        demux = RpcDemux([a.endpoint, b.endpoint])
+        seen = {demux.call("which")[0]["server"] for _ in range(4)}
+        assert seen == {"a", "b"}
+        demux.close()
+        a.stop()
+        b.stop()
+
+    def test_failover_when_replica_dies(self):
+        a, b = self._server("a"), self._server("b")
+        demux = RpcDemux([a.endpoint, b.endpoint])
+        demux.call("which")   # connect both eventually
+        a.stop()
+        # every call still answers, from b
+        for _ in range(4):
+            assert demux.call("which")[0]["server"] == "b"
+        demux.close()
+        b.stop()
+
+    def test_all_down_then_wait_for_channel(self):
+        srv = self._server("a")
+        endpoint = srv.endpoint
+        srv.stop()
+        demux = RpcDemux([endpoint])
+        with pytest.raises(ChannelUnavailable):
+            demux.call("which")
+        # replica comes back on the same port; wait_for_channel reconnects
+        host, port = endpoint.rsplit(":", 1)
+        srv2 = RpcServer(host=host, port=int(port))
+        srv2.register("which", lambda ctx, body: {"server": "a2"},
+                      auth_required=False)
+        srv2.start()
+        demux.wait_for_channel(timeout_s=10)
+        assert demux.call("which")[0]["server"] == "a2"
+        demux.close()
+        srv2.stop()
+
+    def test_discovery_update_add_remove(self):
+        a, b = self._server("a"), self._server("b")
+        demux = RpcDemux([a.endpoint])
+        assert demux.call("which")[0]["server"] == "a"
+        demux.set_endpoints([b.endpoint])   # a removed, b added
+        assert demux.endpoints == [b.endpoint]
+        assert demux.call("which")[0]["server"] == "b"
+        demux.close()
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# domain services over the fabric + near-cache
+# ---------------------------------------------------------------------------
+
+from sitewhere_tpu.instance import Instance  # noqa: E402
+from tests.test_instance import make_config, seed_device  # noqa: E402
+
+
+@pytest.fixture()
+def bound_instance(tmp_path):
+    inst = Instance(make_config(tmp_path))
+    inst.start()
+    srv = RpcServer(port=0, tokens=inst.tokens, tracer=inst.tracer)
+    bind_instance(srv, inst)
+    srv.start()
+    admin = inst.users.authenticate("admin", "password")
+    jwt = inst.tokens.mint(admin.username, admin.authorities)
+    yield inst, srv, jwt
+    srv.stop()
+    inst.stop()
+    inst.terminate()
+
+
+class TestDomainServices:
+    def test_device_crud_and_events_over_fabric(self, bound_instance):
+        inst, srv, jwt = bound_instance
+        demux = RpcDemux([srv.endpoint], token_provider=lambda: jwt)
+        demux.call("devicetype.create", {"token": "sensor", "name": "S"})
+        demux.call("device.create", {"token": "dev-1",
+                                     "device_type": "sensor"})
+        demux.call("assignment.create", {"device": "dev-1"})
+        body, _ = demux.call("device.get", {"token": "dev-1"})
+        assert body["token"] == "dev-1"
+
+        # event intake over the binary lane → owner's journaled wire path
+        lines = b"\n".join(
+            b'{"deviceToken": "dev-1", "type": "Measurement", "request":'
+            b' {"name": "temp", "value": %d, "eventDate": 1000}}' % v
+            for v in range(8))
+        body, _ = demux.call("events.ingest", {"sourceId": "test"},
+                             attachment=lines)
+        assert body["accepted"] == 8
+        inst.dispatcher.flush()
+        body, _ = demux.call("events.query", {"deviceToken": "dev-1"})
+        assert body["numResults"] == 8
+
+        # state over the fabric
+        body, _ = demux.call("state.get", {"deviceToken": "dev-1"})
+        assert body["presence_missing"] in (True, False)
+        demux.close()
+
+    def test_mutations_need_admin(self, bound_instance):
+        inst, srv, jwt = bound_instance
+        inst.users.create_granted_authority("ROLE_USER")
+        inst.users.create_user(username="viewer", password="pw",
+                               authorities=["ROLE_USER"])
+        weak = inst.tokens.mint("viewer", ["ROLE_USER"])
+        demux = RpcDemux([srv.endpoint], token_provider=lambda: weak)
+        with pytest.raises(RpcError) as exc:
+            demux.call("device.create", {"token": "x",
+                                         "device_type": "sensor"})
+        assert exc.value.error == "forbidden"
+        demux.close()
+
+    def test_remote_device_management_cache(self, bound_instance):
+        inst, srv, jwt = bound_instance
+        seed_device(inst, "dev-c")
+        demux = RpcDemux([srv.endpoint], token_provider=lambda: jwt)
+        remote = RemoteDeviceManagement(demux, cache_ttl_s=60)
+        first = remote.get_device("dev-c")
+        again = remote.get_device("dev-c")
+        assert first == again
+        assert remote.hits == 1 and remote.misses == 1
+        # write-through invalidation: update → next get refetches
+        remote.update_device("dev-c", comments="updated")
+        fresh = remote.get_device("dev-c")
+        assert fresh["comments"] == "updated"
+        assert remote.misses == 2
+        # assignment near-cache
+        a1 = remote.get_active_assignment("dev-c")
+        a2 = remote.get_active_assignment("dev-c")
+        assert a1 == a2 and remote.hits == 2
+        demux.close()
+
+
+# ---------------------------------------------------------------------------
+# keyed cross-host forwarding (two Instances = two "hosts")
+# ---------------------------------------------------------------------------
+
+class TestForwarding:
+    def test_owning_process_stable(self):
+        assert owning_process("dev-1", 4) == owning_process("dev-1", 4)
+        owners = {owning_process(f"dev-{i}", 4) for i in range(64)}
+        assert owners == {0, 1, 2, 3}   # spreads over all processes
+
+    def test_split_lines_unparseable_stays_local(self):
+        payload = (b'{"deviceToken": "d", "type": "Measurement"}\n'
+                   b'not json at all\n'
+                   b'{"noToken": 1}')
+        by_owner = split_lines(payload, 2)
+        locals_ = by_owner.get(-1, [])
+        assert len(locals_) == 2   # bad line + tokenless line
+
+    @pytest.fixture()
+    def two_hosts(self, tmp_path):
+        insts, servers = [], []
+        for p in range(2):
+            inst = Instance(make_config(tmp_path / f"host{p}"))
+            inst.start()
+            inst.device_management.create_device_type(token="sensor",
+                                                      name="S")
+            srv = RpcServer(port=0, tokens=inst.tokens, tracer=inst.tracer)
+            bind_instance(srv, inst)
+            srv.start()
+            insts.append(inst)
+            servers.append(srv)
+        yield insts, servers
+        for srv in servers:
+            srv.stop()
+        for inst in insts:
+            inst.stop()
+            inst.terminate()
+
+    def test_rows_land_on_owning_host(self, two_hosts):
+        insts, servers = two_hosts
+        # find tokens owned by each process under the 2-way key hash
+        tok0 = next(f"dev-{i}" for i in range(100)
+                    if owning_process(f"dev-{i}", 2) == 0)
+        tok1 = next(f"dev-{i}" for i in range(100)
+                    if owning_process(f"dev-{i}", 2) == 1)
+        for inst, tok in ((insts[0], tok0), (insts[1], tok1)):
+            inst.device_management.create_device(token=tok,
+                                                 device_type="sensor")
+            inst.device_management.create_device_assignment(device=tok)
+
+        jwt0 = insts[1].tokens.mint("admin", ["ROLE_ADMIN"])
+        demux_to_1 = RpcDemux([servers[1].endpoint],
+                              token_provider=lambda: jwt0)
+        fwd = HostForwarder(
+            insts[0].dispatcher, process_id=0,
+            peer_demuxes={0: None, 1: demux_to_1},
+            dead_letters=insts[0].dead_letters,
+            deadline_ms=10.0)
+        fwd.start()
+        try:
+            # one mixed payload arriving at host 0's frontend
+            lines = []
+            for tok in (tok0, tok1, tok0, tok1):
+                lines.append(
+                    b'{"deviceToken": "%s", "type": "Measurement",'
+                    b' "request": {"name": "t", "value": 1,'
+                    b' "eventDate": 1000}}' % tok.encode())
+            accepted = fwd.ingest_payload(b"\n".join(lines))
+            assert accepted == 2          # local rows only
+            fwd.flush()
+            deadline = time.time() + 10
+            while time.time() < deadline and fwd.forwarded_rows < 2:
+                time.sleep(0.05)
+            assert fwd.forwarded_rows == 2
+        finally:
+            fwd.stop()
+            demux_to_1.close()
+
+        for inst in insts:
+            inst.dispatcher.flush()
+        d0 = insts[0].identity.device.lookup(tok0)
+        d1 = insts[1].identity.device.lookup(tok1)
+        insts[0].event_store.flush()
+        insts[1].event_store.flush()
+        assert len(insts[0].event_store.query(device_id=int(d0))) == 2
+        assert len(insts[1].event_store.query(device_id=int(d1))) == 2
+        # nothing dead-lettered, nothing misplaced
+        assert fwd.dead_lettered == 0
+
+    def test_unreachable_peer_dead_letters(self, tmp_path):
+        inst = Instance(make_config(tmp_path))
+        inst.start()
+        try:
+            demux = RpcDemux(["127.0.0.1:1"])
+            fwd = HostForwarder(
+                inst.dispatcher, process_id=0,
+                peer_demuxes={0: None, 1: demux},
+                dead_letters=inst.dead_letters,
+                deadline_ms=5.0, max_retries=1)
+            tok = next(f"dev-{i}" for i in range(100)
+                       if owning_process(f"dev-{i}", 2) == 1)
+            fwd.ingest_payload(
+                b'{"deviceToken": "%s", "type": "Measurement",'
+                b' "request": {"name": "t", "value": 1}}'
+                % tok.encode())
+            fwd.flush(wait=True)
+            assert fwd.dead_lettered >= 1
+            demux.close()
+        finally:
+            inst.stop()
+            inst.terminate()

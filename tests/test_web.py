@@ -143,6 +143,29 @@ class TestCrudSurface:
         status, metrics = client.request("GET", "/api/instance/metrics")
         assert status == 200 and "accepted" in metrics
 
+    def test_rule_doc_round_trip_and_validation(self, client):
+        """GET serves snake_case keys; PUTting that doc back with an edit
+        must apply it, typos must 400, non-integral enums must 400."""
+        status, rule = client.request("POST", "/api/rules", {
+            "mtype": "temp", "op": "GT", "threshold": 90,
+            "alertType": "hot"})
+        assert status == 200
+        status, doc = client.request("GET", f"/api/rules/{rule['token']}")
+        assert status == 200 and doc["alert_type"] == "hot"
+        doc["kind"] = "WINDOW_MEAN"
+        doc["window_s"] = 120
+        status, updated = client.request(
+            "PUT", f"/api/rules/{rule['token']}", doc)
+        assert status == 200 and updated["window_s"] == 120
+        assert updated["kind"] == 1   # WINDOW_MEAN applied, not ignored
+        status, _ = client.request("PUT", f"/api/rules/{rule['token']}",
+                                   {"treshold": 5})
+        assert status == 400
+        status, _ = client.request("POST", "/api/rules", {
+            "mtype": "t", "alertType": "x", "alertLevel": 2.7})
+        assert status == 400
+        client.request("DELETE", f"/api/rules/{rule['token']}")
+
     def test_label_png(self, client):
         status, data, ctype = client.request(
             "GET", "/api/labels/device/t-1", raw=True)
