@@ -285,6 +285,67 @@ def parse_envelopes(payload: bytes) -> List[dict]:
     return docs
 
 
+_KIND_WIRE_NAMES = {
+    RequestKind.MEASUREMENT: "Measurement",
+    RequestKind.LOCATION: "Location",
+    RequestKind.ALERT: "Alert",
+    RequestKind.COMMAND_RESPONSE: "CommandResponse",
+    RequestKind.REGISTRATION: "Registration",
+    RequestKind.STATE_CHANGE: "StateChange",
+    RequestKind.STREAM_DATA: "StreamData",
+}
+
+
+def encode_envelope(req: DecodedRequest) -> bytes:
+    """:class:`DecodedRequest` → the JSON wire envelope
+    :func:`_decode_one` accepts — the inverse of decode for the fields
+    the pipeline carries.  Used when an already-decoded row must cross
+    DCN to its owning host (``rpc/forward.py``) and re-enter that host's
+    wire intake: re-encoding beats inventing a second serialization for
+    the same data (one wire format, as the reference keeps one protobuf
+    payload schema end to end)."""
+    kind_name = _KIND_WIRE_NAMES.get(req.kind)
+    if kind_name is None:
+        raise ValueError(f"kind {req.kind!r} has no wire envelope")
+    body: Dict[str, object] = {
+        "eventDate": (req.ts_s + req.ts_ns / 1e9) if req.ts_ns else req.ts_s,
+    }
+    if req.metadata:
+        body["metadata"] = req.metadata
+    if req.alternate_id:
+        body["alternateId"] = req.alternate_id
+    if not req.update_state:
+        body["updateState"] = False
+    if req.kind == RequestKind.MEASUREMENT:
+        body["name"] = req.mtype
+        body["value"] = req.value
+    elif req.kind == RequestKind.LOCATION:
+        body["latitude"] = req.lat
+        body["longitude"] = req.lon
+        if req.elevation:
+            body["elevation"] = req.elevation
+    elif req.kind == RequestKind.ALERT:
+        body["type"] = req.alert_type
+        body["level"] = int(req.alert_level)
+        if req.alert_message is not None:
+            body["message"] = req.alert_message
+    elif req.kind == RequestKind.COMMAND_RESPONSE:
+        if req.originating_event is not None:
+            body["originatingEventId"] = req.originating_event
+        if req.response is not None:
+            body["response"] = req.response
+    elif req.kind == RequestKind.REGISTRATION:
+        if req.device_type_token:
+            body["deviceTypeToken"] = req.device_type_token
+        if req.area_token:
+            body["areaToken"] = req.area_token
+        if req.customer_token:
+            body["customerToken"] = req.customer_token
+    return json.dumps(
+        {"deviceToken": req.device_token, "type": kind_name, "request": body},
+        separators=(",", ":")).encode("utf-8")
+
+
 def envelope_fields(doc) -> Tuple[str, str, dict]:
     """Validate one envelope → ``(device_token, type_name, request)``."""
     if not isinstance(doc, dict):

@@ -114,10 +114,14 @@ class Instance(LifecycleComponent):
         else:
             self.mesh = None
 
-        # identity + security
+        # identity + security (a shared jwt secret lets peer hosts verify
+        # each other's service tokens — reference: one instance-wide JWT
+        # secret across all microservices)
         self.identity = IdentityMap(capacity=cap)
         self.users = UserManagement()
-        self.tokens = TokenManagement()
+        jwt_secret = self.config.get("security.jwt_secret")
+        self.tokens = TokenManagement(
+            secret=jwt_secret.encode("utf-8") if jwt_secret else None)
         self.tenants = TenantManagement()
 
         # device system-of-record + device-resident mirrors
@@ -236,6 +240,56 @@ class Instance(LifecycleComponent):
             on_state_changes=self._on_presence_changes,
         ))
         self.sources: List[LifecycleComponent] = []
+
+        # cross-host fabric (rpc/ package; sitewhere-grpc-client analog):
+        # the server publishes this instance's domain surface; a 2+ entry
+        # peers list additionally turns on keyed forwarding so every
+        # ingest row lands on the host that owns its device's shard
+        # (SURVEY.md §2.4 — Kafka partition-leadership at the host plane)
+        self.rpc_server = None
+        self.forwarder = None
+        peers: List[str] = list(self.config.get("rpc.peers") or [])
+        if bool(self.config.get("rpc.server.enabled")) or peers:
+            from sitewhere_tpu.rpc import RpcServer, bind_instance
+
+            self.rpc_server = self.add_child(RpcServer(
+                host=str(self.config.get("rpc.server.host", "127.0.0.1")),
+                port=int(self.config.get("rpc.server.port", 0)),
+                tokens=self.tokens, tracer=self.tracer))
+            bind_instance(self.rpc_server, self)
+        if len(peers) > 1:
+            from sitewhere_tpu.rpc import HostForwarder, RpcDemux
+
+            process_id = int(self.config.get("rpc.process_id", 0))
+            if not 0 <= process_id < len(peers):
+                raise ValueError(
+                    f"rpc.process_id {process_id} outside peers list")
+            if not jwt_secret:
+                # without a shared secret every forwarded batch would be
+                # rejected as unauthorized and dead-lettered — fail at
+                # boot, not silently at runtime
+                raise ValueError(
+                    "multi-host (rpc.peers) requires a shared "
+                    "security.jwt_secret so peers can verify each "
+                    "other's service tokens")
+
+            def _system_jwt() -> str:
+                # service-to-service identity (reference SystemUserRunnable)
+                return self.tokens.mint("system", ["ROLE_ADMIN"])
+
+            self._peer_demuxes = {
+                p: (None if p == process_id
+                    else RpcDemux([ep], token_provider=_system_jwt))
+                for p, ep in enumerate(peers)
+            }
+            self.forwarder = self.add_child(HostForwarder(
+                self.dispatcher, process_id, self._peer_demuxes,
+                dead_letters=self.dead_letters,
+                deadline_ms=float(self.config.get(
+                    "rpc.forward_deadline_ms", 25.0)),
+                data_dir=self.data_dir))
+        else:
+            self._peer_demuxes = {}
 
         # checkpoint/resume (SURVEY.md §5): restore the newest complete
         # snapshot BEFORE start so devices/assignments/users/tenants/rules
@@ -384,12 +438,22 @@ class Instance(LifecycleComponent):
         )
 
     def add_source(self, source: LifecycleComponent) -> LifecycleComponent:
-        """Attach an ingest source wired into the dispatcher."""
-        source.on_event = self.dispatcher.ingest
-        if hasattr(source, "on_events"):
-            # batch forward: one columnar call per wire payload
-            source.on_events = self.dispatcher.ingest_many
-        source.on_registration = self.dispatcher.ingest_registration
+        """Attach an ingest source wired into the dispatcher — or, in a
+        multi-host topology, into the forwarder, which keeps locally-owned
+        rows in-process and ships the rest to their owning host."""
+        if self.forwarder is not None:
+            source.on_event = (
+                lambda req, payload=b"": self.forwarder.ingest_requests(
+                    [req], payload))
+            if hasattr(source, "on_events"):
+                source.on_events = self.forwarder.ingest_requests
+            source.on_registration = self.forwarder.ingest_registration
+        else:
+            source.on_event = self.dispatcher.ingest
+            if hasattr(source, "on_events"):
+                # batch forward: one columnar call per wire payload
+                source.on_events = self.dispatcher.ingest_many
+            source.on_registration = self.dispatcher.ingest_registration
         source.on_failed_decode = self.dispatcher.ingest_failed_decode
         self.sources.append(self.add_child(source))
         return source
@@ -469,6 +533,9 @@ class Instance(LifecycleComponent):
 
     def terminate(self) -> None:
         super().terminate()
+        for demux in self._peer_demuxes.values():
+            if demux is not None:
+                demux.close()
         self.ingest_journal.close()
         self.dead_letters.close()
 

@@ -46,11 +46,12 @@ class ChannelUnavailable(Exception):
 
 
 class _Pending:
-    __slots__ = ("event", "frame")
+    __slots__ = ("event", "frame", "sock")
 
-    def __init__(self):
+    def __init__(self, sock=None):
         self.event = threading.Event()
         self.frame: Optional[wire.Frame] = None
+        self.sock = sock   # the connection this call went out on
 
 
 class RpcChannel:
@@ -138,8 +139,9 @@ class RpcChannel:
             self._drop(sock, e)
 
     def _drop(self, sock: socket.socket, exc: Exception) -> None:
-        """Connection died: fail every in-flight call so callers can
-        fail over to another replica instead of hanging."""
+        """Connection died: fail the in-flight calls THAT WENT OUT ON IT
+        so their callers fail over — calls already riding a newer
+        reconnected socket are untouched (they are still answerable)."""
         with self._lock:
             if self._sock is sock:
                 self._sock = None
@@ -148,7 +150,10 @@ class RpcChannel:
         except OSError:
             pass
         with self._pending_lock:
-            stranded, self._pending = self._pending, {}
+            stranded = {rid: p for rid, p in self._pending.items()
+                        if p.sock is sock}
+            for rid in stranded:
+                del self._pending[rid]
         for p in stranded.values():
             p.event.set()   # frame stays None → ChannelUnavailable
         if stranded and not self._closed:
@@ -176,12 +181,12 @@ class RpcChannel:
         # only (bounded by connect_timeout); the write lock serializes just
         # the sendall so a slow large-attachment writer never stalls other
         # callers' connect/registration — their own timeout_s governs.
-        pending = _Pending()
         with self._lock:
             self._connect_locked()
             sock = self._sock
         if sock is None:
             raise ChannelUnavailable(f"{self.endpoint}: not connected")
+        pending = _Pending(sock)
         request_id = next(self._next_id)
         frame_bytes = wire.encode(wire.request_frame(
             request_id, method, body, hdrs, attachment))

@@ -13,11 +13,19 @@ that belong to another host's shards must cross DCN exactly once, at the
 host plane, before entering the owning host's batcher.  That hop is this
 module: a stable token hash picks the owning process (the partition-key
 analog), local rows go straight to the local dispatcher's columnar wire
-intake, and remote rows batch up per peer and ship over the RPC fabric's
-binary lane (``events.ingest``) — journaled and processed by the OWNER,
-preserving the reference's per-device ordering and at-least-once
-placement (the journal lives where the offsets live, exactly like a
-partition's log living on its leader).
+intake, and remote rows ship over the RPC fabric's binary lane
+(``events.ingest``) — journaled and processed by the OWNER, preserving
+the reference's per-device ordering and at-least-once placement.
+
+Durability of the DCN hop itself: with a ``data_dir``, remote-owned rows
+spool to a per-peer :class:`~sitewhere_tpu.ingest.journal.Journal` at
+intake and the sender commits its reader offset only AFTER the owner
+accepts the batch — the Kafka producer's replicated-ack, as a local
+write-ahead spool.  A crash between intake and send replays the spool on
+restart; a peer outage retains rows on disk (a down broker's partition
+log, exactly).  Without a ``data_dir`` the buffer is memory-only and an
+unreachable peer dead-letters after bounded retries — the
+fire-and-forget producer profile, for tests and ephemeral toys.
 """
 
 from __future__ import annotations
@@ -29,10 +37,13 @@ import time
 import zlib
 from typing import Dict, List, Optional
 
+from sitewhere_tpu.ingest.journal import Journal, JournalReader
 from sitewhere_tpu.rpc.channel import ChannelUnavailable, RpcDemux, RpcError
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 
 logger = logging.getLogger("sitewhere_tpu.rpc")
+
+SPOOL_POLL_RECORDS = 64    # batches per send drain
 
 
 def owning_process(device_token: str, n_processes: int) -> int:
@@ -74,10 +85,10 @@ class HostForwarder(LifecycleComponent):
     ``peer_demuxes[p]`` is the :class:`RpcDemux` for process ``p``
     (``None`` at the local index).  Buffered remote rows flush when the
     buffer reaches ``max_buffer_bytes`` or ``deadline_ms`` elapses —
-    the producer-side linger/batch knobs every Kafka producer has.  A
-    peer that stays unreachable past ``max_retries`` flushes dead-letters
-    the batch locally (at-least-once preserved: rows are never dropped
-    silently, the dead-letter journal is replayable).
+    the producer-side linger/batch knobs every Kafka producer has.  Each
+    peer's sends run on their own thread, so a down peer's connect
+    timeouts and backoffs delay only its own rows.  See the module
+    docstring for the durable (``data_dir``) vs memory-only contract.
     """
 
     def __init__(self, dispatcher, process_id: int,
@@ -86,6 +97,7 @@ class HostForwarder(LifecycleComponent):
                  deadline_ms: float = 25.0,
                  max_buffer_bytes: int = 1 << 20,
                  max_retries: int = 3,
+                 data_dir: Optional[str] = None,
                  name: str = "host-forwarder"):
         super().__init__(name)
         self.dispatcher = dispatcher
@@ -96,16 +108,39 @@ class HostForwarder(LifecycleComponent):
         self.deadline_s = deadline_ms / 1000.0
         self.max_buffer_bytes = max_buffer_bytes
         self.max_retries = max_retries
+        self._lock = threading.Lock()     # buffers + counters + sender set
+        # memory-mode buffers
         self._buffers: Dict[int, List[bytes]] = {}
         self._buffer_bytes: Dict[int, int] = {}
         self._buffer_since: Dict[int, float] = {}
-        self._lock = threading.Lock()
+        # durable-mode spools: write-ahead journal per remote peer, one
+        # sender at a time per peer (the owner lock keeps the reader's
+        # poll→send→commit sequence atomic)
+        self._spools: Dict[int, Journal] = {}
+        self._spool_readers: Dict[int, JournalReader] = {}
+        self._owner_locks: Dict[int, threading.Lock] = {}
+        self._spool_since: Dict[int, float] = {}
+        if data_dir is not None:
+            for p, demux in peer_demuxes.items():
+                if demux is None:
+                    continue
+                spool = Journal(data_dir, name=f"forward-{p}",
+                                fsync_every=64)
+                self._spools[p] = spool
+                self._spool_readers[p] = JournalReader(spool, "sender")
+        for p, demux in peer_demuxes.items():
+            if demux is not None:
+                self._owner_locks[p] = threading.Lock()
         self._senders: set = set()
         self._flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.forwarded_rows = 0
         self.local_rows = 0
         self.dead_lettered = 0
+
+    @property
+    def durable(self) -> bool:
+        return bool(self._spools)
 
     # -- intake --------------------------------------------------------------
 
@@ -123,11 +158,73 @@ class HostForwarder(LifecycleComponent):
         if local:
             accepted = self.dispatcher.ingest_wire_lines(
                 b"\n".join(local), source_id=source_id)
-            self.local_rows += accepted
+            with self._lock:
+                self.local_rows += accepted
         return accepted
 
+    def ingest_requests(self, reqs, payload: bytes = b"",
+                        source_id: str = "wire") -> int:
+        """Route one payload's already-decoded requests (the protocol
+        sources' batch-forward path).  Local rows take the dispatcher's
+        columnar intake; remote rows re-encode to the wire envelope and
+        ship with the next batch to their owner.  Returns rows accepted
+        locally."""
+        from sitewhere_tpu.ingest.decoders import encode_envelope
+
+        local = []
+        remote: Dict[int, List[bytes]] = {}
+        for req in reqs:
+            owner = owning_process(req.device_token, self.n_processes)
+            if owner == self.process_id:
+                local.append(req)
+            else:
+                remote.setdefault(owner, []).append(encode_envelope(req))
+        for owner, lines in remote.items():
+            self._buffer(owner, lines)
+        if local:
+            # A split payload must NOT journal whole here: replaying it
+            # would re-ingest the remote rows on the wrong host.  Journal
+            # a local-only re-encoding instead (each owner's journal holds
+            # exactly its partition's rows — a partition log, precisely).
+            if remote:
+                payload = b"\n".join(encode_envelope(r) for r in local)
+            self.dispatcher.ingest_many(local, payload)
+            with self._lock:
+                self.local_rows += len(local)
+        return len(local)
+
+    def ingest_registration(self, req, payload: bytes = b"") -> None:
+        """Registrations route like events: the owning host mints the
+        device (dense handles are host-local, so registration MUST land
+        where the device's shard lives)."""
+        from sitewhere_tpu.ingest.decoders import encode_envelope
+
+        owner = owning_process(req.device_token, self.n_processes)
+        if owner == self.process_id:
+            self.dispatcher.ingest_registration(req, payload)
+        else:
+            self._buffer(owner, [encode_envelope(req)])
+
     def _buffer(self, owner: int, lines: List[bytes]) -> None:
-        flush_now: Optional[bytes] = None
+        if self.durable:
+            # write-ahead: the spool IS the buffer, so a crash between
+            # intake and send replays these rows on restart
+            spool = self._spools.get(owner)
+            if spool is None:
+                self._dead_letter(owner, b"\n".join(lines),
+                                  "no spool for peer")
+                return
+            spool.append(b"\n".join(lines))
+            kick = False
+            with self._lock:
+                self._spool_since.setdefault(owner, time.monotonic())
+                reader = self._spool_readers[owner]
+                if reader.lag >= SPOOL_POLL_RECORDS:
+                    kick = True
+            if kick:
+                self._send_async(owner)
+            return
+        flush_now = False
         with self._lock:
             buf = self._buffers.setdefault(owner, [])
             if not buf:
@@ -136,14 +233,13 @@ class HostForwarder(LifecycleComponent):
             self._buffer_bytes[owner] = (
                 self._buffer_bytes.get(owner, 0)
                 + sum(len(l) + 1 for l in lines))
-            if self._buffer_bytes[owner] >= self.max_buffer_bytes:
-                flush_now = self._drain_locked(owner)
-        if flush_now is not None:
+            flush_now = self._buffer_bytes[owner] >= self.max_buffer_bytes
+        if flush_now:
             # off the ingest caller's thread: a slow/down peer must not
             # stall the frontend that happened to fill this buffer
-            self._send_async(owner, flush_now)
+            self._send_async(owner)
 
-    def _drain_locked(self, owner: int) -> Optional[bytes]:
+    def _drain_memory_locked(self, owner: int) -> Optional[bytes]:
         lines = self._buffers.pop(owner, None)
         self._buffer_bytes.pop(owner, None)
         self._buffer_since.pop(owner, None)
@@ -153,14 +249,14 @@ class HostForwarder(LifecycleComponent):
 
     # -- egress --------------------------------------------------------------
 
-    def _send_async(self, owner: int, payload: bytes) -> threading.Thread:
-        """Each peer's batch ships on its own thread: a down peer's
+    def _send_async(self, owner: int) -> threading.Thread:
+        """Each peer's batches ship on their own thread: a down peer's
         connect timeouts + retry backoffs delay only ITS rows, never a
         healthy peer's (Kafka producers isolate brokers the same way)."""
 
         def run():
             try:
-                self._send(owner, payload)
+                self._drain_owner(owner)
             finally:
                 with self._lock:
                     self._senders.discard(threading.current_thread())
@@ -172,11 +268,54 @@ class HostForwarder(LifecycleComponent):
         t.start()
         return t
 
-    def _send(self, owner: int, payload: bytes) -> None:
+    def _drain_owner(self, owner: int) -> None:
+        """Send everything pending for one peer.  The per-owner lock
+        serializes senders so the spool reader's poll→send→commit is
+        atomic and batches stay ordered per peer."""
+        lock = self._owner_locks.get(owner)
+        if lock is None:
+            return
+        with lock:
+            if not self.durable:
+                with self._lock:
+                    payload = self._drain_memory_locked(owner)
+                if payload is not None:
+                    delivered = self._deliver(owner, payload)
+                    if not delivered:
+                        self._dead_letter(
+                            owner, payload,
+                            f"peer {owner} unreachable after "
+                            f"{self.max_retries} attempts")
+                return
+            reader = self._spool_readers[owner]
+            while True:
+                start = reader.position
+                records = reader.poll(SPOOL_POLL_RECORDS)
+                if not records:
+                    with self._lock:
+                        self._spool_since.pop(owner, None)
+                    return
+                payload = b"\n".join(r for _, r in records)
+                if self._deliver(owner, payload):
+                    reader.commit()
+                else:
+                    # peer down: rows stay spooled (a down broker's
+                    # partition log); rewind and retry next flush cycle
+                    reader.seek(start)
+                    logger.warning(
+                        "peer %d unreachable; %d spooled batches retained",
+                        owner, reader.lag)
+                    return
+
+    def _deliver(self, owner: int, payload: bytes) -> bool:
+        """One batch to one peer with bounded retries.  True on success
+        or non-retryable rejection (which dead-letters); False when the
+        peer is unreachable (caller decides: spool-retain or
+        dead-letter)."""
         demux = self.peers.get(owner)
         if demux is None:
             self._dead_letter(owner, payload, "no demux for peer")
-            return
+            return True
         rows = payload.count(b"\n") + 1
         for attempt in range(self.max_retries):
             try:
@@ -184,21 +323,21 @@ class HostForwarder(LifecycleComponent):
                     "events.ingest",
                     {"sourceId": f"fwd:{self.process_id}"},
                     attachment=payload)
-                self.forwarded_rows += int(body.get("accepted", rows))
-                return
+                with self._lock:
+                    self.forwarded_rows += int(body.get("accepted", rows))
+                return True
             except ChannelUnavailable as e:
                 logger.info("forward to %d failed (%d/%d): %s", owner,
                             attempt + 1, self.max_retries, e)
                 time.sleep(min(0.1 * (2 ** attempt), 2.0))
             except RpcError as e:
                 self._dead_letter(owner, payload, f"peer rejected: {e}")
-                return
-        self._dead_letter(owner, payload,
-                          f"peer {owner} unreachable after "
-                          f"{self.max_retries} attempts")
+                return True
+        return False
 
     def _dead_letter(self, owner: int, payload: bytes, reason: str) -> None:
-        self.dead_lettered += payload.count(b"\n") + 1
+        with self._lock:
+            self.dead_lettered += payload.count(b"\n") + 1
         logger.warning("dead-lettering forward batch for peer %d: %s",
                        owner, reason)
         if self.dead_letters is not None:
@@ -215,20 +354,24 @@ class HostForwarder(LifecycleComponent):
         while not self._stop.wait(self.deadline_s / 2):
             self.flush(only_expired=True)
 
-    def flush(self, only_expired: bool = False, wait: bool = False) -> None:
+    def _pending_owners(self, only_expired: bool) -> List[int]:
         now = time.monotonic()
-        to_send: List = []
         with self._lock:
-            for owner in list(self._buffers):
-                if only_expired and (
-                        now - self._buffer_since.get(owner, now)
-                        < self.deadline_s):
-                    continue
-                payload = self._drain_locked(owner)
-                if payload is not None:
-                    to_send.append((owner, payload))
-        threads = [self._send_async(owner, payload)
-                   for owner, payload in to_send]
+            if self.durable:
+                since = self._spool_since
+                owners = [o for o, r in self._spool_readers.items()
+                          if r.lag > 0]
+            else:
+                since = self._buffer_since
+                owners = list(self._buffers)
+            if only_expired:
+                owners = [o for o in owners
+                          if now - since.get(o, 0.0) >= self.deadline_s]
+        return owners
+
+    def flush(self, only_expired: bool = False, wait: bool = False) -> None:
+        threads = [self._send_async(owner)
+                   for owner in self._pending_owners(only_expired)]
         if wait:
             with self._lock:
                 threads = list(self._senders)
@@ -240,6 +383,11 @@ class HostForwarder(LifecycleComponent):
         self._flusher = threading.Thread(
             target=self._flush_loop, name=f"{self.name}-flush", daemon=True)
         self._flusher.start()
+        # crash recovery: anything spooled-but-uncommitted from a prior
+        # run ships now (replay-from-offset, MicroserviceKafkaConsumer
+        # semantics applied to the producer side)
+        if self.durable:
+            self.flush()
         super().start()
 
     def stop(self) -> None:
@@ -248,14 +396,20 @@ class HostForwarder(LifecycleComponent):
             self._flusher.join(timeout=5)
             self._flusher = None
         self.flush(wait=True)
+        for spool in self._spools.values():
+            spool.close()
         super().stop()
 
     def metrics(self) -> Dict[str, int]:
         with self._lock:
-            pending = sum(len(v) for v in self._buffers.values())
-        return {
-            "local_rows": self.local_rows,
-            "forwarded_rows": self.forwarded_rows,
-            "dead_lettered": self.dead_lettered,
-            "pending": pending,
-        }
+            if self.durable:
+                pending = sum(r.lag for r in self._spool_readers.values())
+            else:
+                pending = sum(len(v) for v in self._buffers.values())
+            return {
+                "local_rows": self.local_rows,
+                "forwarded_rows": self.forwarded_rows,
+                "dead_lettered": self.dead_lettered,
+                "pending": pending,
+                "durable": self.durable,
+            }

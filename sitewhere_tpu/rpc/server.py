@@ -85,12 +85,14 @@ class RpcServer(LifecycleComponent):
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 tokens=None, tracer=None, name: str = "rpc-server"):
+                 tokens=None, tracer=None, name: str = "rpc-server",
+                 max_inflight_per_conn: int = 32):
         super().__init__(name)
         self._host = host
         self._port = port
         self._tokens = tokens
         self._tracer = tracer
+        self.max_inflight_per_conn = max_inflight_per_conn
         self._handlers: Dict[str, _Handler] = {}
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -132,15 +134,27 @@ class RpcServer(LifecycleComponent):
                 # (channel.py correlates by request id) — so each frame
                 # dispatches on its own worker and only the response
                 # sendall serializes; a slow events.query never blocks a
-                # state.get behind it on the same socket.
+                # state.get behind it on the same socket.  The semaphore
+                # bounds in-flight dispatches per connection: when a
+                # client outruns the handlers, the read loop stalls
+                # (TCP backpressure) instead of spawning unboundedly.
                 send_lock = threading.Lock()
+                slots = threading.Semaphore(outer.max_inflight_per_conn)
                 workers = []
+
+                def dispatch_one(frame):
+                    try:
+                        outer._dispatch(self.request, frame, peer,
+                                        send_lock)
+                    finally:
+                        slots.release()
+
                 try:
                     while True:
                         frame = wire.read_frame(self.request)
+                        slots.acquire()
                         w = threading.Thread(
-                            target=outer._dispatch,
-                            args=(self.request, frame, peer, send_lock),
+                            target=dispatch_one, args=(frame,),
                             name=f"rpc-call-{frame.method}", daemon=True)
                         workers.append(w)
                         w.start()

@@ -166,7 +166,7 @@ class RemoteDeviceManagement:
                 return entry.value
             if entry is not None:
                 del self._cache[key]
-        self.misses += 1
+            self.misses += 1
         return None
 
     def _put(self, kind: str, token: str, value) -> None:

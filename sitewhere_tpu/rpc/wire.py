@@ -140,18 +140,17 @@ def read_frame(sock: socket.socket) -> Frame:
     (headers_len,) = struct.unpack(">I", _read_exact(sock, 4))
     if headers_len > MAX_HEADERS:
         raise WireError(f"headers too large: {headers_len}")
+    headers_raw = _read_exact(sock, headers_len) if headers_len else b"{}"
+    (body_len,) = struct.unpack(">I", _read_exact(sock, 4))
+    if body_len > MAX_BODY:
+        raise WireError(f"body too large: {body_len}")
+    body_raw = _read_exact(sock, body_len) if body_len else b""
     try:
-        headers = (json.loads(_read_exact(sock, headers_len))
-                   if headers_len else {})
-        (body_len,) = struct.unpack(">I", _read_exact(sock, 4))
-        if body_len > MAX_BODY:
-            raise WireError(f"body too large: {body_len}")
-        body = json.loads(_read_exact(sock, body_len)) if body_len else None
+        headers = json.loads(headers_raw)
+        body = json.loads(body_raw) if body_raw else None
     except (ValueError, UnicodeDecodeError) as e:
         # version-skewed or buggy peer: surface as a protocol violation so
         # readers drop the connection instead of dying un-handled
-        if isinstance(e, WireError):
-            raise
         raise WireError(f"undecodable frame payload: {e}") from e
     (attach_len,) = struct.unpack(">I", _read_exact(sock, 4))
     if attach_len > MAX_ATTACH:

@@ -58,6 +58,19 @@ DEFAULTS: Dict[str, Any] = {
     "presence": {"scan_interval_s": 600.0, "missing_after_s": 8 * 3600.0},
     "api": {"host": "127.0.0.1", "port": 8080, "jwt_ttl_s": 3600},
     "metrics": {"report_interval_s": 20.0},
+    # cross-host fabric (sitewhere-grpc-client analog; rpc/ package).
+    # "peers" lists every process's RPC endpoint in process-id order —
+    # a 2+ entry list turns on keyed event forwarding, with this
+    # process at index "process_id".  Multi-host REQUIRES a shared
+    # security.jwt_secret (the reference shares its instance JWT secret
+    # across microservices the same way).
+    "rpc": {
+        "server": {"enabled": False, "host": "127.0.0.1", "port": 0},
+        "process_id": 0,
+        "peers": [],
+        "forward_deadline_ms": 25.0,
+    },
+    "security": {"jwt_secret": None},
 }
 
 

@@ -449,6 +449,158 @@ class TestForwarding:
         # nothing dead-lettered, nothing misplaced
         assert fwd.dead_lettered == 0
 
+    def test_config_driven_multihost_instances(self, tmp_path):
+        """Two Instances from config alone (rpc.peers + shared jwt
+        secret): a TCP protocol source on host 0 receives rows for BOTH
+        hosts; each row lands on its owner, end to end."""
+        import json as _json
+        import socket as _socket
+        import struct
+
+        from sitewhere_tpu.ingest.decoders import JsonDecoder
+        from sitewhere_tpu.ingest.sources import InboundEventSource, TcpReceiver
+
+        # fixed ports so each peer list can be written before boot
+        def free_port():
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        ports = [free_port(), free_port()]
+        peers = [f"127.0.0.1:{p}" for p in ports]
+        insts = []
+        for p in range(2):
+            cfg = make_config(tmp_path / f"host{p}")
+            cfg._tree["rpc"] = {
+                "server": {"enabled": True, "host": "127.0.0.1",
+                           "port": ports[p]},
+                "process_id": p, "peers": peers,
+                "forward_deadline_ms": 10.0,
+            }
+            cfg._tree["security"] = {"jwt_secret": "shared-test-secret"}
+            inst = Instance(cfg)
+            inst.start()
+            inst.device_management.create_device_type(token="sensor",
+                                                      name="S")
+            insts.append(inst)
+        assert insts[0].forwarder is not None
+
+        tok0 = next(f"dev-{i}" for i in range(100)
+                    if owning_process(f"dev-{i}", 2) == 0)
+        tok1 = next(f"dev-{i}" for i in range(100)
+                    if owning_process(f"dev-{i}", 2) == 1)
+        for inst, tok in ((insts[0], tok0), (insts[1], tok1)):
+            inst.device_management.create_device(token=tok,
+                                                 device_type="sensor")
+            inst.device_management.create_device_assignment(device=tok)
+
+        src = insts[0].add_source(InboundEventSource(
+            "tcp", [TcpReceiver(port=0)], JsonDecoder()))
+        src.start()
+        try:
+            port = src.receivers[0].port
+            with _socket.create_connection(("127.0.0.1", port)) as s:
+                for tok, value in ((tok0, 1.0), (tok1, 2.0),
+                                   (tok0, 3.0), (tok1, 4.0)):
+                    payload = _json.dumps({
+                        "deviceToken": tok, "type": "Measurement",
+                        "request": {"name": "t", "value": value,
+                                    "eventDate": 1000},
+                    }).encode()
+                    s.sendall(struct.pack(">I", len(payload)) + payload)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if insts[0].forwarder.forwarded_rows >= 2:
+                    break
+                insts[0].forwarder.flush(wait=True)
+                time.sleep(0.05)
+            assert insts[0].forwarder.forwarded_rows == 2
+            for inst in insts:
+                inst.dispatcher.flush()
+                inst.event_store.flush()
+            d0 = int(insts[0].identity.device.lookup(tok0))
+            d1 = int(insts[1].identity.device.lookup(tok1))
+            assert len(insts[0].event_store.query(device_id=d0)) == 2
+            assert len(insts[1].event_store.query(device_id=d1)) == 2
+        finally:
+            for inst in insts:
+                inst.stop()
+                inst.terminate()
+
+    def test_multihost_requires_shared_secret(self, tmp_path):
+        cfg = make_config(tmp_path)
+        cfg._tree["rpc"] = {"server": {"enabled": True, "host": "127.0.0.1",
+                                       "port": 0},
+                            "process_id": 0,
+                            "peers": ["127.0.0.1:1", "127.0.0.1:2"]}
+        with pytest.raises(ValueError, match="jwt_secret"):
+            Instance(cfg)
+
+    def test_durable_spool_survives_restart_and_peer_outage(self, tmp_path):
+        """With a data_dir the forwarder write-ahead-spools remote rows:
+        an unreachable peer retains them on disk (no dead-letter), and a
+        new forwarder over the same spool delivers them once the peer is
+        back — the crash-recovery half of at-least-once for the DCN hop."""
+        inst = Instance(make_config(tmp_path / "local"))
+        inst.start()
+        tok = next(f"dev-{i}" for i in range(100)
+                   if owning_process(f"dev-{i}", 2) == 1)
+        line = (b'{"deviceToken": "%s", "type": "Measurement",'
+                b' "request": {"name": "t", "value": 7,'
+                b' "eventDate": 1000}}' % tok.encode())
+        spool_dir = str(tmp_path / "spool")
+        try:
+            # phase 1: peer down — rows spool, nothing dead-letters
+            down = RpcDemux(["127.0.0.1:1"])
+            fwd = HostForwarder(inst.dispatcher, 0, {0: None, 1: down},
+                                dead_letters=inst.dead_letters,
+                                deadline_ms=5.0, max_retries=1,
+                                data_dir=spool_dir)
+            assert fwd.durable
+            fwd.ingest_payload(line)
+            fwd.flush(wait=True)
+            assert fwd.dead_lettered == 0
+            assert fwd.metrics()["pending"] == 1
+            fwd.stop()
+            down.close()
+
+            # phase 2: "restart" — peer now up; spool replays on start
+            peer = Instance(make_config(tmp_path / "peer"))
+            peer.start()
+            peer.device_management.create_device_type(token="sensor",
+                                                      name="S")
+            peer.device_management.create_device(token=tok,
+                                                 device_type="sensor")
+            peer.device_management.create_device_assignment(device=tok)
+            srv = RpcServer(port=0, tokens=peer.tokens)
+            bind_instance(srv, peer)
+            srv.start()
+            jwt = peer.tokens.mint("system", ["ROLE_ADMIN"])
+            up = RpcDemux([srv.endpoint], token_provider=lambda: jwt)
+            fwd2 = HostForwarder(inst.dispatcher, 0, {0: None, 1: up},
+                                 dead_letters=inst.dead_letters,
+                                 deadline_ms=5.0, data_dir=spool_dir)
+            fwd2.start()
+            deadline = time.time() + 10
+            while time.time() < deadline and fwd2.forwarded_rows < 1:
+                time.sleep(0.05)
+            assert fwd2.forwarded_rows == 1
+            assert fwd2.metrics()["pending"] == 0
+            peer.dispatcher.flush()
+            peer.event_store.flush()
+            d = int(peer.identity.device.lookup(tok))
+            assert len(peer.event_store.query(device_id=d)) == 1
+            fwd2.stop()
+            up.close()
+            srv.stop()
+            peer.stop()
+            peer.terminate()
+        finally:
+            inst.stop()
+            inst.terminate()
+
     def test_unreachable_peer_dead_letters(self, tmp_path):
         inst = Instance(make_config(tmp_path))
         inst.start()
