@@ -215,6 +215,29 @@ class Journal:
         self.flush()
         self._file.close()
 
+    def prune(self, upto: int) -> int:
+        """Delete whole segments every record of which is below ``upto``.
+
+        The Kafka retention analog, applied at the commit frontier
+        instead of by wall-clock: callers prune only below a durably
+        committed consumer offset (e.g. the forward spool after the peer
+        acked).  The active segment is never deleted; reads below the
+        new first base become invalid by contract.  Returns the number
+        of segments removed."""
+        removed = 0
+        with self._lock:
+            while len(self._segments) > 1 and self._segments[1][0] <= upto:
+                _base, path = self._segments.pop(0)
+                first_base = self._segments[0][0]
+                self._index = [e for e in self._index if e[0] >= first_base]
+                for victim in (path, self._sidecar_path(path)):
+                    try:
+                        os.unlink(victim)
+                    except FileNotFoundError:
+                        pass
+                removed += 1
+        return removed
+
     @property
     def end_offset(self) -> int:
         """Offset one past the last appended record."""
@@ -232,14 +255,18 @@ class Journal:
         """Yield ``(offset, payload)`` for offsets in ``[start, stop)``."""
         with self._lock:
             # Make appended bytes visible to readers of the same files;
-            # durability (fsync) stays on the append policy.
+            # durability (fsync) stays on the append policy.  Segments
+            # snapshot under the lock so a concurrent prune() can't pull
+            # the list out from under the iteration.
             self._file.flush()
             index = list(self._index)
-        for i, (base, path) in enumerate(self._segments):
+            segments = list(self._segments)
+            next_offset = self._next_offset
+        for i, (base, path) in enumerate(segments):
             nxt = (
-                self._segments[i + 1][0]
-                if i + 1 < len(self._segments)
-                else self._next_offset
+                segments[i + 1][0]
+                if i + 1 < len(segments)
+                else next_offset
             )
             if nxt <= start:
                 continue
@@ -256,7 +283,11 @@ class Journal:
                     offset, seek_pos = ioff, ipos
                     break
                 lo -= 1
-            with open(path, "rb") as f:
+            try:
+                f = open(path, "rb")
+            except FileNotFoundError:
+                continue   # pruned between snapshot and open
+            with f:
                 f.seek(seek_pos)
                 while True:
                     header = f.read(_HEADER.size)

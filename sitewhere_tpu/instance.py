@@ -291,6 +291,33 @@ class Instance(LifecycleComponent):
         else:
             self._peer_demuxes = {}
 
+        # event search (service-event-search analog): the local store is
+        # the built-in index; in a multi-host topology every peer's store
+        # is a remote index and "federated" fans out + merges newest-first
+        from sitewhere_tpu.outbound.search import (
+            EventSearchProvider,
+            FederatedSearchProvider,
+            RemoteSearchProvider,
+            SearchProvidersManager,
+            TokenSearchAdapter,
+        )
+
+        self.search_providers = SearchProvidersManager(
+            [EventSearchProvider("local", self.event_store)])
+        if self._peer_demuxes:
+            local_adapter = TokenSearchAdapter(
+                "local", self.event_store, self.identity,
+                self.device_management)
+            legs = [local_adapter] + [
+                RemoteSearchProvider(f"peer-{p}", demux)
+                for p, demux in sorted(self._peer_demuxes.items())
+                if demux is not None
+            ]
+            for leg in legs[1:]:
+                self.search_providers.add_provider(leg)
+            self.search_providers.add_provider(
+                FederatedSearchProvider("federated", legs))
+
         # checkpoint/resume (SURVEY.md §5): restore the newest complete
         # snapshot BEFORE start so devices/assignments/users/tenants/rules
         # and DeviceState survive a restart; the journal replay in start()
@@ -544,7 +571,7 @@ class Instance(LifecycleComponent):
     def topology(self) -> dict:
         """Live component tree + counters (reference
         ``TopologyStateAggregator`` → admin UI WebSocket feed)."""
-        return {
+        topo = {
             "instance": self.instance_id,
             "bootstrapped": self.bootstrapped,
             "components": self.status_tree(),
@@ -553,3 +580,34 @@ class Instance(LifecycleComponent):
             "events_stored": self.event_store.total_events,
             "tracing": self.tracer.stats(),
         }
+        if self.forwarder is not None:
+            topo["forwarding"] = self.forwarder.metrics()
+        return topo
+
+    def cluster_topology(self) -> dict:
+        """Every host's topology, aggregated over the fabric (reference:
+        ``TopologyStateAggregator.java:40-113`` consumes all
+        microservices' state heartbeats into one live cluster view).  A
+        peer that doesn't answer reports as unreachable rather than
+        failing the whole view."""
+        import threading
+
+        view = {"local": self.topology(), "peers": {}}
+
+        def poll(p, demux):
+            try:
+                body, _ = demux.call("instance.topology", timeout_s=2.0)
+                view["peers"][str(p)] = body
+            except Exception as e:   # noqa: BLE001 — degraded view, not error
+                view["peers"][str(p)] = {"unreachable": str(e)}
+
+        # concurrent polls: k dead peers cost ONE timeout, not k — the
+        # endpoint exists to diagnose exactly that outage
+        threads = [threading.Thread(target=poll, args=(p, d), daemon=True)
+                   for p, d in sorted(self._peer_demuxes.items())
+                   if d is not None]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+        return view

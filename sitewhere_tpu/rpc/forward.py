@@ -124,14 +124,17 @@ class HostForwarder(LifecycleComponent):
             for p, demux in peer_demuxes.items():
                 if demux is None:
                     continue
+                # small segments so delivered traffic prunes promptly
+                # (the spool's committed prefix has no future readers)
                 spool = Journal(data_dir, name=f"forward-{p}",
-                                fsync_every=64)
+                                fsync_every=64, segment_bytes=4 << 20)
                 self._spools[p] = spool
                 self._spool_readers[p] = JournalReader(spool, "sender")
         for p, demux in peer_demuxes.items():
             if demux is not None:
                 self._owner_locks[p] = threading.Lock()
         self._senders: set = set()
+        self._active_owners: set = set()
         self._flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.forwarded_rows = 0
@@ -249,16 +252,24 @@ class HostForwarder(LifecycleComponent):
 
     # -- egress --------------------------------------------------------------
 
-    def _send_async(self, owner: int) -> threading.Thread:
+    def _send_async(self, owner: int) -> Optional[threading.Thread]:
         """Each peer's batches ship on their own thread: a down peer's
         connect timeouts + retry backoffs delay only ITS rows, never a
-        healthy peer's (Kafka producers isolate brokers the same way)."""
+        healthy peer's (Kafka producers isolate brokers the same way).
+        One sender per owner at a time — a down peer's still-retrying
+        sender must not accrete a queue of blocked duplicates behind the
+        owner lock on every flusher tick."""
+        with self._lock:
+            if owner in self._active_owners:
+                return None
+            self._active_owners.add(owner)
 
         def run():
             try:
                 self._drain_owner(owner)
             finally:
                 with self._lock:
+                    self._active_owners.discard(owner)
                     self._senders.discard(threading.current_thread())
 
         t = threading.Thread(target=run,
@@ -298,6 +309,10 @@ class HostForwarder(LifecycleComponent):
                 payload = b"\n".join(r for _, r in records)
                 if self._deliver(owner, payload):
                     reader.commit()
+                    # delivered prefix has no future readers: reclaim
+                    # whole segments below the commit (Kafka retention
+                    # at the commit frontier)
+                    self._spools[owner].prune(reader.committed)
                 else:
                     # peer down: rows stay spooled (a down broker's
                     # partition log); rewind and retry next flush cycle
@@ -370,8 +385,8 @@ class HostForwarder(LifecycleComponent):
         return owners
 
     def flush(self, only_expired: bool = False, wait: bool = False) -> None:
-        threads = [self._send_async(owner)
-                   for owner in self._pending_owners(only_expired)]
+        for owner in self._pending_owners(only_expired):
+            self._send_async(owner)
         if wait:
             with self._lock:
                 threads = list(self._senders)

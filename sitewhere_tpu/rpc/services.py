@@ -88,19 +88,23 @@ def bind_instance(server: RpcServer, inst) -> None:
     reg("events.ingest", events_ingest)
 
     def events_query(ctx: CallContext, body):
+        # Unknown tokens return an EMPTY page, not an error: in a
+        # sharded topology most hosts don't know most tokens, and a
+        # federated fan-out must be able to tell "not here" (normal)
+        # from a peer actually failing.
         body = body or {}
         kwargs = {}
         token = body.get("deviceToken")
         if token is not None:
             dense = inst.identity.device.lookup(token)
             if dense < 0:
-                raise EntityNotFound(f"unknown device {token}")
+                return {"numResults": 0, "results": []}
             kwargs["device_id"] = int(dense)
         token = body.get("assignmentToken")
         if token is not None:
             handle = dm.handle_for("assignment", token)
             if handle < 0:
-                raise EntityNotFound(f"unknown assignment {token}")
+                return {"numResults": 0, "results": []}
             kwargs["assignment_id"] = int(handle)
         if body.get("eventType") is not None:
             kwargs["event_type"] = int(body["eventType"])

@@ -586,3 +586,4 @@ def register_routes(gw: RestGateway, inst) -> None:
         return page_response(provider.search(q.criteria()))
 
     r("GET", "/api/search/{provider}", external_search)
+    r("GET", "/api/instance/cluster", lambda q: inst.cluster_topology())

@@ -524,6 +524,19 @@ class TestForwarding:
             d1 = int(insts[1].identity.device.lookup(tok1))
             assert len(insts[0].event_store.query(device_id=d0)) == 2
             assert len(insts[1].event_store.query(device_id=d1)) == 2
+
+            # federated search from host 0 sees BOTH hosts' events
+            fed = insts[0].search_providers.get_provider("federated")
+            all_events = fed.search()
+            assert all_events.total == 4
+            remote_only = fed.search(device_token=tok1)
+            assert remote_only.total == 2   # rows that live on host 1
+
+            # cluster topology aggregates the peer over the fabric
+            view = insts[0].cluster_topology()
+            assert view["local"]["instance"] == "test-instance"
+            assert view["peers"]["1"]["devices"] >= 1
+            assert view["local"]["forwarding"]["forwarded_rows"] == 2
         finally:
             for inst in insts:
                 inst.stop()
@@ -600,6 +613,29 @@ class TestForwarding:
         finally:
             inst.stop()
             inst.terminate()
+
+    def test_down_peer_does_not_accumulate_sender_threads(self, tmp_path):
+        """One sender per owner at a time: a down peer being retried must
+        not grow a thread pile-up as flush ticks arrive (durable mode
+        retains rows, so the owner stays pending for the whole outage)."""
+        tok = next(f"dev-{i}" for i in range(100)
+                   if owning_process(f"dev-{i}", 2) == 1)
+        down = RpcDemux(["127.0.0.1:1"])
+        fwd = HostForwarder(None, 0, {0: None, 1: down},
+                            deadline_ms=1.0, max_retries=2,
+                            data_dir=str(tmp_path))
+        try:
+            fwd.ingest_payload(
+                b'{"deviceToken": "%s", "type": "Measurement",'
+                b' "request": {"name": "t", "value": 1}}' % tok.encode())
+            for _ in range(50):
+                fwd.flush()
+            with fwd._lock:
+                assert len(fwd._senders) <= 1
+            assert fwd.metrics()["pending"] == 1   # retained, not lost
+        finally:
+            fwd.stop()
+            down.close()
 
     def test_unreachable_peer_dead_letters(self, tmp_path):
         inst = Instance(make_config(tmp_path))
