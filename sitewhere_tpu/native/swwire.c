@@ -334,10 +334,264 @@ fail:
     return NULL;
 }
 
+/* ---- split_owner_lines: the multi-host routing edge ------------------
+ *
+ * rpc/forward.py routes every NDJSON line to the host owning its device
+ * (crc32(token) % n_processes, the Kafka partition-key analog).  The
+ * Python path pays one json.loads per line just to read the token; this
+ * scanner extracts the top-level deviceToken/hardwareId value without
+ * building any objects.
+ *
+ * STRICTNESS CONTRACT (stronger than the decoder's, because ownership
+ * must agree BYTE-FOR-BYTE with the Python path cluster-wide — two
+ * frontends disagreeing on an owner would split one device's stream
+ * across hosts): any construct whose token Python could read
+ * differently bails the WHOLE payload (return None → Python path):
+ *   - escape sequences in any top-level key (an escaped key can decode
+ *     to "deviceToken") or in the token value itself,
+ *   - a deviceToken/hardwareId value that is not a plain string.
+ * Malformed lines and token-less lines get owner -1 (local intake
+ * dead-letters them with diagnostics), matching split_lines().
+ * Line enumeration matches payload.split(b"\n") with whitespace-only
+ * lines skipped.
+ */
+
+static uint32_t crc_table[256];
+static int crc_table_ready = 0;
+
+static void crc_init(void) {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc_table[i] = c;
+    }
+    crc_table_ready = 1;
+}
+
+/* zlib-compatible crc32 (poly 0xEDB88320, reflected, init/final xor) */
+static uint32_t crc32_bytes(const char *buf, Py_ssize_t len) {
+    uint32_t c = 0xFFFFFFFFu;
+    for (Py_ssize_t i = 0; i < len; i++)
+        c = crc_table[(c ^ (unsigned char)buf[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+/* String parse distinguishing escape (bail-worthy) from malformed:
+ * 0 = ok, 1 = malformed, 2 = contains escape. */
+static int parse_string_classify(cursor *c, const char **start,
+                                 Py_ssize_t *len) {
+    if (c->p >= c->end || *c->p != '"') return 1;
+    c->p++;
+    *start = c->p;
+    while (c->p < c->end) {
+        unsigned char ch = (unsigned char)*c->p;
+        if (ch == '"') {
+            *len = c->p - *start;
+            c->p++;
+            return 0;
+        }
+        if (ch == '\\') return 2;
+        if (ch < 0x20) return 1;
+        c->p++;
+    }
+    return 1;
+}
+
+/* Skip any JSON value, tolerating escapes inside (skipped content is
+ * never hashed).  0 ok, -1 malformed. */
+static int skip_string_any(cursor *c) {
+    if (c->p >= c->end || *c->p != '"') return -1;
+    c->p++;
+    while (c->p < c->end) {
+        char ch = *c->p;
+        if (ch == '\\') { c->p += 2; continue; }
+        if (ch == '"') { c->p++; return 0; }
+        c->p++;
+    }
+    return -1;
+}
+
+static int skip_value(cursor *c) {
+    skip_ws(c);
+    if (c->p >= c->end) return -1;
+    char ch = *c->p;
+    if (ch == '"') return skip_string_any(c);
+    if (ch == '{' || ch == '[') {
+        int depth = 0;
+        while (c->p < c->end) {
+            ch = *c->p;
+            if (ch == '"') {
+                if (skip_string_any(c) != 0) return -1;
+                continue;
+            }
+            if (ch == '{' || ch == '[') depth++;
+            else if (ch == '}' || ch == ']') {
+                depth--;
+                if (depth == 0) { c->p++; return 0; }
+            }
+            c->p++;
+        }
+        return -1;
+    }
+    /* number / true / false / null — validated, not just consumed:
+     * json.loads rejects bare words and malformed numbers, and a line it
+     * rejects must get owner -1 here too (routing alignment). */
+    if (c->end - c->p >= 4 && memcmp(c->p, "true", 4) == 0) {
+        c->p += 4;
+        return 0;
+    }
+    if (c->end - c->p >= 5 && memcmp(c->p, "false", 5) == 0) {
+        c->p += 5;
+        return 0;
+    }
+    if (c->end - c->p >= 4 && memcmp(c->p, "null", 4) == 0) {
+        c->p += 4;
+        return 0;
+    }
+    double ignored;
+    return parse_number(c, &ignored);
+}
+
+/* CPython-equivalent UTF-8 validation (rejects overlongs, surrogates,
+ * > U+10FFFF): json.loads(bytes) refuses a line with ANY invalid UTF-8,
+ * so such a line must get owner -1 natively too. */
+static int utf8_valid(const unsigned char *s, Py_ssize_t n) {
+    Py_ssize_t i = 0;
+    while (i < n) {
+        unsigned char c = s[i];
+        if (c < 0x80) { i++; continue; }
+        if (c < 0xC2) return 0;               /* stray continuation / overlong */
+        if (c < 0xE0) {
+            if (i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return 0;
+            i += 2; continue;
+        }
+        if (c < 0xF0) {
+            if (i + 2 >= n) return 0;
+            unsigned char c1 = s[i + 1], c2 = s[i + 2];
+            if ((c1 & 0xC0) != 0x80 || (c2 & 0xC0) != 0x80) return 0;
+            if (c == 0xE0 && c1 < 0xA0) return 0;   /* overlong */
+            if (c == 0xED && c1 >= 0xA0) return 0;  /* surrogate */
+            i += 3; continue;
+        }
+        if (c < 0xF5) {
+            if (i + 3 >= n) return 0;
+            unsigned char c1 = s[i + 1], c2 = s[i + 2], c3 = s[i + 3];
+            if ((c1 & 0xC0) != 0x80 || (c2 & 0xC0) != 0x80 ||
+                (c3 & 0xC0) != 0x80) return 0;
+            if (c == 0xF0 && c1 < 0x90) return 0;   /* overlong */
+            if (c == 0xF4 && c1 >= 0x90) return 0;  /* > U+10FFFF */
+            i += 4; continue;
+        }
+        return 0;
+    }
+    return 1;
+}
+
+/* Owner of one line: >= 0 owner, -1 local (malformed/token-less),
+ * -2 bail whole payload. */
+static int owner_of_line(cursor c, uint32_t nproc) {
+    const char *tok = NULL, *hw = NULL;
+    Py_ssize_t tok_len = 0, hw_len = 0;
+    int have_tok = 0, have_hw = 0;
+
+    if (!utf8_valid((const unsigned char *)c.p, c.end - c.p))
+        return -1;   /* json.loads would raise → local dead-letter */
+    skip_ws(&c);
+    if (c.p >= c.end || *c.p != '{') return -1;
+    c.p++;
+    skip_ws(&c);
+    if (c.p < c.end && *c.p == '}') { c.p++; goto close; }
+    for (;;) {
+        const char *k; Py_ssize_t klen;
+        skip_ws(&c);
+        int krc = parse_string_classify(&c, &k, &klen);
+        if (krc == 2) return -2;   /* escaped key could BE deviceToken */
+        if (krc == 1) return -1;
+        skip_ws(&c);
+        if (c.p >= c.end || *c.p != ':') return -1;
+        c.p++;
+        skip_ws(&c);
+        if (key_is(k, klen, "deviceToken")) {
+            if (c.p >= c.end || *c.p != '"') return -2; /* non-string */
+            int vrc = parse_string_classify(&c, &tok, &tok_len);
+            if (vrc == 2) return -2;
+            if (vrc == 1) return -1;
+            have_tok = 1;          /* duplicate keys: last wins, like dict */
+        } else if (key_is(k, klen, "hardwareId")) {
+            if (c.p >= c.end || *c.p != '"') return -2;
+            int vrc = parse_string_classify(&c, &hw, &hw_len);
+            if (vrc == 2) return -2;
+            if (vrc == 1) return -1;
+            have_hw = 1;
+        } else {
+            if (skip_value(&c) != 0) return -1;
+        }
+        skip_ws(&c);
+        if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+        if (c.p < c.end && *c.p == '}') { c.p++; break; }
+        return -1;
+    }
+close:
+    skip_ws(&c);
+    if (c.p < c.end) return -1;   /* trailing garbage: json.loads fails */
+    /* Python: env.get("deviceToken") or env.get("hardwareId") — a falsy
+     * (empty) deviceToken falls through to hardwareId. */
+    const char *use = NULL; Py_ssize_t use_len = 0;
+    if (have_tok && tok_len > 0) { use = tok; use_len = tok_len; }
+    else if (have_hw && hw_len > 0) { use = hw; use_len = hw_len; }
+    if (use == NULL) return -1;
+    return (int)(crc32_bytes(use, use_len) % nproc);
+}
+
+static PyObject *split_owner_lines(PyObject *self, PyObject *args) {
+    PyObject *payload;
+    unsigned int nproc;
+    if (!PyArg_ParseTuple(args, "SI", &payload, &nproc)) return NULL;
+    if (nproc == 0) {
+        PyErr_SetString(PyExc_ValueError, "n_processes must be > 0");
+        return NULL;
+    }
+    if (!crc_table_ready) crc_init();
+    const char *buf = PyBytes_AS_STRING(payload);
+    Py_ssize_t n = PyBytes_GET_SIZE(payload);
+    PyObject *owners = PyList_New(0);
+    if (!owners) return NULL;
+
+    const char *p = buf, *end = buf + n;
+    while (p < end) {
+        const char *nl = memchr(p, '\n', (size_t)(end - p));
+        const char *line_end = nl ? nl : end;
+        const char *q = p;
+        while (q < line_end &&
+               (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+        if (q == line_end) { p = nl ? nl + 1 : end; continue; }
+
+        cursor c = { p, line_end };
+        int owner = owner_of_line(c, (uint32_t)nproc);
+        if (owner == -2) {
+            Py_DECREF(owners);
+            Py_RETURN_NONE;   /* whole payload → Python path */
+        }
+        PyObject *o = PyLong_FromLong(owner);
+        if (!o || PyList_Append(owners, o) != 0) {
+            Py_XDECREF(o);
+            Py_DECREF(owners);
+            return NULL;
+        }
+        Py_DECREF(o);
+        p = nl ? nl + 1 : end;
+    }
+    return owners;
+}
+
 static PyMethodDef methods[] = {
     {"decode_measurement_lines", decode_measurement_lines, METH_O,
      "Scan NDJSON measurement envelopes into column buffers; None = "
      "shape mismatch, caller must fall back to the Python decoder."},
+    {"split_owner_lines", split_owner_lines, METH_VARARGS,
+     "Owner index (crc32(token) %% n) per non-blank NDJSON line; -1 = "
+     "local/malformed; None = bail, caller must use the Python splitter."},
     {NULL, NULL, 0, NULL},
 };
 

@@ -128,8 +128,12 @@ class FederatedSearchProvider:
     def search(self, criteria: Optional[SearchCriteria] = None,
                **filters) -> SearchResults:
         criteria = criteria or SearchCriteria()
+        # page_size <= 0 is the "unlimited" sentinel every provider
+        # honors (SearchCriteria.slice) — propagate it, don't slice to []
+        unlimited = criteria.page_size <= 0
         fetch = SearchCriteria(
-            page=1, page_size=criteria.page * criteria.page_size,
+            page=1,
+            page_size=0 if unlimited else criteria.page * criteria.page_size,
             start_s=criteria.start_s, end_s=criteria.end_s)
         merged: List = []
         total = 0
@@ -146,6 +150,8 @@ class FederatedSearchProvider:
             merged.extend(page.results)
             total += page.total
         merged.sort(key=_record_ts, reverse=True)
+        if unlimited:
+            return SearchResults(results=merged, total=total)
         lo = (criteria.page - 1) * criteria.page_size
         return SearchResults(results=merged[lo:lo + criteria.page_size],
                              total=total)

@@ -60,11 +60,31 @@ def split_lines(payload: bytes, n_processes: int) -> Dict[int, List[bytes]]:
     intake (owner -1): the local dispatcher's decode path is the one
     that dead-letters them with full diagnostics, matching the
     failed-decode topic contract (``EventSourcesManager.java:189``).
+
+    The native scanner (``native/swwire.c`` ``split_owner_lines``) reads
+    each line's token without building objects; it bails to this Python
+    path on anything whose ownership it could compute differently
+    (escaped keys/tokens, non-string tokens) — routing must agree
+    byte-for-byte cluster-wide or one device's stream would split
+    across hosts.
     """
+    # blank-line predicate MUST match the native scanner exactly (space/
+    # tab/CR only — bytes.strip() would also drop \x0b/\x0c lines and
+    # misalign the zip with the native owner array)
+    lines = [ln for ln in payload.split(b"\n") if ln.strip(b" \t\r")]
     out: Dict[int, List[bytes]] = {}
-    for line in payload.splitlines():
-        if not line.strip():
-            continue
+
+    from sitewhere_tpu.native import load_swwire
+
+    sw = load_swwire()
+    if sw is not None and hasattr(sw, "split_owner_lines"):
+        owners = sw.split_owner_lines(payload, n_processes)
+        if owners is not None:
+            for line, owner in zip(lines, owners):
+                out.setdefault(owner, []).append(line)
+            return out
+
+    for line in lines:
         owner = -1
         try:
             env = json.loads(line)
@@ -265,12 +285,22 @@ class HostForwarder(LifecycleComponent):
             self._active_owners.add(owner)
 
         def run():
+            drained_clean = False
             try:
-                self._drain_owner(owner)
+                drained_clean = self._drain_owner(owner)
             finally:
                 with self._lock:
                     self._active_owners.discard(owner)
                     self._senders.discard(threading.current_thread())
+                    rekick = drained_clean and self._owner_pending_locked(owner)
+                # close the check-then-act window: rows buffered between
+                # this sender's last empty poll and the discard above
+                # would otherwise strand until the next flusher tick
+                # (which may never come during stop).  Only after a CLEAN
+                # drain — a peer-down exit must wait for the next tick,
+                # not hot-loop.
+                if rekick:
+                    self._send_async(owner)
 
         t = threading.Thread(target=run,
                              name=f"{self.name}-send-{owner}", daemon=True)
@@ -279,13 +309,20 @@ class HostForwarder(LifecycleComponent):
         t.start()
         return t
 
-    def _drain_owner(self, owner: int) -> None:
+    def _owner_pending_locked(self, owner: int) -> bool:
+        if self.durable:
+            reader = self._spool_readers.get(owner)
+            return reader is not None and reader.lag > 0
+        return bool(self._buffers.get(owner))
+
+    def _drain_owner(self, owner: int) -> bool:
         """Send everything pending for one peer.  The per-owner lock
         serializes senders so the spool reader's poll→send→commit is
-        atomic and batches stay ordered per peer."""
+        atomic and batches stay ordered per peer.  Returns True on a
+        clean drain (emptied), False when the peer was unreachable."""
         lock = self._owner_locks.get(owner)
         if lock is None:
-            return
+            return True
         with lock:
             if not self.durable:
                 with self._lock:
@@ -297,7 +334,7 @@ class HostForwarder(LifecycleComponent):
                             owner, payload,
                             f"peer {owner} unreachable after "
                             f"{self.max_retries} attempts")
-                return
+                return True
             reader = self._spool_readers[owner]
             while True:
                 start = reader.position
@@ -305,7 +342,7 @@ class HostForwarder(LifecycleComponent):
                 if not records:
                     with self._lock:
                         self._spool_since.pop(owner, None)
-                    return
+                    return True
                 payload = b"\n".join(r for _, r in records)
                 if self._deliver(owner, payload):
                     reader.commit()
@@ -320,7 +357,7 @@ class HostForwarder(LifecycleComponent):
                     logger.warning(
                         "peer %d unreachable; %d spooled batches retained",
                         owner, reader.lag)
-                    return
+                    return False
 
     def _deliver(self, owner: int, payload: bytes) -> bool:
         """One batch to one peer with bounded retries.  True on success

@@ -125,3 +125,85 @@ def test_non_json_numbers_bail():
         line = (b'{"deviceToken":"d","type":"Measurement",'
                 b'"request":{"name":"t","value":' + bad + b'}}')
         assert mod.decode_measurement_lines(line) is None, bad
+
+
+# ---------------------------------------------------------------------------
+# split_owner_lines: the multi-host routing edge must agree with the
+# Python splitter byte-for-byte (ownership is a cluster-wide contract)
+# ---------------------------------------------------------------------------
+
+def _python_owner(line: bytes, n: int) -> int:
+    from sitewhere_tpu.rpc.forward import owning_process
+
+    try:
+        env = json.loads(line)
+        token = (env.get("deviceToken") or env.get("hardwareId")
+                 if isinstance(env, dict) else None)
+        if token:
+            return owning_process(str(token), n)
+    except (ValueError, UnicodeDecodeError):
+        pass
+    return -1
+
+
+def test_split_owner_lines_matches_python():
+    sw = load_swwire()
+    if not hasattr(sw, "split_owner_lines"):
+        pytest.skip("split_owner_lines not built")
+    lines = [
+        _line(f"dev-{i}", 1.0).encode() for i in range(50)
+    ] + [
+        b'{"hardwareId": "hw-1", "type": "Location"}',     # alias
+        b'{"deviceToken": "", "hardwareId": "hw-2"}',      # falsy -> alias
+        b'{"deviceToken": "a", "deviceToken": "b"}',       # dup: last wins
+        b'{"noToken": 5}',                                 # tokenless -> -1
+        b'not json',                                       # malformed -> -1
+        b'[1, 2, 3]',                                      # non-dict -> -1
+        b'{"deviceToken": "t", "extra": {"deviceToken": "nested"}}',
+        b'{"deviceToken": "t2", "arr": [1, "x", {"a": null}], "n": -1.5e3}',
+        b'  {"deviceToken": "sp"}  ',                      # padded line
+        '{"deviceToken": "ütf-8"}'.encode(),               # non-ascii utf8
+        b'\x0b',                                  # NOT blank to json/native
+        b'{"deviceToken": "t", "x": bogus}',      # bare word -> -1 both
+        b'{"deviceToken": "t", "n": 01}',         # leading zero -> -1 both
+        b'{"deviceToken": "\xff"}',               # invalid utf-8 -> -1 both
+        b'{"deviceToken": "ok", "b": true, "c": null, "d": false}',
+    ]
+    payload = b"\n".join(lines) + b"\n\n  \r\n"           # blank tails
+    for n in (2, 3, 8):
+        owners = sw.split_owner_lines(payload, n)
+        assert owners is not None
+        expected = [_python_owner(ln, n) for ln in lines]
+        assert owners == expected
+
+
+@pytest.mark.parametrize("line", [
+    b'{"device\\u0054oken": "x"}',        # escaped KEY could be the token
+    b'{"deviceToken": "a\\nb"}',          # escaped token value
+    b'{"deviceToken": 42}',               # non-string token
+    b'{"hardwareId": null}',              # non-string alias
+])
+def test_split_owner_lines_bails_on_ambiguity(line):
+    sw = load_swwire()
+    if not hasattr(sw, "split_owner_lines"):
+        pytest.skip("split_owner_lines not built")
+    payload = b'{"deviceToken": "ok"}\n' + line
+    assert sw.split_owner_lines(payload, 4) is None
+    # and the public splitter still routes every line via the Python path
+    from sitewhere_tpu.rpc.forward import split_lines
+
+    by_owner = split_lines(payload, 4)
+    assert sum(len(v) for v in by_owner.values()) == 2
+
+
+def test_split_lines_uses_same_enumeration_as_native():
+    """Blank-line skipping and \\n-splitting must align between the
+    native owner array and the Python-side line list they zip with."""
+    from sitewhere_tpu.rpc.forward import split_lines
+
+    payload = (b'\n  \n{"deviceToken": "a"}\r\n\n'
+               b'{"deviceToken": "b"}\n\t\n')
+    by_owner = split_lines(payload, 1)
+    lines = [ln for v in by_owner.values() for ln in v]
+    assert sorted(lines) == sorted(
+        [b'{"deviceToken": "a"}\r', b'{"deviceToken": "b"}'])

@@ -531,6 +531,10 @@ class TestForwarding:
             assert all_events.total == 4
             remote_only = fed.search(device_token=tok1)
             assert remote_only.total == 2   # rows that live on host 1
+            # page_size 0 = unlimited sentinel, same as other providers
+            from sitewhere_tpu.services.common import SearchCriteria
+            unlimited = fed.search(SearchCriteria(page_size=0))
+            assert len(unlimited.results) == unlimited.total == 4
 
             # cluster topology aggregates the peer over the fabric
             view = insts[0].cluster_topology()
