@@ -398,60 +398,115 @@ static int parse_string_classify(cursor *c, const char **start,
     return 1;
 }
 
-/* Skip any JSON value, tolerating escapes inside (skipped content is
- * never hashed).  0 ok, -1 malformed. */
-static int skip_string_any(cursor *c) {
-    if (c->p >= c->end || *c->p != '"') return -1;
+/* Skip one JSON value with FULL json.loads-equivalent validation —
+ * skipped content is never hashed, but whether the LINE is valid decides
+ * its owner (-1 for lines json.loads rejects), so the skipper must
+ * accept exactly what json.loads accepts: validated escape sequences,
+ * proper object/array structure, strict number grammar plus the
+ * NaN/Infinity/-Infinity constants the Python parser allows.
+ * Returns 0 ok, 1 malformed (→ owner -1), 2 bail whole payload. */
+
+#define SKIP_MAX_DEPTH 128
+
+static int skip_string_valid(cursor *c) {
+    if (c->p >= c->end || *c->p != '"') return 1;
     c->p++;
     while (c->p < c->end) {
-        char ch = *c->p;
-        if (ch == '\\') { c->p += 2; continue; }
+        unsigned char ch = (unsigned char)*c->p;
         if (ch == '"') { c->p++; return 0; }
-        c->p++;
-    }
-    return -1;
-}
-
-static int skip_value(cursor *c) {
-    skip_ws(c);
-    if (c->p >= c->end) return -1;
-    char ch = *c->p;
-    if (ch == '"') return skip_string_any(c);
-    if (ch == '{' || ch == '[') {
-        int depth = 0;
-        while (c->p < c->end) {
-            ch = *c->p;
-            if (ch == '"') {
-                if (skip_string_any(c) != 0) return -1;
+        if (ch < 0x20) return 1;      /* raw control char: strict mode */
+        if (ch == '\\') {
+            c->p++;
+            if (c->p >= c->end) return 1;
+            char e = *c->p;
+            if (e == '"' || e == '\\' || e == '/' || e == 'b' ||
+                e == 'f' || e == 'n' || e == 'r' || e == 't') {
+                c->p++;
                 continue;
             }
-            if (ch == '{' || ch == '[') depth++;
-            else if (ch == '}' || ch == ']') {
-                depth--;
-                if (depth == 0) { c->p++; return 0; }
+            if (e == 'u') {
+                c->p++;
+                for (int i = 0; i < 4; i++) {
+                    if (c->p >= c->end) return 1;
+                    char h = *c->p;
+                    if (!((h >= '0' && h <= '9') ||
+                          (h >= 'a' && h <= 'f') ||
+                          (h >= 'A' && h <= 'F'))) return 1;
+                    c->p++;
+                }
+                continue;
             }
-            c->p++;
+            return 1;                  /* \q etc: json.loads raises */
         }
-        return -1;
+        c->p++;
     }
-    /* number / true / false / null — validated, not just consumed:
-     * json.loads rejects bare words and malformed numbers, and a line it
-     * rejects must get owner -1 here too (routing alignment). */
+    return 1;
+}
+
+static int skip_value_depth(cursor *c, int depth) {
+    if (depth > SKIP_MAX_DEPTH) return 2;  /* deeper than we validate:
+                                            * bail, let json.loads rule */
+    skip_ws(c);
+    if (c->p >= c->end) return 1;
+    char ch = *c->p;
+    if (ch == '"') return skip_string_valid(c);
+    if (ch == '{') {
+        c->p++;
+        skip_ws(c);
+        if (c->p < c->end && *c->p == '}') { c->p++; return 0; }
+        for (;;) {
+            skip_ws(c);
+            int rc = skip_string_valid(c);     /* keys must be strings */
+            if (rc) return rc;
+            skip_ws(c);
+            if (c->p >= c->end || *c->p != ':') return 1;
+            c->p++;
+            rc = skip_value_depth(c, depth + 1);
+            if (rc) return rc;
+            skip_ws(c);
+            if (c->p < c->end && *c->p == ',') { c->p++; continue; }
+            if (c->p < c->end && *c->p == '}') { c->p++; return 0; }
+            return 1;
+        }
+    }
+    if (ch == '[') {
+        c->p++;
+        skip_ws(c);
+        if (c->p < c->end && *c->p == ']') { c->p++; return 0; }
+        for (;;) {
+            int rc = skip_value_depth(c, depth + 1);
+            if (rc) return rc;
+            skip_ws(c);
+            if (c->p < c->end && *c->p == ',') { c->p++; continue; }
+            if (c->p < c->end && *c->p == ']') { c->p++; return 0; }
+            return 1;
+        }
+    }
+    /* literals json.loads accepts — including its non-standard float
+     * constants (check -Infinity before the number grammar eats '-') */
     if (c->end - c->p >= 4 && memcmp(c->p, "true", 4) == 0) {
-        c->p += 4;
-        return 0;
+        c->p += 4; return 0;
     }
     if (c->end - c->p >= 5 && memcmp(c->p, "false", 5) == 0) {
-        c->p += 5;
-        return 0;
+        c->p += 5; return 0;
     }
     if (c->end - c->p >= 4 && memcmp(c->p, "null", 4) == 0) {
-        c->p += 4;
-        return 0;
+        c->p += 4; return 0;
+    }
+    if (c->end - c->p >= 3 && memcmp(c->p, "NaN", 3) == 0) {
+        c->p += 3; return 0;
+    }
+    if (c->end - c->p >= 8 && memcmp(c->p, "Infinity", 8) == 0) {
+        c->p += 8; return 0;
+    }
+    if (c->end - c->p >= 9 && memcmp(c->p, "-Infinity", 9) == 0) {
+        c->p += 9; return 0;
     }
     double ignored;
-    return parse_number(c, &ignored);
+    return parse_number(c, &ignored) == 0 ? 0 : 1;
 }
+
+static int skip_value(cursor *c) { return skip_value_depth(c, 0); }
 
 /* CPython-equivalent UTF-8 validation (rejects overlongs, surrogates,
  * > U+10FFFF): json.loads(bytes) refuses a line with ANY invalid UTF-8,
@@ -525,7 +580,9 @@ static int owner_of_line(cursor c, uint32_t nproc) {
             if (vrc == 1) return -1;
             have_hw = 1;
         } else {
-            if (skip_value(&c) != 0) return -1;
+            int src = skip_value(&c);
+            if (src == 2) return -2;
+            if (src != 0) return -1;
         }
         skip_ws(&c);
         if (c.p < c.end && *c.p == ',') { c.p++; continue; }

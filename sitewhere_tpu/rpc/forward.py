@@ -79,7 +79,10 @@ def split_lines(payload: bytes, n_processes: int) -> Dict[int, List[bytes]]:
     sw = load_swwire()
     if sw is not None and hasattr(sw, "split_owner_lines"):
         owners = sw.split_owner_lines(payload, n_processes)
-        if owners is not None:
+        # trust the alignment only when the enumerations provably agree —
+        # a length mismatch (future predicate drift) must degrade to the
+        # Python path, never zip-misroute rows cluster-wide
+        if owners is not None and len(owners) == len(lines):
             for line, owner in zip(lines, owners):
                 out.setdefault(owner, []).append(line)
             return out
@@ -92,7 +95,9 @@ def split_lines(payload: bytes, n_processes: int) -> Dict[int, List[bytes]]:
                      if isinstance(env, dict) else None)
             if token:
                 owner = owning_process(str(token), n_processes)
-        except (ValueError, UnicodeDecodeError):
+        except (ValueError, UnicodeDecodeError, RecursionError):
+            # RecursionError: pathologically nested line (the native
+            # scanner bails those to here at depth 128) — local intake
             pass
         out.setdefault(owner, []).append(line)
     return out

@@ -587,3 +587,11 @@ def register_routes(gw: RestGateway, inst) -> None:
 
     r("GET", "/api/search/{provider}", external_search)
     r("GET", "/api/instance/cluster", lambda q: inst.cluster_topology())
+
+    # ---- self-describing API listing (reference: Swagger) -----------------
+    from sitewhere_tpu.web.http import openapi_spec
+
+    r("GET", "/api/openapi.json",
+      lambda q: openapi_spec(gw.router,
+                             f"sitewhere-tpu ({inst.instance_id})"),
+      auth_required=False)

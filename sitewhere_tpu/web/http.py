@@ -101,6 +101,10 @@ class Router:
 
     def __init__(self):
         self._routes: List[Tuple[str, re.Pattern, Handler, bool, Optional[str]]] = []
+        # (method, pattern, auth_required, authority, summary) — the
+        # self-describing surface behind /api/openapi.json (the
+        # reference's Swagger listing, SURVEY.md §2.3 service-web-rest)
+        self.descriptors: List[Tuple[str, str, bool, Optional[str], str]] = []
 
     def add(self, method: str, pattern: str, handler: Handler,
             auth_required: bool = True,
@@ -108,11 +112,20 @@ class Router:
         """``authority`` additionally requires that granted authority in
         the caller's JWT claims (403 otherwise) — e.g. script upload is
         arbitrary code execution and demands ROLE_ADMIN."""
+        # literal segments are escaped so metachars in paths (e.g. the
+        # '.' in /api/openapi.json) match only themselves
+        parts = _CAPTURE.split(pattern)
         regex = re.compile(
-            "^" + _CAPTURE.sub(r"(?P<\1>[^/]+)", pattern) + "$"
+            "^" + "".join(
+                f"(?P<{part}>[^/]+)" if i % 2 else re.escape(part)
+                for i, part in enumerate(parts)
+            ) + "$"
         )
         self._routes.append(
             (method.upper(), regex, handler, auth_required, authority))
+        summary = (handler.__doc__ or "").strip().split("\n")[0]
+        self.descriptors.append(
+            (method.upper(), pattern, auth_required, authority, summary))
 
     def route(self, method: str, path: str):
         """Returns (handler, params, auth_required, authority)."""
@@ -130,6 +143,45 @@ class Router:
 
 class MethodNotAllowed(Exception):
     pass
+
+
+def openapi_spec(router: Router, title: str, version: str = "3.0.0") -> dict:
+    """OpenAPI 3 document generated from the live route table.
+
+    Reference: service-web-rest ships Swagger so every controller is
+    self-describing (SURVEY.md §2.3).  Here the router IS the single
+    source of truth — paths, methods, path parameters, and the JWT
+    security requirement come straight from what was registered, so the
+    document can never drift from the actual surface."""
+    paths: Dict[str, dict] = {}
+    for method, pattern, auth_required, authority, summary in router.descriptors:
+        op: Dict[str, object] = {
+            "summary": summary or f"{method} {pattern}",
+            "responses": {"200": {"description": "OK"},
+                          "400": {"description": "Validation error"},
+                          "404": {"description": "Not found"}},
+        }
+        params = _CAPTURE.findall(pattern)
+        if params:
+            op["parameters"] = [
+                {"name": p, "in": "path", "required": True,
+                 "schema": {"type": "string"}} for p in params
+            ]
+        if auth_required:
+            op["security"] = [{"bearerAuth": []}]
+            op["responses"]["401"] = {"description": "Unauthorized"}
+        if authority:
+            op["x-required-authority"] = authority
+            op["responses"]["403"] = {"description": "Forbidden"}
+        paths.setdefault(pattern, {})[method.lower()] = op
+    return {
+        "openapi": "3.0.3",
+        "info": {"title": title, "version": version},
+        "components": {"securitySchemes": {
+            "bearerAuth": {"type": "http", "scheme": "bearer",
+                           "bearerFormat": "JWT"}}},
+        "paths": paths,
+    }
 
 
 class RestGateway:

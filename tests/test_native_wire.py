@@ -168,6 +168,15 @@ def test_split_owner_lines_matches_python():
         b'{"deviceToken": "t", "n": 01}',         # leading zero -> -1 both
         b'{"deviceToken": "\xff"}',               # invalid utf-8 -> -1 both
         b'{"deviceToken": "ok", "b": true, "c": null, "d": false}',
+        b'{"deviceToken": "t", "v": NaN}',          # json.loads accepts
+        b'{"deviceToken": "t", "v": Infinity}',
+        b'{"deviceToken": "t", "v": -Infinity}',
+        b'{"deviceToken": "t", "x": {bogus}}',      # invalid nested -> -1
+        b'{"deviceToken": "t", "x": "a\\qb"}',      # bad escape -> -1
+        b'{"deviceToken": "t", "x": "a\\u00e9\\n"}',  # valid escapes -> ok
+        b'{"deviceToken": "t", "x": [1, {"k": "v"}, [true]]}',
+        b'{"deviceToken": "t", "x": [1, 2}',        # mismatched -> -1
+        b'{"deviceToken": "t", "x": {"a": 1,}}',    # trailing comma -> -1
     ]
     payload = b"\n".join(lines) + b"\n\n  \r\n"           # blank tails
     for n in (2, 3, 8):

@@ -166,6 +166,27 @@ class TestCrudSurface:
         assert status == 400
         client.request("DELETE", f"/api/rules/{rule['token']}")
 
+    def test_openapi_document(self, client):
+        """The spec generates from the live route table — every
+        registered route appears with its method, path params, and the
+        JWT security requirement; no drift possible."""
+        status, doc = client.request("GET", "/api/openapi.json")
+        assert status == 200
+        assert doc["openapi"].startswith("3.")
+        assert "/api/devices/{token}" in doc["paths"]
+        dev = doc["paths"]["/api/devices/{token}"]["get"]
+        assert dev["parameters"][0]["name"] == "token"
+        assert dev["security"] == [{"bearerAuth": []}]
+        # unauthenticated routes carry no security requirement
+        assert "security" not in doc["paths"]["/api/jwt"]["post"]
+        # authority-gated routes advertise it
+        script = doc["paths"]["/api/scripts/{name}"]["put"]
+        assert script["x-required-authority"] == "ROLE_ADMIN"
+        assert len(doc["paths"]) > 40
+        # literal '.' in the path is escaped in the route regex
+        status, _ = client.request("GET", "/api/openapiXjson")
+        assert status == 404
+
     def test_label_png(self, client):
         status, data, ctype = client.request(
             "GET", "/api/labels/device/t-1", raw=True)
