@@ -584,6 +584,100 @@ class Instance(LifecycleComponent):
             topo["forwarding"] = self.forwarder.metrics()
         return topo
 
+    def create_command_invocation(self, assignment_token: str,
+                                  command_token: str,
+                                  parameter_values: Optional[Dict[str, str]] = None,
+                                  initiator: str = "REST",
+                                  initiator_id: Optional[str] = None,
+                                  ts_s: Optional[int] = None) -> dict:
+        """Create a command-invocation EVENT for an assignment: journal
+        the invocation body and let the pipeline's command-row egress
+        deliver it (reference: REST creates an invocation event which
+        flows enriched-command-invocations → command-delivery,
+        SURVEY.md §3.4).  One delivery path — a direct ``commands.invoke``
+        would double-deliver.  Raises EntityNotFound when the assignment
+        is not on THIS host; the web layer federates that case over the
+        fabric to the owner (``command.invoke``)."""
+        import json as _json
+
+        from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
+        from sitewhere_tpu.services.common import mint_token, now_s
+
+        assignment = self.device_management.get_device_assignment(
+            assignment_token)
+        device = self.device_management.get_device(assignment.device)
+        inv_token = mint_token("inv")
+        payload = _json.dumps({
+            "deviceToken": device.token,
+            "type": "commandinvocation",
+            "request": {
+                "commandToken": str(command_token),
+                "assignmentToken": assignment_token,
+                "parameterValues": dict(parameter_values or {}),
+                "initiator": initiator,
+                "initiatorId": initiator_id,
+                "invocationToken": inv_token,
+            },
+        }).encode()
+        self.dispatcher.ingest(DecodedRequest(
+            kind=RequestKind.COMMAND_INVOCATION,
+            device_token=device.token,
+            ts_s=int(ts_s if ts_s is not None else now_s()),
+        ), payload)
+        self.dispatcher.flush()
+        return {"queued": True, "token": inv_token,
+                "deviceToken": device.token,
+                "host": self.instance_id}
+
+    def invoke_command(self, assignment_token: str, command_token: str,
+                       parameter_values: Optional[Dict[str, str]] = None,
+                       initiator: str = "REST",
+                       initiator_id: Optional[str] = None,
+                       ts_s: Optional[int] = None) -> dict:
+        """Federated invocation: run locally when this host owns the
+        assignment, otherwise route over the fabric to the owner (the
+        reference's web-rest demuxing management calls to the owning
+        service instance, SURVEY.md §3.3-3.4).  An unreachable peer makes
+        the outcome AMBIGUOUS (it may have queued before dying) — that
+        surfaces as a 5xx-class ServiceError, never a definitive 404 that
+        would invite a double-delivering retry."""
+        from sitewhere_tpu.services.common import EntityNotFound, ServiceError
+
+        kwargs = dict(command_token=command_token,
+                      parameter_values=parameter_values,
+                      initiator=initiator, initiator_id=initiator_id,
+                      ts_s=ts_s)
+        try:
+            return self.create_command_invocation(assignment_token, **kwargs)
+        except EntityNotFound:
+            from sitewhere_tpu.rpc.channel import RpcError
+
+            ambiguous = False
+            for _p, demux in sorted(self._peer_demuxes.items()):
+                if demux is None:
+                    continue
+                try:
+                    result, _ = demux.call("command.invoke", {
+                        "assignmentToken": assignment_token,
+                        "commandToken": command_token,
+                        "parameterValues": dict(parameter_values or {}),
+                        "initiator": initiator,
+                        "initiatorId": initiator_id,
+                        "ts": ts_s,
+                    })
+                    return result
+                except RpcError as e:
+                    if e.error != "not_found":
+                        raise
+                except Exception:
+                    ambiguous = True   # peer may have queued before dying
+            if ambiguous:
+                raise ServiceError(
+                    f"assignment {assignment_token} not found locally and "
+                    "a peer was unreachable — invocation state unknown; "
+                    "retrying may double-deliver")
+            raise
+
     def cluster_topology(self) -> dict:
         """Every host's topology, aggregated over the fabric (reference:
         ``TopologyStateAggregator.java:40-113`` consumes all

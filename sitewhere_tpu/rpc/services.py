@@ -117,6 +117,19 @@ def bind_instance(server: RpcServer, inst) -> None:
     # ---- state / topology (DeviceStateImpl + TopologyStateAggregator) ------
     reg("state.get", lambda c, b: jsonable(
         inst.device_state.get_device_state(b["deviceToken"])))
+
+    # ---- command delivery (federated invocation; SURVEY.md §3.4) ----------
+    # Deliberately create_command_invocation, NOT invoke_command: the
+    # owner must answer not_found for an assignment it doesn't hold, or
+    # two peers would ping-pong an unknown token forever.  The caller's
+    # initiator rides through so audit data doesn't depend on placement.
+    reg("command.invoke", lambda c, b: inst.create_command_invocation(
+        b["assignmentToken"],
+        command_token=str(b["commandToken"]),
+        parameter_values=dict(b.get("parameterValues") or {}),
+        initiator=str(b.get("initiator") or "RPC"),
+        initiator_id=b.get("initiatorId") or c.username,
+        ts_s=b.get("ts")))
     reg("instance.topology", lambda c, b: inst.topology())
     reg("instance.ping", lambda c, b: {"instance": inst.instance_id,
                                        "ts": time.time()},

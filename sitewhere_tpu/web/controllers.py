@@ -221,6 +221,10 @@ def register_routes(gw: RestGateway, inst) -> None:
         kind = q.params["kind"]
         etype = _EVENT_TYPE_NAMES.get(kind)
         require(etype is not None, EntityNotFound(f"no event kind {kind!r}"))
+        if etype == EventType.COMMAND_INVOCATION:
+            # before the local assignment lookup: invocations federate to
+            # the assignment's owning host when it isn't here
+            return create_invocation(q)
         device, _ = _assignment_device(q.params["token"])
         body = q.json()
         from sitewhere_tpu.services.common import now_s
@@ -249,8 +253,6 @@ def register_routes(gw: RestGateway, inst) -> None:
                 alert_type=str(body.get("type", "alert")),
                 alert_level=int(body.get("level", AlertLevel.INFO)),
                 alert_message=body.get("message"), **common)
-        elif etype == EventType.COMMAND_INVOCATION:
-            return create_invocation(q)
         else:
             req_ = DecodedRequest(kind=RequestKind.STATE_CHANGE, **common)
         inst.dispatcher.ingest(req_)
@@ -259,45 +261,23 @@ def register_routes(gw: RestGateway, inst) -> None:
                 "eventType": kind}
 
     def create_invocation(q: Request):
-        """Command invocation: ONE delivery path — journal the invocation
-        body and let the pipeline's command-row egress deliver it
-        (reference: REST creates an invocation *event* which flows through
-        enriched-command-invocations → command-delivery, SURVEY.md §3.4).
-        A direct ``commands.invoke`` here would double-deliver or, with no
-        journaled payload, false-positive the dead-letter journal."""
-        import json as _json
-
-        from sitewhere_tpu.services.common import mint_token, now_s
-
+        """Command invocation, federated: the assignment's owner runs the
+        one delivery path (invocation event → pipeline → command-row
+        egress).  Locally-unknown assignments are routed over the fabric
+        to the host that owns them — the web gateway demuxes management
+        calls to the right service instance exactly as the reference's
+        web-rest does over its ApiDemux (SURVEY.md §3.3-3.4)."""
         body = q.json()
         require("commandToken" in body,
                 ValidationError("commandToken required"))
-        device, _ = _assignment_device(q.params["token"])
-        # Pre-mint the invocation token so the caller can correlate with
-        # command responses and undelivered dead-letter records; delivery
-        # itself is asynchronous from the API's point of view, as in the
-        # reference (no delivery guarantee in the REST response).
-        inv_token = mint_token("inv")
-        payload = _json.dumps({
-            "deviceToken": device.token,
-            "type": "commandinvocation",
-            "request": {
-                "commandToken": str(body["commandToken"]),
-                "assignmentToken": q.params["token"],
-                "parameterValues": dict(body.get("parameterValues", {})),
-                "initiator": "REST",
-                "initiatorId": (q.claims or {}).get("sub"),
-                "invocationToken": inv_token,
-            },
-        }).encode()
-        inst.dispatcher.ingest(DecodedRequest(
-            kind=RequestKind.COMMAND_INVOCATION,
-            device_token=device.token,
-            ts_s=int(body.get("ts", now_s())),
-        ), payload)
-        inst.dispatcher.flush()
-        return {"queued": True, "token": inv_token,
-                "deviceToken": device.token}
+        return inst.invoke_command(
+            q.params["token"],
+            command_token=str(body["commandToken"]),
+            parameter_values=dict(body.get("parameterValues", {})),
+            initiator="REST",
+            initiator_id=(q.claims or {}).get("sub"),
+            ts_s=body.get("ts"),
+        )
 
     # Stream routes must precede the generic {kind} event routes or
     # GET .../streams would match {kind} and 404 as an unknown event kind

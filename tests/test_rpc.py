@@ -541,6 +541,39 @@ class TestForwarding:
             assert view["local"]["instance"] == "test-instance"
             assert view["peers"]["1"]["devices"] >= 1
             assert view["local"]["forwarding"]["forwarded_rows"] == 2
+
+            # federated command invocation: REST on host 0 invokes a
+            # command for host 1's device; the owner runs delivery
+            import http.client as _http
+
+            from sitewhere_tpu.web import WebServer
+
+            insts[1].device_management.create_device_command(
+                "sensor", token="ping", name="ping")
+            a1 = insts[1].device_management.get_active_assignment(tok1)
+            ws = WebServer(insts[0], port=0)
+            ws.start()
+            try:
+                conn = _http.HTTPConnection("127.0.0.1", ws.port,
+                                            timeout=10)
+                jwt = insts[0].tokens.mint("admin", ["ROLE_ADMIN"])
+                conn.request(
+                    "POST", f"/api/assignments/{a1.token}/invocations",
+                    body=_json.dumps({"commandToken": "ping"}),
+                    headers={"Authorization": f"Bearer {jwt}"})
+                resp = conn.getresponse()
+                out = _json.loads(resp.read())
+                conn.close()
+                assert resp.status == 200 and out["queued"]
+                insts[1].dispatcher.flush()
+                insts[1].event_store.flush()
+                from sitewhere_tpu.schema import EventType
+                invs = insts[1].event_store.query(
+                    device_id=d1,
+                    event_type=int(EventType.COMMAND_INVOCATION))
+                assert len(invs) == 1
+            finally:
+                ws.stop()
         finally:
             for inst in insts:
                 inst.stop()

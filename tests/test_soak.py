@@ -278,7 +278,9 @@ def test_multihost_peer_outage_loses_nothing(tmp_path):
             time.sleep(0.2)
         assert fwd.metrics()["pending"] == 0
         assert fwd.dead_lettered == 0
-        assert fwd.forwarded_rows == rows_each
+        # >= not ==: a batch accepted right as the peer stopped (reply
+        # lost) redelivers after restart and counts twice — at-least-once
+        assert fwd.forwarded_rows >= rows_each
 
         for inst in insts:
             inst.dispatcher.flush()
