@@ -657,6 +657,9 @@ class Instance(LifecycleComponent):
                 if demux is None:
                     continue
                 try:
+                    # short per-peer timeout: one hung peer must not
+                    # stall the caller's thread for the 30s default
+                    # times the fleet size
                     result, _ = demux.call("command.invoke", {
                         "assignmentToken": assignment_token,
                         "commandToken": command_token,
@@ -664,7 +667,7 @@ class Instance(LifecycleComponent):
                         "initiator": initiator,
                         "initiatorId": initiator_id,
                         "ts": ts_s,
-                    })
+                    }, timeout_s=5.0)
                     return result
                 except RpcError as e:
                     if e.error != "not_found":

@@ -369,12 +369,60 @@ static void crc_init(void) {
     crc_table_ready = 1;
 }
 
-/* zlib-compatible crc32 (poly 0xEDB88320, reflected, init/final xor) */
-static uint32_t crc32_bytes(const char *buf, Py_ssize_t len) {
-    uint32_t c = 0xFFFFFFFFu;
+/* zlib-compatible crc32 (poly 0xEDB88320, reflected, init/final xor);
+ * the chained form matches zlib.crc32(buf, prev). */
+static uint32_t crc32_chain(uint32_t prev, const char *buf, Py_ssize_t len) {
+    uint32_t c = prev ^ 0xFFFFFFFFu;
     for (Py_ssize_t i = 0; i < len; i++)
         c = crc_table[(c ^ (unsigned char)buf[i]) & 0xFF] ^ (c >> 8);
     return c ^ 0xFFFFFFFFu;
+}
+
+static uint32_t crc32_bytes(const char *buf, Py_ssize_t len) {
+    return crc32_chain(0, buf, len);
+}
+
+/* Rendezvous (HRW) owner — MUST match rpc/forward.owning_process:
+ * argmax_p crc32(token + "|p"), ties to the smallest p.  The per-process
+ * suffix strings are formatted ONCE per payload (hrw_ctx), not per line.
+ */
+typedef struct {
+    uint32_t nproc;
+    char (*suffix)[16];
+    int *slen;
+} hrw_ctx;
+
+static int hrw_ctx_init(hrw_ctx *ctx, uint32_t nproc) {
+    ctx->nproc = nproc;
+    ctx->suffix = malloc((size_t)nproc * sizeof *ctx->suffix);
+    ctx->slen = malloc((size_t)nproc * sizeof *ctx->slen);
+    if (!ctx->suffix || !ctx->slen) return -1;
+    for (uint32_t p = 0; p < nproc; p++)
+        ctx->slen[p] = snprintf(ctx->suffix[p], sizeof ctx->suffix[p],
+                                "|%u", p);
+    return 0;
+}
+
+static void hrw_ctx_free(hrw_ctx *ctx) {
+    free(ctx->suffix);
+    free(ctx->slen);
+}
+
+static int hrw_owner(const hrw_ctx *ctx, const char *token, Py_ssize_t len) {
+    if (ctx->nproc <= 1) return 0;
+    uint32_t base = crc32_bytes(token, len);
+    int best = 0;
+    uint32_t best_h = 0;
+    int have = 0;
+    for (uint32_t p = 0; p < ctx->nproc; p++) {
+        uint32_t h = crc32_chain(base, ctx->suffix[p], ctx->slen[p]);
+        if (!have || h > best_h) {
+            best = (int)p;
+            best_h = h;
+            have = 1;
+        }
+    }
+    return best;
 }
 
 /* String parse distinguishing escape (bail-worthy) from malformed:
@@ -545,7 +593,7 @@ static int utf8_valid(const unsigned char *s, Py_ssize_t n) {
 
 /* Owner of one line: >= 0 owner, -1 local (malformed/token-less),
  * -2 bail whole payload. */
-static int owner_of_line(cursor c, uint32_t nproc) {
+static int owner_of_line(cursor c, const hrw_ctx *ctx) {
     const char *tok = NULL, *hw = NULL;
     Py_ssize_t tok_len = 0, hw_len = 0;
     int have_tok = 0, have_hw = 0;
@@ -598,7 +646,7 @@ close:
     if (have_tok && tok_len > 0) { use = tok; use_len = tok_len; }
     else if (have_hw && hw_len > 0) { use = hw; use_len = hw_len; }
     if (use == NULL) return -1;
-    return (int)(crc32_bytes(use, use_len) % nproc);
+    return hrw_owner(ctx, use, use_len);
 }
 
 static PyObject *split_owner_lines(PyObject *self, PyObject *args) {
@@ -610,10 +658,15 @@ static PyObject *split_owner_lines(PyObject *self, PyObject *args) {
         return NULL;
     }
     if (!crc_table_ready) crc_init();
+    hrw_ctx ctx;
+    if (hrw_ctx_init(&ctx, (uint32_t)nproc) != 0) {
+        hrw_ctx_free(&ctx);
+        return PyErr_NoMemory();
+    }
     const char *buf = PyBytes_AS_STRING(payload);
     Py_ssize_t n = PyBytes_GET_SIZE(payload);
     PyObject *owners = PyList_New(0);
-    if (!owners) return NULL;
+    if (!owners) { hrw_ctx_free(&ctx); return NULL; }
 
     const char *p = buf, *end = buf + n;
     while (p < end) {
@@ -625,20 +678,23 @@ static PyObject *split_owner_lines(PyObject *self, PyObject *args) {
         if (q == line_end) { p = nl ? nl + 1 : end; continue; }
 
         cursor c = { p, line_end };
-        int owner = owner_of_line(c, (uint32_t)nproc);
+        int owner = owner_of_line(c, &ctx);
         if (owner == -2) {
             Py_DECREF(owners);
+            hrw_ctx_free(&ctx);
             Py_RETURN_NONE;   /* whole payload → Python path */
         }
         PyObject *o = PyLong_FromLong(owner);
         if (!o || PyList_Append(owners, o) != 0) {
             Py_XDECREF(o);
             Py_DECREF(owners);
+            hrw_ctx_free(&ctx);
             return NULL;
         }
         Py_DECREF(o);
         p = nl ? nl + 1 : end;
     }
+    hrw_ctx_free(&ctx);
     return owners;
 }
 
@@ -647,7 +703,7 @@ static PyMethodDef methods[] = {
      "Scan NDJSON measurement envelopes into column buffers; None = "
      "shape mismatch, caller must fall back to the Python decoder."},
     {"split_owner_lines", split_owner_lines, METH_VARARGS,
-     "Owner index (crc32(token) %% n) per non-blank NDJSON line; -1 = "
+     "Rendezvous-hash owner per non-blank NDJSON line; -1 = "
      "local/malformed; None = bail, caller must use the Python splitter."},
     {NULL, NULL, 0, NULL},
 };

@@ -47,10 +47,28 @@ SPOOL_POLL_RECORDS = 64    # batches per send drain
 
 
 def owning_process(device_token: str, n_processes: int) -> int:
-    """Stable token → process mapping (Kafka's murmur2-keyed partition
-    analog).  crc32 is stable across processes and Python runs — the
-    builtin ``hash`` is salted per process and MUST NOT be used here."""
-    return zlib.crc32(device_token.encode("utf-8")) % n_processes
+    """Stable token → process mapping by rendezvous (highest-random-
+    weight) hashing: owner = argmax_p crc32(token + "|p").
+
+    Kafka's keyed partitioning analog, but with the elasticity property
+    a plain ``hash % P`` lacks: growing the fleet from P to P+1 hosts
+    remaps only ~1/(P+1) of devices instead of nearly all of them — the
+    partition-reassignment story without a coordinator.  Ties break to
+    the smallest process id (first maximum).  crc32 is stable across
+    processes and Python runs — the builtin ``hash`` is salted per
+    process and MUST NOT be used here.  The native scanner
+    (``swwire.c``) computes the identical function; the two MUST stay in
+    lock-step or one device's stream would split across hosts.
+    """
+    if n_processes <= 1:
+        return 0
+    base = zlib.crc32(device_token.encode("utf-8"))
+    best, best_h = 0, -1
+    for p in range(n_processes):
+        h = zlib.crc32(b"|%d" % p, base)
+        if h > best_h:
+            best, best_h = p, h
+    return best
 
 
 def split_lines(payload: bytes, n_processes: int) -> Dict[int, List[bytes]]:

@@ -128,7 +128,7 @@ def bind_instance(server: RpcServer, inst) -> None:
         command_token=str(b["commandToken"]),
         parameter_values=dict(b.get("parameterValues") or {}),
         initiator=str(b.get("initiator") or "RPC"),
-        initiator_id=b.get("initiatorId") or c.username,
+        initiator_id=b.get("initiatorId"),
         ts_s=b.get("ts")))
     reg("instance.topology", lambda c, b: inst.topology())
     reg("instance.ping", lambda c, b: {"instance": inst.instance_id,

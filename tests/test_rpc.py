@@ -367,8 +367,23 @@ class TestDomainServices:
 class TestForwarding:
     def test_owning_process_stable(self):
         assert owning_process("dev-1", 4) == owning_process("dev-1", 4)
-        owners = {owning_process(f"dev-{i}", 4) for i in range(64)}
+        owners = {owning_process(f"dev-{i}", 4) for i in range(512)}
         assert owners == {0, 1, 2, 3}   # spreads over all processes
+
+    def test_owning_process_rendezvous_elasticity(self):
+        """Growing the fleet P -> P+1 remaps only ~1/(P+1) of devices
+        (rendezvous hashing) — a modulo hash would remap ~P/(P+1)."""
+        tokens = [f"dev-{i}" for i in range(2000)]
+        for P in (2, 4, 8):
+            moved = sum(owning_process(t, P) != owning_process(t, P + 1)
+                        for t in tokens)
+            frac = moved / len(tokens)
+            assert frac < 2.5 / (P + 1), f"P={P}: {frac:.2%} moved"
+            assert frac > 0   # some movement is expected
+            # devices that moved only ever move TO the new process
+            for t in tokens:
+                a, b = owning_process(t, P), owning_process(t, P + 1)
+                assert a == b or b == P
 
     def test_split_lines_unparseable_stays_local(self):
         payload = (b'{"deviceToken": "d", "type": "Measurement"}\n'
@@ -669,6 +684,15 @@ class TestForwarding:
                 fwd.flush()
             with fwd._lock:
                 assert len(fwd._senders) <= 1
+            # wait out the in-flight sender: mid-poll the reader position
+            # sits past the record until the failure seeks back, so
+            # pending only settles once no sender is running
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                with fwd._lock:
+                    if not fwd._senders:
+                        break
+                time.sleep(0.05)
             assert fwd.metrics()["pending"] == 1   # retained, not lost
         finally:
             fwd.stop()
