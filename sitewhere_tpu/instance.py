@@ -290,6 +290,14 @@ class Instance(LifecycleComponent):
                 data_dir=self.data_dir))
         else:
             self._peer_demuxes = {}
+        self._rpc_peers = list(peers)
+        if self._peer_demuxes:
+            # live endpoint reload (the Consul-watch analog): a peer that
+            # moved hosts/ports picks up on config.reload() without a
+            # restart.  Changing the NUMBER of peers changes device
+            # ownership (rendezvous hash over P) and requires a restart —
+            # reject it rather than silently split streams.
+            self.config.on_change(self._on_peers_changed)
 
         # event search (service-event-search analog): the local store is
         # the built-in index; in a multi-host topology every peer's store
@@ -332,6 +340,36 @@ class Instance(LifecycleComponent):
         self.restored = self.checkpointer.restore()
 
     # -- wiring helpers -----------------------------------------------------
+
+    def _on_peers_changed(self, config) -> None:
+        new_peers = list(config.get("rpc.peers") or [])
+        old_peers = self._rpc_peers
+        if len(new_peers) != len(old_peers):
+            logger.error(
+                "rpc.peers count changed %d -> %d: device ownership "
+                "(rendezvous over P) would shift — restart required; "
+                "keeping the old endpoints",
+                len(old_peers), len(new_peers))
+            return
+        # A reorder of EXISTING endpoints rebinds process ids to
+        # different hosts — the same ownership shift as a count change
+        # (devices of process p would ship to a host that believes it is
+        # process q).  A host MOVING keeps its index; an address already
+        # bound to another index (including our own) may not reappear at
+        # a changed one.
+        for p, ep in enumerate(new_peers):
+            if ep != old_peers[p] and ep in old_peers:
+                logger.error(
+                    "rpc.peers reorder detected (%s moved from index %d "
+                    "to %d): process-id/host binding would shift — "
+                    "restart required; keeping the old endpoints",
+                    ep, old_peers.index(ep), p)
+                return
+        for p, demux in self._peer_demuxes.items():
+            if demux is not None and demux.endpoints != [new_peers[p]]:
+                logger.info("peer %d endpoint -> %s", p, new_peers[p])
+                demux.set_endpoints([new_peers[p]])
+        self._rpc_peers = new_peers
 
     def _tenant_dense_id(self, token: str) -> int:
         return self.identity.tenant.mint(token)
@@ -560,6 +598,10 @@ class Instance(LifecycleComponent):
 
     def terminate(self) -> None:
         super().terminate()
+        if self._peer_demuxes:
+            # the Config can outlive this Instance: a stale listener
+            # would hold the whole graph and resurrect closed channels
+            self.config.remove_listener(self._on_peers_changed)
         for demux in self._peer_demuxes.values():
             if demux is not None:
                 demux.close()

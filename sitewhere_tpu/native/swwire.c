@@ -382,30 +382,41 @@ static uint32_t crc32_bytes(const char *buf, Py_ssize_t len) {
     return crc32_chain(0, buf, len);
 }
 
+/* murmur3 32-bit finalizer: the non-linear mixer rendezvous weights
+ * need (raw CRC32 is linear — equal-length suffixes give weights that
+ * differ by constant XORs, so the argmax would ignore the token). */
+static inline uint32_t fmix32(uint32_t h) {
+    h ^= h >> 16;
+    h *= 0x85EBCA6Bu;
+    h ^= h >> 13;
+    h *= 0xC2B2AE35u;
+    h ^= h >> 16;
+    return h;
+}
+
 /* Rendezvous (HRW) owner — MUST match rpc/forward.owning_process:
- * argmax_p crc32(token + "|p"), ties to the smallest p.  The per-process
- * suffix strings are formatted ONCE per payload (hrw_ctx), not per line.
+ * argmax_p fmix32(crc32(token) ^ crc32("|p")), ties to the smallest p.
+ * The per-process suffix CRCs are computed ONCE per payload (hrw_ctx).
  */
 typedef struct {
     uint32_t nproc;
-    char (*suffix)[16];
-    int *slen;
+    uint32_t *suffix_crc;
 } hrw_ctx;
 
 static int hrw_ctx_init(hrw_ctx *ctx, uint32_t nproc) {
     ctx->nproc = nproc;
-    ctx->suffix = malloc((size_t)nproc * sizeof *ctx->suffix);
-    ctx->slen = malloc((size_t)nproc * sizeof *ctx->slen);
-    if (!ctx->suffix || !ctx->slen) return -1;
-    for (uint32_t p = 0; p < nproc; p++)
-        ctx->slen[p] = snprintf(ctx->suffix[p], sizeof ctx->suffix[p],
-                                "|%u", p);
+    ctx->suffix_crc = malloc((size_t)nproc * sizeof *ctx->suffix_crc);
+    if (!ctx->suffix_crc) return -1;
+    char suffix[16];
+    for (uint32_t p = 0; p < nproc; p++) {
+        int slen = snprintf(suffix, sizeof suffix, "|%u", p);
+        ctx->suffix_crc[p] = crc32_bytes(suffix, slen);
+    }
     return 0;
 }
 
 static void hrw_ctx_free(hrw_ctx *ctx) {
-    free(ctx->suffix);
-    free(ctx->slen);
+    free(ctx->suffix_crc);
 }
 
 static int hrw_owner(const hrw_ctx *ctx, const char *token, Py_ssize_t len) {
@@ -415,7 +426,7 @@ static int hrw_owner(const hrw_ctx *ctx, const char *token, Py_ssize_t len) {
     uint32_t best_h = 0;
     int have = 0;
     for (uint32_t p = 0; p < ctx->nproc; p++) {
-        uint32_t h = crc32_chain(base, ctx->suffix[p], ctx->slen[p]);
+        uint32_t h = fmix32(base ^ ctx->suffix_crc[p]);
         if (!have || h > best_h) {
             best = (int)p;
             best_h = h;

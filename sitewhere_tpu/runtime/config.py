@@ -145,6 +145,15 @@ class Config:
     def on_change(self, listener: Callable[["Config"], None]) -> None:
         self._listeners.append(listener)
 
+    def remove_listener(self, listener: Callable[["Config"], None]) -> None:
+        """Deregister (components MUST call this on terminate — a Config
+        can outlive the Instance built from it, and a stale listener
+        would hold the whole object graph and act on a dead instance)."""
+        try:
+            self._listeners.remove(listener)
+        except ValueError:
+            pass
+
     def reload(self) -> None:
         """Re-read source files + env; notify listeners (dynamic restart
         analog, ``MultitenantMicroservice.java:342``)."""

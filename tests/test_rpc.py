@@ -275,6 +275,30 @@ class TestDemux:
         b.stop()
 
 
+class TestWireLimits:
+    def test_oversized_attachment_rejected_at_encode(self):
+        frame = wire.request_frame(
+            1, "m", None, attachment=b"x" * (wire.MAX_ATTACH + 1))
+        with pytest.raises(wire.WireError):
+            wire.encode(frame)
+
+    def test_expired_token_unauthorized(self):
+        tokens = TokenManagement()
+        srv = RpcServer(port=0, tokens=tokens)
+        srv.register("who", lambda ctx, body: {"user": ctx.username})
+        srv.start()
+        try:
+            expired = tokens.mint("u", ["ROLE_USER"], expiration_min=-1)
+            chan = RpcChannel(srv.endpoint,
+                              token_provider=lambda: expired)
+            with pytest.raises(RpcError) as exc:
+                chan.call("who", {})
+            assert exc.value.error == "unauthorized"
+            chan.close()
+        finally:
+            srv.stop()
+
+
 # ---------------------------------------------------------------------------
 # domain services over the fabric + near-cache
 # ---------------------------------------------------------------------------
@@ -371,15 +395,27 @@ class TestForwarding:
         assert owners == {0, 1, 2, 3}   # spreads over all processes
 
     def test_owning_process_rendezvous_elasticity(self):
-        """Growing the fleet P -> P+1 remaps only ~1/(P+1) of devices
-        (rendezvous hashing) — a modulo hash would remap ~P/(P+1)."""
-        tokens = [f"dev-{i}" for i in range(2000)]
-        for P in (2, 4, 8):
+        """Growing the fleet P -> P+1 remaps ~1/(P+1) of devices
+        (rendezvous hashing; a modulo hash would remap ~P/(P+1)) and
+        load stays balanced — including odd P, where a linear weight
+        function (raw chained CRC32, the bug this test pins) skewed one
+        process to 2× its share."""
+        from collections import Counter
+
+        tokens = [f"dev-{i}" for i in range(4000)]
+        for P in (2, 3, 4, 5, 7, 8):
+            counts = Counter(owning_process(t, P) for t in tokens)
+            assert set(counts) == set(range(P))
+            share = len(tokens) / P
+            for p, n in counts.items():
+                assert 0.8 * share < n < 1.2 * share, \
+                    f"P={P}: process {p} holds {n} (fair share {share:.0f})"
             moved = sum(owning_process(t, P) != owning_process(t, P + 1)
                         for t in tokens)
             frac = moved / len(tokens)
-            assert frac < 2.5 / (P + 1), f"P={P}: {frac:.2%} moved"
-            assert frac > 0   # some movement is expected
+            ideal = 1 / (P + 1)
+            assert ideal / 1.5 < frac < ideal * 1.5, \
+                f"P={P}: {frac:.2%} moved (ideal {ideal:.2%})"
             # devices that moved only ever move TO the new process
             for t in tokens:
                 a, b = owning_process(t, P), owning_process(t, P + 1)
@@ -665,6 +701,106 @@ class TestForwarding:
         finally:
             inst.stop()
             inst.terminate()
+
+    def test_peer_endpoint_live_reload(self, tmp_path):
+        """A peer that moves to a new port picks up on config.reload()
+        (Consul-watch analog) without restarting the local instance; a
+        peer-COUNT change is rejected (ownership would shift)."""
+        import json as _json
+        import socket as _socket
+
+        def free_port():
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        p0 = free_port()
+        # remote "host 1": one instance, server rebinds ports across the test
+        remote = Instance(make_config(tmp_path / "remote"))
+        remote.start()
+        remote.device_management.create_device_type(token="sensor", name="S")
+        tok1 = next(f"dev-{i}" for i in range(100)
+                    if owning_process(f"dev-{i}", 2) == 1)
+        remote.device_management.create_device(token=tok1,
+                                               device_type="sensor")
+        remote.device_management.create_device_assignment(device=tok1)
+        srv_a = RpcServer(port=0, tokens=remote.tokens)
+        bind_instance(srv_a, remote)
+        srv_a.start()
+
+        cfg_path = tmp_path / "host0.json"
+        base = make_config(tmp_path / "local")._tree
+
+        def write_cfg(peer_ep):
+            base["rpc"] = {
+                "server": {"enabled": True, "host": "127.0.0.1",
+                           "port": p0},
+                "process_id": 0,
+                "peers": [f"127.0.0.1:{p0}", peer_ep],
+                "forward_deadline_ms": 10.0,
+            }
+            base["security"] = {"jwt_secret": "reload-secret"}
+            cfg_path.write_text(_json.dumps(base))
+
+        write_cfg(srv_a.endpoint)
+        from sitewhere_tpu.runtime.config import Config
+        cfg = Config.load(str(cfg_path), apply_env=False)
+        local = Instance(cfg)
+        local.start()
+        # remote verifies local's service JWTs: same shared secret
+        remote.tokens._secret = local.tokens._secret
+
+        line = (b'{"deviceToken": "%s", "type": "Measurement",'
+                b' "request": {"name": "t", "value": 1,'
+                b' "eventDate": 1000}}' % tok1.encode())
+        try:
+            local.forwarder.ingest_payload(line)
+            local.forwarder.flush(wait=True)
+            assert local.forwarder.forwarded_rows == 1
+
+            # peer moves: new server, new port; config file follows
+            srv_a.stop()
+            srv_b = RpcServer(port=0, tokens=remote.tokens)
+            bind_instance(srv_b, remote)
+            srv_b.start()
+            write_cfg(srv_b.endpoint)
+            cfg.reload()
+            assert local._peer_demuxes[1].endpoints == [srv_b.endpoint]
+
+            local.forwarder.ingest_payload(line)
+            deadline = time.time() + 10
+            while (time.time() < deadline
+                   and local.forwarder.forwarded_rows < 2):
+                local.forwarder.flush(wait=True)
+                time.sleep(0.05)
+            assert local.forwarder.forwarded_rows == 2
+            assert local.forwarder.dead_lettered == 0
+
+            # count change is refused: endpoints stay as they were
+            base["rpc"]["peers"] = [f"127.0.0.1:{p0}", srv_b.endpoint,
+                                    "127.0.0.1:9999"]
+            cfg_path.write_text(_json.dumps(base))
+            cfg.reload()
+            assert len(local._peer_demuxes) == 2
+            assert local._peer_demuxes[1].endpoints == [srv_b.endpoint]
+            # a pure swap is refused too: same endpoints, different
+            # process-id binding = ownership shift
+            base["rpc"]["peers"] = [srv_b.endpoint, f"127.0.0.1:{p0}"]
+            cfg_path.write_text(_json.dumps(base))
+            cfg.reload()
+            assert local._peer_demuxes[1].endpoints == [srv_b.endpoint]
+            srv_b.stop()
+            # terminate deregisters the listener: a reload after
+            # teardown must not touch the dead instance's demuxes
+            assert local._on_peers_changed in cfg._listeners
+        finally:
+            local.stop()
+            local.terminate()
+            remote.stop()
+            remote.terminate()
+        assert local._on_peers_changed not in cfg._listeners
 
     def test_down_peer_does_not_accumulate_sender_threads(self, tmp_path):
         """One sender per owner at a time: a down peer being retried must
