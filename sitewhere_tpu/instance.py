@@ -342,7 +342,17 @@ class Instance(LifecycleComponent):
     # -- wiring helpers -----------------------------------------------------
 
     def _on_peers_changed(self, config) -> None:
+        from sitewhere_tpu.rpc.wire import parse_endpoint
+
         new_peers = list(config.get("rpc.peers") or [])
+        # validate EVERY endpoint before touching any demux: a typo'd
+        # port must not leave the fleet half-updated
+        try:
+            for ep in new_peers:
+                parse_endpoint(str(ep))
+        except ValueError as e:
+            logger.error("rpc.peers reload rejected: %s", e)
+            return
         old_peers = self._rpc_peers
         if len(new_peers) != len(old_peers):
             logger.error(

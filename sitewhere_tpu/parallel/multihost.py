@@ -28,11 +28,14 @@ Durability stays per-host: each process journals ITS ingest locally and
 commits its own offsets (Kafka's per-partition offsets, exactly);
 checkpoints of the sharded tensors go through jax process-local shards.
 
-Validation status: the shard-ownership math and global-batch assembly
-are unit-tested in-process (a 1-process "cluster" is a degenerate but
-real configuration); true multi-process DCN runs need hardware this
-environment does not provide and MUST be smoke-tested before production
-use.
+Validation status: the shard-ownership math and global assembly are
+unit-tested in-process AND exercised by a real 2-process cluster —
+``tests/test_multihost.py::test_two_process_sharded_step`` spawns two
+OS processes over a loopback coordinator (Gloo collectives standing in
+for DCN), each holding 2 of 4 mesh shards and contributing only its
+own registry/state rows + batch segment via :func:`make_global_inputs`,
+and runs ONE shard_map pipeline step across both.  True TPU-pod DCN
+runs still deserve a hardware smoke test before production use.
 """
 
 from __future__ import annotations
@@ -115,6 +118,57 @@ def owned_device_range(shard: int, registry_capacity: int,
     return shard * rows, (shard + 1) * rows
 
 
+def make_global_tree(mesh, local_tree, specs, global_rows: int):
+    """Assemble a pytree of per-process LOCAL rows into globally sharded
+    arrays (``jax.make_array_from_process_local_data`` per leaf).
+
+    ``specs`` is the matching PartitionSpec tree (``_specs_sharded`` /
+    ``_specs_replicated`` from :mod:`sitewhere_tpu.pipeline.sharded`):
+    sharded leaves carry this process's shard rows and get a global
+    leading dim of ``global_rows``; replicated leaves (``P()``) must be
+    byte-identical on every process and keep their local shape."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    replicated = PartitionSpec()
+
+    def one(local, spec):
+        local = np.asarray(local)
+        sharding = NamedSharding(mesh, spec)
+        if spec == replicated:
+            shape = local.shape
+        else:
+            shape = (global_rows,) + local.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, local, shape)
+
+    return jax.tree_util.tree_map(one, local_tree, specs)
+
+
+def make_global_inputs(mesh, registry_local, state_local, rules, zones,
+                       batch_local, *, registry_capacity: int,
+                       batch_width: int):
+    """The multi-process analog of ``pipeline.sharded.place_inputs`` +
+    ``place_batch``: each process contributes ONLY its shards' registry/
+    state rows and its batch segment; rules/zones replicate.  No host
+    ever materializes a full global array — the property that lets the
+    registry scale past one host's memory (SURVEY.md §2.4)."""
+    from sitewhere_tpu.pipeline.sharded import (
+        _specs_replicated,
+        _specs_sharded,
+    )
+
+    return (
+        make_global_tree(mesh, registry_local, _specs_sharded(registry_local),
+                         registry_capacity),
+        make_global_tree(mesh, state_local, _specs_sharded(state_local),
+                         registry_capacity),
+        make_global_tree(mesh, rules, _specs_replicated(rules), 0),
+        make_global_tree(mesh, zones, _specs_replicated(zones), 0),
+        make_global_tree(mesh, batch_local, _specs_sharded(batch_local),
+                         batch_width),
+    )
+
+
 def make_global_batch(mesh, local_cols: Dict[str, np.ndarray],
                       global_width: int):
     """Assemble this process's batch segment into the global sharded
@@ -123,13 +177,10 @@ def make_global_batch(mesh, local_cols: Dict[str, np.ndarray],
     ``local_cols`` carries this host's rows for ITS shard segments, laid
     out contiguously (the batcher's per-shard segment layout restricted
     to local shards); ``global_width`` is the full batch width across
-    all processes.
+    all processes.  Thin wrapper over :func:`make_global_tree` so there
+    is exactly one assembly implementation.
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
-    sharding = NamedSharding(mesh, P(SHARD_AXIS))
-    return {
-        name: jax.make_array_from_process_local_data(
-            sharding, arr, (global_width,) + arr.shape[1:])
-        for name, arr in local_cols.items()
-    }
+    specs = {name: P(SHARD_AXIS) for name in local_cols}
+    return make_global_tree(mesh, local_cols, specs, global_width)

@@ -75,6 +75,12 @@ def owning_process(device_token: str, n_processes: int) -> int:
     (``swwire.c`` ``hrw_owner``) computes the identical function; the
     two MUST stay in lock-step or one device's stream would split
     across hosts.
+
+    VERSIONING: this function IS the cluster's data placement.  Any
+    change to it (or to fmix32) remaps devices to different owners, so
+    it must roll out as a coordinated full-fleet restart with registry
+    re-registration — a mixed-version fleet splits streams exactly like
+    a Python/C mismatch would.
     """
     if n_processes <= 1:
         return 0

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import copy
 import json
+import logging
 import os
 import threading
 from typing import Any, Callable, Dict, List, Optional
@@ -165,8 +166,14 @@ class Config:
             self._tree = copy.deepcopy(DEFAULTS)
             _deep_merge(self._tree, tree)
             self._apply_env()
-        for listener in self._listeners:
-            listener(self)
+        # snapshot: listeners may deregister concurrently (terminate),
+        # and one raising listener must not starve the rest
+        for listener in list(self._listeners):
+            try:
+                listener(self)
+            except Exception:   # noqa: BLE001
+                logging.getLogger("sitewhere_tpu.config").exception(
+                    "config listener %r failed", listener)
 
 
 class _Missing:

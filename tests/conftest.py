@@ -37,3 +37,8 @@ def mesh8(devices):
     from sitewhere_tpu.parallel.mesh import make_mesh
 
     return make_mesh(n_devices=8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running soak / multi-process integration tests")

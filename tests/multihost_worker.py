@@ -1,0 +1,125 @@
+"""One process of a REAL multi-process pipeline-step run.
+
+Spawned by ``tests/test_multihost.py::test_two_process_sharded_step`` —
+two of these form a genuine ``jax.distributed`` cluster over a loopback
+coordinator (Gloo collectives = the DCN path on CPU), each holding 2 of
+the 4 mesh shards.  Every process contributes ONLY its shards' registry/
+state rows and its own batch segment (``make_global_inputs``), then the
+one jitted shard_map step runs across both processes and the psum'd
+metrics must agree everywhere.  This is the validation the module
+docstring of ``parallel/multihost.py`` calls for: the shard-ownership
+math and global assembly exercised by an actual multi-process program,
+not a 1-process degenerate.
+"""
+
+import os
+import sys
+
+# 2 virtual CPU devices per process -> 4 global over 2 processes.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from sitewhere_tpu.parallel import multihost  # noqa: E402
+
+assert multihost.initialize_from_env(), "SW_COORDINATOR env must be set"
+
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from sitewhere_tpu.parallel.mesh import make_mesh  # noqa: E402
+from sitewhere_tpu.pipeline.sharded import build_sharded_step  # noqa: E402
+from sitewhere_tpu.schema import (  # noqa: E402
+    AssignmentStatus,
+    DeviceState,
+    EventBatch,
+    EventType,
+    Registry,
+    RuleTable,
+    ZoneTable,
+)
+
+PID = int(os.environ["SW_PROCESS_ID"])
+N_SHARDS = 4
+CAPACITY = 64           # global registry rows
+WIDTH = 64              # global batch rows
+ROWS_LOCAL = CAPACITY // N_SHARDS
+
+mesh = make_mesh(n_devices=N_SHARDS)
+local_shards = multihost.process_local_shards(mesh)
+print(f"[p{PID}] local shards: {local_shards}", flush=True)
+assert len(local_shards) == 2, local_shards
+
+# --- this process's registry/state rows (its shards only) ----------------
+def slice_rows(arr):
+    arr = np.asarray(arr)
+    if arr.ndim == 0:
+        return arr        # scalar leaves replicate (spec P())
+    out = []
+    for s in local_shards:
+        lo, hi = multihost.owned_device_range(s, CAPACITY, N_SHARDS)
+        out.append(arr[lo:hi])
+    return np.concatenate(out)
+
+
+# every device active + actively assigned (built identically on every
+# process, then sliced down to the local shards' rows)
+full_registry = jax.tree_util.tree_map(
+    lambda a: np.array(a), Registry.empty(CAPACITY))
+full_registry.active[:] = True
+full_registry.tenant_id[:] = 0
+full_registry.device_type_id[:] = 0
+full_registry.assignment_id[:] = np.arange(CAPACITY, dtype=np.int32)
+full_registry.assignment_status[:] = int(AssignmentStatus.ACTIVE)
+registry_local = jax.tree_util.tree_map(slice_rows, full_registry)
+
+state_local = jax.tree_util.tree_map(
+    lambda a: slice_rows(np.asarray(a)), DeviceState.empty(CAPACITY))
+rules = jax.tree_util.tree_map(np.asarray, RuleTable.empty(1))
+zones = jax.tree_util.tree_map(np.asarray, ZoneTable.empty(1, max_verts=4))
+
+# --- this process's batch segment: rows for ITS devices -------------------
+width_local = WIDTH // 2
+batch_local = jax.tree_util.tree_map(
+    lambda a: np.array(a), EventBatch.empty(width_local))
+device_ids = []
+for s in local_shards:
+    lo, hi = multihost.owned_device_range(s, CAPACITY, N_SHARDS)
+    device_ids.extend(range(lo, lo + width_local // len(local_shards)))
+batch_local.valid[:] = True
+batch_local.device_id[:] = np.asarray(device_ids, np.int32)
+batch_local.tenant_id[:] = 0
+batch_local.event_type[:] = int(EventType.MEASUREMENT)
+batch_local.ts_s[:] = 1_753_800_000 + PID
+batch_local.mtype_id[:] = 0
+batch_local.value[:] = np.arange(width_local, dtype=np.float32) + 100 * PID
+
+registry, state, rules_g, zones_g, batch = multihost.make_global_inputs(
+    mesh, registry_local, state_local, rules, zones, batch_local,
+    registry_capacity=CAPACITY, batch_width=WIDTH)
+
+step = build_sharded_step(mesh, donate=False)
+new_state, out = step(registry, state, rules_g, zones_g, batch)
+jax.block_until_ready(out.metrics.processed)
+
+processed = int(out.metrics.processed.addressable_shards[0].data)
+accepted = int(out.metrics.accepted.addressable_shards[0].data)
+unregistered = int(out.metrics.unregistered.addressable_shards[0].data)
+print(f"[p{PID}] processed={processed} accepted={accepted} "
+      f"unregistered={unregistered}", flush=True)
+assert processed == WIDTH, processed
+assert accepted == WIDTH, accepted
+assert unregistered == 0, unregistered
+
+# state landed on the right shards: OUR addressable shard rows carry the
+# new timestamps for the devices we fed
+for shard in new_state.last_event_ts_s.addressable_shards:
+    rows = np.asarray(shard.data)
+    touched = (rows >= 1_753_800_000).sum()
+    assert touched == width_local // len(local_shards), (
+        PID, shard.index, touched)
+print(f"[p{PID}] MULTIPROC OK", flush=True)

@@ -6,6 +6,8 @@ full batch.  True DCN runs need multi-process hardware (documented in
 parallel/multihost.py).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -48,3 +50,52 @@ def test_make_global_batch_round_trips(mesh8):
     np.testing.assert_array_equal(np.asarray(out["device_id"]),
                                   cols["device_id"])
     np.testing.assert_allclose(np.asarray(out["value"]), cols["value"])
+
+
+@pytest.mark.slow
+def test_two_process_sharded_step(tmp_path):
+    """REAL multi-process validation: two OS processes form a
+    jax.distributed cluster (loopback coordinator, Gloo collectives —
+    the CPU stand-in for DCN), each holding 2 of 4 mesh shards, each
+    contributing only its own registry/state rows and batch segment;
+    ONE shard_map pipeline step runs across both and the psum'd metrics
+    agree everywhere.  See tests/multihost_worker.py."""
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "SW_COORDINATOR": f"127.0.0.1:{port}",
+            "SW_NUM_PROCESSES": "2",
+            "SW_PROCESS_ID": str(pid),
+            "PYTHONPATH": os.path.dirname(os.path.dirname(worker))
+                          + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        # fresh XLA_FLAGS: the worker sets its own device count and the
+        # conftest's 8-device flag would skew the per-process mesh
+        env["XLA_FLAGS"] = ""
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+        assert f"[p{pid}] MULTIPROC OK" in out, out
+        assert "processed=64 accepted=64 unregistered=0" in out, out
