@@ -22,7 +22,6 @@ What it shows:
 import json
 import socket
 import tempfile
-import time
 
 from sitewhere_tpu.instance import Instance
 from sitewhere_tpu.runtime.config import Config

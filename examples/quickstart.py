@@ -93,7 +93,6 @@ while time.time() < deadline:
     time.sleep(0.2)
 
 # --- query it all back ------------------------------------------------------
-d0 = int(inst.identity.device.lookup("thermo-0"))
 measurements = inst.event_store.query(
     event_type=int(EventType.MEASUREMENT))
 alerts = inst.event_store.query(event_type=int(EventType.ALERT))
@@ -108,7 +107,7 @@ print(f"thermo-0 last loc   : {state['last_location']['lat']:.1f}, "
 print(f"pipeline accepted   : {topo['pipeline']['accepted']}")
 
 assert measurements.total == 12
-assert alerts.total == 7     # six overheats (26..36 > 30) + intrusion
+assert alerts.total == 7     # six overheats (31..36 > 30) + intrusion
 assert state["last_location"]["lat"] == 5.0
 
 inst.stop()
