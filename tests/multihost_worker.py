@@ -44,9 +44,10 @@ from sitewhere_tpu.schema import (  # noqa: E402
 )
 
 PID = int(os.environ["SW_PROCESS_ID"])
-N_SHARDS = 4
-CAPACITY = 64           # global registry rows
-WIDTH = 64              # global batch rows
+NPROC = int(os.environ["SW_NUM_PROCESSES"])
+N_SHARDS = 2 * NPROC    # 2 local devices per process
+CAPACITY = 16 * N_SHARDS   # global registry rows
+WIDTH = 16 * N_SHARDS      # global batch rows
 ROWS_LOCAL = CAPACITY // N_SHARDS
 
 mesh = make_mesh(n_devices=N_SHARDS)
@@ -83,7 +84,7 @@ rules = jax.tree_util.tree_map(np.asarray, RuleTable.empty(1))
 zones = jax.tree_util.tree_map(np.asarray, ZoneTable.empty(1, max_verts=4))
 
 # --- this process's batch segment: rows for ITS devices -------------------
-width_local = WIDTH // 2
+width_local = WIDTH // NPROC
 batch_local = jax.tree_util.tree_map(
     lambda a: np.array(a), EventBatch.empty(width_local))
 device_ids = []
