@@ -1,9 +1,11 @@
 """One process of a REAL multi-process pipeline-step run.
 
-Spawned by ``tests/test_multihost.py::test_two_process_sharded_step`` —
-two of these form a genuine ``jax.distributed`` cluster over a loopback
-coordinator (Gloo collectives = the DCN path on CPU), each holding 2 of
-the 4 mesh shards.  Every process contributes ONLY its shards' registry/
+Spawned once per process (``SW_NUM_PROCESSES`` of them; the in-suite
+``tests/test_multihost.py::test_two_process_sharded_step`` runs 2,
+standalone runs have validated 4) — together they form a genuine
+``jax.distributed`` cluster over a loopback coordinator (Gloo
+collectives = the DCN path on CPU), each process holding 2 of the
+``2*NPROC`` mesh shards.  Every process contributes ONLY its shards' registry/
 state rows and its own batch segment (``make_global_inputs``), then the
 one jitted shard_map step runs across both processes and the psum'd
 metrics must agree everywhere.  This is the validation the module
@@ -15,7 +17,7 @@ not a 1-process degenerate.
 import os
 import sys
 
-# 2 virtual CPU devices per process -> 4 global over 2 processes.
+# 2 virtual CPU devices per process -> 2*NPROC global devices.
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=2").strip()
 
@@ -44,6 +46,8 @@ from sitewhere_tpu.schema import (  # noqa: E402
 )
 
 PID = int(os.environ["SW_PROCESS_ID"])
+assert "SW_NUM_PROCESSES" in os.environ, \
+    "set SW_NUM_PROCESSES (fleet size) alongside SW_COORDINATOR"
 NPROC = int(os.environ["SW_NUM_PROCESSES"])
 N_SHARDS = 2 * NPROC    # 2 local devices per process
 CAPACITY = 16 * N_SHARDS   # global registry rows

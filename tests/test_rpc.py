@@ -10,6 +10,7 @@ Kafka's keyed-partition placement at the host boundary
 process, rows crossing "DCN" (localhost TCP) to their owning host.
 """
 
+import json
 import socket
 import threading
 import time
@@ -833,6 +834,46 @@ class TestForwarding:
         finally:
             fwd.stop()
             down.close()
+
+    def test_wrong_secret_peer_dead_letters_as_rejected(self, tmp_path):
+        """A peer whose JWT secret doesn't match rejects the forward as
+        unauthorized — a NON-retryable rejection, so rows dead-letter
+        with the reason recorded instead of spooling forever."""
+        peer = Instance(make_config(tmp_path / "peer"))
+        peer.start()
+        srv = RpcServer(port=0, tokens=peer.tokens)   # peer's own secret
+        bind_instance(srv, peer)
+        srv.start()
+        local = Instance(make_config(tmp_path / "local"))
+        local.start()
+        try:
+            # local mints with ITS secret; peer can't verify it
+            jwt = local.tokens.mint("system", ["ROLE_ADMIN"])
+            demux = RpcDemux([srv.endpoint], token_provider=lambda: jwt)
+            fwd = HostForwarder(local.dispatcher, 0, {0: None, 1: demux},
+                                dead_letters=local.dead_letters,
+                                deadline_ms=5.0)
+            tok = next(f"dev-{i}" for i in range(100)
+                       if owning_process(f"dev-{i}", 2) == 1)
+            fwd.ingest_payload(
+                b'{"deviceToken": "%s", "type": "Measurement",'
+                b' "request": {"name": "t", "value": 1}}' % tok.encode())
+            fwd.flush(wait=True)
+            assert fwd.dead_lettered == 1
+            assert fwd.forwarded_rows == 0
+            dead = [json.loads(p) for _, p in
+                    local.dead_letters.scan(0)]
+            rejected = [d for d in dead
+                        if d.get("kind") == "undeliverable-forward"]
+            assert rejected and "unauthorized" in rejected[0]["reason"]
+        finally:
+            fwd.stop()
+            demux.close()
+            srv.stop()
+            local.stop()
+            local.terminate()
+            peer.stop()
+            peer.terminate()
 
     def test_unreachable_peer_dead_letters(self, tmp_path):
         inst = Instance(make_config(tmp_path))
