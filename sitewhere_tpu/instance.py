@@ -146,6 +146,7 @@ class Instance(LifecycleComponent):
         self.event_store = self.add_child(EventStore(
             self.data_dir,
             flush_interval_s=0.25,
+            retention_s=self.config.get("events.retention_s"),
         ))
         self.streams = self.add_child(DeviceStreamManagement(self.data_dir))
         self.stream_manager = self.add_child(DeviceStreamManager(
@@ -336,6 +337,8 @@ class Instance(LifecycleComponent):
         self.checkpointer = self.add_child(Checkpointer(
             self,
             interval_s=float(self.config.get("checkpoint.interval_s", 30.0)),
+            prune_journal=bool(self.config.get(
+                "journal.prune_after_checkpoint", False)),
         ))
         self.restored = self.checkpointer.restore()
 

@@ -110,10 +110,12 @@ def _atomic_write(path: str, write_fn) -> None:
 class Checkpointer(LifecycleComponent):
     """Periodic + shutdown snapshots of one :class:`Instance`'s state."""
 
-    def __init__(self, instance, interval_s: float = 30.0):
+    def __init__(self, instance, interval_s: float = 30.0,
+                 prune_journal: bool = False):
         super().__init__(name="checkpointer")
         self.instance = instance
         self.interval_s = float(interval_s)
+        self.prune_journal = bool(prune_journal)
         self.dir = os.path.join(instance.data_dir, "checkpoint")
         os.makedirs(self.dir, exist_ok=True)
         self._stop = threading.Event()
@@ -217,6 +219,20 @@ class Checkpointer(LifecycleComponent):
             self.generation = gen
             self.last_saved_at = time.time()
             self._gc(keep=gen)
+            # 6. journal retention (opt-in): everything below the
+            # pipeline's durably committed offset is re-derivable from
+            # this snapshot + the event store, so whole segments under
+            # it reclaim.  payload_ref resolution for rows older than
+            # the snapshot becomes unresolvable — every downstream
+            # handler already tolerates a missing ref.
+            if self.prune_journal:
+                reader = getattr(inst.dispatcher, "journal_reader", None)
+                if reader is not None:
+                    pruned = inst.ingest_journal.prune(reader.committed)
+                    if pruned:
+                        logger.info(
+                            "pruned %d ingest-journal segment(s) below "
+                            "committed offset %d", pruned, reader.committed)
             logger.info("checkpoint generation %d saved", gen)
             return self._manifest_path
 

@@ -55,7 +55,14 @@ DEFAULTS: Dict[str, Any] = {
         "deadline_ms": 5.0,
         "n_shards": 1,
     },
-    "journal": {"fsync_every": 256, "segment_bytes": 64 << 20},
+    # prune_after_checkpoint reclaims journal segments below the
+    # pipeline's committed offset after each snapshot (everything under
+    # it is re-derivable from checkpoint + event store)
+    "journal": {"fsync_every": 256, "segment_bytes": 64 << 20,
+                "prune_after_checkpoint": False},
+    # events.retention_s: event-time retention window for the columnar
+    # store, enforced chunk-at-a-time (0 = keep forever)
+    "events": {"retention_s": 0},
     "presence": {"scan_interval_s": 600.0, "missing_after_s": 8 * 3600.0},
     "api": {"host": "127.0.0.1", "port": 8080, "jwt_ttl_s": 3600},
     "metrics": {"report_interval_s": 20.0},

@@ -28,6 +28,7 @@ device arrays with no row pivot.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import re
 import threading
@@ -38,6 +39,7 @@ import numpy as np
 
 from sitewhere_tpu.ids import NULL_ID
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+
 from sitewhere_tpu.schema import EventType
 from sitewhere_tpu.services.common import (
     EntityNotFound,
@@ -45,6 +47,8 @@ from sitewhere_tpu.services.common import (
     SearchResults,
     ValidationError,
 )
+
+logger = logging.getLogger("sitewhere_tpu.event_store")
 
 # Column schema of one stored event row: the EventBatch columns that matter
 # post-pipeline, plus the enrichment context (IDeviceEventContext analog).
@@ -135,6 +139,7 @@ class EventStore(LifecycleComponent):
         root: str,
         flush_rows: int = 10_000,
         flush_interval_s: float = 0.25,
+        retention_s: Optional[int] = None,
         name: str = "event-store",
     ):
         super().__init__(name)
@@ -142,6 +147,12 @@ class EventStore(LifecycleComponent):
         os.makedirs(self.dir, exist_ok=True)
         self.flush_rows = flush_rows
         self.flush_interval_s = flush_interval_s
+        # event-time retention window; 0/None = keep forever.  The
+        # reference delegates retention to its datastores (Cassandra
+        # hour buckets, CassandraClient.java:47, are exactly
+        # prune-whole-bucket); here the flusher enforces it.
+        self.retention_s = int(retention_s) if retention_s else 0
+        self._last_prune = 0.0
         self._lock = threading.Lock()
         self._buffer: List[Dict[str, np.ndarray]] = []
         self._buffered_rows = 0
@@ -167,6 +178,14 @@ class EventStore(LifecycleComponent):
                     cols[name] = np.full(len(cols["ts_s"]), NULL_ID, dtype)
             self._chunks.append(_Chunk(seq, cols))
             self._next_seq = max(self._next_seq, seq + 1)
+        # high-water marker: retention may have pruned EVERY chunk file,
+        # and seqs must never regress — a reissued event id would resolve
+        # to an unrelated newer event (ids embed the chunk seq)
+        try:
+            with open(os.path.join(self.dir, "next-seq")) as f:
+                self._next_seq = max(self._next_seq, int(f.read() or 0))
+        except (FileNotFoundError, ValueError):
+            pass
 
     def start(self) -> None:
         super().start()
@@ -196,11 +215,15 @@ class EventStore(LifecycleComponent):
                     self.flush()
                 except Exception:  # transient I/O failure must not kill the
                     # flusher; the buffer is retained and retried next tick.
-                    import logging
-
-                    logging.getLogger("sitewhere_tpu.event_store").exception(
-                        "event flush failed; will retry"
-                    )
+                    logger.exception("event flush failed; will retry")
+            if (self.retention_s
+                    and time.monotonic() - self._last_prune >= 60.0):
+                self._last_prune = time.monotonic()
+                try:
+                    self.prune_older_than(int(time.time()) - self.retention_s)
+                except Exception:
+                    logger.exception(
+                        "event retention prune failed; will retry")
 
     # -- writes -------------------------------------------------------------
 
@@ -305,6 +328,11 @@ class EventStore(LifecycleComponent):
                     self._next_seq += 1
                     self._chunks.append(_Chunk(seq, part))
                     flushed += len(part["ts_s"])
+                    marker = os.path.join(self.dir, "next-seq")
+                    tmp_m = f"{marker}.tmp.{os.getpid()}"
+                    with open(tmp_m, "w") as f:
+                        f.write(str(self._next_seq))
+                    os.replace(tmp_m, marker)
             finally:
                 if flushed:
                     remainder = {k: v[flushed:] for k, v in merged.items()}
@@ -321,6 +349,30 @@ class EventStore(LifecycleComponent):
     def total_events(self) -> int:
         with self._lock:
             return sum(c.n for c in self._chunks) + self._buffered_rows
+
+    def prune_older_than(self, cutoff_s: int) -> int:
+        """Delete whole sealed chunks whose NEWEST row predates
+        ``cutoff_s`` (event time).  A chunk straddling the cutoff is
+        kept whole — retention is per-bucket, exactly like dropping an
+        expired Cassandra hour bucket, never a row-level rewrite.
+        Event ids inside pruned chunks become unresolvable, as expired
+        ids do in any TTL'd store.  Returns rows removed."""
+        removed = 0
+        with self._lock:
+            keep: List[_Chunk] = []
+            for chunk in self._chunks:
+                if chunk.n and chunk.max_ts < cutoff_s:
+                    removed += chunk.n
+                    path = os.path.join(self.dir,
+                                        f"events-{chunk.seq:010d}.npz")
+                    try:
+                        os.unlink(path)
+                    except FileNotFoundError:
+                        pass
+                else:
+                    keep.append(chunk)
+            self._chunks = keep
+        return removed
 
     def get_event(self, eid: int) -> EventRecord:
         seq, row = split_event_id(eid)

@@ -157,3 +157,126 @@ def test_iter_chunks_for_analytics(tmp_path):
     chunks = list(store.iter_chunks())
     assert len(chunks) == 2
     assert chunks[1]["ts_s"][0] == 2000
+
+
+class TestRetention:
+    def test_prune_older_than_drops_whole_chunks(self, tmp_path):
+        import os as _os
+
+        store = EventStore(str(tmp_path), flush_rows=4,
+                           flush_interval_s=999.0)
+        # two sealed chunks: old (ts 100..103) and new (ts 5000..5003)
+        for base in (100, 5000):
+            for i in range(4):
+                store.add_event(device_id=1, tenant_id=0, event_type=0,
+                                ts_s=base + i, mtype_id=0, value=1.0)
+            store.flush()
+        assert store.total_events == 8
+        n_files = len([f for f in _os.listdir(store.dir)
+                       if f.endswith(".npz")])
+        assert n_files == 2
+
+        removed = store.prune_older_than(cutoff_s=1000)
+        assert removed == 4
+        assert store.total_events == 4
+        assert len([f for f in _os.listdir(store.dir)
+                    if f.endswith(".npz")]) == 1
+        # queries only see the surviving chunk
+        res = store.query()
+        assert all(r.ts_s >= 5000 for r in res.results)
+        # a straddling chunk is kept whole
+        assert store.prune_older_than(cutoff_s=5002) == 0
+
+        # reopen over the pruned directory resumes cleanly at the next seq
+        store2 = EventStore(str(tmp_path), flush_rows=4,
+                            flush_interval_s=999.0)
+        assert store2.total_events == 4
+        store2.add_event(device_id=1, tenant_id=0, event_type=0,
+                         ts_s=6000, mtype_id=0, value=1.0)
+        store2.flush()
+        assert store2.total_events == 5
+
+    def test_checkpoint_prunes_committed_journal(self, tmp_path):
+        """With journal.prune_after_checkpoint, a snapshot reclaims
+        ingest-journal segments below the pipeline's committed offset —
+        and a restart over the pruned dir still restores and accepts."""
+        import json as _json
+
+        from sitewhere_tpu.instance import Instance
+        from sitewhere_tpu.runtime.config import Config
+
+        def cfg():
+            return Config({
+                "instance": {"id": "ret", "data_dir": str(tmp_path / "d")},
+                "pipeline": {"width": 64, "registry_capacity": 1024,
+                             "mtype_slots": 4, "deadline_ms": 5.0,
+                             "n_shards": 1},
+                "presence": {"scan_interval_s": 3600.0,
+                             "missing_after_s": 1800},
+                "journal": {"fsync_every": 0, "segment_bytes": 256,
+                            "prune_after_checkpoint": True},
+                "checkpoint": {"interval_s": 3600.0},
+            }, apply_env=False)
+
+        inst = Instance(cfg())
+        inst.start()
+        inst.device_management.create_device_type(token="s", name="S")
+        inst.device_management.create_device(token="dev", device_type="s")
+        inst.device_management.create_device_assignment(device="dev")
+        for i in range(30):     # tiny segments -> several rotations
+            inst.dispatcher.ingest_wire_lines(_json.dumps({
+                "deviceToken": "dev", "type": "Measurement",
+                "request": {"name": "t", "value": i, "eventDate": 1000 + i},
+            }).encode())
+        inst.dispatcher.flush()
+        import os as _os
+
+        jdir = inst.ingest_journal.dir
+        before = len([f for f in _os.listdir(jdir) if f.endswith(".log")])
+        assert before > 1
+        inst.checkpointer.save()
+        after = len([f for f in _os.listdir(jdir) if f.endswith(".log")])
+        assert after < before
+        inst.stop()
+        inst.terminate()
+
+        # restart over the pruned journal: restore + new intake both work
+        inst2 = Instance(cfg())
+        inst2.start()
+        assert inst2.device_management.get_device("dev") is not None
+        inst2.dispatcher.ingest_wire_lines(_json.dumps({
+            "deviceToken": "dev", "type": "Measurement",
+            "request": {"name": "t", "value": 99, "eventDate": 2000},
+        }).encode())
+        inst2.dispatcher.flush()
+        inst2.event_store.flush()
+        d = int(inst2.identity.device.lookup("dev"))
+        assert len(inst2.event_store.query(device_id=d)) == 31
+        inst2.stop()
+        inst2.terminate()
+
+    def test_seqs_never_regress_after_full_prune(self, tmp_path):
+        """Retention can delete EVERY chunk; a restart must still issue
+        fresh chunk seqs — a reissued event id would silently resolve to
+        an unrelated newer event (ids embed the chunk seq)."""
+        store = EventStore(str(tmp_path), flush_rows=2,
+                           flush_interval_s=999.0)
+        store.add_event(device_id=1, tenant_id=0, event_type=0,
+                        ts_s=100, mtype_id=0, value=1.0)
+        store.flush()
+        old_id = store.query().results[0].event_id
+        assert store.prune_older_than(cutoff_s=10_000) == 1
+        assert store.total_events == 0
+
+        store2 = EventStore(str(tmp_path), flush_rows=2,
+                            flush_interval_s=999.0)
+        store2.add_event(device_id=2, tenant_id=0, event_type=0,
+                         ts_s=20_000, mtype_id=0, value=2.0)
+        store2.flush()
+        new_id = store2.query().results[0].event_id
+        assert new_id != old_id            # seq did not regress
+        import pytest as _pytest
+
+        from sitewhere_tpu.services.common import EntityNotFound
+        with _pytest.raises(EntityNotFound):
+            store2.get_event(old_id)      # pruned id stays dead
