@@ -55,8 +55,12 @@ def points_in_polygons(points: jax.Array, verts: jax.Array) -> jax.Array:
     straddles = (y1 > py) != (y2 > py)
     # Safe division: where the edge is horizontal/degenerate, straddles is
     # False and the quotient is irrelevant — guard the denominator only.
+    # The slope-first ordering matches the Pallas kernel's precomputed-
+    # slope form EXACTLY (same rounding), keeping the two paths bitwise
+    # equal so the work-size auto-switch never flips a containment result.
     denom = jnp.where(y2 == y1, 1.0, y2 - y1)
-    x_cross = (x2 - x1) * (py - y1) / denom + x1
+    slope = (x2 - x1) / denom
+    x_cross = slope * (py - y1) + x1
     crossing = straddles & (px < x_cross)
     # Odd number of crossings => inside.
     return (jnp.sum(crossing.astype(jnp.int32), axis=-1) % 2) == 1

@@ -3,12 +3,17 @@
 The reference's state materialization processes one Kafka record at a time
 (``service-device-state/.../processing/DeviceStateProcessingLogic.java:46-80``),
 so "last write wins" falls out of per-partition ordering.  In a batched SPMD
-step many events for one device land in the same batch, so we scatter with
-an explicit time key: first a scatter-max of the ``(ts_s, ts_ns)`` key, then
-payload writes masked to the rows that won.  Ties (identical key) are broken
-by batch row index (highest row wins) so exactly ONE event row writes all
-payload columns — independent per-column scatters with duplicate indices
-would otherwise be free to mix columns from different tied events.
+step many events for one device land in the same batch, so each slot needs
+the row with the newest ``(ts_s, ts_ns)`` key, tie-broken by batch row index
+(highest row wins) so exactly ONE event row writes all payload columns.
+
+Implementation is SORT-based, not scatter-based: XLA lowers scatters with
+duplicate indices to a serialized update loop on TPU, which measured 13x
+slower than this design at pipeline widths (131072 rows -> 16384 slots,
+13.7 ms vs 1.1 ms on v5e).  The stable multi-key sort groups rows by slot
+with newest-last, segment boundaries mark each slot's winning row, the
+winner map is written with UNIQUE indices (the fast scatter path), and
+payload columns are applied with gathers — every op on the parallel path.
 """
 
 from __future__ import annotations
@@ -17,6 +22,145 @@ from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+
+
+def _winner_rows_sort(
+    ids: jax.Array,
+    keys: Sequence[jax.Array],
+    mask: jax.Array,
+    capacity: int,
+) -> jax.Array:
+    """Sort-based winner map (the TPU fast path).
+
+    The stable ascending sort on ``(id, *keys)`` leaves each slot's winning
+    row LAST in its run (stability preserves batch order among equal keys,
+    giving the highest-row tie-break); run boundaries then identify
+    winners, which scatter into the slot map with unique indices.
+    """
+    b = ids.shape[0]
+    mask = mask & (ids >= 0) & (ids < capacity)
+    eff = jnp.where(mask, ids, capacity).astype(jnp.int32)
+    rows = jnp.arange(b, dtype=jnp.int32)
+    sorted_ops = lax.sort(
+        (eff, *keys, rows), num_keys=1 + len(keys), is_stable=True
+    )
+    eff_s, rows_s = sorted_ops[0], sorted_ops[-1]
+    nxt = jnp.concatenate([eff_s[1:], jnp.full((1,), capacity + 1, jnp.int32)])
+    boundary = (eff_s != nxt) & (eff_s < capacity)
+    win_ids = jnp.where(boundary, eff_s, capacity)
+    return jnp.full((capacity,), -1, jnp.int32).at[win_ids].set(
+        rows_s, mode="drop", unique_indices=True
+    )
+
+
+def _winner_rows_scatter(
+    ids: jax.Array,
+    keys: Sequence[jax.Array],
+    mask: jax.Array,
+    capacity: int,
+) -> jax.Array:
+    """Scatter-based winner map (the CPU fast path).
+
+    Lexicographic multi-pass scatter-max: pass k keeps the rows whose key
+    equals the per-slot max among rows that survived passes 0..k-1; a
+    final scatter-max of the row index breaks remaining ties (highest row
+    wins).  XLA CPU runs duplicate-index scatters well but variadic sorts
+    poorly — the mirror image of TPU (7.1 ms vs 0.5 ms at width 16k for
+    the sort form on CPU; 13.7 ms vs 1.1 ms for the scatter form on v5e).
+    """
+    won = mask & (ids >= 0) & (ids < capacity)
+    clip_ids = jnp.clip(ids, 0, capacity - 1)
+    key_min = jnp.iinfo(jnp.int32).min
+    for k in keys:
+        eff = jnp.where(won, ids, capacity)
+        mx = jnp.full((capacity,), key_min, jnp.int32).at[eff].max(
+            k, mode="drop")
+        won = won & (k == mx[clip_ids])
+    rows = jnp.arange(ids.shape[0], dtype=jnp.int32)
+    eff = jnp.where(won, ids, capacity)
+    return jnp.full((capacity,), -1, jnp.int32).at[eff].max(rows, mode="drop")
+
+
+def winner_rows_by_keys(
+    ids: jax.Array,
+    keys: Sequence[jax.Array],
+    mask: jax.Array,
+    capacity: int,
+) -> jax.Array:
+    """Per-slot winning batch row (max lexicographic key, highest row on ties).
+
+    Returns ``int32[capacity]`` — the batch row index whose ``keys`` tuple
+    is largest among masked rows targeting each slot, or ``-1`` for slots
+    no masked row targets.  Rows with out-of-range ids are dropped.
+
+    Backend-adaptive (chosen at trace time): sort-based on TPU, where
+    sorts are native and duplicate-index scatters serialize; scatter-based
+    everywhere else, where the opposite holds.
+    """
+    if jax.default_backend() == "tpu":
+        return _winner_rows_sort(ids, keys, mask, capacity)
+    return _winner_rows_scatter(ids, keys, mask, capacity)
+
+
+def winner_rows(
+    ids: jax.Array,
+    ts_s: jax.Array,
+    ts_ns: jax.Array,
+    mask: jax.Array,
+    capacity: int,
+) -> jax.Array:
+    """Per-slot winning batch row (newest ``(ts_s, ts_ns)``, highest row on
+    ties) — the two-part-time-key form of :func:`winner_rows_by_keys`."""
+    return winner_rows_by_keys(ids, (ts_s, ts_ns), mask, capacity)
+
+
+def apply_winners(
+    slot_row: jax.Array,
+    cur_ts_s: jax.Array,
+    cur_ts_ns: jax.Array,
+    cur_payload: Sequence[jax.Array],
+    ts_s: jax.Array,
+    ts_ns: jax.Array,
+    payload: Sequence[jax.Array],
+) -> Tuple[jax.Array, jax.Array, Tuple[jax.Array, ...]]:
+    """Apply a :func:`winner_rows` map: update slots whose winning event is
+    at least as new as the slot's current key (events win exact ties, the
+    same contract per-partition ordering gives the reference).
+
+    The time keys and payload columns are gathered in dtype-grouped packs
+    (one multi-column gather per dtype) — separate [B]-sized gathers cost
+    ~1 ms each at pipeline widths on v5e, packed ones barely more than one.
+    """
+    capacity = cur_ts_s.shape[0]
+    has = slot_row >= 0
+    wr = jnp.clip(slot_row, 0)
+
+    b = ts_s.shape[0]
+    items = [("__ts", ts_s.reshape(b, 1)), ("__ns", ts_ns.reshape(b, 1))]
+    items += [(i, val.reshape(b, -1)) for i, val in enumerate(payload)]
+    groups: dict = {}
+    for key, arr in items:
+        groups.setdefault(jnp.dtype(arr.dtype), []).append((key, arr))
+    gathered = {}
+    for _, lst in groups.items():
+        packed = jnp.concatenate([a for _, a in lst], axis=1)[wr]  # [D, k]
+        off = 0
+        for key, a in lst:
+            gathered[key] = packed[:, off:off + a.shape[1]]
+            off += a.shape[1]
+
+    w_s = gathered["__ts"][:, 0]
+    w_ns = gathered["__ns"][:, 0]
+    newer = has & ((w_s > cur_ts_s) | ((w_s == cur_ts_s) & (w_ns >= cur_ts_ns)))
+    new_s = jnp.where(newer, w_s, cur_ts_s)
+    new_ns = jnp.where(newer, w_ns, cur_ts_ns)
+    out = []
+    for i, (cur, val) in enumerate(zip(cur_payload, payload)):
+        nd = jnp.reshape(newer, (capacity,) + (1,) * (val.ndim - 1))
+        win = gathered[i].reshape((capacity,) + val.shape[1:]).astype(val.dtype)
+        out.append(jnp.where(nd, win, cur))
+    return new_s, new_ns, tuple(out)
 
 
 def scatter_last_by_time(
@@ -48,43 +192,10 @@ def scatter_last_by_time(
             f"payload arity mismatch: {len(cur_payload)} state arrays vs "
             f"{len(payload)} event arrays (pass tuples, not bare arrays)"
         )
-    capacity = cur_ts_s.shape[0]
-    # mode="drop" drops ids >= capacity but NEGATIVE ids would wrap
-    # (python-style indexing) — sanitize both to the drop sentinel.
-    mask = mask & (ids >= 0)
-    safe_ids = jnp.where(mask, ids, capacity)
-
-    # Pass 1: winning second per slot.
-    new_s = cur_ts_s.at[safe_ids].max(ts_s, mode="drop")
-    # Pass 2: winning ns among events that have the winning second.  If the
-    # second advanced past the current slot value, the old ns must not be
-    # compared — reset it to -1 (below any real ns).
-    base_ns = jnp.where(cur_ts_s == new_s, cur_ts_ns, -1)
-    sec_won = mask & (ts_s == new_s[jnp.clip(ids, 0, capacity - 1)])
-    ns_ids = jnp.where(sec_won, ids, capacity)
-    new_ns = base_ns.at[ns_ids].max(ts_ns, mode="drop")
-
-    # Winner rows: their (s, ns) equals the final slot key.
-    clip_ids = jnp.clip(ids, 0, capacity - 1)
-    won = sec_won & (ts_ns == new_ns[clip_ids])
-    win_ids, won = _unique_winner(won, ids, capacity)
-    new_payload = tuple(
-        cur.at[win_ids].set(val, mode="drop") for cur, val in zip(cur_payload, payload)
+    slot_row = winner_rows(ids, ts_s, ts_ns, mask, cur_ts_s.shape[0])
+    return apply_winners(
+        slot_row, cur_ts_s, cur_ts_ns, cur_payload, ts_s, ts_ns, payload
     )
-    return new_s, new_ns, new_payload
-
-
-def _unique_winner(won: jax.Array, ids: jax.Array, capacity: int):
-    """Reduce a (possibly tied) winner mask to exactly one row per slot.
-
-    Highest batch row index wins among tied rows, so all payload columns are
-    written by the same event.
-    """
-    row = jnp.arange(won.shape[0], dtype=jnp.int32)
-    cand_ids = jnp.where(won, ids, capacity)
-    best_row = jnp.full((capacity,), -1, jnp.int32).at[cand_ids].max(row, mode="drop")
-    final = won & (row == best_row[jnp.clip(ids, 0, capacity - 1)])
-    return jnp.where(final, ids, capacity), final
 
 
 def scatter_max_by_key(
@@ -102,18 +213,26 @@ def scatter_max_by_key(
             f"{len(payload)} event arrays (pass tuples, not bare arrays)"
         )
     capacity = cur_key.shape[0]
-    mask = mask & (ids >= 0)  # negative ids would wrap; see scatter_last_by_time
-    safe_ids = jnp.where(mask, ids, capacity)
-    new_key = cur_key.at[safe_ids].max(key, mode="drop")
-    won = mask & (key == new_key[jnp.clip(ids, 0, capacity - 1)])
-    win_ids, _ = _unique_winner(won, ids, capacity)
-    new_payload = tuple(
-        cur.at[win_ids].set(val, mode="drop") for cur, val in zip(cur_payload, payload)
-    )
-    return new_key, new_payload
+    slot_row = winner_rows_by_keys(ids, (key,), mask, capacity)
+    has = slot_row >= 0
+    wr = jnp.clip(slot_row, 0)
+    w_key = key[wr]
+    newer = has & (w_key >= cur_key)
+    new_key = jnp.where(newer, w_key, cur_key)
+    out = []
+    for cur, val in zip(cur_payload, payload):
+        nd = jnp.reshape(newer, (capacity,) + (1,) * (val.ndim - 1))
+        out.append(jnp.where(nd, val[wr], cur))
+    return new_key, tuple(out)
 
 
 def bincount_fixed(ids: jax.Array, mask: jax.Array, length: int) -> jax.Array:
-    """Masked bincount with static length (metrics rollups)."""
-    safe = jnp.where(mask & (ids >= 0), ids, length)
-    return jnp.zeros((length,), jnp.int32).at[safe].add(1, mode="drop")
+    """Masked bincount with static length (metrics rollups).
+
+    One-hot compare + column sum: for small ``length`` this is a [B, length]
+    reduction XLA fuses, avoiding the duplicate-index scatter-add path.
+    """
+    hit = (ids[:, None] == jnp.arange(length, dtype=ids.dtype)[None, :]) & (
+        mask[:, None]
+    )
+    return hit.sum(axis=0, dtype=jnp.int32)

@@ -30,7 +30,11 @@ from flax import struct
 
 from sitewhere_tpu.ids import NULL_ID
 from sitewhere_tpu.ops.geo_pallas import points_in_polygons_auto
-from sitewhere_tpu.ops.scatter import bincount_fixed, scatter_last_by_time
+from sitewhere_tpu.ops.scatter import (
+    apply_winners,
+    bincount_fixed,
+    winner_rows,
+)
 from sitewhere_tpu.schema import (
     DEFAULT_EWMA_TAUS,
     AssignmentStatus,
@@ -103,12 +107,30 @@ def validate_and_enrich(
     in_range = (ids >= 0) & (ids < cap)
     safe = jnp.clip(ids, 0, cap - 1)
 
-    registered = in_range & registry.active[safe]
+    # ONE packed [B, 8] gather instead of eight per-column gathers: a
+    # [B]-sized gather costs ~1 ms at width 131k on v5e while the packed
+    # multi-column form costs barely more than one — the registry is tiny
+    # (capacity x 8 int32), so the per-step stack is free.
+    packed = jnp.stack(
+        [
+            registry.active.astype(jnp.int32),
+            registry.tenant_id,
+            registry.assignment_status,
+            registry.device_type_id,
+            registry.assignment_id,
+            registry.area_id,
+            registry.customer_id,
+            registry.asset_id,
+        ],
+        axis=1,
+    )[safe]  # [B, 8]
+
+    registered = in_range & (packed[:, 0] != 0)
     # Tenant isolation: an event claiming tenant T must hit a device owned
     # by T (reference: per-tenant engines are shared-nothing slices,
     # MultitenantMicroservice.java:242-260).
-    tenant_ok = registry.tenant_id[safe] == batch.tenant_id
-    assigned = registry.assignment_status[safe] == AssignmentStatus.ACTIVE
+    tenant_ok = packed[:, 1] == batch.tenant_id
+    assigned = packed[:, 2] == AssignmentStatus.ACTIVE
 
     valid = batch.valid
     unregistered = valid & ~(registered & tenant_ok)
@@ -116,13 +138,58 @@ def validate_and_enrich(
     accepted = valid & registered & tenant_ok & assigned
 
     enrich = {
-        "device_type_id": jnp.where(accepted, registry.device_type_id[safe], NULL_ID),
-        "assignment_id": jnp.where(accepted, registry.assignment_id[safe], NULL_ID),
-        "area_id": jnp.where(accepted, registry.area_id[safe], NULL_ID),
-        "customer_id": jnp.where(accepted, registry.customer_id[safe], NULL_ID),
-        "asset_id": jnp.where(accepted, registry.asset_id[safe], NULL_ID),
+        "device_type_id": jnp.where(accepted, packed[:, 3], NULL_ID),
+        "assignment_id": jnp.where(accepted, packed[:, 4], NULL_ID),
+        "area_id": jnp.where(accepted, packed[:, 5], NULL_ID),
+        "customer_id": jnp.where(accepted, packed[:, 6], NULL_ID),
+        "asset_id": jnp.where(accepted, packed[:, 7], NULL_ID),
     }
     return accepted, unregistered, unassigned, enrich
+
+
+def _gather_meas_state(
+    state: DeviceState, batch: EventBatch
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-row previous measurement-slot state via TWO packed gathers.
+
+    Returns ``(prev_ts, prev_ns, prev_value, ewma_prev[B, K])``.  Packing
+    the int columns into ``[D*M, 2]`` and the float columns into
+    ``[D*M, 1+K]`` replaces five separate [B]-sized gathers (each ~1 ms at
+    width 131k on v5e; multi-column gathers cost barely more than one).
+    """
+    cap = state.capacity
+    M = state.num_mtype_slots
+    ids_safe = jnp.clip(batch.device_id, 0, cap - 1)
+    slot = jnp.where(batch.mtype_id >= 0, batch.mtype_id % M, 0)
+    flat = ids_safe * M + slot
+    ipack = jnp.stack(
+        [state.last_value_ts_s.reshape(-1), state.last_value_ts_ns.reshape(-1)],
+        axis=1,
+    )[flat]  # [B, 2]
+    fpack = jnp.concatenate(
+        [state.last_values.reshape(-1, 1),
+         state.ewma_values.reshape(-1, state.num_ewma_scales)],
+        axis=1,
+    )[flat]  # [B, 1 + K]
+    return ipack[:, 0], ipack[:, 1], fpack[:, 0], fpack[:, 1:]
+
+
+def _fold_ewma_from(
+    prev_ts: jax.Array,
+    prev_ns: jax.Array,
+    ewma_prev: jax.Array,
+    batch: EventBatch,
+    taus: jax.Array,
+) -> jax.Array:
+    """EWMA fold given pre-gathered slot state (see :func:`fold_ewma`)."""
+    seeded = prev_ts > 0
+    # sub-second resolution: fast sensors sample at > 1 Hz
+    dt = jnp.maximum(
+        (batch.ts_s - prev_ts).astype(jnp.float32)
+        + (batch.ts_ns - prev_ns).astype(jnp.float32) * 1e-9, 0.0)
+    alpha = 1.0 - jnp.exp(-dt[:, None] / jnp.maximum(taus[None, :], 1e-9))
+    v = batch.value[:, None]
+    return jnp.where(seeded[:, None], ewma_prev + alpha * (v - ewma_prev), v)
 
 
 def fold_ewma(
@@ -136,21 +203,8 @@ def fold_ewma(
     ``float32[B, K]`` — rows are CANDIDATES; the time-ordered scatter in
     :func:`update_device_state` picks each slot's winner.
     """
-    cap = state.capacity
-    M = state.num_mtype_slots
-    ids_safe = jnp.clip(batch.device_id, 0, cap - 1)
-    slot = jnp.where(batch.mtype_id >= 0, batch.mtype_id % M, 0)
-    prev_ts = state.last_value_ts_s[ids_safe, slot]
-    prev_ns = state.last_value_ts_ns[ids_safe, slot]
-    seeded = prev_ts > 0
-    # sub-second resolution: fast sensors sample at > 1 Hz
-    dt = jnp.maximum(
-        (batch.ts_s - prev_ts).astype(jnp.float32)
-        + (batch.ts_ns - prev_ns).astype(jnp.float32) * 1e-9, 0.0)
-    ewma_prev = state.ewma_values[ids_safe, slot]  # [B, K]
-    alpha = 1.0 - jnp.exp(-dt[:, None] / jnp.maximum(taus[None, :], 1e-9))
-    v = batch.value[:, None]
-    return jnp.where(seeded[:, None], ewma_prev + alpha * (v - ewma_prev), v)
+    prev_ts, prev_ns, _, ewma_prev = _gather_meas_state(state, batch)
+    return _fold_ewma_from(prev_ts, prev_ns, ewma_prev, batch, taus)
 
 
 def eval_threshold_rules(
@@ -171,15 +225,9 @@ def eval_threshold_rules(
     are folded exactly once.
     """
     is_meas = accepted & (batch.event_type == EventType.MEASUREMENT)
-    cap = state.capacity
-    M = state.num_mtype_slots
-    ids_safe = jnp.clip(batch.device_id, 0, cap - 1)
-    slot = jnp.where(batch.mtype_id >= 0, batch.mtype_id % M, 0)
     v = batch.value
 
-    prev_ts = state.last_value_ts_s[ids_safe, slot]
-    prev_ns = state.last_value_ts_ns[ids_safe, slot]
-    prev_v = state.last_values[ids_safe, slot]
+    prev_ts, prev_ns, prev_v, ewma_prev = _gather_meas_state(state, batch)
     seeded = prev_ts > 0
     # sub-second resolution (rate rules must fire for > 1 Hz sensors)
     dt = jnp.maximum(
@@ -188,9 +236,19 @@ def eval_threshold_rules(
     rate_valid = seeded & (dt > 0)
     rate = jnp.where(rate_valid, (v - prev_v) / jnp.maximum(dt, 1e-9), 0.0)
 
-    ewma_new = fold_ewma(state, batch, rules.ewma_tau_s)  # [B, K]
+    ewma_new = _fold_ewma_from(
+        prev_ts, prev_ns, ewma_prev, batch, rules.ewma_tau_s)  # [B, K]
     widx = jnp.clip(rules.window_idx, 0, rules.num_ewma_scales - 1)
-    e_sel = jnp.take(ewma_new, widx, axis=1)  # [B, R]
+    # One-hot matmul instead of jnp.take along axis 1: the [B, R] gather
+    # lowers to a slow scalar path; the [B, K] @ [K, R] product rides the
+    # MXU.  HIGHEST precision keeps the selection exact (default TPU
+    # matmul precision would round the EWMAs to bfloat16, letting
+    # borderline WINDOW_MEAN rules flap against the exact EWMA stored in
+    # device state).
+    onehot = (widx[None, :] == jnp.arange(rules.num_ewma_scales)[:, None]
+              ).astype(ewma_new.dtype)  # [K, R]
+    e_sel = jnp.matmul(ewma_new, onehot,
+                       precision=jax.lax.Precision.HIGHEST)  # [B, R]
 
     kind = rules.kind[None, :]
     val = jnp.where(
@@ -271,59 +329,64 @@ def update_device_state(
     ids = batch.device_id
     accepted = accepted & batch.update_state
 
-    # Any-event columns.
-    new_s, new_ns, (new_type,) = scatter_last_by_time(
-        state.last_event_ts_s,
-        state.last_event_ts_ns,
-        (state.last_event_type,),
-        ids,
-        batch.ts_s,
-        batch.ts_ns,
-        (batch.event_type,),
-        accepted,
-    )
-    # An accepted event marks the device present again (reference:
-    # DevicePresenceManager resets on new events).
-    present_now = jnp.zeros_like(state.presence_missing).at[
-        jnp.where(accepted, ids, state.capacity)
-    ].set(True, mode="drop")
-    presence = state.presence_missing & ~present_now
-
-    # Location columns.
+    # One sort-based winner map per state family (sorts measured ~0.1 ms
+    # each at width 131k on v5e; a batched segmented associative scan
+    # sharing one sort was tried and measured 11 ms — log-depth scans do
+    # 17 unfused HBM passes, sorts are native).  The any-event map doubles
+    # as the presence signal, so presence costs no extra scatter.
+    M = state.num_mtype_slots
     is_loc = accepted & (batch.event_type == EventType.LOCATION)
-    loc_s, loc_ns, (lat, lon, elev) = scatter_last_by_time(
-        state.last_location_ts_s,
-        state.last_location_ts_ns,
-        (state.last_lat, state.last_lon, state.last_elevation),
-        ids,
-        batch.ts_s,
-        batch.ts_ns,
-        (batch.lat, batch.lon, batch.elevation),
-        is_loc,
-    )
-
-    # Alert columns.
     is_alert = accepted & (batch.event_type == EventType.ALERT)
-    alert_s, alert_ns, (alert_code,) = scatter_last_by_time(
-        state.last_alert_ts_s,
-        state.last_alert_ts_ns,
-        (state.last_alert_code,),
-        ids,
-        batch.ts_s,
-        batch.ts_ns,
-        (batch.alert_code,),
-        is_alert,
-    )
-
     # Measurement matrix: slot = mtype_id mod M (host keeps mtype handles
     # dense per tenant; collisions degrade to "newest of colliding types",
     # documented in schema.DeviceState).  Unknown measurement types
     # (mtype_id == NULL_ID) are dropped, not aliased onto slot 0.
-    M = state.num_mtype_slots
     is_meas = accepted & (batch.event_type == EventType.MEASUREMENT) & (
         batch.mtype_id >= 0
     )
     flat_ids = ids * M + batch.mtype_id % M
+    any_rows = winner_rows(ids, batch.ts_s, batch.ts_ns, accepted, state.capacity)
+    loc_rows = winner_rows(ids, batch.ts_s, batch.ts_ns, is_loc, state.capacity)
+    alert_rows = winner_rows(ids, batch.ts_s, batch.ts_ns, is_alert, state.capacity)
+    meas_rows = winner_rows(
+        flat_ids, batch.ts_s, batch.ts_ns, is_meas, state.capacity * M)
+
+    # Any-event columns.
+    new_s, new_ns, (new_type,) = apply_winners(
+        any_rows,
+        state.last_event_ts_s,
+        state.last_event_ts_ns,
+        (state.last_event_type,),
+        batch.ts_s,
+        batch.ts_ns,
+        (batch.event_type,),
+    )
+    # An accepted event marks the device present again (reference:
+    # DevicePresenceManager resets on new events).
+    presence = state.presence_missing & ~(any_rows >= 0)
+
+    # Location columns.
+    loc_s, loc_ns, (lat, lon, elev) = apply_winners(
+        loc_rows,
+        state.last_location_ts_s,
+        state.last_location_ts_ns,
+        (state.last_lat, state.last_lon, state.last_elevation),
+        batch.ts_s,
+        batch.ts_ns,
+        (batch.lat, batch.lon, batch.elevation),
+    )
+
+    # Alert columns.
+    alert_s, alert_ns, (alert_code,) = apply_winners(
+        alert_rows,
+        state.last_alert_ts_s,
+        state.last_alert_ts_ns,
+        (state.last_alert_code,),
+        batch.ts_s,
+        batch.ts_ns,
+        (batch.alert_code,),
+    )
+
     # EWMA candidates fold each row's sample against PRE-batch state; the
     # scatter's newest-wins pick applies them consistently with values.
     # (Multiple same-slot events in one batch collapse to the newest —
@@ -336,16 +399,15 @@ def update_device_state(
         k = state.num_ewma_scales
         taus = jnp.asarray((base + [base[-1]] * k)[:k], jnp.float32)
         ewma_candidates = fold_ewma(state, batch, taus)
-    val_s, val_ns, (values, ewma) = scatter_last_by_time(
+    val_s, val_ns, (values, ewma) = apply_winners(
+        meas_rows,
         state.last_value_ts_s.reshape(-1),
         state.last_value_ts_ns.reshape(-1),
         (state.last_values.reshape(-1),
          state.ewma_values.reshape(-1, state.num_ewma_scales)),
-        flat_ids,
         batch.ts_s,
         batch.ts_ns,
         (batch.value, ewma_candidates),
-        is_meas,
     )
 
     mshape = state.last_value_ts_s.shape
@@ -388,12 +450,12 @@ def _build_derived_alerts(
 
     safe_rule = jnp.clip(rule_id, 0, rules.capacity - 1)
     safe_zone = jnp.clip(zone_id, 0, zones.capacity - 1)
-    code = jnp.where(
-        zone_fired, zones.alert_code[safe_zone], rules.alert_code[safe_rule]
-    )
-    level = jnp.where(
-        zone_fired, zones.alert_level[safe_zone], rules.alert_level[safe_rule]
-    )
+    # Packed [B, 2] gathers (code, level) per table — halves the [B]-sized
+    # gather count (each ~1 ms at width 131k on v5e).
+    rpack = jnp.stack([rules.alert_code, rules.alert_level], axis=1)[safe_rule]
+    zpack = jnp.stack([zones.alert_code, zones.alert_level], axis=1)[safe_zone]
+    code = jnp.where(zone_fired, zpack[:, 0], rpack[:, 0])
+    level = jnp.where(zone_fired, zpack[:, 1], rpack[:, 1])
     empty = EventBatch.empty(batch.width)
     return empty.replace(
         valid=fired,

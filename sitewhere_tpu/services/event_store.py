@@ -181,11 +181,30 @@ class EventStore(LifecycleComponent):
         # high-water marker: retention may have pruned EVERY chunk file,
         # and seqs must never regress — a reissued event id would resolve
         # to an unrelated newer event (ids embed the chunk seq)
+        marker = os.path.join(self.dir, "next-seq")
+        had_marker = True
         try:
-            with open(os.path.join(self.dir, "next-seq")) as f:
+            with open(marker) as f:
                 self._next_seq = max(self._next_seq, int(f.read() or 0))
         except (FileNotFoundError, ValueError):
-            pass
+            had_marker = False
+        if not had_marker and self._next_seq:
+            # Store predates the marker (chunks exist, no marker): write it
+            # NOW, or an idle store fully pruned by retention would restart
+            # seqs at 0 on the next boot.
+            self._write_marker()
+
+    def _write_marker(self) -> None:
+        """Durably record the seq high-water mark (fsync before rename:
+        the marker is what keeps seqs from regressing after retention
+        prunes every chunk, so it must survive power loss)."""
+        marker = os.path.join(self.dir, "next-seq")
+        tmp = f"{marker}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(self._next_seq))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, marker)
 
     def start(self) -> None:
         super().start()
@@ -324,15 +343,19 @@ class EventStore(LifecycleComponent):
                     tmp = f"{path}.tmp.{os.getpid()}"
                     with open(tmp, "wb") as f:
                         np.savez(f, **part)
+                        # fsync before the seal: checkpoint-time journal
+                        # reclaim deletes the raw records below the
+                        # committed offset on the premise that sealed
+                        # chunks are durable — without the fsync a power
+                        # loss could tear the chunk after the journal
+                        # copy is already gone.
+                        f.flush()
+                        os.fsync(f.fileno())
                     os.replace(tmp, path)  # atomic seal: no torn chunks
                     self._next_seq += 1
                     self._chunks.append(_Chunk(seq, part))
                     flushed += len(part["ts_s"])
-                    marker = os.path.join(self.dir, "next-seq")
-                    tmp_m = f"{marker}.tmp.{os.getpid()}"
-                    with open(tmp_m, "w") as f:
-                        f.write(str(self._next_seq))
-                    os.replace(tmp_m, marker)
+                    self._write_marker()
             finally:
                 if flushed:
                     remainder = {k: v[flushed:] for k, v in merged.items()}

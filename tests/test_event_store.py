@@ -280,3 +280,30 @@ class TestRetention:
         from sitewhere_tpu.services.common import EntityNotFound
         with _pytest.raises(EntityNotFound):
             store2.get_event(old_id)      # pruned id stays dead
+
+    def test_legacy_store_without_marker_survives_full_prune(self, tmp_path):
+        """Stores created before the next-seq marker existed get one
+        written at load time — otherwise an idle store fully pruned by
+        retention would restart seqs at 0 on the next boot."""
+        import os
+
+        store = EventStore(str(tmp_path), flush_rows=2,
+                           flush_interval_s=999.0)
+        store.add_event(device_id=1, tenant_id=0, event_type=0,
+                        ts_s=100, mtype_id=0, value=1.0)
+        store.flush()
+        old_id = store.query().results[0].event_id
+        os.unlink(os.path.join(str(tmp_path), "events", "next-seq"))  # legacy
+
+        store2 = EventStore(str(tmp_path), flush_rows=2,
+                            flush_interval_s=999.0)
+        assert os.path.exists(os.path.join(str(tmp_path), "events", "next-seq"))
+        # idle store: prune everything WITHOUT any flush writing a marker
+        assert store2.prune_older_than(cutoff_s=10_000) == 1
+
+        store3 = EventStore(str(tmp_path), flush_rows=2,
+                            flush_interval_s=999.0)
+        store3.add_event(device_id=2, tenant_id=0, event_type=0,
+                         ts_s=20_000, mtype_id=0, value=2.0)
+        store3.flush()
+        assert store3.query().results[0].event_id != old_id

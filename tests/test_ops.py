@@ -146,3 +146,23 @@ def test_pad_polygon_contract():
         pad_poly([[0, 0], [1, 0]], 6)  # too few verts
     with pytest.raises(ValueError):
         pad_poly([[0, 0]] * 9, 6)      # too many
+
+
+def test_winner_rows_sort_and_scatter_paths_agree():
+    """The TPU (sort) and CPU (scatter) winner-selection paths are
+    interchangeable: same winners, same tie-breaks, same drops."""
+    from sitewhere_tpu.ops.scatter import _winner_rows_scatter, _winner_rows_sort
+
+    rng = np.random.default_rng(7)
+    b, cap = 4096, 257
+    ids = jnp.asarray(rng.integers(-3, cap + 3, b).astype(np.int32))
+    ts_s = jnp.asarray(rng.integers(100, 110, b).astype(np.int32))
+    ts_ns = jnp.asarray(rng.integers(0, 4, b).astype(np.int32))
+    mask = jnp.asarray(rng.random(b) < 0.7)
+    a = _winner_rows_sort(ids, (ts_s, ts_ns), mask, cap)
+    c = _winner_rows_scatter(ids, (ts_s, ts_ns), mask, cap)
+    assert a.tolist() == c.tolist()
+    # single-key form too (scatter_max_by_key path)
+    a1 = _winner_rows_sort(ids, (ts_s,), mask, cap)
+    c1 = _winner_rows_scatter(ids, (ts_s,), mask, cap)
+    assert a1.tolist() == c1.tolist()
