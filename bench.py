@@ -267,6 +267,12 @@ def bench_pipeline() -> None:
         "value": round(events_per_sec, 1),
         "unit": "events/s",
         "vs_baseline": round(events_per_sec / TARGET_EVENTS_PER_SEC, 3),
+        # Device-side rate from the chained-steps probe: what a
+        # host-attached chip sustains once per-step dispatch (~30 ms
+        # through the axon tunnel, ~50 us on a real host) stops dominating.
+        "device_events_per_sec": (
+            round(width / device_step_ms * 1e3, 1) if device_step_ms > 0
+            else None),
         "device_step_ms": round(device_step_ms, 4),
         "host_step_p50_ms": round(p50, 3),
         "host_step_p99_ms": round(p99, 3),

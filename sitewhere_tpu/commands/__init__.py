@@ -18,7 +18,9 @@ from sitewhere_tpu.commands.destinations import (
     CoapDeliveryProvider,
     CoapParameterExtractor,
     CommandDestination,
+    HttpDeliveryProvider,
     MqttDeliveryProvider,
+    SmsParameterExtractor,
     TopicParameterExtractor,
 )
 from sitewhere_tpu.commands.routing import (
@@ -35,7 +37,9 @@ __all__ = [
     "decode_binary_execution",
     "CallbackDeliveryProvider",
     "CommandDestination",
+    "HttpDeliveryProvider",
     "MqttDeliveryProvider",
+    "SmsParameterExtractor",
     "TopicParameterExtractor",
     "DeviceTypeMappingRouter",
     "SingleDestinationRouter",

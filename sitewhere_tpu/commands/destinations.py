@@ -3,11 +3,12 @@
 Reference: ``ICommandDestination`` composes exactly these three SPIs
 (``service-command-delivery/.../destination/mqtt/MqttCommandDestination.java``
 + ``MqttParameterExtractor`` computing a per-device topic +
-``MqttCommandDeliveryProvider`` publishing).  SMS (Twilio) and CoAP
-destinations follow the same shape; here providers without client
-libraries in the image are represented by :class:`CallbackDeliveryProvider`
-(any callable transport — the SPI point where a Twilio/CoAP client plugs
-in).
+``MqttCommandDeliveryProvider`` publishing).  CoAP delivery speaks RFC
+7252 directly (:class:`CoapDeliveryProvider`); SMS delivery
+(``twilio/TwilioCommandDeliveryProvider.java`` — an HTTPS POST of form
+fields to a gateway) generalizes to :class:`HttpDeliveryProvider` +
+:class:`SmsParameterExtractor`; anything else plugs in through
+:class:`CallbackDeliveryProvider`.
 """
 
 from __future__ import annotations
@@ -259,6 +260,100 @@ class CoapDeliveryProvider(LifecycleComponent):
                 f"{self.max_retransmit + 1} attempts)")
         finally:
             sock.close()
+
+
+class SmsParameterExtractor:
+    """Per-device SMS parameters (destination phone number).
+
+    Reference: ``destination/sms/SmsParameterExtractor.java`` — the
+    phone number comes from device metadata.  Executions for devices
+    without one fail delivery (→ undelivered dead-letter), matching the
+    reference's null-check.
+    """
+
+    def __init__(self, metadata_phone_key: str = "phone_number"):
+        self.metadata_phone_key = metadata_phone_key
+
+    def __call__(self, execution: CommandExecution) -> Dict[str, str]:
+        meta = dict(execution.device_metadata or {})
+        phone = str(meta.get(self.metadata_phone_key, "")).strip()
+        fields = _placeholder_fields(execution)
+        return {"phone": phone, "device": fields["device"]}
+
+
+class HttpDeliveryProvider(LifecycleComponent):
+    """Deliver encoded executions by POSTing to an HTTP gateway.
+
+    Reference: ``twilio/TwilioCommandDeliveryProvider.java`` — Twilio SMS
+    delivery is an HTTPS POST of (from, to, body) form fields to an
+    account endpoint.  This provider generalizes that shape: form fields
+    come from a template over the extractor's params plus the payload, so
+    any SMS/webhook gateway (Twilio-compatible or otherwise) plugs in via
+    config rather than code.  A missing required param (e.g. no phone
+    number in device metadata) or an HTTP error status raises
+    :class:`DeliveryError` → undelivered dead-letter.
+    """
+
+    def __init__(
+        self,
+        url: str,
+        field_map: Optional[Dict[str, str]] = None,
+        headers: Optional[Dict[str, str]] = None,
+        require: tuple = ("phone",),
+        timeout_s: float = 10.0,
+        name: str = "http-delivery",
+    ):
+        super().__init__(name)
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url)
+        if parts.scheme not in ("http", "https"):
+            raise ValueError(f"unsupported gateway scheme: {parts.scheme!r}")
+        self._scheme = parts.scheme
+        self._netloc = parts.netloc
+        self._path = (parts.path or "/") + (
+            "?" + parts.query if parts.query else "")
+        # each value is a str.format template over params ∪ {payload}
+        self.field_map = dict(field_map or {"To": "{phone}", "Body": "{payload}"})
+        self.headers = dict(headers or {})
+        self.require = tuple(require)
+        self.timeout_s = timeout_s
+
+    def deliver(self, execution: CommandExecution, payload: bytes,
+                params: Dict[str, str]) -> None:
+        import http.client
+        from urllib.parse import urlencode
+
+        for key in self.require:
+            if not params.get(key):
+                raise DeliveryError(
+                    f"missing delivery parameter {key!r} "
+                    f"(device metadata incomplete)")
+        fields = dict(params)
+        fields["payload"] = payload.decode("utf-8", "replace")
+        body = urlencode(
+            {k: v.format(**fields) for k, v in self.field_map.items()})
+        headers = {
+            "Content-Type": "application/x-www-form-urlencoded",
+            **self.headers,
+        }
+        cls = (http.client.HTTPSConnection if self._scheme == "https"
+               else http.client.HTTPConnection)
+        conn = cls(self._netloc, timeout=self.timeout_s)
+        try:
+            conn.request("POST", self._path, body=body.encode(), headers=headers)
+            resp = conn.getresponse()
+            resp.read()
+            # only 2xx is delivery: redirects are not followed, so a 3xx
+            # means the gateway never got the command
+            if not 200 <= resp.status < 300:
+                raise DeliveryError(f"gateway returned {resp.status}")
+        except DeliveryError:
+            raise
+        except Exception as e:
+            raise DeliveryError(f"gateway POST failed: {e}") from e
+        finally:
+            conn.close()
 
 
 class CallbackDeliveryProvider:

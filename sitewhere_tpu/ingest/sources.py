@@ -23,10 +23,16 @@ runtime), and receivers are threads owning sockets:
   ``websocket/WebSocketEventReceiver.java``).
 - :class:`sitewhere_tpu.ingest.coap.CoapServerReceiver` — RFC 7252 CoAP
   server (reference ``coap/CoapServerEventReceiver.java``).
+- :class:`sitewhere_tpu.ingest.stomp.StompReceiver` — STOMP 1.2 broker
+  subscription with per-message acks; ActiveMQ and RabbitMQ both speak
+  STOMP natively, so this covers the reference's
+  ``activemq/ActiveMQClientEventReceiver.java`` and
+  ``rabbitmq/RabbitMqInboundEventReceiver.java`` without their client
+  stacks.
 
-AMQP brokers (ActiveMQ/RabbitMQ/EventHub in the reference) are gated: no
-client libraries exist in this image; their role (durable broker buffering)
-is covered by the journal, and the receiver SPI accepts new implementations.
+Azure EventHub (proprietary AMQP dialect behind SAS auth) stays gated: its
+role (durable broker buffering) is covered by the journal + the STOMP/MQTT
+receivers, and the receiver SPI accepts new implementations.
 """
 
 from __future__ import annotations

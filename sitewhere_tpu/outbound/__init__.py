@@ -19,6 +19,7 @@ from sitewhere_tpu.outbound.filters import (
 from sitewhere_tpu.outbound.connectors import (
     CallbackConnector,
     FileConnector,
+    HttpConnector,
     MqttOutboundConnector,
     OutboundConnector,
 )
@@ -33,6 +34,7 @@ __all__ = [
     "EventTypeFilter",
     "CallbackConnector",
     "FileConnector",
+    "HttpConnector",
     "MqttOutboundConnector",
     "OutboundConnector",
     "OutboundConnectorsManager",

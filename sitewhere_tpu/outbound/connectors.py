@@ -143,6 +143,105 @@ class FileConnector(OutboundConnector):
                 f.write(json.dumps(marshal_row(cols, int(row), self.identity)) + "\n")
 
 
+class HttpConnector(OutboundConnector):
+    """POST surviving events as a JSON array to a webhook URL.
+
+    Reference: the SaaS push connectors — ``InitialStateEventsConnector``
+    and ``DweetConnector`` (``service-outbound-connectors/.../initialstate``,
+    ``.../dweetio``) are HTTPS POSTs of marshaled events to a per-account
+    endpoint.  One generic webhook connector covers the shape; per-service
+    envelopes are a ``transform`` away.  Delivery is batched (one request
+    per surviving batch, not per event) and reuses the connection
+    (keep-alive) until an error forces a reconnect.
+    """
+
+    def __init__(
+        self,
+        connector_id: str,
+        url: str,
+        identity=None,
+        headers: Optional[Dict[str, str]] = None,
+        transform: Optional[Callable[[List[dict]], bytes]] = None,
+        timeout_s: float = 10.0,
+        filters=None,
+    ):
+        super().__init__(connector_id, filters)
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url)
+        if parts.scheme not in ("http", "https"):
+            raise ValueError(f"unsupported webhook scheme: {parts.scheme!r}")
+        self._scheme = parts.scheme
+        self._netloc = parts.netloc
+        self._path = parts.path or "/"
+        if parts.query:
+            self._path += "?" + parts.query
+        self.identity = identity
+        self.headers = dict(headers or {})
+        self.transform = transform
+        self.timeout_s = timeout_s
+        self._conn = None
+
+    def _connect(self):
+        import http.client
+
+        cls = (http.client.HTTPSConnection if self._scheme == "https"
+               else http.client.HTTPConnection)
+        return cls(self._netloc, timeout=self.timeout_s)
+
+    def stop(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+            self._conn = None
+        super().stop()
+
+    def deliver(self, cols: Columns, mask: np.ndarray) -> None:
+        rows = np.nonzero(mask)[0]
+        docs = [marshal_row(cols, int(r), self.identity) for r in rows]
+        body = (self.transform(docs) if self.transform is not None
+                else json.dumps(docs).encode("utf-8"))
+        headers = {"Content-Type": "application/json", **self.headers}
+        # one retry on a fresh connection: a keep-alive socket the server
+        # already closed fails the first write/read, not the request
+        for attempt in (0, 1):
+            if self._conn is None:
+                self._conn = self._connect()
+            try:
+                self._conn.request("POST", self._path, body=body,
+                                   headers=headers)
+                resp = self._conn.getresponse()
+                resp.read()
+                # only 2xx is delivery: http.client does not follow
+                # redirects, so a 3xx means the events never arrived
+                if not 200 <= resp.status < 300:
+                    raise DeliveryFailed(
+                        f"webhook returned {resp.status}")
+                return
+            except DeliveryFailed:
+                with self._lock:
+                    self.errors += 1
+                logger.error("%s POST %s rejected", self.name, self._path)
+                return
+            except Exception:
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = None
+                if attempt:
+                    with self._lock:
+                        self.errors += 1
+                    logger.exception("%s POST %s failed", self.name,
+                                     self._path)
+
+
+class DeliveryFailed(Exception):
+    """Webhook answered with an error status (no reconnect needed)."""
+
+
 class MqttOutboundConnector(OutboundConnector):
     """Publish surviving events to MQTT topics via multicast routing.
 

@@ -182,16 +182,18 @@ class EventStore(LifecycleComponent):
         # and seqs must never regress — a reissued event id would resolve
         # to an unrelated newer event (ids embed the chunk seq)
         marker = os.path.join(self.dir, "next-seq")
-        had_marker = True
+        marker_value = -1
         try:
             with open(marker) as f:
-                self._next_seq = max(self._next_seq, int(f.read() or 0))
+                marker_value = int(f.read() or 0)
+                self._next_seq = max(self._next_seq, marker_value)
         except (FileNotFoundError, ValueError):
-            had_marker = False
-        if not had_marker and self._next_seq:
-            # Store predates the marker (chunks exist, no marker): write it
-            # NOW, or an idle store fully pruned by retention would restart
-            # seqs at 0 on the next boot.
+            pass
+        if self._next_seq > max(marker_value, 0):
+            # Marker absent (store predates it) or stale (crash between a
+            # chunk seal and its marker write): bring it up to the
+            # chunk-derived value NOW, or an idle store fully pruned by
+            # retention would regress seqs on the next boot.
             self._write_marker()
 
     def _write_marker(self) -> None:
@@ -205,6 +207,23 @@ class EventStore(LifecycleComponent):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, marker)
+        self._fsync_dir()
+
+    def _fsync_dir(self) -> None:
+        """Make the latest rename itself durable: fsyncing file CONTENTS
+        does not persist the directory entry — without this a power loss
+        can vanish a freshly sealed chunk/marker whose journal copy was
+        already reclaimed."""
+        try:
+            fd = os.open(self.dir, os.O_RDONLY)
+        except OSError:
+            return  # platform without directory fds: best effort
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
 
     def start(self) -> None:
         super().start()
@@ -352,6 +371,7 @@ class EventStore(LifecycleComponent):
                         f.flush()
                         os.fsync(f.fileno())
                     os.replace(tmp, path)  # atomic seal: no torn chunks
+                    self._fsync_dir()      # …and make the rename durable
                     self._next_seq += 1
                     self._chunks.append(_Chunk(seq, part))
                     flushed += len(part["ts_s"])

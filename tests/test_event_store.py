@@ -307,3 +307,28 @@ class TestRetention:
                          ts_s=20_000, mtype_id=0, value=2.0)
         store3.flush()
         assert store3.query().results[0].event_id != old_id
+
+    def test_stale_marker_backfilled_at_load(self, tmp_path):
+        """Crash between a chunk seal and its marker write leaves the
+        marker below the chunk-derived seq; load must bring it forward or
+        a later full prune regresses seqs."""
+        import os
+
+        store = EventStore(str(tmp_path), flush_rows=2,
+                           flush_interval_s=999.0)
+        store.add_event(device_id=1, tenant_id=0, event_type=0,
+                        ts_s=100, mtype_id=0, value=1.0)
+        store.flush()
+        marker = os.path.join(str(tmp_path), "events", "next-seq")
+        with open(marker, "w") as f:
+            f.write("0")  # simulate the pre-seal marker surviving a crash
+
+        store2 = EventStore(str(tmp_path), flush_rows=2,
+                            flush_interval_s=999.0)
+        with open(marker) as f:
+            assert int(f.read()) == 1  # backfilled from the chunk scan
+        assert store2.prune_older_than(cutoff_s=10_000) == 1
+
+        store3 = EventStore(str(tmp_path), flush_rows=2,
+                            flush_interval_s=999.0)
+        assert store3._next_seq == 1  # marker, not the (empty) chunk scan
