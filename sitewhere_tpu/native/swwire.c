@@ -138,6 +138,41 @@ static int bbuf_push(bbuf *b, uint8_t v) {
     return 0;
 }
 
+/* string slice into the payload buffer (valid while the buffer lives) */
+typedef struct {
+    const char *p;
+    Py_ssize_t len;
+} slice;
+
+typedef struct {
+    slice *data;
+    Py_ssize_t len, cap;
+} sbuf;
+
+static int sbuf_push(sbuf *b, const char *p, Py_ssize_t len) {
+    if (b->len == b->cap) {
+        Py_ssize_t ncap = b->cap ? b->cap * 2 : 1024;
+        slice *nd = (slice *)realloc(b->data, (size_t)ncap * sizeof(slice));
+        if (!nd) return -1;
+        b->data = nd;
+        b->cap = ncap;
+    }
+    b->data[b->len].p = p;
+    b->data[b->len].len = len;
+    b->len++;
+    return 0;
+}
+
+/* Strict UTF-8 gate for the GIL-free scan: the "undecodable token/name
+ * -> bail to the Python path" contract must be enforced without the
+ * Python API.  Delegates to utf8_valid() (defined with the owner-split
+ * path below) so the CPython-equivalent rejection rules live once. */
+static int utf8_valid(const unsigned char *s, Py_ssize_t n);
+
+static int utf8_ok(const char *s, Py_ssize_t len) {
+    return utf8_valid((const unsigned char *)s, len);
+}
+
 /* result codes for one line: 0 ok, 1 bail (shape mismatch), -1 error */
 static int parse_line(cursor *c,
                       const char **token, Py_ssize_t *token_len,
@@ -251,23 +286,11 @@ static int parse_line(cursor *c,
     return 0;
 }
 
-static PyObject *decode_measurement_lines(PyObject *self, PyObject *arg) {
-    /* bytes only: strtod relies on the NUL terminator PyBytes guarantees */
-    if (!PyBytes_Check(arg)) {
-        PyErr_SetString(PyExc_TypeError, "payload must be bytes");
-        return NULL;
-    }
-    Py_buffer view;
-    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) return NULL;
-    const char *buf = (const char *)view.buf;
-    Py_ssize_t n = view.len;
-
-    PyObject *tokens = PyList_New(0);
-    PyObject *names = PyList_New(0);
-    dbuf values = {0}, tss = {0};
-    bbuf us = {0};
-    if (!tokens || !names) goto fail;
-
+/* GIL-free scan of the whole payload into C buffers.
+ * Returns 0 ok, 1 bail (fall back to Python), -1 out-of-memory. */
+static int scan_lines(const char *buf, Py_ssize_t n,
+                      sbuf *toks, sbuf *nms,
+                      dbuf *values, dbuf *tss, bbuf *us) {
     const char *p = buf, *end = buf + n;
     while (p < end) {
         const char *nl = memchr(p, '\n', (size_t)(end - p));
@@ -286,25 +309,85 @@ static PyObject *decode_measurement_lines(PyObject *self, PyObject *arg) {
         uint8_t update_state;
         int rc = parse_line(&c, &token, &token_len, &name, &name_len,
                             &value, &has_value, &ts, &update_state);
-        if (rc != 0) goto bail;
-
-        PyObject *t = PyUnicode_DecodeUTF8(token, token_len, NULL);
-        if (!t) { PyErr_Clear(); goto bail; }
-        if (PyList_Append(tokens, t) != 0) { Py_DECREF(t); goto fail; }
-        Py_DECREF(t);
-        PyObject *nm = PyUnicode_DecodeUTF8(name, name_len, NULL);
-        if (!nm) { PyErr_Clear(); goto bail; }
-        if (PyList_Append(names, nm) != 0) { Py_DECREF(nm); goto fail; }
-        Py_DECREF(nm);
-        if (dbuf_push(&values, value) != 0 || dbuf_push(&tss, ts) != 0 ||
-            bbuf_push(&us, update_state) != 0) {
-            PyErr_NoMemory();
-            goto fail;
-        }
+        if (rc != 0) return 1;
+        if (!utf8_ok(token, token_len) || !utf8_ok(name, name_len))
+            return 1; /* undecodable -> Python path, as before */
+        if (sbuf_push(toks, token, token_len) != 0 ||
+            sbuf_push(nms, name, name_len) != 0 ||
+            dbuf_push(values, value) != 0 || dbuf_push(tss, ts) != 0 ||
+            bbuf_push(us, update_state) != 0)
+            return -1;
         p = nl ? nl + 1 : end;
     }
+    return 0;
+}
 
+/* Small content-keyed memo for the build phase: payloads carry a handful
+ * of distinct measurement names, so most lines reuse a cached str. */
+#define NAME_MEMO 32
+
+static PyObject *decode_measurement_lines(PyObject *self, PyObject *arg) {
+    /* bytes only: strtod relies on the NUL terminator PyBytes guarantees */
+    if (!PyBytes_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "payload must be bytes");
+        return NULL;
+    }
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) return NULL;
+    const char *buf = (const char *)view.buf;
+    Py_ssize_t n = view.len;
+
+    sbuf toks = {0}, nms = {0};
+    dbuf values = {0}, tss = {0};
+    bbuf us = {0};
+    PyObject *tokens = NULL, *names = NULL;
+    int rc;
+
+    /* Phase 1: pure C scan — no Python API, GIL released so sibling
+     * intake threads decode concurrently. */
+    Py_BEGIN_ALLOW_THREADS
+    rc = scan_lines(buf, n, &toks, &nms, &values, &tss, &us);
+    Py_END_ALLOW_THREADS
+    if (rc == 1) goto bail;
+    if (rc == -1) { PyErr_NoMemory(); goto fail; }
+
+    /* Phase 2: materialize Python objects (GIL held). */
     {
+        Py_ssize_t count = toks.len;
+        slice memo_sl[NAME_MEMO];
+        PyObject *memo_obj[NAME_MEMO];
+        int memo_n = 0;
+        tokens = PyList_New(count);
+        names = PyList_New(count);
+        if (!tokens || !names) goto fail;
+        for (Py_ssize_t i = 0; i < count; i++) {
+            PyObject *t = PyUnicode_DecodeUTF8(
+                toks.data[i].p, toks.data[i].len, NULL);
+            if (!t) goto fail; /* utf8_ok passed; real errors propagate */
+            PyList_SET_ITEM(tokens, i, t);
+
+            slice s = nms.data[i];
+            PyObject *nm = NULL;
+            for (int m = 0; m < memo_n; m++) {
+                if (memo_sl[m].len == s.len &&
+                    memcmp(memo_sl[m].p, s.p, (size_t)s.len) == 0) {
+                    nm = memo_obj[m];
+                    Py_INCREF(nm);
+                    break;
+                }
+            }
+            if (!nm) {
+                nm = PyUnicode_DecodeUTF8(s.p, s.len, NULL);
+                if (!nm) goto fail;
+                if (memo_n < NAME_MEMO) {
+                    memo_sl[memo_n] = s;
+                    memo_obj[memo_n] = nm; /* borrowed from the list slot */
+                    memo_n++;
+                }
+            }
+            PyList_SET_ITEM(names, i, nm);
+        }
+
         PyObject *v = PyBytes_FromStringAndSize(
             (const char *)values.data, values.len * (Py_ssize_t)sizeof(double));
         PyObject *t = PyBytes_FromStringAndSize(
@@ -316,19 +399,21 @@ static PyObject *decode_measurement_lines(PyObject *self, PyObject *arg) {
             out = PyTuple_Pack(5, tokens, names, v, t, u);
         Py_XDECREF(v); Py_XDECREF(t); Py_XDECREF(u);
         Py_DECREF(tokens); Py_DECREF(names);
+        free(toks.data); free(nms.data);
         free(values.data); free(tss.data); free(us.data);
         PyBuffer_Release(&view);
         return out; /* NULL propagates the MemoryError */
     }
 
 bail:
-    Py_XDECREF(tokens); Py_XDECREF(names);
+    free(toks.data); free(nms.data);
     free(values.data); free(tss.data); free(us.data);
     PyBuffer_Release(&view);
     Py_RETURN_NONE;
 
 fail:
     Py_XDECREF(tokens); Py_XDECREF(names);
+    free(toks.data); free(nms.data);
     free(values.data); free(tss.data); free(us.data);
     PyBuffer_Release(&view);
     return NULL;
