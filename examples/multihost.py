@@ -19,6 +19,13 @@ What it shows:
 4. a command invoked on host 0 for a host-1 device routes to the owner.
 """
 
+import os
+import sys
+
+# runnable from anywhere: put the repo root on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
 import json
 import socket
 import tempfile

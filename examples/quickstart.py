@@ -19,6 +19,13 @@ What it shows, end to end:
    state, and the live topology.
 """
 
+import os
+import sys
+
+# runnable from anywhere: put the repo root on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
 import json
 import socket
 import struct

@@ -91,6 +91,7 @@ def build_sharded_step(mesh: Mesh, donate: bool = True):
         assignment_id=jnp.zeros(8, jnp.int32), area_id=jnp.zeros(8, jnp.int32),
         customer_id=jnp.zeros(8, jnp.int32), asset_id=jnp.zeros(8, jnp.int32),
         rule_id=jnp.zeros(8, jnp.int32), zone_id=jnp.zeros(8, jnp.int32),
+        present_now=jnp.zeros(8, bool),
         derived_alerts=batch_t, metrics=metrics_t,
     )
     out_specs = (

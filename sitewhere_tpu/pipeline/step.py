@@ -87,6 +87,10 @@ class PipelineOutputs:
     # Rule results (first firing rule/zone per event; counts in metrics):
     rule_id: jax.Array         # int32[B] — NULL_ID if none fired
     zone_id: jax.Array         # int32[B] — NULL_ID if none fired
+    # Devices this step merged an event into (bool[capacity]) — the
+    # presence signal; StateManager.commit uses it to reconcile with a
+    # concurrent sweep without re-deriving a scatter.
+    present_now: jax.Array
     # Derived alert events ready for re-injection (same width as input):
     derived_alerts: EventBatch
     metrics: StepMetrics
@@ -316,7 +320,7 @@ def eval_zone_rules(
 def update_device_state(
     state: DeviceState, batch: EventBatch, accepted: jax.Array,
     ewma_candidates: Optional[jax.Array] = None,
-) -> DeviceState:
+) -> Tuple[DeviceState, jax.Array]:
     """Merge accepted events into last-known state (time-ordered scatters).
 
     Reference: ``DeviceStateProcessingLogic.java:46-80`` merges each event
@@ -325,6 +329,10 @@ def update_device_state(
     ``update_state=False`` (system-generated events, reference
     ``IDeviceEvent.isUpdateState()``) are persisted/fanned-out upstream but
     never merged here — and never mark a device present.
+
+    Returns ``(new_state, present_now)`` where ``present_now`` is
+    ``bool[capacity]`` — devices this step merged at least one event into
+    (the presence signal, free from the any-event winner map).
     """
     ids = batch.device_id
     accepted = accepted & batch.update_state
@@ -411,7 +419,7 @@ def update_device_state(
     )
 
     mshape = state.last_value_ts_s.shape
-    return state.replace(
+    new_state = state.replace(
         last_event_ts_s=new_s,
         last_event_ts_ns=new_ns,
         last_event_type=new_type,
@@ -429,6 +437,7 @@ def update_device_state(
         last_values=values.reshape(state.last_values.shape),
         ewma_values=ewma.reshape(state.ewma_values.shape),
     )
+    return new_state, any_rows >= 0
 
 
 def _build_derived_alerts(
@@ -491,7 +500,8 @@ def pipeline_step(
     rule_fired, rule_id, ewma_candidates = eval_threshold_rules(
         rules, state, batch, accepted)
     zone_fired, zone_id = eval_zone_rules(zones, batch, accepted, enrich["area_id"])
-    new_state = update_device_state(state, batch, accepted, ewma_candidates)
+    new_state, present_now = update_device_state(
+        state, batch, accepted, ewma_candidates)
     derived = _build_derived_alerts(batch, rules, zones, rule_id, zone_id)
 
     metrics = StepMetrics(
@@ -509,6 +519,7 @@ def pipeline_step(
         unassigned=unassigned,
         rule_id=rule_id,
         zone_id=zone_id,
+        present_now=present_now,
         derived_alerts=derived,
         metrics=metrics,
         **enrich,

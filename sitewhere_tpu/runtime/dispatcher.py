@@ -492,8 +492,8 @@ class PipelineDispatcher(LifecycleComponent):
             with trace.span("step.dispatch").tag("rows", plan.n_events):
                 new_state, out = self._step(registry, state, rules, zones,
                                             batch)
-                self.state_manager.commit(new_state, batch=batch,
-                                          accepted=out.accepted)
+                self.state_manager.commit(new_state,
+                                          present_now=out.present_now)
             self.steps += 1
             # Double-buffer: leave this step in flight (dispatch is async)
             # and egress the PREVIOUS step while the device computes.

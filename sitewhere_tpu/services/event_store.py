@@ -276,19 +276,33 @@ class EventStore(LifecycleComponent):
         n = None
         out: Dict[str, np.ndarray] = {}
         received = np.int32(int(time.time()))
+        # One index vector shared by every column: boolean-mask indexing
+        # re-scans the mask per column, and the masked take already yields
+        # a fresh array, so the defensive astype copy is only needed on
+        # the unmasked path (buffered columns must never alias caller
+        # arrays the intake may reuse).
+        mask_arr = None if mask is None else np.asarray(mask)
+        idx = None if mask_arr is None else np.nonzero(mask_arr)[0]
+        src_n = None
         for name, dtype in COLUMNS:
             if name == "received_s":
                 continue
             if name not in cols:
                 raise ValidationError(f"missing event column {name}")
             arr = np.asarray(cols[name])
-            if mask is not None:
-                arr = arr[mask]
-            if n is None:
-                n = len(arr)
-            elif len(arr) != n:
-                raise ValidationError(f"column {name} length {len(arr)} != {n}")
-            out[name] = arr.astype(dtype, copy=True)
+            if src_n is None:
+                src_n = len(arr)
+                n = len(idx) if idx is not None else src_n
+                if mask_arr is not None and len(mask_arr) != src_n:
+                    raise ValidationError(
+                        f"mask length {len(mask_arr)} != {src_n}")
+            elif len(arr) != src_n:
+                raise ValidationError(
+                    f"column {name} length {len(arr)} != {src_n}")
+            if idx is not None:
+                out[name] = arr.take(idx).astype(dtype, copy=False)
+            else:
+                out[name] = arr.astype(dtype, copy=True)
         if not n:
             return 0
         out["received_s"] = np.full(n, received, np.int32)

@@ -62,32 +62,39 @@ class DeviceStateManager(LifecycleComponent):
 
     def commit(self, new_state: DeviceState,
                batch: Optional[EventBatch] = None,
-               accepted=None) -> None:
+               accepted=None, present_now=None) -> None:
         """Adopt a pipeline step's output state (the merge already ran on
         device inside the step).
 
-        Pass the ``batch`` the step consumed — and the step's ``accepted``
-        output mask (``PipelineOutputs.accepted``) — so a presence sweep
+        Pass the step's ``present_now`` output (``bool[capacity]``, the
+        devices the step actually merged) — or the ``batch`` it consumed
+        plus the ``accepted`` mask to re-derive it — so a presence sweep
         that ran concurrently (between the dispatcher's read and this
         commit) is not lost: ``presence_missing`` flags on the current
         epoch are re-applied for devices the step did not actually merge.
         Rows the step REJECTED (unregistered/unassigned/tenant mismatch)
         never cleared presence in the step, so they must not count as
         touched here either.  Computed on device — no host transfer on the
-        hot path.
+        hot path; the ``present_now`` form also costs no extra scatter
+        (the step derived it from its winner map).
         """
         with self._lock:
             current = self._state
-            if batch is not None and current is not new_state:
+            if current is not new_state and (
+                    present_now is not None or batch is not None):
                 cap = new_state.capacity
-                # mirror the step's merge mask: update_state=False rows
-                # never cleared presence in the step
-                merged_rows = (batch.valid & (batch.device_id >= 0)
-                               & batch.update_state)
-                if accepted is not None:
-                    merged_rows = merged_rows & accepted
-                ids = jnp.where(merged_rows, batch.device_id, cap)
-                touched = jnp.zeros((cap,), bool).at[ids].set(True, mode="drop")
+                if present_now is not None:
+                    touched = present_now
+                else:
+                    # mirror the step's merge mask: update_state=False rows
+                    # never cleared presence in the step
+                    merged_rows = (batch.valid & (batch.device_id >= 0)
+                                   & batch.update_state)
+                    if accepted is not None:
+                        merged_rows = merged_rows & accepted
+                    ids = jnp.where(merged_rows, batch.device_id, cap)
+                    touched = jnp.zeros((cap,), bool).at[ids].set(
+                        True, mode="drop")
                 merged = new_state.presence_missing | (
                     current.presence_missing & ~touched
                 )

@@ -224,6 +224,30 @@ class TestCommitMergeRace:
         # though a (rejected) row named it
         assert manager.missing_device_ids() == [5]
 
+    def test_present_now_commit_path_matches_batch_path(self, manager):
+        """The dispatcher's hot path passes the step's present_now output
+        instead of re-deriving touched rows from the batch; both forms
+        must reconcile a concurrent sweep identically."""
+        import numpy as np
+
+        run_step(manager, [measurement(0, ts=1000), measurement(5, ts=1000)])
+        base = manager.current
+        registry = make_registry(capacity=CAP, n_devices=8)
+        batch = make_batch([measurement(0, ts=90_000)])
+        new_state, out = pipeline_step(
+            registry, base, RuleTable.empty(4), ZoneTable.empty(4), batch
+        )
+        # present_now marks exactly the merged device
+        pn = np.asarray(out.present_now)
+        assert pn[0] and not pn[5] and pn.sum() == 1
+
+        manager.apply_presence_sweep(now_s=80_000, missing_after_s=10_000)
+        assert sorted(manager.missing_device_ids()) == [0, 5]
+        manager.commit(new_state, present_now=out.present_now)
+        # dev-0 (merged) cleared; dev-5 (untouched) keeps the sweep flag —
+        # identical to the batch/accepted re-derive form above
+        assert manager.missing_device_ids() == [5]
+
 
 def test_update_state_false_rows_do_not_touch_state(manager):
     """System-generated events (presence STATE_CHANGEs, derived alerts)
