@@ -12,4 +12,5 @@ from sitewhere_tpu.analytics.runner import (  # noqa: F401
     WindowGrid,
     build_window_grid,
     detect_anomalies,
+    detect_anomalies_window_sharded,
 )

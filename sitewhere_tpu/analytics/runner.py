@@ -116,25 +116,127 @@ def detect_anomalies(
         prev_lagged = jnp.pad(lagged, ((0, 0), (1, 0)))[:, :-1]
         return prev - prev_lagged
 
-    base_n = trailing(counts)
+    return _flag_from_trailing(
+        counts, grid.means, grid.variances,
+        trailing(counts), trailing(sums),
+        trailing(counts * grid.means * grid.means), trailing(m2),
+        z_threshold, min_baseline_count, std_floor)
+
+
+def _flag_from_trailing(counts, means, variances,
+                        base_n, base_sum, base_msq, base_m2,
+                        z_threshold, min_baseline_count, std_floor):
+    """z-scores given the four trailing-baseline sums (shared by the
+    local-window and window-sharded paths — the math must not diverge)."""
     safe_n = jnp.maximum(base_n, 1.0)
-    base_mean = trailing(sums) / safe_n
+    base_mean = base_sum / safe_n
     # total variance = within-window residuals + between-window spread
     # Σ n_w·mean_w² − N·μ².  AnalyticsJob centers values by the global
     # mean first, so window means are small deviations and this float32
     # difference stays well-conditioned.
-    between = trailing(counts * grid.means * grid.means) \
-        - base_n * base_mean * base_mean
-    base_var = jnp.maximum((trailing(m2) + between) / safe_n, 0.0)
+    between = base_msq - base_n * base_mean * base_mean
+    base_var = jnp.maximum((base_m2 + between) / safe_n, 0.0)
     # Welch-style denominator: the candidate window's own spread counts
     # too, so quantization jitter inside a window (small mean shift, same
     # order as its own std) never explodes into a huge z-score.
-    base_std = jnp.maximum(jnp.sqrt(base_var + grid.variances), std_floor)
+    base_std = jnp.maximum(jnp.sqrt(base_var + variances), std_floor)
 
-    z = (grid.means - base_mean) / base_std
-    ready = (base_n >= min_baseline_count) & (grid.counts > 0)
+    z = (means - base_mean) / base_std
+    ready = (base_n >= min_baseline_count) & (counts > 0)
     anomalous = ready & (jnp.abs(z) > z_threshold)
     return anomalous, jnp.where(ready, z, 0.0)
+
+
+def detect_anomalies_window_sharded(
+    mesh,
+    grid: WindowGrid,
+    baseline_windows: int = 8,
+    z_threshold: float = 3.0,
+    min_baseline_count: int = 8,
+    std_floor: float = 1e-3,
+):
+    """:func:`detect_anomalies` with the WINDOW (history) axis sharded
+    across the mesh — the long-context leg of the analytics job.
+
+    When the per-device history is too long for one chip, the ``[D, W]``
+    grid block-shards along windows and each trailing baseline crossing a
+    shard boundary needs the tail of the LEFT neighbor's block: a single
+    ``ppermute`` ring-shifts every shard's last ``L`` windows (packed, one
+    collective) to its right neighbor — the halo-exchange form of the
+    ring-style history rotation SURVEY.md §5/§7 names as the sequence-
+    parallel analog.  Shard 0 receives zeros, matching the local path's
+    empty-left-edge semantics.  Results agree with single-chip
+    :func:`detect_anomalies` up to float32 summation order (each shard
+    prefix-sums only ``L + W/S`` windows instead of the whole history —
+    shorter sums, so if anything better-conditioned).
+
+    Requires ``baseline_windows <= W // n_shards`` (one-hop halo).
+    Returns ``(anomalous, z)`` sharded like the input grid.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sitewhere_tpu.parallel.mesh import SHARD_AXIS
+
+    n_shards = mesh.shape[SHARD_AXIS]
+    w = grid.n_windows
+    if w % n_shards != 0:
+        raise ValueError(f"n_windows={w} not divisible by {n_shards} shards")
+    w_local = w // n_shards
+    if baseline_windows > w_local:
+        raise ValueError(
+            f"baseline_windows={baseline_windows} exceeds the per-shard "
+            f"window block {w_local}: the one-hop halo cannot cover it")
+
+    sharding = NamedSharding(mesh, P(None, SHARD_AXIS))
+    counts = jax.device_put(grid.counts, sharding)
+    means = jax.device_put(grid.means, sharding)
+    variances = jax.device_put(grid.variances, sharding)
+    fn = _window_sharded_flagger(
+        mesh, baseline_windows, z_threshold, min_baseline_count, std_floor,
+        n_shards)
+    return fn(counts, means, variances)
+
+
+@functools.lru_cache(maxsize=16)
+def _window_sharded_flagger(mesh, baseline_windows, z_threshold,
+                            min_baseline_count, std_floor, n_shards):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from sitewhere_tpu.parallel.mesh import SHARD_AXIS
+
+    L = baseline_windows
+    spec = P(None, SHARD_AXIS)
+
+    def local(counts_i, means, variances):
+        counts = counts_i.astype(jnp.float32)
+        sums = means * counts
+        m2 = variances * counts
+        msq = counts * means * means
+        pack = jnp.stack([counts, sums, msq, m2], axis=-1)  # [D, Wl, 4]
+        # Ring halo: every shard ships its last L windows right; shard 0
+        # receives nothing (zeros) — the global left edge.
+        halo = jax.lax.ppermute(
+            pack[:, -L:, :], SHARD_AXIS,
+            [(i, i + 1) for i in range(n_shards - 1)])
+        ext = jnp.concatenate([halo, pack], axis=1)  # [D, L + Wl, 4]
+        c = jnp.cumsum(ext, axis=1)
+        cpad = jnp.pad(c, ((0, 0), (1, 0), (0, 0)))
+        w_local = counts.shape[1]
+        # trailing-L sum ending just before local window w:
+        # cpad[w + L] - cpad[w]
+        tr = cpad[:, L:L + w_local, :] - cpad[:, :w_local, :]
+        return _flag_from_trailing(
+            counts, means, variances,
+            tr[..., 0], tr[..., 1], tr[..., 2], tr[..., 3],
+            z_threshold, min_baseline_count, std_floor)
+
+    mapped = shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=(spec, spec),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
 
 
 def route_events_by_shard(
