@@ -349,3 +349,52 @@ def test_stomp_poison_message_left_unacked_and_receiver_survives():
     finally:
         rx.stop()
         broker.close()
+
+
+def test_stomp_heartbeats_negotiated_and_sent():
+    """CONNECTED advertising heart-beats makes the client emit LF frames
+    on the negotiated cadence and detect a silent broker."""
+    raw_frames = []
+
+    class HBBroker(MiniBroker):
+        def _session(self, conn):
+            reader = FrameReader()
+            conn.settimeout(0.05)
+            import time as _t
+            until = _t.monotonic() + 3.0
+            try:
+                while self._alive and _t.monotonic() < until:
+                    try:
+                        data = conn.recv(65536)
+                    except socket.timeout:
+                        continue
+                    if not data:
+                        return
+                    raw_frames.append(data)
+                    for cmd, headers, _ in reader.feed(data):
+                        if cmd == "CONNECT":
+                            # we want 100ms both ways
+                            conn.sendall(encode_frame(
+                                "CONNECTED",
+                                {"version": "1.2", "heart-beat": "100,100"},
+                                escape=False))
+                        elif cmd == "SUBSCRIBE":
+                            self.subscribes.append(headers)
+                # go silent: client should cut the connection
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    broker = HBBroker()
+    rx = StompReceiver("127.0.0.1", broker.port, destination="/queue/q",
+                       heartbeat_ms=100, reconnect_delay_s=5.0)
+    rx.sink = lambda p: None
+    rx.start()
+    try:
+        assert _wait(lambda: broker.subscribes)
+        # client LF heart-beats arrive between frames
+        assert _wait(lambda: any(d == b"\n" for d in raw_frames), timeout=2.0)
+    finally:
+        rx.stop()
+        broker.close()
