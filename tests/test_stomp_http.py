@@ -80,11 +80,15 @@ class MiniBroker:
     """Single-session scripted broker: CONNECT→CONNECTED, records
     SUBSCRIBE/ACK frames, pushes queued MESSAGEs."""
 
-    def __init__(self, drop_first_session=False):
+    def __init__(self, drop_first_session=False, heartbeat="0,0",
+                 raw_capture=None, go_silent_after_subscribe=False):
         self.acks = []
         self.subscribes = []
         self.sessions = 0
         self.drop_first_session = drop_first_session
+        self.heartbeat = heartbeat
+        self.raw_capture = raw_capture
+        self.go_silent_after_subscribe = go_silent_after_subscribe
         self._to_send = []
         self._lock = threading.Lock()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -127,6 +131,17 @@ class MiniBroker:
         try:
             while self._alive:
                 if subscribed:  # a real broker never delivers pre-SUBSCRIBE
+                    if self.go_silent_after_subscribe:
+                        # stop answering entirely (still RECORDING what
+                        # the client sends): the client's dead-connection
+                        # cutoff must fire
+                        try:
+                            got = conn.recv(65536)
+                            if got and self.raw_capture is not None:
+                                self.raw_capture.append(got)
+                        except socket.timeout:
+                            pass
+                        continue
                     with self._lock:
                         pending, self._to_send = self._to_send, []
                     for ack_id, body in pending:
@@ -140,11 +155,14 @@ class MiniBroker:
                     continue
                 if not data:
                     return
+                if self.raw_capture is not None:
+                    self.raw_capture.append(data)
                 for cmd, headers, _ in reader.feed(data):
                     if cmd == "CONNECT":
                         conn.sendall(encode_frame(
                             "CONNECTED",
-                            {"version": "1.2", "heart-beat": "0,0"},
+                            {"version": "1.2",
+                             "heart-beat": self.heartbeat},
                             escape=False))
                     elif cmd == "SUBSCRIBE":
                         subscribed = True
@@ -353,48 +371,25 @@ def test_stomp_poison_message_left_unacked_and_receiver_survives():
 
 def test_stomp_heartbeats_negotiated_and_sent():
     """CONNECTED advertising heart-beats makes the client emit LF frames
-    on the negotiated cadence and detect a silent broker."""
+    on the negotiated cadence and CUT a connection to a silent broker
+    (then retry after the reconnect backoff)."""
     raw_frames = []
-
-    class HBBroker(MiniBroker):
-        def _session(self, conn):
-            reader = FrameReader()
-            conn.settimeout(0.05)
-            import time as _t
-            until = _t.monotonic() + 3.0
-            try:
-                while self._alive and _t.monotonic() < until:
-                    try:
-                        data = conn.recv(65536)
-                    except socket.timeout:
-                        continue
-                    if not data:
-                        return
-                    raw_frames.append(data)
-                    for cmd, headers, _ in reader.feed(data):
-                        if cmd == "CONNECT":
-                            # we want 100ms both ways
-                            conn.sendall(encode_frame(
-                                "CONNECTED",
-                                {"version": "1.2", "heart-beat": "100,100"},
-                                escape=False))
-                        elif cmd == "SUBSCRIBE":
-                            self.subscribes.append(headers)
-                # go silent: client should cut the connection
-            except OSError:
-                pass
-            finally:
-                conn.close()
-
-    broker = HBBroker()
+    broker = MiniBroker(heartbeat="100,100", raw_capture=raw_frames,
+                        go_silent_after_subscribe=True)
     rx = StompReceiver("127.0.0.1", broker.port, destination="/queue/q",
-                       heartbeat_ms=100, reconnect_delay_s=5.0)
+                       heartbeat_ms=100, reconnect_delay_s=0.2)
     rx.sink = lambda p: None
     rx.start()
     try:
         assert _wait(lambda: broker.subscribes)
-        # client LF heart-beats arrive between frames
-        assert _wait(lambda: any(d == b"\n" for d in raw_frames), timeout=2.0)
+        # client LF heart-beats arrive (boundary-insensitive: a chunk of
+        # nothing but LFs, however many coalesced)
+        assert _wait(lambda: any(
+            d and d.strip(b"\n") == b"" for d in raw_frames), timeout=2.0)
+        # silent broker -> heart-beat cutoff -> reconnect attempt: the
+        # broker sees a SECOND session (would never happen if the
+        # dead-connection detection in _session were removed)
+        assert _wait(lambda: broker.sessions >= 2, timeout=5.0)
     finally:
         rx.stop()
         broker.close()
