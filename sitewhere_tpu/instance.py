@@ -491,6 +491,7 @@ class Instance(LifecycleComponent):
             "invocation": invocation.token,
             "command": invocation.command_token,
             "assignment": invocation.target_assignment,
+            "parameterValues": invocation.parameter_values,
             "reason": str(reason),
         })
 
@@ -638,6 +639,113 @@ class Instance(LifecycleComponent):
         if self.forwarder is not None:
             topo["forwarding"] = self.forwarder.metrics()
         return topo
+
+    # -- dead-letter operations (the reprocess-topic analog) ----------------
+
+    def list_dead_letters(self, limit: int = 100,
+                          start: int = 0) -> List[dict]:
+        """Most recent dead-letter records, newest last.
+
+        Reference: the dead-letter topics (failed-decode, unregistered,
+        undelivered commands — ``KafkaTopicNaming.java:48-78``) are
+        operator-inspectable with Kafka tooling; here they are one
+        CRC-checked journal, surfaced with their offsets so records can
+        be requeued.  Offsets are dense, so the tail listing reads at
+        most ``limit`` records regardless of journal size.
+        """
+        limit = max(1, limit)
+        start = max(start, self.dead_letters.end_offset - limit)
+        out: List[dict] = []
+        for offset, raw in self.dead_letters.scan(start):
+            try:
+                doc = json.loads(raw)
+            except ValueError:
+                doc = {"kind": "corrupt", "raw": raw.hex()}
+            doc["offset"] = offset
+            out.append(doc)
+        return out[-limit:]
+
+    def requeue_dead_letter(self, offset: int) -> dict:
+        """Re-drive one dead-letter record through the pipeline (the
+        reprocess-topic analog, ``KafkaTopicNaming.java:172-174``).
+
+        - ``failed-decode``: re-decode the captured raw payload with the
+          dispatcher's recovery decoder (the operator may have fixed the
+          device type/scripts since) and re-ingest; a second decode
+          failure dead-letters again.
+        - ``unregistered``: re-read each referenced ingest-journal
+          payload and re-ingest — after the operator registered the
+          device manually, the rows now validate.
+        - ``undelivered-command``: re-invoke the command against its
+          target assignment.
+        Requeue granularity is the PAYLOAD (at-least-once): a multi-device
+        payload whose other rows already processed re-ingests those rows
+        too, exactly like the reference's reprocess topic redelivering a
+        whole record.
+        """
+        from sitewhere_tpu.ingest.decoders import DecodeError, JsonLinesDecoder
+        from sitewhere_tpu.services.common import EntityNotFound, ValidationError
+
+        try:
+            raw = self.dead_letters.read_one(int(offset))
+        except KeyError:
+            raise EntityNotFound(f"dead letter {offset} (pruned or invalid)")
+        try:
+            doc = json.loads(raw)
+        except ValueError:
+            raise ValidationError(f"dead letter {offset} is not requeueable "
+                                  f"(corrupt record)")
+        kind = doc.get("kind")
+        # same default the dispatcher's crash recovery uses
+        decoder = self.dispatcher.recovery_decoder or JsonLinesDecoder()
+        if kind == "failed-decode" and "payload" in doc:
+            payload = bytes.fromhex(doc["payload"])
+            try:
+                reqs = decoder(payload)
+            except DecodeError as e:
+                self.dispatcher.ingest_failed_decode(
+                    payload, doc.get("source", "requeue"), e)
+                return {"requeued": False, "kind": kind,
+                        "reason": f"decode failed again: {e}"}
+            if not reqs:
+                return {"requeued": False, "kind": kind,
+                        "reason": "decode failed again: no rows decoded"}
+            events = [r for r in reqs if r.event_type is not None]
+            if events:
+                self.dispatcher.ingest_many(events, payload)
+            for r in reqs:
+                if r.event_type is None:
+                    self.dispatcher.ingest_registration(r)
+            return {"requeued": True, "kind": kind, "rows": len(events)}
+        if kind == "unregistered" and doc.get("refs"):
+            rows = 0
+            missing: List[int] = []
+            for ref in doc["refs"]:
+                try:
+                    payload = self.ingest_journal.read_one(int(ref))
+                    reqs = [r for r in decoder(payload)
+                            if r.event_type is not None]
+                except Exception:
+                    missing.append(int(ref))
+                    continue
+                if reqs:
+                    self.dispatcher.ingest_many(reqs, payload)
+                    rows += len(reqs)
+            return {"requeued": rows > 0, "kind": kind, "rows": rows,
+                    **({"unreadable_refs": missing} if missing else {})}
+        if kind == "undelivered-command" and doc.get("command") \
+                and doc.get("assignment"):
+            ok = self.commands.invoke(CommandInvocation(
+                command_token=doc["command"],
+                target_assignment=doc["assignment"],
+                parameter_values=doc.get("parameterValues", {}),
+                initiator="REQUEUE",
+            ))
+            # a repeat failure has already dead-lettered a fresh record
+            return {"requeued": bool(ok), "kind": kind,
+                    **({} if ok else {"reason": "delivery failed again"})}
+        return {"requeued": False, "kind": kind,
+                "reason": "record kind is not requeueable"}
 
     def create_command_invocation(self, assignment_token: str,
                                   command_token: str,

@@ -558,6 +558,24 @@ def register_routes(gw: RestGateway, inst) -> None:
     r("GET", "/api/instance/metrics",
       lambda q: inst.dispatcher.metrics_snapshot())
 
+    # ---- dead letters: inspect + requeue (reprocess-topic analog) ---------
+    def _int_arg(raw, field: str) -> int:
+        try:
+            return int(raw)
+        except (TypeError, ValueError):
+            raise ValidationError(f"{field} must be an integer: {raw!r}")
+
+    def list_dead_letters(q: Request):
+        limit = _int_arg(q.q1("limit", "100"), "limit")
+        start = _int_arg(q.q1("start", "0"), "start")
+        return {"results": inst.list_dead_letters(limit=limit, start=start)}
+
+    r("GET", "/api/deadletters", list_dead_letters)
+    r("POST", "/api/deadletters/{offset}/requeue",
+      lambda q: inst.requeue_dead_letter(
+          _int_arg(q.params["offset"], "offset")),
+      authority="ROLE_ADMIN")
+
     # ---- external search providers (service-event-search analog) ----------
     def external_search(q: Request):
         mgr = getattr(inst, "search_providers", None)

@@ -137,7 +137,9 @@ class MiniBroker:
                         # cutoff must fire
                         try:
                             got = conn.recv(65536)
-                            if got and self.raw_capture is not None:
+                            if not got:
+                                return  # client cut the connection
+                            if self.raw_capture is not None:
                                 self.raw_capture.append(got)
                         except socket.timeout:
                             pass

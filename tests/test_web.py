@@ -359,3 +359,57 @@ class TestWebSocketProtocolErrors:
         finally:
             a.close()
             b.close()
+
+
+class TestDeadLetters:
+    """Dead-letter inspect + requeue (the reprocess-topic analog,
+    KafkaTopicNaming.java:48-78, 172-174)."""
+
+    def test_failed_decode_listed_and_requeued(self, server, client):
+        inst = server.inst
+        dm = inst.device_management
+        if "dlq-sensor" not in dm.device_types:
+            dm.create_device_type(token="dlq-sensor", name="S")
+        dm.create_device(token="dlq-1", device_type="dlq-sensor")
+        dm.create_device_assignment(device="dlq-1")
+
+        # a payload that fails the JSON decoder -> dead letter
+        inst.dispatcher.ingest_failed_decode(
+            b"not json at all", "test-source", ValueError("bad json"))
+        status, body = client.request("GET", "/api/deadletters?limit=10")
+        assert status == 200
+        recs = [r for r in body["results"] if r["kind"] == "failed-decode"]
+        assert recs and recs[-1]["source"] == "test-source"
+        off = recs[-1]["offset"]
+
+        # garbage stays garbage: requeue reports the second decode failure
+        status, body = client.request(
+            "POST", f"/api/deadletters/{off}/requeue")
+        assert status == 200
+        assert body["requeued"] is False
+        assert "decode failed again" in body["reason"]
+
+        # a VALID payload dead-lettered by a (since-fixed) source decoder
+        # requeues through the recovery decoder into the pipeline
+        good = json.dumps({
+            "deviceToken": "dlq-1", "type": "Measurement",
+            "request": {"name": "temp", "value": 55.0,
+                        "eventDate": 1_753_800_000},
+        }).encode()
+        inst.dispatcher.ingest_failed_decode(
+            good, "broken-source", ValueError("custom decoder crashed"))
+        status, body = client.request("GET", "/api/deadletters?limit=5")
+        off = [r for r in body["results"]
+               if r.get("source") == "broken-source"][-1]["offset"]
+        before = inst.event_store.total_events
+        status, body = client.request(
+            "POST", f"/api/deadletters/{off}/requeue")
+        assert status == 200 and body["requeued"] is True, body
+        inst.dispatcher.flush()
+        inst.dispatcher.flush()
+        assert inst.event_store.total_events == before + 1
+
+    def test_requeue_requires_admin(self, server):
+        c = Client(server.port)  # unauthenticated
+        status, _ = c.request("POST", "/api/deadletters/0/requeue")
+        assert status in (401, 403)
