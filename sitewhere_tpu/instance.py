@@ -643,27 +643,69 @@ class Instance(LifecycleComponent):
     # -- dead-letter operations (the reprocess-topic analog) ----------------
 
     def list_dead_letters(self, limit: int = 100,
-                          start: int = 0) -> List[dict]:
-        """Most recent dead-letter records, newest last.
+                          start: Optional[int] = None) -> List[dict]:
+        """Dead-letter records with their offsets.
+
+        Without ``start``: the newest ``limit`` records (the tail —
+        offsets are dense, so this reads at most ``limit`` records
+        regardless of journal size).  With ``start``: the first ``limit``
+        records from that offset (oldest-first paging; pass the last
+        returned offset + 1 as the next page's start).
 
         Reference: the dead-letter topics (failed-decode, unregistered,
         undelivered commands — ``KafkaTopicNaming.java:48-78``) are
         operator-inspectable with Kafka tooling; here they are one
-        CRC-checked journal, surfaced with their offsets so records can
-        be requeued.  Offsets are dense, so the tail listing reads at
-        most ``limit`` records regardless of journal size.
+        CRC-checked journal.  Records already requeued carry
+        ``"requeued": true``.
         """
         limit = max(1, limit)
-        start = max(start, self.dead_letters.end_offset - limit)
+        if start is None:
+            begin = self.dead_letters.end_offset - limit
+            stop = None
+        else:
+            begin = start
+            stop = start + limit
+        requeued = self._requeued_dead_letters()
         out: List[dict] = []
-        for offset, raw in self.dead_letters.scan(start):
+        for offset, raw in self.dead_letters.scan(max(0, begin), stop):
             try:
                 doc = json.loads(raw)
             except ValueError:
                 doc = {"kind": "corrupt", "raw": raw.hex()}
+            if doc.get("kind") == "requeue-marker":
+                continue  # bookkeeping, not an operator-facing record
             doc["offset"] = offset
+            if offset in requeued:
+                doc["requeued"] = True
             out.append(doc)
         return out[-limit:]
+
+    def _requeued_dead_letters(self) -> set:
+        """Offsets already requeued, rebuilt from the retained journal
+        tail's marker records (cached against the journal end offset)."""
+        end = self.dead_letters.end_offset
+        cache = getattr(self, "_requeue_cache", None)
+        if cache is not None and cache[0] == end:
+            return cache[1]
+        done: set = set()
+        # scan(0) starts at the first RETAINED segment (prune contract),
+        # so this is bounded by the retention window
+        for _, raw in self.dead_letters.scan(0):
+            try:
+                doc = json.loads(raw)
+            except ValueError:
+                continue
+            if doc.get("kind") == "requeue-marker":
+                done.add(int(doc.get("target", -1)))
+        self._requeue_cache = (end, done)
+        return done
+
+    def _mark_requeued(self, offset: int) -> None:
+        """Durable idempotency marker: requeuing the same offset twice
+        must not re-deliver (markers ride the same journal, so they
+        survive restarts and age out with the records they guard)."""
+        self.dead_letters.append_json(
+            {"kind": "requeue-marker", "target": int(offset)})
 
     def requeue_dead_letter(self, offset: int) -> dict:
         """Re-drive one dead-letter record through the pipeline (the
@@ -696,6 +738,10 @@ class Instance(LifecycleComponent):
             raise ValidationError(f"dead letter {offset} is not requeueable "
                                   f"(corrupt record)")
         kind = doc.get("kind")
+        if int(offset) in self._requeued_dead_letters():
+            # idempotent retry: a second POST must not re-deliver
+            return {"requeued": False, "kind": kind, "already": True,
+                    "reason": "record was already requeued"}
         # same default the dispatcher's crash recovery uses
         decoder = self.dispatcher.recovery_decoder or JsonLinesDecoder()
         if kind == "failed-decode" and "payload" in doc:
@@ -716,6 +762,7 @@ class Instance(LifecycleComponent):
             for r in reqs:
                 if r.event_type is None:
                     self.dispatcher.ingest_registration(r)
+            self._mark_requeued(offset)
             return {"requeued": True, "kind": kind, "rows": len(events)}
         if kind == "unregistered" and doc.get("refs"):
             rows = 0
@@ -731,6 +778,8 @@ class Instance(LifecycleComponent):
                 if reqs:
                     self.dispatcher.ingest_many(reqs, payload)
                     rows += len(reqs)
+            if rows > 0:
+                self._mark_requeued(offset)
             return {"requeued": rows > 0, "kind": kind, "rows": rows,
                     **({"unreadable_refs": missing} if missing else {})}
         if kind == "undelivered-command" and doc.get("command") \
@@ -741,6 +790,8 @@ class Instance(LifecycleComponent):
                 parameter_values=doc.get("parameterValues", {}),
                 initiator="REQUEUE",
             ))
+            if ok:
+                self._mark_requeued(offset)
             # a repeat failure has already dead-lettered a fresh record
             return {"requeued": bool(ok), "kind": kind,
                     **({} if ok else {"reason": "delivery failed again"})}

@@ -233,6 +233,16 @@ class Checkpointer(LifecycleComponent):
                         logger.info(
                             "pruned %d ingest-journal segment(s) below "
                             "committed offset %d", pruned, reader.committed)
+            # 7. dead-letter retention: keep the newest N records (the
+            # Kafka-retention analog for the dead-letter topics); pruned
+            # records stop being listable/requeueable, which is what
+            # retention means.  0 disables.
+            keep = int(inst.config.get("dead_letters.retain_records",
+                                       10_000) or 0)
+            if keep > 0:
+                cut = inst.dead_letters.end_offset - keep
+                if cut > 0 and inst.dead_letters.prune(cut):
+                    logger.info("pruned dead-letter segments below %d", cut)
             logger.info("checkpoint generation %d saved", gen)
             return self._manifest_path
 

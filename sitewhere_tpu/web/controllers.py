@@ -567,7 +567,8 @@ def register_routes(gw: RestGateway, inst) -> None:
 
     def list_dead_letters(q: Request):
         limit = _int_arg(q.q1("limit", "100"), "limit")
-        start = _int_arg(q.q1("start", "0"), "start")
+        raw_start = q.q1("start")
+        start = _int_arg(raw_start, "start") if raw_start is not None else None
         return {"results": inst.list_dead_letters(limit=limit, start=start)}
 
     r("GET", "/api/deadletters", list_dead_letters)

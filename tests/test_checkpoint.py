@@ -247,3 +247,30 @@ def test_kill_and_restart_on_mesh_restores_sharded_state(tmp_path):
     finally:
         b.stop()
         b.terminate()
+
+
+def test_dead_letter_retention_at_checkpoint(tmp_path):
+    """Checkpoint-time dead-letter retention keeps only the newest N
+    records (segment-granular, like Kafka topic retention)."""
+    cfg = _cfg(tmp_path, dead_letters={"retain_records": 4})
+    a = Instance(cfg)
+    # tiny segments so several records span multiple segments
+    a.dead_letters.segment_bytes = 128
+    a.start()
+    try:
+        for i in range(30):
+            a.dead_letters.append_json(
+                {"kind": "failed-decode", "source": f"s{i}",
+                 "payload": "00" * 16})
+        end = a.dead_letters.end_offset
+        a.checkpointer.save()
+        listed = a.list_dead_letters(limit=100)
+        # everything still listable is in the retained tail; the oldest
+        # records are gone (segment-granular: at LEAST records below the
+        # last whole segment under the cut are dropped)
+        assert listed and listed[-1]["offset"] == end - 1
+        assert listed[0]["offset"] > 0
+        assert len(listed) < 30
+    finally:
+        a.stop()
+        a.terminate()

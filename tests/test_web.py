@@ -413,3 +413,37 @@ class TestDeadLetters:
         c = Client(server.port)  # unauthenticated
         status, _ = c.request("POST", "/api/deadletters/0/requeue")
         assert status in (401, 403)
+
+    def test_requeue_is_idempotent_and_listing_pages(self, server, client):
+        inst = server.inst
+        good = json.dumps({
+            "deviceToken": "dlq-1", "type": "Measurement",
+            "request": {"name": "temp", "value": 56.0,
+                        "eventDate": 1_753_800_010},
+        }).encode()
+        inst.dispatcher.ingest_failed_decode(
+            good, "idem-source", ValueError("x"))
+        status, body = client.request("GET", "/api/deadletters?limit=5")
+        off = [r for r in body["results"]
+               if r.get("source") == "idem-source"][-1]["offset"]
+        before = inst.event_store.total_events
+        status, body = client.request(
+            "POST", f"/api/deadletters/{off}/requeue")
+        assert status == 200 and body["requeued"] is True
+        # retry: must NOT re-ingest
+        status, body = client.request(
+            "POST", f"/api/deadletters/{off}/requeue")
+        assert status == 200 and body["requeued"] is False
+        assert body.get("already") is True
+        inst.dispatcher.flush()
+        inst.dispatcher.flush()
+        assert inst.event_store.total_events == before + 1
+        # listing marks it requeued and hides the marker records
+        status, body = client.request("GET", "/api/deadletters?limit=50")
+        rec = [r for r in body["results"] if r["offset"] == off][0]
+        assert rec.get("requeued") is True
+        assert not any(r["kind"] == "requeue-marker" for r in body["results"])
+        # explicit start pages oldest-first from that offset
+        status, body = client.request(
+            "GET", f"/api/deadletters?start={off}&limit=1")
+        assert [r["offset"] for r in body["results"]] == [off]
