@@ -241,6 +241,7 @@ class Instance(LifecycleComponent):
             on_state_changes=self._on_presence_changes,
         ))
         self.sources: List[LifecycleComponent] = []
+        self._config_sources_built = False
 
         # cross-host fabric (rpc/ package; sitewhere-grpc-client analog):
         # the server publishes this instance's domain surface; a 2+ entry
@@ -594,6 +595,17 @@ class Instance(LifecycleComponent):
 
         _threading.Thread(target=load_swwire, daemon=True,
                           name="native-warmup").start()
+        # Config-declared sources (EventSourcesParser analog): built and
+        # attached before the lifecycle start below brings them up.  A bad
+        # declaration fails boot, like the reference's schema-validated
+        # tenant XML.
+        source_docs = self.config.get("sources")
+        if source_docs and not self._config_sources_built:
+            from sitewhere_tpu.ingest.factory import build_sources
+
+            for src in build_sources(source_docs, scripts=self.scripts):
+                self.add_source(src)
+            self._config_sources_built = True
         # Capture the journal end BEFORE sources start so crash recovery
         # never double-ingests a fresh append racing the replay.
         recover_upto = self.ingest_journal.end_offset
