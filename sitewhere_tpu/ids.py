@@ -155,6 +155,7 @@ class IdentityMap:
         "mtype",
         "alert_type",
         "command",
+        "invocation",
         "zone",
         "user",
         "area_type",

@@ -121,6 +121,8 @@ class Batcher:
         resolve_device: Callable[[str], int],
         resolve_mtype: Callable[[str], int],
         resolve_alert: Callable[[str], int],
+        invocations=None,  # HandleSpace-like (mint/lookup) for
+                           # invocation-token correlation
         deadline_ms: float = 5.0,
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -137,6 +139,7 @@ class Batcher:
         self.resolve_device = resolve_device
         self.resolve_mtype = resolve_mtype
         self.resolve_alert = resolve_alert
+        self.invocations = invocations
         self.deadline_s = deadline_ms / 1e3
         self.clock = clock
         self._pending: List[Deque[_Chunk]] = [
@@ -171,7 +174,10 @@ class Batcher:
             alert_code=(self.resolve_alert(req.alert_type)
                         if req.alert_type else NULL_ID),
             alert_level=int(req.alert_level),
-            command_id=NULL_ID,
+            # responses/invocations correlate through the invocation
+            # token (reference: originatingEventId links a response to
+            # its invocation event)
+            command_id=self._invocation_id(req),
             payload_ref=payload_ref,
             update_state=bool(req.update_state),
         )
@@ -332,6 +338,20 @@ class Batcher:
             plans.append(self._emit())
         return plans
 
+    def _invocation_id(self, req: DecodedRequest) -> int:
+        """Invocation rows MINT their token (host- or replay-created);
+        responses only LOOK UP, so a device sending garbage
+        originatingEventId values cannot permanently allocate handles —
+        the unknown token just stays uncorrelated (NULL_ID)."""
+        inv = self.invocations
+        if inv is None or not req.originating_event:
+            return NULL_ID
+        from sitewhere_tpu.ingest.decoders import RequestKind
+
+        if req.kind == RequestKind.COMMAND_INVOCATION:
+            return inv.mint(req.originating_event)
+        return inv.lookup(req.originating_event)
+
     def add_requests(
         self,
         reqs: Sequence[DecodedRequest],
@@ -363,9 +383,10 @@ class Batcher:
             out["alert_code"][i] = ra(req.alert_type) if req.alert_type else NULL_ID
             out["alert_level"][i] = int(req.alert_level)
             out["update_state"][i] = bool(req.update_state)
+            # invocation-token correlation, same contract as add()
+            out["command_id"][i] = self._invocation_id(req)
         out["tenant_id"][:] = np.asarray(tenant_ids, np.int32)
         out["payload_ref"][:] = np.asarray(payload_refs, np.int32)
-        out["command_id"][:] = NULL_ID
         return self.add_arrays(_copy=False, **out)  # freshly built here
 
     # -- deadline/flush ------------------------------------------------------

@@ -60,6 +60,7 @@ _KIND_EXACT.update({
     "Registration": RequestKind.REGISTRATION,
     "Acknowledge": RequestKind.COMMAND_RESPONSE,
     "CommandResponse": RequestKind.COMMAND_RESPONSE,
+    "CommandInvocation": RequestKind.COMMAND_INVOCATION,
     "StateChange": RequestKind.STATE_CHANGE,
     "StreamData": RequestKind.STREAM_DATA,
 })
@@ -247,6 +248,7 @@ def _decode_mixed(tokens, kinds, reqs, ts_s, ts_ns, event_type,
     lats = np.zeros(n, np.float32)
     lons = np.zeros(n, np.float32)
     elevs = np.zeros(n, np.float32)
+    origins: List[Optional[str]] = []  # invocation-token correlation
     for i, (kind, r) in enumerate(zip(kinds, reqs)):
         # touches only the fields the kind carries; no object construction
         if kind == RequestKind.MEASUREMENT:
@@ -258,6 +260,7 @@ def _decode_mixed(tokens, kinds, reqs, ts_s, ts_ns, event_type,
             mtypes.append(str(name))
             values[i] = float(r["value"])
             alert_types.append(None)
+            origins.append(None)
         elif kind == RequestKind.LOCATION:
             try:
                 lats[i] = float(r["latitude"])
@@ -267,6 +270,7 @@ def _decode_mixed(tokens, kinds, reqs, ts_s, ts_ns, event_type,
             elevs[i] = float(r.get("elevation", 0.0))
             mtypes.append(None)
             alert_types.append(None)
+            origins.append(None)
         elif kind == RequestKind.ALERT:
             # same semantics as the scalar decoder: missing type defaults
             # to "alert", an unknown string level is a decode error —
@@ -281,14 +285,22 @@ def _decode_mixed(tokens, kinds, reqs, ts_s, ts_ns, event_type,
                 level = lv
             alert_levels[i] = int(level)
             mtypes.append(None)
+            origins.append(None)
             if "latitude" in r and "longitude" in r:
                 lats[i] = float(r["latitude"])
                 lons[i] = float(r["longitude"])
         else:
-            # COMMAND_INVOCATION / COMMAND_RESPONSE / STATE_CHANGE rows
-            # carry no columnar fields beyond type + timestamp
+            # COMMAND_INVOCATION / COMMAND_RESPONSE / STATE_CHANGE rows:
+            # only the correlation token beyond type + timestamp (the
+            # scalar path resolves the same fields — never diverge)
             mtypes.append(None)
             alert_types.append(None)
+            if kind == RequestKind.COMMAND_RESPONSE:
+                origins.append(r.get("originatingEventId"))
+            elif kind == RequestKind.COMMAND_INVOCATION:
+                origins.append(r.get("invocationToken"))
+            else:
+                origins.append(None)
 
     columns: Dict[str, object] = {
         "device_token": tokens,
@@ -300,6 +312,8 @@ def _decode_mixed(tokens, kinds, reqs, ts_s, ts_ns, event_type,
         "alert_level": alert_levels,
         "update_state": update_state,
     }
+    if any(o is not None for o in origins):
+        columns["origin"] = origins
     return columns, []
 
 
@@ -337,6 +351,7 @@ def resolve_columns(
     resolve_device,
     resolve_mtype,
     resolve_alert,
+    invocations=None,
 ) -> Dict[str, np.ndarray]:
     """Map token/name columns to dense handles → batcher-ready arrays.
 
@@ -373,4 +388,19 @@ def resolve_columns(
 
     out["mtype_id"] = memoized(columns["mtype"], resolve_mtype)
     out["alert_code"] = memoized(columns["alert_type"], resolve_alert)
+    origins = columns.get("origin")
+    if origins is not None and invocations is not None:
+        from sitewhere_tpu.schema import EventType
+
+        et = np.asarray(columns["event_type"])
+        cid = np.full(n, NULL_ID, np.int32)
+        for i, tok in enumerate(origins):
+            if tok:
+                # invocations MINT their token (host- or replay-created);
+                # responses only LOOK UP — an unknown/garbage token stays
+                # uncorrelated instead of permanently allocating a handle
+                cid[i] = (invocations.mint(tok)
+                          if et[i] == int(EventType.COMMAND_INVOCATION)
+                          else invocations.lookup(tok))
+        out["command_id"] = cid
     return out

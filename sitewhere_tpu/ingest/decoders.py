@@ -63,6 +63,7 @@ _TYPE_ALIASES = {
     "registration": RequestKind.REGISTRATION,
     "acknowledge": RequestKind.COMMAND_RESPONSE,
     "commandresponse": RequestKind.COMMAND_RESPONSE,
+    "commandinvocation": RequestKind.COMMAND_INVOCATION,
     "statechange": RequestKind.STATE_CHANGE,
     "streamdata": RequestKind.STREAM_DATA,
 }
@@ -195,6 +196,14 @@ def _decode_one_inner(token: str, kind_name: str, req: dict) -> DecodedRequest:
             response=req.get("response"),
             **common,
         )
+    if kind == RequestKind.COMMAND_INVOCATION:
+        # journaled invocation payloads (create_command_invocation) must
+        # re-decode on crash replay; the invocation token correlates the
+        # row with its responses
+        return DecodedRequest(
+            originating_event=req.get("invocationToken"),
+            **common,
+        )
     if kind == RequestKind.REGISTRATION:
         return DecodedRequest(
             device_type_token=req.get("deviceTypeToken", req.get("specificationToken")),
@@ -290,6 +299,7 @@ _KIND_WIRE_NAMES = {
     RequestKind.LOCATION: "Location",
     RequestKind.ALERT: "Alert",
     RequestKind.COMMAND_RESPONSE: "CommandResponse",
+    RequestKind.COMMAND_INVOCATION: "CommandInvocation",
     RequestKind.REGISTRATION: "Registration",
     RequestKind.STATE_CHANGE: "StateChange",
     RequestKind.STREAM_DATA: "StreamData",
@@ -334,6 +344,9 @@ def encode_envelope(req: DecodedRequest) -> bytes:
             body["originatingEventId"] = req.originating_event
         if req.response is not None:
             body["response"] = req.response
+    elif req.kind == RequestKind.COMMAND_INVOCATION:
+        if req.originating_event is not None:
+            body["invocationToken"] = req.originating_event
     elif req.kind == RequestKind.REGISTRATION:
         if req.device_type_token:
             body["deviceTypeToken"] = req.device_type_token

@@ -214,6 +214,7 @@ class Instance(LifecycleComponent):
             resolve_device=self.identity.device.lookup,
             resolve_mtype=self.identity.mtype.mint,
             resolve_alert=self.identity.alert_type.mint,
+            invocations=self.identity.invocation,
             deadline_ms=float(self.config["pipeline.deadline_ms"]),
         )
         self.dispatcher = self.add_child(PipelineDispatcher(
@@ -833,6 +834,7 @@ class Instance(LifecycleComponent):
             assignment_token)
         device = self.device_management.get_device(assignment.device)
         inv_token = mint_token("inv")
+        event_ts = int(ts_s if ts_s is not None else now_s())
         payload = _json.dumps({
             "deviceToken": device.token,
             "type": "commandinvocation",
@@ -843,12 +845,19 @@ class Instance(LifecycleComponent):
                 "initiator": initiator,
                 "initiatorId": initiator_id,
                 "invocationToken": inv_token,
+                # crash replay re-decodes this payload: without the
+                # eventDate the recovered row would be stamped 1970 and
+                # immediately TTL-pruned
+                "eventDate": event_ts,
             },
         }).encode()
         self.dispatcher.ingest(DecodedRequest(
             kind=RequestKind.COMMAND_INVOCATION,
             device_token=device.token,
-            ts_s=int(ts_s if ts_s is not None else now_s()),
+            ts_s=event_ts,
+            # the invocation row carries the invocation handle so its
+            # responses (correlated by the same token) query directly
+            originating_event=inv_token,
         ), payload)
         self.dispatcher.flush()
         return {"queued": True, "token": inv_token,

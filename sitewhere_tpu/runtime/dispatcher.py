@@ -280,6 +280,7 @@ class PipelineDispatcher(LifecycleComponent):
             self.batcher.resolve_device,
             self.batcher.resolve_mtype,
             self.batcher.resolve_alert,
+            invocations=self.batcher.invocations,
         )
         cols["payload_ref"] = np.full(n, ref, np.int32)
         cols["tenant_id"] = np.full(

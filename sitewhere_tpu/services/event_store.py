@@ -458,6 +458,7 @@ class EventStore(LifecycleComponent):
         event_type: Optional[int] = None,
         mtype_id: Optional[int] = None,
         alert_code: Optional[int] = None,
+        command_id: Optional[int] = None,
     ) -> SearchResults[EventRecord]:
         """Indexed event listing, newest-first (reference list* semantics).
 
@@ -477,6 +478,7 @@ class EventStore(LifecycleComponent):
             "event_type": event_type,
             "mtype_id": mtype_id,
             "alert_code": alert_code,
+            "command_id": command_id,
         }
         with self._lock:
             chunks = list(self._chunks)

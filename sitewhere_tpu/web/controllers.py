@@ -279,6 +279,18 @@ def register_routes(gw: RestGateway, inst) -> None:
             ts_s=body.get("ts"),
         )
 
+    # ---- responses for one invocation (reference:
+    # listCommandResponsesForInvocation, correlated by originatingEventId) -
+    def invocation_responses(q: Request):
+        handle = inst.identity.invocation.lookup(q.params["token"])
+        require(handle != NULL_ID,
+                EntityNotFound(f"invocation {q.params['token']}"))
+        return page_response(inst.event_store.query(
+            q.criteria(), command_id=handle,
+            event_type=int(EventType.COMMAND_RESPONSE)))
+
+    r("GET", "/api/invocations/{token}/responses", invocation_responses)
+
     # Stream routes must precede the generic {kind} event routes or
     # GET .../streams would match {kind} and 404 as an unknown event kind
     # (the handlers are defined below; the lambdas bind late).

@@ -225,3 +225,154 @@ def test_invoke_many(dm):
         for i in range(3)
     ] + [CommandInvocation(command_token="set-point", target_assignment="a-404")]
     assert proc.invoke_many(invs) == 3
+
+
+def test_invocation_response_correlation_and_replay(tmp_path):
+    """A device's command response correlates with its invocation through
+    the invocation token (reference: originatingEventId →
+    listCommandResponsesForInvocation), and a journaled invocation
+    re-decodes on crash replay (the 'commandinvocation' wire type)."""
+    import json as _json
+
+    from sitewhere_tpu.ingest.decoders import JsonDecoder
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+    from sitewhere_tpu.schema import EventType
+
+    cfg = Config({
+        "instance": {"id": "corr", "data_dir": str(tmp_path / "data")},
+        "pipeline": {"width": 64, "registry_capacity": 256, "mtype_slots": 4,
+                     "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+        "checkpoint": {"interval_s": 0},
+    }, apply_env=False)
+    inst = Instance(cfg)
+    inst.start()
+    try:
+        dm = inst.device_management
+        dm.create_device_type(token="s", name="S")
+        dm.create_device_command("s", token="reboot", name="Reboot",
+                                 namespace="sw")
+        dm.create_device(token="d-1", device_type="s")
+        a = dm.create_device_assignment(device="d-1")
+
+        out = inst.create_command_invocation(a.token, "reboot")
+        inv_token = out["token"]
+        inst.dispatcher.flush()
+
+        # device acknowledges, naming the invocation token
+        payload = _json.dumps({
+            "deviceToken": "d-1", "type": "commandResponse",
+            "request": {"originatingEventId": inv_token,
+                        "response": "ok", "eventDate": 1_753_800_100},
+        }).encode()
+        inst.dispatcher.ingest(JsonDecoder()(payload)[0], payload=payload)
+        inst.dispatcher.flush()
+
+        handle = inst.identity.invocation.lookup(inv_token)
+        assert handle >= 0
+        res = inst.event_store.query(
+            command_id=handle, event_type=int(EventType.COMMAND_RESPONSE))
+        assert res.total == 1
+        # the invocation row carries the same handle
+        res_inv = inst.event_store.query(
+            command_id=handle,
+            event_type=int(EventType.COMMAND_INVOCATION))
+        assert res_inv.total == 1
+        # snapshot (persists the invocation-token handle), then CRASH
+        # with one more invocation journaled but never processed — the
+        # crash window between Journal.append and egress
+        inst.checkpointer.save()
+        crash_inv = _json.dumps({
+            "deviceToken": "d-1", "type": "commandInvocation",
+            "request": {"commandToken": "reboot",
+                        "assignmentToken": a.token,
+                        "invocationToken": "inv-crashed",
+                        "eventDate": 1_753_800_200},
+        }).encode()
+        inst.ingest_journal.append(crash_inv)
+        events_before = inst.event_store.total_events
+    finally:
+        inst.ingest_journal.close()
+        inst.dead_letters.close()
+        del inst  # simulated kill
+
+    b = Instance(cfg)
+    assert b.restored
+    b.start()
+    try:
+        b.dispatcher.flush()
+        b.dispatcher.flush()
+        # the uncommitted invocation re-decoded (the 'commandinvocation'
+        # wire type) and replayed — no failed-decode dead letter
+        dls = b.list_dead_letters(limit=50)
+        assert not any(d["kind"] == "failed-decode" for d in dls), dls
+        assert b.event_store.total_events >= events_before + 1
+        # checkpoint restored the invocation-token handle, so the
+        # correlation query still works after restart
+        handle = b.identity.invocation.lookup(inv_token)
+        assert handle >= 0
+        assert b.event_store.query(
+            command_id=handle,
+            event_type=int(EventType.COMMAND_RESPONSE)).total == 1
+        # the crashed invocation's token got a handle during replay
+        assert b.identity.invocation.lookup("inv-crashed") >= 0
+    finally:
+        b.stop()
+        b.terminate()
+
+
+def test_response_correlation_on_columnar_wire_path(tmp_path):
+    """A commandResponse arriving over the NDJSON wire edge (the path
+    cross-host forwarding delivers into) must correlate exactly like the
+    scalar path — and an unknown originatingEventId must stay
+    uncorrelated WITHOUT minting a handle (garbage tokens from devices
+    cannot exhaust the invocation space)."""
+    import json as _json
+
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+    from sitewhere_tpu.schema import EventType
+
+    cfg = Config({
+        "instance": {"id": "corrw", "data_dir": str(tmp_path / "data")},
+        "pipeline": {"width": 64, "registry_capacity": 256, "mtype_slots": 4,
+                     "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+    }, apply_env=False)
+    inst = Instance(cfg)
+    inst.start()
+    try:
+        dm = inst.device_management
+        dm.create_device_type(token="s", name="S")
+        dm.create_device_command("s", token="reboot", name="Reboot",
+                                 namespace="sw")
+        dm.create_device(token="d-1", device_type="s")
+        a = dm.create_device_assignment(device="d-1")
+        inv_token = inst.create_command_invocation(a.token, "reboot")["token"]
+        inst.dispatcher.flush()
+
+        lines = b"\n".join([
+            _json.dumps({"deviceToken": "d-1", "type": "CommandResponse",
+                         "request": {"originatingEventId": inv_token,
+                                     "response": "ok",
+                                     "eventDate": 1_753_800_100}}).encode(),
+            _json.dumps({"deviceToken": "d-1", "type": "CommandResponse",
+                         "request": {"originatingEventId": "garbage-9999",
+                                     "response": "??",
+                                     "eventDate": 1_753_800_101}}).encode(),
+        ])
+        before = len(inst.identity.invocation)
+        assert inst.dispatcher.ingest_wire_lines(lines) == 2
+        inst.dispatcher.flush()
+
+        handle = inst.identity.invocation.lookup(inv_token)
+        res = inst.event_store.query(
+            command_id=handle, event_type=int(EventType.COMMAND_RESPONSE))
+        assert res.total == 1  # the garbage-token response is NOT here
+        # no handle was minted for the garbage token
+        assert len(inst.identity.invocation) == before
+        assert inst.identity.invocation.lookup("garbage-9999") < 0
+    finally:
+        inst.stop()
+        inst.terminate()
