@@ -49,6 +49,8 @@ class RequestKind(enum.IntEnum):
     REGISTRATION = 10       # reference: RegisterDevice → registration topic
     STREAM_DATA = 11        # reference: device stream chunks
     MAPPING = 12            # reference: DeviceMappingCreateRequest
+    STREAM_CREATE = 13      # reference: DeviceStreamCreateRequest
+    STREAM_SEND = 14        # reference: SendDeviceStreamDataRequest
 
 
 _TYPE_ALIASES = {
@@ -66,6 +68,10 @@ _TYPE_ALIASES = {
     "commandinvocation": RequestKind.COMMAND_INVOCATION,
     "statechange": RequestKind.STATE_CHANGE,
     "streamdata": RequestKind.STREAM_DATA,
+    "devicestreamdata": RequestKind.STREAM_DATA,
+    "devicestream": RequestKind.STREAM_CREATE,
+    "devicestreamcreate": RequestKind.STREAM_CREATE,
+    "sendstreamdata": RequestKind.STREAM_SEND,
 }
 
 _LEVEL_ALIASES = {
@@ -104,6 +110,11 @@ class DecodedRequest:
     customer_token: Optional[str] = None
     # generic
     metadata: Optional[dict] = None
+    # device stream requests (host plane)
+    stream_id: Optional[str] = None
+    sequence_number: int = 0
+    stream_data: Optional[bytes] = None
+    content_type: Optional[str] = None
     alternate_id: Optional[str] = None   # dedup key (AlternateIdDeduplicator)
     update_state: bool = True            # reference: event.isUpdateState()
 
@@ -211,8 +222,38 @@ def _decode_one_inner(token: str, kind_name: str, req: dict) -> DecodedRequest:
             customer_token=req.get("customerToken"),
             **common,
         )
-    if kind in (RequestKind.STATE_CHANGE, RequestKind.STREAM_DATA,
-                RequestKind.MAPPING):
+    if kind in (RequestKind.STREAM_CREATE, RequestKind.STREAM_DATA,
+                RequestKind.STREAM_SEND):
+        stream_id = req.get("streamId")
+        if not stream_id:
+            raise DecodeError("stream request needs streamId")
+        if kind == RequestKind.STREAM_CREATE:
+            return DecodedRequest(
+                stream_id=str(stream_id),
+                # `or`: an explicit JSON null must fall back, not become
+                # the literal string "None"
+                content_type=str(req.get("contentType")
+                                 or "application/octet-stream"),
+                **common)
+        seq = req.get("sequenceNumber")
+        if seq is None:
+            raise DecodeError("stream request needs sequenceNumber")
+        if kind == RequestKind.STREAM_SEND:
+            return DecodedRequest(stream_id=str(stream_id),
+                                  sequence_number=int(seq), **common)
+        raw = req.get("data")
+        if raw is None:
+            raise DecodeError("stream data needs data (base64)")
+        import base64 as _base64
+
+        try:
+            blob = _base64.b64decode(raw, validate=True)
+        except Exception as e:
+            raise DecodeError(f"bad stream data base64: {e}") from e
+        return DecodedRequest(stream_id=str(stream_id),
+                              sequence_number=int(seq),
+                              stream_data=blob, **common)
+    if kind in (RequestKind.STATE_CHANGE, RequestKind.MAPPING):
         return DecodedRequest(**common)
     raise DecodeError(f"unsupported request type {kind_name!r}")
 
@@ -303,6 +344,8 @@ _KIND_WIRE_NAMES = {
     RequestKind.REGISTRATION: "Registration",
     RequestKind.STATE_CHANGE: "StateChange",
     RequestKind.STREAM_DATA: "StreamData",
+    RequestKind.STREAM_CREATE: "DeviceStream",
+    RequestKind.STREAM_SEND: "SendStreamData",
 }
 
 
@@ -354,6 +397,19 @@ def encode_envelope(req: DecodedRequest) -> bytes:
             body["areaToken"] = req.area_token
         if req.customer_token:
             body["customerToken"] = req.customer_token
+    elif req.kind in (RequestKind.STREAM_CREATE, RequestKind.STREAM_DATA,
+                      RequestKind.STREAM_SEND):
+        import base64 as _base64
+
+        body["streamId"] = req.stream_id
+        if req.kind == RequestKind.STREAM_CREATE:
+            if req.content_type:
+                body["contentType"] = req.content_type
+        else:
+            body["sequenceNumber"] = req.sequence_number
+        if req.kind == RequestKind.STREAM_DATA:
+            body["data"] = _base64.b64encode(
+                req.stream_data or b"").decode("ascii")
     return json.dumps(
         {"deviceToken": req.device_token, "type": kind_name, "request": body},
         separators=(",", ":")).encode("utf-8")

@@ -230,6 +230,7 @@ class Instance(LifecycleComponent):
             journal=self.ingest_journal,
             dead_letters=self.dead_letters,
             resolve_tenant=self._tenant_dense_id,
+            on_host_request=self._on_host_request,
             mesh=self.mesh,
             journal_reader=JournalReader(self.ingest_journal, "pipeline"),
             recovery_decoder=recovery_decoder,
@@ -529,6 +530,10 @@ class Instance(LifecycleComponent):
             if hasattr(source, "on_events"):
                 source.on_events = self.forwarder.ingest_requests
             source.on_registration = self.forwarder.ingest_registration
+            # stream requests route to the device's owning host, which
+            # handles them via its local _on_host_request
+            self.forwarder.on_host_request = self._on_host_request
+            source.on_host_request = self.forwarder.ingest_host_request
         else:
             source.on_event = self.dispatcher.ingest
             if hasattr(source, "on_events"):
@@ -536,8 +541,56 @@ class Instance(LifecycleComponent):
                 source.on_events = self.dispatcher.ingest_many
             source.on_registration = self.dispatcher.ingest_registration
         source.on_failed_decode = self.dispatcher.ingest_failed_decode
+        if getattr(source, "on_host_request", None) is None \
+                and self.forwarder is None:
+            source.on_host_request = self._on_host_request
         self.sources.append(self.add_child(source))
         return source
+
+    def _on_host_request(self, req, payload: bytes = b"") -> None:
+        """Route host-plane requests from sources (reference: device
+        stream create/data/send-back requests flow through the event
+        sources into ``DeviceStreamManager``,
+        ``media/DeviceStreamManager.java``).  Stream requests are
+        handled by the RECEIVING host (streams are assignment-scoped,
+        management-plane); anything unroutable dead-letters."""
+        from sitewhere_tpu.ingest.decoders import RequestKind
+        from sitewhere_tpu.services.common import ServiceError
+
+        try:
+            if req.kind == RequestKind.STREAM_CREATE:
+                self.stream_manager.handle_device_stream_request(
+                    req.device_token, req.stream_id,
+                    req.content_type or "application/octet-stream")
+                return
+            if req.kind == RequestKind.STREAM_DATA:
+                self.stream_manager.handle_device_stream_data_request(
+                    req.device_token, req.stream_id,
+                    req.sequence_number, req.stream_data or b"")
+                return
+            if req.kind == RequestKind.STREAM_SEND:
+                self.stream_manager.handle_send_device_stream_data_request(
+                    req.device_token, req.stream_id, req.sequence_number)
+                return
+        except ServiceError as e:
+            from sitewhere_tpu.ingest.decoders import encode_envelope
+
+            # the raw request is recorded so the operator requeue path
+            # can replay it (e.g. after the missing stream was created)
+            self.dead_letters.append_json({
+                "kind": "failed-stream-request",
+                "request_kind": req.kind.name,
+                "device_token": req.device_token,
+                "stream_id": req.stream_id,
+                "error": str(e),
+                "payload": (payload or encode_envelope(req)).hex(),
+            })
+            return
+        self.dead_letters.append_json({
+            "kind": "unsupported-host-request",
+            "request_kind": req.kind.name,
+            "device_token": req.device_token,
+        })
 
     # -- bootstrap (service-instance-management) ----------------------------
 
@@ -757,7 +810,8 @@ class Instance(LifecycleComponent):
                     "reason": "record was already requeued"}
         # same default the dispatcher's crash recovery uses
         decoder = self.dispatcher.recovery_decoder or JsonLinesDecoder()
-        if kind == "failed-decode" and "payload" in doc:
+        if kind in ("failed-decode", "failed-stream-request") \
+                and "payload" in doc:
             payload = bytes.fromhex(doc["payload"])
             try:
                 reqs = decoder(payload)
@@ -769,14 +823,24 @@ class Instance(LifecycleComponent):
             if not reqs:
                 return {"requeued": False, "kind": kind,
                         "reason": "decode failed again: no rows decoded"}
+            from sitewhere_tpu.ingest.decoders import RequestKind
+
             events = [r for r in reqs if r.event_type is not None]
             if events:
                 self.dispatcher.ingest_many(events, payload)
+            rows = len(events)
             for r in reqs:
-                if r.event_type is None:
+                if r.event_type is not None:
+                    continue
+                if r.kind == RequestKind.REGISTRATION:
                     self.dispatcher.ingest_registration(r)
+                else:
+                    # host-plane (stream) request — re-route; a repeat
+                    # failure dead-letters a fresh record
+                    self._on_host_request(r, payload)
+                    rows += 1
             self._mark_requeued(offset)
-            return {"requeued": True, "kind": kind, "rows": len(events)}
+            return {"requeued": True, "kind": kind, "rows": rows}
         if kind == "unregistered" and doc.get("refs"):
             rows = 0
             missing: List[int] = []

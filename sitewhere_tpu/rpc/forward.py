@@ -166,6 +166,9 @@ class HostForwarder(LifecycleComponent):
                  name: str = "host-forwarder"):
         super().__init__(name)
         self.dispatcher = dispatcher
+        # local handler for host-plane requests owned by this host
+        # (set by the instance; see ingest_host_request)
+        self.on_host_request = None
         self.process_id = process_id
         self.n_processes = len(peer_demuxes)
         self.peers = peer_demuxes
@@ -270,6 +273,20 @@ class HostForwarder(LifecycleComponent):
         owner = owning_process(req.device_token, self.n_processes)
         if owner == self.process_id:
             self.dispatcher.ingest_registration(req, payload)
+        else:
+            self._buffer(owner, [encode_envelope(req)])
+
+    def ingest_host_request(self, req, payload: bytes = b"") -> None:
+        """Host-plane requests (device streams) route like registrations:
+        streams are assignment-scoped and the device model lives on the
+        owning host, so the request must land there.  The owner handles
+        it through ``on_host_request`` (set by the instance)."""
+        from sitewhere_tpu.ingest.decoders import encode_envelope
+
+        owner = owning_process(req.device_token, self.n_processes)
+        if owner == self.process_id:
+            if self.on_host_request is not None:
+                self.on_host_request(req, payload)
         else:
             self._buffer(owner, [encode_envelope(req)])
 

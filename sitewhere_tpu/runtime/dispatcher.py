@@ -80,6 +80,7 @@ class PipelineDispatcher(LifecycleComponent):
         journal: Optional[Journal] = None,
         dead_letters: Optional[Journal] = None,
         resolve_tenant: Optional[Callable[[str], int]] = None,
+        on_host_request: Optional[Callable[[DecodedRequest, bytes], None]] = None,
         max_replay_depth: int = 4,
         mesh=None,
         journal_reader: Optional[JournalReader] = None,
@@ -100,6 +101,8 @@ class PipelineDispatcher(LifecycleComponent):
         self.journal = journal
         self.dead_letters = dead_letters
         self.resolve_tenant = resolve_tenant or (lambda token: 0)
+        # host-plane requests (device streams) decoded off the wire path
+        self.on_host_request = on_host_request
         self.max_replay_depth = max_replay_depth
         # No donation of `state`: DeviceStateManager.commit's sweep-merge
         # and concurrent readers still reference the previous epoch.
@@ -263,8 +266,12 @@ class PipelineDispatcher(LifecycleComponent):
         for req in host_reqs:
             if req.kind == RequestKind.REGISTRATION:
                 self.ingest_registration(req, b"")
+            elif self.on_host_request is not None:
+                # device-stream requests (and other host-plane lines)
+                # route to the instance handler — this is also how a
+                # FORWARDED stream request is handled at its owning host
+                self.on_host_request(req, payload)
             elif self.dead_letters is not None:
-                # stream-data/mapping lines need their own host channels;
                 # they must never silently mint devices via registration
                 self.dead_letters.append_json({
                     "kind": "unsupported-wire-line",

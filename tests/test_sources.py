@@ -153,10 +153,18 @@ def test_host_plane_request_does_not_kill_receiver():
         port = src.receivers[0].port
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         s.sendto(json.dumps({"deviceToken": "d", "type": "StreamData",
+                             "request": {"streamId": "s1",
+                                         "sequenceNumber": 0,
+                                         "data": "AAAA"}}).encode(),
+                 ("127.0.0.1", port))
+        # malformed stream request (no streamId) dead-letters as a
+        # failed decode rather than killing the receiver
+        s.sendto(json.dumps({"deviceToken": "d", "type": "StreamData",
                              "request": {}}).encode(), ("127.0.0.1", port))
         s.sendto(meas_payload(value=3.0), ("127.0.0.1", port))
         assert wait_for(lambda: len(events) == 1)  # receiver survived
         assert src.dropped_host_requests == 1
+        assert src.failed_count == 1
     finally:
         src.stop()
 

@@ -262,3 +262,78 @@ def test_create_failure_acks_failed(dm, streams):
         == DeviceStreamStatus.FAILED
     )
     assert acks[-1]["status"] == "failed"
+
+
+def test_device_streams_over_wire_source(tmp_path):
+    """A device creates a stream and uploads chunks through a protocol
+    source (reference: stream requests flow event-sources →
+    DeviceStreamManager, media/DeviceStreamManager.java) — no
+    programmatic stream calls, just wire payloads."""
+    import base64
+    import json as _json
+    import socket
+    import struct
+    import time as _time
+
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+
+    cfg = Config({
+        "instance": {"id": "stream-wire", "data_dir": str(tmp_path / "d")},
+        "pipeline": {"width": 64, "registry_capacity": 256, "mtype_slots": 4,
+                     "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+        "sources": [{"id": "wire", "decoder": "json",
+                     "receivers": [{"type": "tcp", "port": 0}]}],
+    }, apply_env=False)
+    inst = Instance(cfg)
+    inst.start()
+    try:
+        dm = inst.device_management
+        dm.create_device_type(token="cam", name="Cam")
+        dm.create_device(token="cam-1", device_type="cam")
+        a = dm.create_device_assignment(device="cam-1")
+
+        rx = inst.sources[0].receivers[0]
+
+        def send(doc):
+            payload = _json.dumps(doc).encode()
+            with socket.create_connection(("127.0.0.1", rx.port),
+                                          timeout=5) as s:
+                s.sendall(struct.pack(">I", len(payload)) + payload)
+
+        send({"deviceToken": "cam-1", "type": "DeviceStream",
+              "request": {"streamId": "clip-1", "contentType": "video/mp4"}})
+        send({"deviceToken": "cam-1", "type": "StreamData",
+              "request": {"streamId": "clip-1", "sequenceNumber": 0,
+                          "data": base64.b64encode(b"AB").decode()}})
+        send({"deviceToken": "cam-1", "type": "StreamData",
+              "request": {"streamId": "clip-1", "sequenceNumber": 1,
+                          "data": base64.b64encode(b"CD").decode()}})
+
+        deadline = _time.monotonic() + 5
+        stream = None
+        while _time.monotonic() < deadline:
+            stream = inst.streams.get_assignment_stream(a.token, "clip-1")
+            if stream is not None and \
+                    inst.streams.stream_content(stream.token) == b"ABCD":
+                break
+            _time.sleep(0.05)
+        assert stream is not None
+        assert inst.streams.stream_content(stream.token) == b"ABCD"
+        assert stream.content_type == "video/mp4"
+        # a chunk for an unknown stream dead-letters, doesn't explode
+        send({"deviceToken": "cam-1", "type": "StreamData",
+              "request": {"streamId": "nope", "sequenceNumber": 0,
+                          "data": "AAAA"}})
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            if any(d["kind"] == "failed-stream-request"
+                   for d in inst.list_dead_letters(limit=20)):
+                break
+            _time.sleep(0.05)
+        assert any(d["kind"] == "failed-stream-request"
+                   for d in inst.list_dead_letters(limit=20))
+    finally:
+        inst.stop()
+        inst.terminate()
