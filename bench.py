@@ -1,8 +1,21 @@
 """Benchmarks: device events/sec/chip through the TPU pipeline (+ aux configs).
 
 Output contract: the LAST stdout line is the authoritative JSON doc
-{"metric", "value", "unit", "vs_baseline", ...extras}; an earlier line
-marked ``"provisional": true`` may precede it (early CPU evidence).
+{"metric", "value", "unit", "vs_baseline", ...extras}; earlier lines
+marked ``"provisional": true`` may precede it (early CPU evidence,
+per-config results in the default all-configs mode).  The default run
+covers ALL FIVE BASELINE.md configs; the final doc is config 1's
+headline augmented with a ``configs`` summary and — when config 2
+measured a real dispatcher-path p99 — ``latency_p99_ms`` /
+``latency_target_met`` judged on that path (the one BASELINE.md's <10ms
+actually means), labelled with ``latency_backend``.
+
+TPU evidence cache: every authoritative TPU line is persisted to
+``BENCH_TPU_CACHE.json`` (capture time, git SHA, attempt log).  When
+live TPU attempts fail, the cached line is re-emitted as the parsed
+result with ``backend: "tpu-cached"`` + provenance, alongside the fresh
+CPU fallback — a wedged tunnel at capture time cannot erase evidence
+that already exists.  Live attempts always run first.
 Baseline target (BASELINE.md): 1M events/sec/chip end-to-end with <10ms p99,
 so ``vs_baseline = events_per_sec / 1e6`` and the headline JSON also carries
 ``device_step_ms`` / ``host_step_p50_ms`` / ``host_step_p99_ms``.
@@ -52,8 +65,6 @@ import time
 import numpy as np
 
 TARGET_EVENTS_PER_SEC = 1e6  # BASELINE.md north star, per chip
-ATTEMPTS = 3
-BACKOFFS_S = (5, 15, 30)
 
 
 def _force_cpu_if_requested() -> None:
@@ -550,8 +561,73 @@ _METRIC_BY_CONFIG = {
     5: "media_label_ops_per_sec",
 }
 
+# The TPU evidence cache: every authoritative TPU line a supervised run
+# captures is persisted here (with capture timestamp, git SHA, and the
+# attempt log) so a wedged tunnel at driver-capture time cannot erase
+# evidence that already exists.  When live TPU attempts fail, the
+# supervisor re-emits the cached line as the parsed result with
+# ``backend: "tpu-cached"`` and its provenance fields, alongside the
+# fresh CPU fallback.  Live attempts always run first.
+CACHE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_CACHE.json")
+
 # Supervisor state shared with the signal handler.
-_SUP = {"best": None, "attempts": [], "child": None}
+_SUP = {"best": None, "attempts": [], "child": None, "summary": None}
+
+
+def _git_sha() -> str | None:
+    try:
+        root = os.path.dirname(os.path.abspath(__file__))
+        sha = subprocess.check_output(
+            ["git", "rev-parse", "HEAD"], cwd=root, text=True,
+            stderr=subprocess.DEVNULL).strip()
+        dirty = subprocess.check_output(
+            ["git", "status", "--porcelain"], cwd=root, text=True,
+            stderr=subprocess.DEVNULL).strip()
+        return sha + ("-dirty" if dirty else "")
+    except Exception:
+        return None
+
+
+def _load_cache() -> dict:
+    try:
+        with open(CACHE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _store_cache(metric: str, doc: dict, attempts: list) -> None:
+    cache = _load_cache()
+    cache[metric] = {
+        "doc": doc,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_sha": _git_sha(),
+        "attempts": attempts,
+    }
+    tmp = CACHE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(cache, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, CACHE_PATH)
+    _emit_now({"diagnostic": True, "cached": metric,
+               "value": doc.get("value")}, sys.stderr)
+
+
+def _cached_doc(metric: str):
+    """Return the cached TPU doc for ``metric`` re-labelled with
+    provenance, or None."""
+    entry = _load_cache().get(metric)
+    if not entry or not isinstance(entry.get("doc"), dict):
+        return None
+    doc = dict(entry["doc"])
+    doc["backend"] = "tpu-cached"
+    doc["cache_captured_at"] = entry.get("captured_at")
+    doc["cache_git_sha"] = entry.get("git_sha")
+    doc["cache_attempts"] = entry.get("attempts")
+    if "source" in entry:
+        doc["cache_source"] = entry["source"]
+    return doc
 
 
 def _emit_now(doc: dict, stream=None) -> None:
@@ -568,7 +644,7 @@ def _emit_final_and_exit(signum=None, frame=None) -> None:
             os.killpg(child.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
-    doc = _SUP["best"]
+    doc = _SUP.get("summary") or _SUP["best"]
     if doc is None:
         doc = {
             "metric": _SUP.get("metric", "pipeline_events_per_sec_per_chip"),
@@ -614,19 +690,134 @@ def _last_json_line(text: str):
     return None
 
 
-def supervise(args, extra_argv) -> None:
-    """CPU evidence first, then bounded TPU attempts; flush as we go.
+def _probe_tunnel(base_env, timeout_s: float) -> bool:
+    """One cheap child that initializes the backend and runs a trivial jit.
 
-    Every attempt's diagnostic goes to stderr the moment the attempt ends;
-    stdout carries (at most) an early provisional CPU line and the final
-    authoritative line.  The final stdout line is the TPU doc when one
-    landed, else the labelled CPU fallback, else a value=0 diagnostic.
+    The tunnel's dominant failure mode is a HANG in backend init; probing
+    once up front costs ~30s when the tunnel is up and saves 3 full
+    attempt timeouts per config when it is down.
     """
-    total_s = float(os.environ.get("SW_BENCH_TOTAL_BUDGET_S", "330"))
+    t0 = time.monotonic()
+    rc, out, err, reason = _run_child(["--probe"], base_env, timeout_s)
+    ok = rc == 0 and (_last_json_line(out) or {}).get("probe") == "tpu"
+    entry = {"phase": "tunnel-probe", "rc": rc, "reason": reason,
+             "elapsed_s": round(time.monotonic() - t0, 1), "tpu": ok,
+             "stderr_tail": (err or "")[-300:]}
+    _SUP["attempts"].append(entry)
+    _emit_now(dict(entry, diagnostic=True), sys.stderr)
+    return ok
+
+
+def _probe_main() -> None:
+    import jax
+    emit({"probe": jax.default_backend(),
+          "trivial": int(jax.jit(lambda x: x + 1)(jax.numpy.int32(41)))})
+
+
+def supervise_config(config: int, base_env, deadline: float,
+                     tunnel_ok: bool, tpu_attempts: int) -> dict:
+    """Run one config: CPU fallback first, then bounded TPU attempts,
+    then cache fallback.  Returns the authoritative doc for this config.
+    """
+    metric = _METRIC_BY_CONFIG[config]
     attempt_s = float(os.environ.get("SW_BENCH_TIMEOUT_S", "120"))
+    extra = [f"--config={config}"]
+
+    def record(kind, rc, err, reason, t_s):
+        entry = {"phase": f"c{config}-{kind}", "rc": rc, "reason": reason,
+                 "elapsed_s": round(t_s, 1),
+                 "stderr_tail": (err or "")[-600:]}
+        _SUP["attempts"].append(entry)
+        _emit_now(dict(entry, diagnostic=True), sys.stderr)
+
+    def config_attempts():
+        return [a for a in _SUP["attempts"]
+                if a.get("phase", "").startswith(f"c{config}-")]
+
+    # Config 5 never touches the accelerator: run once, in-process budget.
+    if config == 5:
+        t0 = time.monotonic()
+        rc, out, err, reason = _run_child(
+            extra, dict(base_env, SW_BENCH_FORCE_CPU="1"),
+            min(90.0, max(30.0, deadline - time.monotonic())))
+        record("host", rc, err, reason, time.monotonic() - t0)
+        doc = _last_json_line(out) if rc == 0 else None
+        return doc or {"metric": metric, "value": 0, "unit": "ops/s",
+                       "vs_baseline": None, "error": reason}
+
+    # Phase 1: CPU fallback FIRST (reduced profile; cannot hang).
+    cpu_env = dict(base_env, SW_BENCH_FORCE_CPU="1")
+    cpu_budget = min(attempt_s, max(45.0, deadline - time.monotonic()))
+    t0 = time.monotonic()
+    rc, out, err, reason = _run_child(extra, cpu_env, cpu_budget)
+    cpu_doc = _last_json_line(out) if rc == 0 else None
+    if cpu_doc is not None:
+        cpu_doc["backend"] = "cpu-fallback"
+        cpu_doc["note"] = ("reduced-profile CPU fallback, NOT a per-chip "
+                           "TPU figure; kept only if no TPU line (live or "
+                           "cached) exists")
+        _SUP["best"] = cpu_doc
+        _emit_now(dict(cpu_doc, provisional=True, config=config))
+    record("cpu-fallback", rc, err, reason, time.monotonic() - t0)
+
+    # Phase 2: live TPU attempts (always first-class; skipped only when
+    # the up-front probe showed the tunnel wedged).
+    tpu_doc = None
+    attempt = 0
+    while (tunnel_ok and attempt < tpu_attempts
+           and time.monotonic() + 45 < deadline):
+        attempt += 1
+        budget = min(attempt_s, deadline - time.monotonic() - 5)
+        t0 = time.monotonic()
+        rc, out, err, reason = _run_child(extra, base_env, budget)
+        doc = _last_json_line(out) if rc == 0 else None
+        if doc is not None and doc.get("backend") != "tpu":
+            record(f"tpu-attempt-{attempt}", rc, err,
+                   f"child ran on {doc.get('backend')}, not tpu",
+                   time.monotonic() - t0)
+            continue
+        record(f"tpu-attempt-{attempt}", rc, err, reason,
+               time.monotonic() - t0)
+        if doc is not None:
+            tpu_doc = doc
+            break
+    if not tunnel_ok:
+        record("tpu-attempts", 0, "", "skipped: tunnel probe failed", 0.0)
+
+    if tpu_doc is not None:
+        # Persist the authoritative line so a wedged tunnel at a later
+        # capture time cannot erase this evidence.
+        _store_cache(metric, tpu_doc, config_attempts())
+        _SUP["best"] = tpu_doc
+        return tpu_doc
+
+    # Phase 3: cached TPU evidence with provenance, CPU fallback attached.
+    cached = _cached_doc(metric)
+    if cached is not None:
+        cached["cpu_fallback"] = cpu_doc
+        _SUP["best"] = cached
+        return cached
+    if cpu_doc is not None:
+        return cpu_doc
+    return {"metric": metric, "value": 0, "unit": "events/s",
+            "vs_baseline": 0,
+            "error": "no attempt produced a number within budget"}
+
+
+def supervise(args) -> None:
+    """Evidence-first orchestration over one or all configs.
+
+    stdout carries per-config provisional/final lines as they land; the
+    LAST stdout line is the authoritative headline doc (config 1's,
+    augmented with a ``configs`` summary when running all five).  stderr
+    carries every attempt diagnostic the moment it ends.
+    """
+    all_configs = args.config is None
+    configs = sorted(CONFIGS) if all_configs else [args.config]
+    total_default = "520" if all_configs else "330"
+    total_s = float(os.environ.get("SW_BENCH_TOTAL_BUDGET_S", total_default))
     deadline = time.monotonic() + total_s
-    _SUP["metric"] = _METRIC_BY_CONFIG.get(
-        args.config, "pipeline_events_per_sec_per_chip")
+    _SUP["metric"] = _METRIC_BY_CONFIG[configs[0]]
     signal.signal(signal.SIGTERM, _emit_final_and_exit)
     signal.signal(signal.SIGINT, _emit_final_and_exit)
 
@@ -639,65 +830,71 @@ def supervise(args, extra_argv) -> None:
     if args.no_pallas:
         base_env["SW_TPU_GEO_PALLAS"] = "0"
 
-    def record(kind, rc, err, reason, t_s):
-        entry = {"phase": kind, "rc": rc, "reason": reason,
-                 "elapsed_s": round(t_s, 1),
-                 "stderr_tail": (err or "")[-600:]}
-        _SUP["attempts"].append(entry)
-        _emit_now(dict(entry, diagnostic=True), sys.stderr)
+    probe_s = float(os.environ.get("SW_BENCH_PROBE_TIMEOUT_S", "75"))
+    # Config 5 never touches the accelerator — don't pay a (hangable)
+    # backend probe for a host-only run.
+    tunnel_ok = (any(c != 5 for c in configs)
+                 and _probe_tunnel(base_env, probe_s))
 
-    # Phase 1: CPU fallback FIRST (reduced profile; cannot hang).  Leaves
-    # a labelled provisional number on stdout before any TPU risk.
-    cpu_env = dict(base_env, SW_BENCH_FORCE_CPU="1")
-    cpu_budget = min(attempt_s, max(45.0, deadline - time.monotonic() - 150))
-    t0 = time.monotonic()
-    rc, out, err, reason = _run_child(extra_argv, cpu_env, cpu_budget)
-    cpu_doc = _last_json_line(out) if rc == 0 else None
-    if cpu_doc is not None:
-        cpu_doc["backend"] = "cpu-fallback"
-        cpu_doc["note"] = ("reduced-profile CPU fallback, NOT a per-chip "
-                           "TPU figure; kept only if no TPU attempt lands")
-        _SUP["best"] = cpu_doc
-        _emit_now(dict(cpu_doc, provisional=True))
-    record("cpu-fallback", rc, err, reason, time.monotonic() - t0)
-
-    # Phase 2: TPU attempts inside the remaining budget.
-    attempt = 0
-    while time.monotonic() + 45 < deadline and attempt < ATTEMPTS:
-        attempt += 1
-        budget = min(attempt_s, deadline - time.monotonic() - 10)
-        t0 = time.monotonic()
-        rc, out, err, reason = _run_child(extra_argv, base_env, budget)
-        doc = _last_json_line(out) if rc == 0 else None
-        if doc is not None and doc.get("backend") not in ("tpu", None):
-            # The child fell back to a non-TPU backend on its own; keep it
-            # only as a labelled fallback, never as the TPU result.
-            record(f"tpu-attempt-{attempt}",
-                   rc, err, f"child ran on {doc.get('backend')}, not tpu",
-                   time.monotonic() - t0)
-            doc = None
-            continue
-        record(f"tpu-attempt-{attempt}", rc, err, reason,
-               time.monotonic() - t0)
-        if doc is not None:
-            _SUP["best"] = doc
+    results: dict[int, dict] = {}
+    for i, config in enumerate(configs):
+        # Per-config budget: the headline config gets the lion's share of
+        # whatever remains; later configs split the rest evenly.
+        remaining = deadline - time.monotonic()
+        n_left = len(configs) - i
+        share = remaining if n_left == 1 else (
+            remaining * (0.45 if i == 0 and all_configs else 1.0 / n_left))
+        cfg_deadline = time.monotonic() + max(30.0, share)
+        tpu_attempts = (3 if not all_configs else (2 if config == 1 else 1))
+        doc = supervise_config(config, base_env, min(cfg_deadline, deadline),
+                               tunnel_ok, tpu_attempts)
+        results[config] = doc
+        if all_configs:
+            # Every pre-summary stdout line is provisional: the LAST line
+            # is the only authoritative doc (module-docstring contract).
+            _emit_now(dict(doc, config=config, provisional=True))
+        _update_summary(results, all_configs)
+        if time.monotonic() + 20 > deadline:
             break
-        if attempt < ATTEMPTS and time.monotonic() + 60 < deadline:
-            time.sleep(BACKOFFS_S[min(attempt - 1, len(BACKOFFS_S) - 1)])
 
-    # Phase 3: authoritative final line.
-    final = _SUP["best"]
-    if final is None:
-        final = {
-            "metric": _SUP["metric"], "value": 0, "unit": "events/s",
-            "vs_baseline": 0,
-            "error": "no attempt produced a number within budget",
-        }
-    final = dict(final)
-    final.pop("provisional", None)
+    final = _SUP["summary"]
     final["attempts"] = _SUP["attempts"]
     _emit_now(final)
-    sys.exit(0 if _SUP["best"] is not None else 1)
+    produced = [d for d in results.values() if "error" not in d]
+    sys.exit(0 if produced else 1)
+
+
+def _update_summary(results: dict, all_configs: bool) -> None:
+    """Keep _SUP["summary"] current so SIGTERM dumps partial evidence.
+
+    The headline doc is config 1's (throughput + step latency); when the
+    dispatcher path (config 2) has a real measured p99, the headline's
+    ``latency_target_met`` is judged on THAT path — batcher deadline +
+    step + egress, the number BASELINE.md's <10ms actually means — with
+    config 1's device-step criterion kept as ``device_latency_target_met``.
+    """
+    head = dict(results.get(1) or next(iter(results.values())))
+    if all_configs:
+        head["configs"] = {
+            str(k): {f: v.get(f) for f in (
+                "metric", "value", "unit", "vs_baseline", "backend",
+                "latency_p50_ms", "latency_p99_ms", "latency_target_met",
+                "device_step_ms", "device_events_per_sec", "cache_captured_at",
+                "stream_mb_per_sec", "qr_labels_per_sec")
+                if v.get(f) is not None}
+            for k, v in results.items()}
+        c2 = results.get(2)
+        if c2 and c2.get("latency_p99_ms") is not None:
+            # Judged on the best backend config 2 actually ran on this
+            # time — explicitly labelled so a cpu-fallback p99 can never
+            # masquerade as a TPU-path verdict.
+            head["device_latency_target_met"] = head.get("latency_target_met")
+            head["latency_p99_ms"] = c2["latency_p99_ms"]
+            head["latency_target_met"] = bool(c2["latency_p99_ms"] < 10.0)
+            head["latency_backend"] = c2.get("backend")
+            head["latency_path"] = ("dispatcher bytes-in -> egress-out "
+                                    f"(config 2, backend={c2.get('backend')})")
+    _SUP["summary"] = head
 
 
 CONFIGS = {
@@ -711,9 +908,12 @@ CONFIGS = {
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", type=int, default=1,
+    parser.add_argument("--config", type=int, default=None,
                         choices=sorted(CONFIGS),
-                        help="benchmark config (BASELINE.md); default 1")
+                        help="benchmark config (BASELINE.md); default: "
+                             "all five, headline = config 1")
+    parser.add_argument("--probe", action="store_true",
+                        help="backend liveness probe (internal)")
     parser.add_argument("--pallas", action="store_true",
                         help="force-enable the Pallas geofence kernel "
                              "(already the default on TPU; overrides "
@@ -722,8 +922,13 @@ def main() -> None:
                         help="disable the Pallas geofence kernel for an "
                              "A/B run against the dense XLA path")
     parser.add_argument("--no-supervise", action="store_true",
-                        help="run in-process without retry wrapper")
+                        help="run ONE config in-process without the retry "
+                             "wrapper (default config 1; pass --config)")
     args = parser.parse_args()
+
+    if args.probe:
+        _probe_main()
+        return
 
     if os.environ.get("SW_BENCH_CHILD") == "1" or args.no_supervise:
         if args.pallas:
@@ -731,16 +936,10 @@ def main() -> None:
         if args.no_pallas:
             os.environ["SW_TPU_GEO_PALLAS"] = "0"
         _force_cpu_if_requested()
-        CONFIGS[args.config]()
+        CONFIGS[args.config or 1]()
         return
 
-    # Config 5 never touches the accelerator; run it directly.
-    if args.config == 5:
-        CONFIGS[args.config]()
-        return
-
-    extra = [f"--config={args.config}"]
-    supervise(args, extra)
+    supervise(args)
 
 
 if __name__ == "__main__":
