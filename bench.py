@@ -174,7 +174,15 @@ def bench_pipeline() -> None:
     import jax.numpy as jnp
 
     from sitewhere_tpu.ops.geo_pallas import PALLAS_ENABLED
+    from sitewhere_tpu.pipeline.packed import (
+        pack_batch_host,
+        pack_state,
+        pack_tables,
+        packed_pipeline_step,
+    )
+
     from sitewhere_tpu.pipeline import pipeline_step
+    from sitewhere_tpu.pipeline.packed import packed_step_default
     from sitewhere_tpu.schema import EventBatch
 
     reduced = os.environ.get("SW_BENCH_FORCE_CPU") == "1"
@@ -186,16 +194,46 @@ def bench_pipeline() -> None:
     registry, state, rules, zones = build_tables(capacity, n_active)
     raw = host_batches(width, n_active, n_batches=8)
 
-    step = jax.jit(pipeline_step, donate_argnums=(1,))
+    # Step interface is backend-adaptive, mirroring the dispatcher: on
+    # TPU the packed form (11 buffers/call instead of ~110) removes the
+    # per-call dispatch tax; the CPU backend materializes the packs as
+    # real memcpys and measures faster per-column (pipeline/packed.py).
+    use_packed = packed_step_default()
+    if use_packed:
+        tables = jax.jit(pack_tables)(registry, rules, zones)
+        carry = jax.jit(pack_state)(state)
+        step = jax.jit(packed_pipeline_step, donate_argnums=(1,))
+        staged = [
+            tuple(jax.device_put(a) for a in pack_batch_host(b, width))
+            for b in raw
+        ]
 
-    staged = [
-        EventBatch(**{k: jax.device_put(v) for k, v in b.items()}) for b in raw
-    ]
+        def run(c, i):
+            c, oi, metrics, present = step(tables, c, *staged[i % len(staged)])
+            return c, metrics
+
+        def force(metrics):
+            return int(metrics[0])  # processed
+    else:
+        carry = state
+        step = jax.jit(pipeline_step, donate_argnums=(1,))
+        staged = [
+            EventBatch(**{k: jax.device_put(v) for k, v in b.items()})
+            for b in raw
+        ]
+
+        def run(c, i):
+            c, out = step(registry, c, rules, zones, staged[i % len(staged)])
+            return c, out
+
+        def force(out):
+            return int(out.metrics.processed)
+
     jax.block_until_ready(staged)
 
     # Warm-up: compile (fetch so compile can't bleed into the timed region).
-    state, out = step(registry, state, rules, zones, staged[0])
-    int(out.metrics.processed)
+    carry, out = run(carry, 0)
+    force(out)
 
     # Timing boundaries are device-to-host scalar FETCHES, not
     # block_until_ready: through the axon tunnel block_until_ready has
@@ -207,8 +245,8 @@ def bench_pipeline() -> None:
     # ahead, fetch at the end; the fetch is inside the timed region).
     t0 = time.perf_counter()
     for i in range(iters):
-        state, out = step(registry, state, rules, zones, staged[i % len(staged)])
-    processed = int(out.metrics.processed)  # forces the whole chain
+        carry, out = run(carry, i)
+    processed = force(out)  # forces the whole chain
     t1 = time.perf_counter()
     assert processed == width
     events_per_sec = width * iters / (t1 - t0)
@@ -219,8 +257,8 @@ def bench_pipeline() -> None:
     times = []
     for i in range(lat_iters):
         t2 = time.perf_counter()
-        state, out = step(registry, state, rules, zones, staged[i % len(staged)])
-        int(out.metrics.processed)
+        carry, out = run(carry, i)
+        force(out)
         times.append(time.perf_counter() - t2)
     p50 = float(np.percentile(times, 50) * 1e3)
     p99 = float(np.percentile(times, 99) * 1e3)
@@ -230,31 +268,45 @@ def bench_pipeline() -> None:
     # round-trip covers K steps; subtract the round-trip measured on a
     # trivial program.  This is the per-step number a host-attached chip
     # sees, and the one the <10ms p99 target is judged against (an event's
-    # end-to-end latency = batcher deadline + this + egress).
-    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *staged)
+    # end-to-end latency = batcher deadline + this + egress).  The carry
+    # folds in a reduction over EVERY output leg so XLA cannot
+    # dead-code-eliminate the rule/geofence/enrichment work.
+    if use_packed:
+        stacked_i = jnp.stack([b for b, _ in staged])
+        stacked_f = jnp.stack([f for _, f in staged])
 
-    @jax.jit
-    def chain(st):
-        # The carry folds in a reduction over EVERY output leg so XLA
-        # cannot dead-code-eliminate the rule/geofence/enrichment work
-        # the way it would if ``out`` were simply discarded.
-        def body(i, carry):
-            st, acc = carry
-            batch = jax.tree.map(
-                lambda x: jax.lax.dynamic_index_in_dim(
-                    x, i % len(staged), keepdims=False), stacked)
-            st, out = pipeline_step(registry, st, rules, zones, batch)
-            acc = (acc
-                   + out.metrics.accepted
-                   + out.metrics.threshold_alerts
-                   + out.metrics.zone_alerts
-                   + out.rule_id.sum() + out.zone_id.sum()
-                   + out.assignment_id.sum()
-                   + out.derived_alerts.alert_code.sum())
-            return st, acc
-        st, acc = jax.lax.fori_loop(
-            0, chain_k, body, (st, jnp.int32(0)))
-        return st, acc
+        @jax.jit
+        def chain(c):
+            def body(i, cr):
+                c, acc = cr
+                k = i % len(staged)
+                bi = jax.lax.dynamic_index_in_dim(stacked_i, k, keepdims=False)
+                bf = jax.lax.dynamic_index_in_dim(stacked_f, k, keepdims=False)
+                c, oi, metrics, present = packed_pipeline_step(
+                    tables, c, bi, bf)
+                acc = acc + metrics.sum() + oi.sum() + present.sum()
+                return c, acc
+            return jax.lax.fori_loop(0, chain_k, body, (c, jnp.int32(0)))
+    else:
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *staged)
+
+        @jax.jit
+        def chain(c):
+            def body(i, cr):
+                c, acc = cr
+                batch = jax.tree.map(
+                    lambda x: jax.lax.dynamic_index_in_dim(
+                        x, i % len(staged), keepdims=False), stacked)
+                c, out = pipeline_step(registry, c, rules, zones, batch)
+                acc = (acc
+                       + out.metrics.accepted
+                       + out.metrics.threshold_alerts
+                       + out.metrics.zone_alerts
+                       + out.rule_id.sum() + out.zone_id.sum()
+                       + out.assignment_id.sum()
+                       + out.derived_alerts.alert_code.sum())
+                return c, acc
+            return jax.lax.fori_loop(0, chain_k, body, (c, jnp.int32(0)))
 
     trivial = jax.jit(lambda x: x + 1)
     int(trivial(jnp.int32(0)))
@@ -265,10 +317,10 @@ def bench_pipeline() -> None:
         rtts.append(time.perf_counter() - t4)
     rtt = float(np.median(rtts))
 
-    state, probe = chain(state)  # compile
+    carry, probe = chain(carry)  # compile
     int(probe)
     t5 = time.perf_counter()
-    state, probe = chain(state)
+    carry, probe = chain(carry)
     int(probe)
     t6 = time.perf_counter()
     device_step_ms = max(0.0, (t6 - t5 - rtt)) / chain_k * 1e3
@@ -279,8 +331,8 @@ def bench_pipeline() -> None:
         "unit": "events/s",
         "vs_baseline": round(events_per_sec / TARGET_EVENTS_PER_SEC, 3),
         # Device-side rate from the chained-steps probe: what a
-        # host-attached chip sustains once per-step dispatch (~30 ms
-        # through the axon tunnel, ~50 us on a real host) stops dominating.
+        # host-attached chip sustains once per-step dispatch (~50 us on a
+        # real host, tunnel-RTT-sized here) stops dominating.
         "device_events_per_sec": (
             round(width / device_step_ms * 1e3, 1) if device_step_ms > 0
             else None),
@@ -290,6 +342,7 @@ def bench_pipeline() -> None:
         "host_rtt_ms": round(rtt * 1e3, 3),
         "latency_target_met": bool(device_step_ms < 10.0),
         "batch_width": width,
+        "step_interface": "packed" if use_packed else "per-column",
         "backend": jax.default_backend(),
         "geo_pallas": bool(PALLAS_ENABLED and jax.default_backend() == "tpu"),
     })
@@ -439,7 +492,18 @@ def bench_multitenant() -> None:
     import jax
     import jax.numpy as jnp
 
+    from sitewhere_tpu.pipeline.packed import (
+        BATCH_I,
+        F_ACCEPTED,
+        pack_batch_host,
+        pack_state,
+        pack_tables,
+        packed_pipeline_step,
+        packed_presence_sweep,
+    )
+
     from sitewhere_tpu.pipeline import pipeline_step
+    from sitewhere_tpu.pipeline.packed import packed_step_default
     from sitewhere_tpu.schema import EventBatch
     from sitewhere_tpu.state.presence import presence_sweep
 
@@ -450,33 +514,72 @@ def bench_multitenant() -> None:
         capacity, n_active, n_tenants=n_tenants)
     raw = host_batches(width, n_active, n_batches=8, n_tenants=n_tenants)
 
-    step = jax.jit(pipeline_step, donate_argnums=(1,))
-    staged = [
-        EventBatch(**{k: jax.device_put(v) for k, v in b.items()}) for b in raw
-    ]
-    jax.block_until_ready(staged)
-
     now = jnp.int32(1_753_800_000 + 10_000)
     missing_after = jnp.int32(3600)
-    state, out = step(registry, state, rules, zones, staged[0])
-    state, newly = presence_sweep(state, now, missing_after)
+    use_packed = packed_step_default()  # mirror the dispatcher's choice
+    if use_packed:
+        tables = jax.jit(pack_tables)(registry, rules, zones)
+        carry = jax.jit(pack_state)(state)
+        step = jax.jit(packed_pipeline_step, donate_argnums=(1,))
+        psweep = jax.jit(packed_presence_sweep, donate_argnums=(0,))
+        staged = [
+            tuple(jax.device_put(a) for a in pack_batch_host(b, width))
+            for b in raw
+        ]
+
+        def run(c, i):
+            c, oi, metrics, present = step(tables, c, *staged[i % len(staged)])
+            return c, (oi, metrics)
+
+        def do_sweep(c):
+            c, newly = psweep(c, now, missing_after)
+            return c, newly
+
+        def force(out):
+            return int(out[1][0])
+
+        def accepted_mask(out):
+            return (np.asarray(out[0][0]) & F_ACCEPTED) != 0
+    else:
+        carry = state
+        step = jax.jit(pipeline_step, donate_argnums=(1,))
+        staged = [
+            EventBatch(**{k: jax.device_put(v) for k, v in b.items()})
+            for b in raw
+        ]
+
+        def run(c, i):
+            c, out = step(registry, c, rules, zones, staged[i % len(staged)])
+            return c, out
+
+        def do_sweep(c):
+            return presence_sweep(c, now, missing_after)
+
+        def force(out):
+            return int(out.metrics.processed)
+
+        def accepted_mask(out):
+            return np.asarray(out.accepted)
+
+    jax.block_until_ready(staged)
+    carry, out = run(carry, 0)
+    carry, newly = do_sweep(carry)
     int(newly.sum())  # compile both programs + fetch
 
     iters = 10 if reduced else 100
     sweep_every = 10
     t0 = time.perf_counter()
     for i in range(iters):
-        state, out = step(registry, state, rules, zones, staged[i % len(staged)])
+        carry, out = run(carry, i)
         if (i + 1) % sweep_every == 0:
-            state, newly = presence_sweep(state, now, missing_after)
+            carry, newly = do_sweep(carry)
     # Fetch forces the whole donated-state chain (incl. interleaved sweeps).
-    processed = int(out.metrics.processed)
+    processed = force(out)
     t1 = time.perf_counter()
     assert processed == width
     # per-tenant fan-out accounting on the last step's accepted rows
     by_tenant = np.bincount(
-        np.asarray(staged[(iters - 1) % len(staged)].tenant_id)[
-            np.asarray(out.accepted)],
+        raw[(iters - 1) % len(raw)]["tenant_id"][accepted_mask(out)],
         minlength=n_tenants)
     events_per_sec = width * iters / (t1 - t0)
     emit({
@@ -487,6 +590,7 @@ def bench_multitenant() -> None:
         "tenants": n_tenants,
         "sweep_every": sweep_every,
         "min_tenant_share": round(float(by_tenant.min() / max(1, by_tenant.sum())), 4),
+        "step_interface": "packed" if use_packed else "per-column",
         "backend": __import__("jax").default_backend(),
     })
 

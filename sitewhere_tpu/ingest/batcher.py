@@ -92,12 +92,18 @@ class BatchPlan:
     only step *outputs* cross the host boundary after dispatch.
     """
 
-    batch: EventBatch
+    batch: Optional[EventBatch]
     n_events: int
     width: int
     created_at: float
     max_wait_s: float  # how long the oldest row waited before emit
     host_cols: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    # Packed wire form ([12, B] int32 / [4, B] float32, pipeline/packed.py)
+    # when the batcher was built with ``emit_packed`` — then ``batch`` is
+    # None and the dispatcher feeds the packed step directly (2 transfers
+    # instead of 16).
+    packed_i: Optional[np.ndarray] = None
+    packed_f: Optional[np.ndarray] = None
 
     @property
     def fill(self) -> float:
@@ -125,6 +131,7 @@ class Batcher:
                            # invocation-token correlation
         deadline_ms: float = 5.0,
         clock: Callable[[], float] = time.monotonic,
+        emit_packed: bool = False,
     ):
         if width % n_shards != 0:
             raise ValueError(f"width={width} not divisible by n_shards={n_shards}")
@@ -142,6 +149,7 @@ class Batcher:
         self.invocations = invocations
         self.deadline_s = deadline_ms / 1e3
         self.clock = clock
+        self.emit_packed = emit_packed
         self._pending: List[Deque[_Chunk]] = [
             collections.deque() for _ in range(n_shards)
         ]
@@ -414,9 +422,33 @@ class Batcher:
     def _emit(self) -> BatchPlan:
         import jax.numpy as jnp
 
-        out = {
-            name: np.full(self.width, fill, dtype=dt) for name, dt, fill in _FIELDS
-        }
+        ibuf = fbuf = None
+        if self.emit_packed:
+            # Build the host columns directly as rows of the packed wire
+            # buffers — the fill loop below writes into them via the
+            # ``out`` views, so emission costs no extra pass.  Bool
+            # columns keep their own arrays (host_cols consumers expect
+            # bool dtype) and land in their int rows at the end.
+            from sitewhere_tpu.pipeline.packed import BATCH_F, BATCH_I
+
+            ibuf = np.empty((len(BATCH_I), self.width), np.int32)
+            fbuf = np.empty((len(BATCH_F), self.width), np.float32)
+            out = {}
+            for i, f in enumerate(BATCH_I):
+                if f in ("valid", "update_state"):
+                    out[f] = np.full(self.width, _FILL[f], np.bool_)
+                else:
+                    ibuf[i].fill(_FILL[f])
+                    out[f] = ibuf[i]
+            for i, f in enumerate(BATCH_F):
+                fbuf[i].fill(_FILL[f])
+                out[f] = fbuf[i]
+            out["valid"][:] = False
+        else:
+            out = {
+                name: np.full(self.width, fill, dtype=dt)
+                for name, dt, fill in _FIELDS
+            }
         n = 0
         for s in range(self.n_shards):
             base = s * self.seg
@@ -446,6 +478,15 @@ class Batcher:
         self._oldest = min(remaining) if remaining else None
         self.emitted_batches += 1
         self.emitted_events += n
+        if self.emit_packed:
+            from sitewhere_tpu.pipeline.packed import BATCH_I
+
+            ibuf[BATCH_I.index("valid")] = out["valid"]
+            ibuf[BATCH_I.index("update_state")] = out["update_state"]
+            return BatchPlan(
+                batch=None, n_events=n, width=self.width, created_at=now,
+                max_wait_s=wait, host_cols=out, packed_i=ibuf, packed_f=fbuf,
+            )
         batch = EventBatch(**{k: jnp.asarray(v) for k, v in out.items()})
         return BatchPlan(
             batch=batch, n_events=n, width=self.width, created_at=now,

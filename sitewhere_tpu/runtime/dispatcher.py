@@ -116,6 +116,26 @@ class PipelineDispatcher(LifecycleComponent):
             self._step = build_sharded_step(mesh, donate=False)
         else:
             self._step = jax.jit(pipeline_step)
+            # Single-chip fast path: the packed step moves ~11 buffers per
+            # call instead of ~110 — per-call dispatch scales with buffer
+            # count, which measured ~30 ms/step at width 131k through a
+            # network-attached chip (pipeline/packed.py).  Used whenever
+            # the batcher emits packed plans; the donated PackedState is
+            # the device-resident steady-state carry.
+            from sitewhere_tpu.pipeline.packed import (
+                pack_tables,
+                packed_pipeline_step,
+            )
+
+            # NO donation: the carry passed in is the state manager's
+            # LIVE epoch — donating it would leave concurrent readers
+            # (checkpointer, presence sweep, REST queries) holding
+            # deleted buffers until commit_packed lands.  Donation is for
+            # private carries (bench loops); here XLA just allocates
+            # fresh output buffers (~3 MB/step, HBM-trivial).
+            self._packed_step = jax.jit(packed_pipeline_step)
+            self._pack_tables = jax.jit(pack_tables)
+            self._tables_cache: Optional[tuple] = None
         # Identity-keyed cache of mesh-placed epochs: providers return the
         # same object while clean, so steady-state steps reuse the resident
         # sharded arrays instead of re-placing every step.
@@ -466,12 +486,42 @@ class PipelineDispatcher(LifecycleComponent):
         self._placed_epochs[kind] = (obj, placed)
         return placed
 
+    def _tables_packed(self):
+        """PackedTables for the current provider epochs, identity-cached
+        (re-packs only when a registry/rule/zone epoch actually changed)."""
+        reg = self.registry_provider()
+        rules = self.rules_provider()
+        zones = self.zones_provider()
+        c = self._tables_cache
+        if c is not None and c[0] is reg and c[1] is rules and c[2] is zones:
+            return c[3]
+        t = self._pack_tables(reg, rules, zones)
+        self._tables_cache = (reg, rules, zones, t)
+        return t
+
     def _run_plan(self, plan: BatchPlan, replay_depth: int = 0) -> None:
         trace = self.tracer.trace("pipeline.plan")
         # the batcher wait of the oldest row = the "batch assemble" stage
         trace.record("batch.assemble", plan.max_wait_s,
                      rows=plan.n_events, fill=round(plan.fill, 3))
         with self._step_lock:
+            if self.mesh is None and plan.packed_i is not None:
+                from sitewhere_tpu.pipeline.packed import PackedView
+
+                tables = self._tables_packed()
+                ps = self.state_manager.current_packed
+                with trace.span("step.dispatch").tag("rows", plan.n_events):
+                    new_ps, oi, metrics, present = self._packed_step(
+                        tables, ps, plan.packed_i, plan.packed_f)
+                    self.state_manager.commit_packed(
+                        new_ps, present_now=present, read_epoch=ps)
+                out = PackedView(oi, metrics, present)
+                self.steps += 1
+                prev, self._inflight = (
+                    self._inflight, (plan, out, replay_depth, trace))
+                if prev is not None:
+                    self._egress(*prev)
+                return
             batch = plan.batch
             state = self.state_manager.current
             if self.mesh is not None:
@@ -575,7 +625,7 @@ class PipelineDispatcher(LifecycleComponent):
         #    through event management) — fetched only when rules fired
         if int(m.threshold_alerts) + int(m.zone_alerts) > 0:
             with trace.span("egress.derived-alerts"):
-                self._reinject_derived(out, replay_depth)
+                self._reinject_derived(plan, out, replay_depth)
 
         # Egress complete: record the plan's end-to-end latency (batcher
         # wait of its oldest row + emit→egress) and release it from the
@@ -682,8 +732,22 @@ class PipelineDispatcher(LifecycleComponent):
             for plan in self._take(intake):
                 self._run_plan(plan, replay_depth + 1)
 
-    def _reinject_derived(self, out, replay_depth: int) -> None:
+    def _reinject_derived(self, plan: BatchPlan, out,
+                          replay_depth: int) -> None:
         if replay_depth >= self.max_replay_depth:
+            return
+        if hasattr(out, "derived_cols"):
+            # Packed path: reconstruct the (rare) derived rows from host
+            # columns + the packed output block — no same-width EventBatch
+            # round-trip off the device.
+            rows = np.nonzero(out.derived_valid)[0]
+            if rows.size == 0:
+                return
+            self.totals["derived_alerts"] += int(rows.size)
+            cols = out.derived_cols(plan.host_cols, rows)
+            for p in self._take(
+                    lambda: self.batcher.add_arrays(_copy=False, **cols)):
+                self._run_plan(p, replay_depth + 1)
             return
         derived = as_numpy(out.derived_alerts)
         mask = np.asarray(derived.valid)

@@ -29,6 +29,30 @@ from sitewhere_tpu.services.common import EntityNotFound, require
 from sitewhere_tpu.state.presence import presence_sweep, state_changes_for
 
 
+def _packed_codecs():
+    """Module-level jitted pack/unpack (lazy import breaks the cycle;
+    per-call ``jax.jit(...)`` would retrace every time)."""
+    global _PACK, _UNPACK
+    if "_PACK" not in globals():
+        from sitewhere_tpu.pipeline.packed import pack_state, unpack_state
+
+        _PACK = jax.jit(pack_state)
+        _UNPACK = jax.jit(unpack_state)
+    return _PACK, _UNPACK
+
+
+@jax.jit
+def _merge_presence(new_si, cur_si, present_now):
+    """Packed-form presence reconciliation (see :meth:`commit` docstring):
+    a concurrent sweep's missing flags survive unless THIS step merged an
+    event for the device."""
+    from sitewhere_tpu.pipeline.packed import PRESENCE_ROW
+
+    merged = (new_si[PRESENCE_ROW] != 0) | (
+        (cur_si[PRESENCE_ROW] != 0) & ~present_now)
+    return new_si.at[PRESENCE_ROW].set(merged.astype(new_si.dtype))
+
+
 class DeviceStateManager(LifecycleComponent):
     """Holds the authoritative :class:`DeviceState` epoch.
 
@@ -49,8 +73,13 @@ class DeviceStateManager(LifecycleComponent):
         super().__init__(name="device-state-manager")
         self.identity = identity
         self._lock = threading.RLock()
-        self._state = DeviceState.empty(capacity, num_mtype_slots,
-                                        num_ewma_scales)
+        self._state: Optional[DeviceState] = DeviceState.empty(
+            capacity, num_mtype_slots, num_ewma_scales)
+        # Packed twin of the epoch (pipeline/packed.py): the dispatcher's
+        # steady-state carry.  Exactly one of the two may be stale (None);
+        # each is materialized lazily from the other so sweeps/queries and
+        # the packed step loop never force each other's representation.
+        self._packed = None
         self._tenant_id_of_device = tenant_id_of_device
 
     # -- epoch plumbing ----------------------------------------------------
@@ -58,7 +87,39 @@ class DeviceStateManager(LifecycleComponent):
     @property
     def current(self) -> DeviceState:
         with self._lock:
+            if self._state is None:
+                _, unpack = _packed_codecs()
+                self._state = unpack(self._packed)
             return self._state
+
+    @property
+    def current_packed(self):
+        """The packed epoch (pack lazily after an unpacked commit)."""
+        with self._lock:
+            if self._packed is None:
+                pack, _ = _packed_codecs()
+                self._packed = pack(self.current)
+            return self._packed
+
+    def commit_packed(self, new_packed, present_now,
+                      read_epoch=None) -> None:
+        """Adopt a packed step's output state (the packed-loop analog of
+        :meth:`commit`): re-apply ``presence_missing`` flags a concurrent
+        sweep set on the current epoch for devices this step did not merge
+        (``present_now`` = the step's winner map).
+
+        Pass ``read_epoch`` (the PackedState the step consumed): when the
+        current epoch is still that object, nothing intervened and the
+        merge — an extra per-step dispatch — is skipped entirely.
+        """
+        with self._lock:
+            unchanged = read_epoch is not None and self._packed is read_epoch
+            if not unchanged:
+                cur = self.current_packed
+                new_packed = new_packed.replace(
+                    si=_merge_presence(new_packed.si, cur.si, present_now))
+            self._packed = new_packed
+            self._state = None
 
     def commit(self, new_state: DeviceState,
                batch: Optional[EventBatch] = None,
@@ -79,7 +140,7 @@ class DeviceStateManager(LifecycleComponent):
         (the step derived it from its winner map).
         """
         with self._lock:
-            current = self._state
+            current = self.current
             if current is not new_state and (
                     present_now is not None or batch is not None):
                 cap = new_state.capacity
@@ -100,6 +161,7 @@ class DeviceStateManager(LifecycleComponent):
                 )
                 new_state = new_state.replace(presence_missing=merged)
             self._state = new_state
+            self._packed = None
 
     # -- presence ----------------------------------------------------------
 
@@ -110,9 +172,10 @@ class DeviceStateManager(LifecycleComponent):
         STATE_CHANGE batch for newly-missing devices (None if none)."""
         with self._lock:
             new_state, newly_missing = presence_sweep(
-                self._state, jnp.int32(now_s), jnp.int32(missing_after_s)
+                self.current, jnp.int32(now_s), jnp.int32(missing_after_s)
             )
             self._state = new_state
+            self._packed = None
         (idx,) = np.nonzero(np.asarray(newly_missing))
         if idx.size == 0:
             return None
@@ -135,7 +198,7 @@ class DeviceStateManager(LifecycleComponent):
 
     def get_device_state_by_id(self, device_id: int) -> Dict[str, object]:
         with self._lock:
-            s = self._state
+            s = self.current
         require(
             0 <= device_id < s.capacity, EntityNotFound(f"bad device id {device_id}")
         )
@@ -166,13 +229,13 @@ class DeviceStateManager(LifecycleComponent):
     def missing_device_ids(self) -> List[int]:
         """Devices currently flagged missing (vectorized scan + index copy)."""
         with self._lock:
-            mask = np.asarray(self._state.presence_missing)
+            mask = np.asarray(self.current.presence_missing)
         return [int(i) for i in np.nonzero(mask)[0]]
 
     def seen_since(self, since_s: int) -> List[int]:
         """Devices with any event at/after ``since_s``."""
         with self._lock:
-            s = self._state
+            s = self.current
             mask = np.asarray(
                 (s.last_event_type != NULL_ID) & (s.last_event_ts_s >= since_s)
             )
@@ -180,7 +243,7 @@ class DeviceStateManager(LifecycleComponent):
 
     def summary(self) -> Dict[str, int]:
         with self._lock:
-            s = self._state
+            s = self.current
             has = np.asarray(s.last_event_type != NULL_ID)
             missing = np.asarray(s.presence_missing)
         return {
