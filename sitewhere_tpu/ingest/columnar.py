@@ -87,7 +87,7 @@ def decode_json_lines(
     """
     native = _native_decode(payload)
     if native is not None:
-        return native, []
+        return native
     try:
         return _decode_lines_inner(parse_envelopes(payload))
     except DecodeError:
@@ -99,40 +99,83 @@ def decode_json_lines(
         raise DecodeError(f"bad wire batch: {e}") from e
 
 
-def _native_decode(payload: bytes) -> Optional[Dict[str, object]]:
-    """The C fast path for homogeneous NDJSON measurement payloads.
+def _native_decode(
+    payload: bytes,
+) -> Optional[Tuple[Dict[str, object], List[DecodedRequest]]]:
+    """The C fast path for NDJSON event payloads — measurements,
+    locations and alerts in any mix, with registration lines split out
+    for the (rare) host-plane path.
 
-    Strictness contract (swwire.c): ANY deviation from the common shape
-    returns None and the pure-Python decoder takes over — the native
-    tier only accelerates, it never changes behavior.
+    Strictness contract (swwire.c): ANY deviation from the supported
+    shapes returns None and the pure-Python decoder takes over — the
+    native tier only accelerates, it never changes behavior.  A
+    registration line the native scanner accepted but ``json.loads``
+    rejects dead-letters the whole payload, exactly like the pure path.
     """
+    import json as _json
+
     from sitewhere_tpu.native import load_swwire
 
     mod = load_swwire()
     if mod is None or not isinstance(payload, bytes) \
             or payload[:1] == b"[":
         return None
-    out = mod.decode_measurement_lines(payload)
+    # Homogeneous measurement payloads (the dominant fleet shape) go
+    # through the specialized single-purpose scanner (~2x the generic
+    # one); it bails within the first divergent line, so trying it first
+    # costs mixed payloads almost nothing.
+    meas = mod.decode_measurement_lines(payload)
+    if meas is not None:
+        tokens, names, values_b, ts_b, us_b = meas
+        n = len(tokens)
+        if n == 0:
+            return None  # preserve the Python path's empty-payload error
+        ts_s, ts_ns = _split_epoch(np.frombuffer(ts_b, np.float64))
+        zeros = np.zeros(n, np.float32)
+        return {
+            "device_token": tokens,
+            "event_type": np.zeros(n, np.int32),  # all MEASUREMENT
+            "ts_s": ts_s, "ts_ns": ts_ns,
+            "mtype": names,
+            "value": np.frombuffer(values_b, np.float64).astype(np.float32),
+            "lat": zeros, "lon": zeros, "elevation": zeros,
+            "alert_type": [None] * n,
+            "alert_level": np.zeros(n, np.int32),
+            "update_state": np.frombuffer(us_b, np.uint8).astype(np.bool_),
+        }, []
+    out = mod.decode_event_lines(payload)
     if out is None:
         return None
-    tokens, names, values_b, ts_b, us_b = out
+    (tokens, kinds_b, names, alert_types, values_b, ts_b, lat_b, lon_b,
+     elev_b, lvl_b, us_b, host_lines) = out
     n = len(tokens)
-    if n == 0:
+    if n == 0 and not host_lines:
         return None  # preserve the Python path's empty-payload error
+    host: List[DecodedRequest] = []
+    for line in host_lines:
+        try:
+            doc = _json.loads(line)
+        except ValueError as e:
+            raise DecodeError(f"bad wire batch: {e}") from e
+        host.append(_decode_one(*envelope_fields(doc)))
+    if n == 0:
+        return {"device_token": [], "mtype": [], "alert_type": []}, host
     ts_s, ts_ns = _split_epoch(np.frombuffer(ts_b, np.float64))
-    zeros = np.zeros(n, np.float32)
-    return {
+    columns: Dict[str, object] = {
         "device_token": tokens,
-        "event_type": np.zeros(n, np.int32),  # all MEASUREMENT
+        "event_type": np.frombuffer(kinds_b, np.uint8).astype(np.int32),
         "ts_s": ts_s.astype(np.int32),
         "ts_ns": ts_ns.astype(np.int32),
         "mtype": names,
         "value": np.frombuffer(values_b, np.float64).astype(np.float32),
-        "lat": zeros, "lon": zeros, "elevation": zeros,
-        "alert_type": [None] * n,
-        "alert_level": np.zeros(n, np.int32),
+        "lat": np.frombuffer(lat_b, np.float64).astype(np.float32),
+        "lon": np.frombuffer(lon_b, np.float64).astype(np.float32),
+        "elevation": np.frombuffer(elev_b, np.float64).astype(np.float32),
+        "alert_type": alert_types,
+        "alert_level": np.frombuffer(lvl_b, np.int32).copy(),
         "update_state": np.frombuffer(us_b, np.uint8).astype(np.bool_),
     }
+    return columns, host
 
 
 def _decode_lines_inner(

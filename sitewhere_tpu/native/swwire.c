@@ -794,9 +794,440 @@ static PyObject *split_owner_lines(PyObject *self, PyObject *args) {
     return owners;
 }
 
+/* ---- decode_event_lines: the full wire family ------------------------
+ *
+ * Extends the measurement fast path to the whole EVENT family —
+ * Measurement / Location / Alert lines in any mix — plus Registration
+ * lines, which are SPLIT OUT as raw line bytes for the (rare) Python
+ * host-plane path instead of bailing the whole payload.  Shape per line:
+ *
+ *   {"deviceToken"|"hardwareId":"...","type":"...","request":{...}}
+ *
+ * keys in any order (the request span is recorded and parsed after the
+ * kind is known).  Unknown ENVELOPE and REQUEST keys are skipped with
+ * full json.loads-equivalent validation (the Python decoder ignores
+ * extras, so skipping matches it); known fields must be plain (escape
+ * sequences anywhere load-bearing bail to Python).  Alias precedence
+ * mirrors ingest/columnar.py exactly:
+ *   token:  deviceToken, empty falls through to hardwareId
+ *   meas:   name or measurementId (falsy falls through); value required
+ *   loc:    latitude+longitude required; elevation default 0
+ *   alert:  type PRESENT wins (get-with-default, even empty) else
+ *           alertType else "alert"; level default info, lowercase alias
+ *           strings only (other casings bail); lat/lon applied only as
+ *           a pair
+ *   ts:     eventDate or timestamp or 0 (nonzero eventDate wins)
+ * Kind ints MATCH RequestKind (decoders.py): 0/1/2, registration 10.
+ *
+ * Returns (tokens, kinds u8, names, alert_types, values f64, ts f64,
+ *          lat f64, lon f64, elev f64, levels i32, update u8,
+ *          host_lines list[bytes]) or None (bail → Python path).
+ */
+
+#define K_MEAS 0
+#define K_LOC 1
+#define K_ALERT 2
+#define K_REG 10
+
+static int type_to_kind(const char *t, Py_ssize_t n) {
+    if (key_is(t, n, "Measurement") || key_is(t, n, "Measurements") ||
+        key_is(t, n, "DeviceMeasurements") || key_is(t, n, "measurement") ||
+        key_is(t, n, "measurements") || key_is(t, n, "devicemeasurements"))
+        return K_MEAS;
+    if (key_is(t, n, "Location") || key_is(t, n, "DeviceLocation") ||
+        key_is(t, n, "location") || key_is(t, n, "devicelocation"))
+        return K_LOC;
+    if (key_is(t, n, "Alert") || key_is(t, n, "DeviceAlert") ||
+        key_is(t, n, "alert") || key_is(t, n, "devicealert"))
+        return K_ALERT;
+    if (key_is(t, n, "RegisterDevice") || key_is(t, n, "Registration") ||
+        key_is(t, n, "registerdevice") || key_is(t, n, "registration"))
+        return K_REG;
+    return -1; /* other kinds (stream/command/...) → Python path */
+}
+
+typedef struct {
+    const char *token; Py_ssize_t token_len;
+    int kind;
+    const char *name; Py_ssize_t name_len;   /* NULL = absent */
+    const char *atype; Py_ssize_t atype_len; /* NULL = absent */
+    double value, ts, lat, lon, elev;
+    int32_t level;
+    uint8_t update_state;
+} evrow;
+
+/* Parse one request object span for an event kind.  0 ok, 1 bail. */
+static int parse_request_fields(cursor *c, int kind, evrow *r) {
+    const char *nm1 = NULL, *nm2 = NULL, *ty = NULL, *aty = NULL;
+    Py_ssize_t nm1_len = 0, nm2_len = 0, ty_len = 0, aty_len = 0;
+    int has_ty = 0, has_aty = 0, has_value = 0, has_lat = 0, has_lon = 0;
+    double ed1 = 0.0, ed2 = 0.0, lat = 0.0, lon = 0.0, elev = 0.0;
+    double value = 0.0;
+    r->level = 0; /* AlertLevel.INFO */
+    r->update_state = 1;
+
+    if (expect(c, '{') != 0) return 1;
+    skip_ws(c);
+    if (c->p < c->end && *c->p == '}') { c->p++; goto done; }
+    for (;;) {
+        const char *k; Py_ssize_t klen;
+        skip_ws(c);
+        if (parse_plain_string(c, &k, &klen) != 0) return 1;
+        if (expect(c, ':') != 0) return 1;
+        skip_ws(c);
+        if (key_is(k, klen, "name")) {
+            if (parse_plain_string(c, &nm1, &nm1_len) != 0) return 1;
+        } else if (key_is(k, klen, "measurementId")) {
+            if (parse_plain_string(c, &nm2, &nm2_len) != 0) return 1;
+        } else if (key_is(k, klen, "value")) {
+            if (parse_number(c, &value) != 0) return 1;
+            has_value = 1;
+        } else if (key_is(k, klen, "eventDate")) {
+            if (parse_number(c, &ed1) != 0) return 1;
+        } else if (key_is(k, klen, "timestamp")) {
+            if (parse_number(c, &ed2) != 0) return 1;
+        } else if (key_is(k, klen, "latitude")) {
+            if (parse_number(c, &lat) != 0) return 1;
+            has_lat = 1;
+        } else if (key_is(k, klen, "longitude")) {
+            if (parse_number(c, &lon) != 0) return 1;
+            has_lon = 1;
+        } else if (key_is(k, klen, "elevation")) {
+            if (parse_number(c, &elev) != 0) return 1;
+        } else if (key_is(k, klen, "type")) {
+            if (parse_plain_string(c, &ty, &ty_len) != 0) return 1;
+            has_ty = 1;
+        } else if (key_is(k, klen, "alertType")) {
+            if (parse_plain_string(c, &aty, &aty_len) != 0) return 1;
+            has_aty = 1;
+        } else if (key_is(k, klen, "level")) {
+            if (c->p < c->end && *c->p == '"') {
+                const char *lv; Py_ssize_t lvlen;
+                if (parse_plain_string(c, &lv, &lvlen) != 0) return 1;
+                /* lowercase aliases only — other casings bail so the
+                 * Python .lower() normalization stays authoritative */
+                if (key_is(lv, lvlen, "info")) r->level = 0;
+                else if (key_is(lv, lvlen, "warning")) r->level = 1;
+                else if (key_is(lv, lvlen, "error")) r->level = 2;
+                else if (key_is(lv, lvlen, "critical")) r->level = 3;
+                else return 1;
+            } else {
+                double lv;
+                if (parse_number(c, &lv) != 0) return 1;
+                if (lv < -2147483648.0 || lv > 2147483647.0) return 1;
+                r->level = (int32_t)lv; /* int() truncation, like Python */
+            }
+        } else if (key_is(k, klen, "updateState")) {
+            if (c->end - c->p >= 4 && memcmp(c->p, "true", 4) == 0) {
+                r->update_state = 1; c->p += 4;
+            } else if (c->end - c->p >= 5 && memcmp(c->p, "false", 5) == 0) {
+                r->update_state = 0; c->p += 5;
+            } else return 1;
+        } else {
+            /* unknown request key: Python ignores it — skip with full
+             * validation (escapes inside skipped values are fine) */
+            int src = skip_value(c);
+            if (src != 0) return 1;
+        }
+        skip_ws(c);
+        if (c->p < c->end && *c->p == ',') { c->p++; continue; }
+        if (c->p < c->end && *c->p == '}') { c->p++; break; }
+        return 1;
+    }
+done:
+    /* cursor sits just past the closing '}' — the caller's envelope
+     * loop (or span exactness, for the re-parse case) takes over */
+    r->ts = (ed1 != 0.0) ? ed1 : ed2;
+    r->name = NULL; r->name_len = 0;
+    r->atype = NULL; r->atype_len = 0;
+    r->value = 0.0; r->lat = 0.0; r->lon = 0.0; r->elev = 0.0;
+    if (kind == K_MEAS) {
+        if (nm1 != NULL && nm1_len > 0) { r->name = nm1; r->name_len = nm1_len; }
+        else if (nm2 != NULL) { r->name = nm2; r->name_len = nm2_len; }
+        if (r->name == NULL || r->name_len == 0 || !has_value) return 1;
+        r->value = value;
+    } else if (kind == K_LOC) {
+        if (!has_lat || !has_lon) return 1;
+        r->lat = lat; r->lon = lon; r->elev = elev;
+    } else { /* K_ALERT */
+        /* get-with-default precedence: a PRESENT "type" wins even when
+         * empty (columnar.py: r.get("type", r.get("alertType", "alert"))) */
+        if (has_ty) { r->atype = ty; r->atype_len = ty_len; }
+        else if (has_aty) { r->atype = aty; r->atype_len = aty_len; }
+        else { r->atype = "alert"; r->atype_len = 5; }
+        if (has_lat && has_lon) { r->lat = lat; r->lon = lon; }
+    }
+    return 0;
+}
+
+/* One line: 0 event row, 2 registration (host line), 1 bail. */
+static int parse_event_line(cursor *c, evrow *r) {
+    const char *tok1 = NULL, *tok2 = NULL, *req = NULL;
+    Py_ssize_t tok1_len = 0, tok2_len = 0, req_len = 0;
+    int has_tok1 = 0, kind = -2, parsed_req = 0, parsed_kind = -2;
+
+    if (expect(c, '{') != 0) return 1;
+    skip_ws(c);
+    if (c->p < c->end && *c->p == '}') return 1; /* empty envelope */
+    for (;;) {
+        const char *k; Py_ssize_t klen;
+        skip_ws(c);
+        if (parse_plain_string(c, &k, &klen) != 0) return 1;
+        if (expect(c, ':') != 0) return 1;
+        skip_ws(c);
+        if (key_is(k, klen, "deviceToken")) {
+            if (parse_plain_string(c, &tok1, &tok1_len) != 0) return 1;
+            has_tok1 = 1;
+        } else if (key_is(k, klen, "hardwareId")) {
+            if (parse_plain_string(c, &tok2, &tok2_len) != 0) return 1;
+        } else if (key_is(k, klen, "type")) {
+            const char *t; Py_ssize_t tlen;
+            if (parse_plain_string(c, &t, &tlen) != 0) return 1;
+            kind = type_to_kind(t, tlen);
+            if (kind < 0) return 1;
+        } else if (key_is(k, klen, "request")) {
+            /* a duplicate "request" key (last-wins under json.loads)
+             * would need a merge-free re-parse — bail, it's pathological */
+            if (req != NULL || parsed_req) return 1;
+            if (c->p >= c->end || *c->p != '{') return 1;
+            if (kind >= 0 && kind != K_REG) {
+                /* kind already known (the common key order): single-pass
+                 * parse, no span + re-scan */
+                if (parse_request_fields(c, kind, r) != 0) return 1;
+                parsed_req = 1;
+                parsed_kind = kind;
+            } else {
+                req = c->p;
+                int src = skip_value(c);
+                if (src != 0) return 1;
+                req_len = c->p - req;
+            }
+        } else {
+            int src = skip_value(c); /* extras: Python ignores them */
+            if (src != 0) return 1;
+        }
+        skip_ws(c);
+        if (c->p < c->end && *c->p == ',') { c->p++; continue; }
+        if (c->p < c->end && *c->p == '}') { c->p++; break; }
+        return 1;
+    }
+    skip_ws(c);
+    if (c->p < c->end) return 1;
+    if (kind == -2 || (req == NULL && !parsed_req)) return 1;
+    /* envelope_fields: doc.get("deviceToken", doc.get("hardwareId")) —
+     * a PRESENT deviceToken wins even when empty (empty → error; bail),
+     * it does NOT fall through to hardwareId. */
+    if (has_tok1) { r->token = tok1; r->token_len = tok1_len; }
+    else { r->token = tok2; r->token_len = tok2_len; }
+    if (r->token == NULL || r->token_len == 0) return 1;
+    r->kind = kind;
+    if (kind == K_REG) {
+        /* request parsed by the Python path; if it was single-pass
+         * parsed the kind was known then, so this is the span case */
+        return parsed_req ? 1 : 2;
+    }
+    if (parsed_req) {
+        /* a duplicate "type" key after the request could have CHANGED
+         * the kind (json.loads last-wins) — the parse must match it */
+        return parsed_kind == kind ? 0 : 1;
+    }
+    cursor rc = { req, req + req_len };
+    if (parse_request_fields(&rc, kind, r) != 0) return 1;
+    skip_ws(&rc);
+    return rc.p < rc.end ? 1 : 0; /* span must be exactly the object */
+}
+
+typedef struct {
+    int32_t *data;
+    Py_ssize_t len, cap;
+} ibuf32;
+
+static int ibuf32_push(ibuf32 *b, int32_t v) {
+    if (b->len == b->cap) {
+        Py_ssize_t ncap = b->cap ? b->cap * 2 : 1024;
+        int32_t *nd = (int32_t *)realloc(b->data, (size_t)ncap * sizeof(int32_t));
+        if (!nd) return -1;
+        b->data = nd;
+        b->cap = ncap;
+    }
+    b->data[b->len++] = v;
+    return 0;
+}
+
+typedef struct {
+    sbuf toks, nms, atys, hosts;
+    bbuf kinds, us;
+    dbuf values, tss, lats, lons, elevs;
+    ibuf32 lvls;
+} evcols;
+
+static void evcols_free(evcols *e) {
+    free(e->toks.data); free(e->nms.data); free(e->atys.data);
+    free(e->hosts.data); free(e->kinds.data); free(e->us.data);
+    free(e->values.data); free(e->tss.data); free(e->lats.data);
+    free(e->lons.data); free(e->elevs.data); free(e->lvls.data);
+}
+
+/* GIL-free scan: 0 ok, 1 bail, -1 oom. */
+static int scan_event_lines(const char *buf, Py_ssize_t n, evcols *e) {
+    const char *p = buf, *end = buf + n;
+    while (p < end) {
+        const char *nl = memchr(p, '\n', (size_t)(end - p));
+        const char *line_end = nl ? nl : end;
+        const char *q = p;
+        while (q < line_end &&
+               (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+        if (q == line_end) { p = nl ? nl + 1 : end; continue; }
+
+        cursor c = { q, line_end };
+        evrow r;
+        int rc = parse_event_line(&c, &r);
+        if (rc == 1) return 1;
+        if (rc == 2) { /* registration → raw line for the Python path */
+            if (sbuf_push(&e->hosts, q, line_end - q) != 0) return -1;
+            p = nl ? nl + 1 : end;
+            continue;
+        }
+        if (!utf8_ok(r.token, r.token_len)) return 1;
+        if (r.name && !utf8_ok(r.name, r.name_len)) return 1;
+        if (r.atype && !utf8_ok(r.atype, r.atype_len)) return 1;
+        if (sbuf_push(&e->toks, r.token, r.token_len) != 0 ||
+            sbuf_push(&e->nms, r.name, r.name ? r.name_len : -1) != 0 ||
+            sbuf_push(&e->atys, r.atype, r.atype ? r.atype_len : -1) != 0 ||
+            bbuf_push(&e->kinds, (uint8_t)r.kind) != 0 ||
+            bbuf_push(&e->us, r.update_state) != 0 ||
+            dbuf_push(&e->values, r.value) != 0 ||
+            dbuf_push(&e->tss, r.ts) != 0 ||
+            dbuf_push(&e->lats, r.lat) != 0 ||
+            dbuf_push(&e->lons, r.lon) != 0 ||
+            dbuf_push(&e->elevs, r.elev) != 0 ||
+            ibuf32_push(&e->lvls, r.level) != 0)
+            return -1;
+        p = nl ? nl + 1 : end;
+    }
+    return 0;
+}
+
+/* Materialize a list of str-or-None from slices with a small memo
+ * (payloads carry a handful of distinct names/alert types). */
+static PyObject *slices_to_list(sbuf *b) {
+    slice memo_sl[NAME_MEMO];
+    PyObject *memo_obj[NAME_MEMO];
+    int memo_n = 0;
+    PyObject *list = PyList_New(b->len);
+    if (!list) return NULL;
+    for (Py_ssize_t i = 0; i < b->len; i++) {
+        slice s = b->data[i];
+        if (s.len < 0) {
+            Py_INCREF(Py_None);
+            PyList_SET_ITEM(list, i, Py_None);
+            continue;
+        }
+        PyObject *o = NULL;
+        for (int m = 0; m < memo_n; m++) {
+            if (memo_sl[m].len == s.len &&
+                memcmp(memo_sl[m].p, s.p, (size_t)s.len) == 0) {
+                o = memo_obj[m];
+                Py_INCREF(o);
+                break;
+            }
+        }
+        if (!o) {
+            o = PyUnicode_DecodeUTF8(s.p, s.len, NULL);
+            if (!o) { Py_DECREF(list); return NULL; }
+            if (memo_n < NAME_MEMO) {
+                memo_sl[memo_n] = s;
+                memo_obj[memo_n] = o; /* borrowed from the list slot */
+                memo_n++;
+            }
+        }
+        PyList_SET_ITEM(list, i, o);
+    }
+    return list;
+}
+
+static PyObject *decode_event_lines(PyObject *self, PyObject *arg) {
+    if (!PyBytes_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "payload must be bytes");
+        return NULL;
+    }
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) return NULL;
+    const char *buf = (const char *)view.buf;
+    Py_ssize_t n = view.len;
+
+    evcols e;
+    memset(&e, 0, sizeof e);
+    int rc;
+    Py_BEGIN_ALLOW_THREADS
+    rc = scan_event_lines(buf, n, &e);
+    Py_END_ALLOW_THREADS
+    if (rc == 1) {
+        evcols_free(&e);
+        PyBuffer_Release(&view);
+        Py_RETURN_NONE;
+    }
+    if (rc == -1) {
+        evcols_free(&e);
+        PyBuffer_Release(&view);
+        return PyErr_NoMemory();
+    }
+
+    PyObject *tokens = NULL, *names = NULL, *atys = NULL, *hosts = NULL;
+    PyObject *out = NULL;
+    tokens = slices_to_list(&e.toks);
+    names = slices_to_list(&e.nms);
+    atys = slices_to_list(&e.atys);
+    if (!tokens || !names || !atys) goto fail;
+    hosts = PyList_New(e.hosts.len);
+    if (!hosts) goto fail;
+    for (Py_ssize_t i = 0; i < e.hosts.len; i++) {
+        PyObject *b = PyBytes_FromStringAndSize(e.hosts.data[i].p,
+                                                e.hosts.data[i].len);
+        if (!b) goto fail;
+        PyList_SET_ITEM(hosts, i, b);
+    }
+    {
+        PyObject *kinds = PyBytes_FromStringAndSize(
+            (const char *)e.kinds.data, e.kinds.len);
+        PyObject *v = PyBytes_FromStringAndSize(
+            (const char *)e.values.data,
+            e.values.len * (Py_ssize_t)sizeof(double));
+        PyObject *t = PyBytes_FromStringAndSize(
+            (const char *)e.tss.data, e.tss.len * (Py_ssize_t)sizeof(double));
+        PyObject *la = PyBytes_FromStringAndSize(
+            (const char *)e.lats.data, e.lats.len * (Py_ssize_t)sizeof(double));
+        PyObject *lo = PyBytes_FromStringAndSize(
+            (const char *)e.lons.data, e.lons.len * (Py_ssize_t)sizeof(double));
+        PyObject *el = PyBytes_FromStringAndSize(
+            (const char *)e.elevs.data,
+            e.elevs.len * (Py_ssize_t)sizeof(double));
+        PyObject *lv = PyBytes_FromStringAndSize(
+            (const char *)e.lvls.data,
+            e.lvls.len * (Py_ssize_t)sizeof(int32_t));
+        PyObject *u = PyBytes_FromStringAndSize(
+            (const char *)e.us.data, e.us.len);
+        if (kinds && v && t && la && lo && el && lv && u)
+            out = PyTuple_Pack(12, tokens, kinds, names, atys, v, t,
+                               la, lo, el, lv, u, hosts);
+        Py_XDECREF(kinds); Py_XDECREF(v); Py_XDECREF(t); Py_XDECREF(la);
+        Py_XDECREF(lo); Py_XDECREF(el); Py_XDECREF(lv); Py_XDECREF(u);
+    }
+fail:
+    Py_XDECREF(tokens); Py_XDECREF(names); Py_XDECREF(atys);
+    Py_XDECREF(hosts);
+    evcols_free(&e);
+    PyBuffer_Release(&view);
+    return out; /* NULL propagates the error */
+}
+
 static PyMethodDef methods[] = {
     {"decode_measurement_lines", decode_measurement_lines, METH_O,
      "Scan NDJSON measurement envelopes into column buffers; None = "
+     "shape mismatch, caller must fall back to the Python decoder."},
+    {"decode_event_lines", decode_event_lines, METH_O,
+     "Scan NDJSON measurement/location/alert envelopes into column "
+     "buffers, splitting registration lines out as raw bytes; None = "
      "shape mismatch, caller must fall back to the Python decoder."},
     {"split_owner_lines", split_owner_lines, METH_VARARGS,
      "Rendezvous-hash owner per non-blank NDJSON line; -1 = "
