@@ -17,6 +17,13 @@ from sitewhere_tpu.rpc.channel import (
     RpcDemux,
     RpcError,
 )
+from sitewhere_tpu.rpc.domains import (
+    DOMAIN_SURFACE,
+    RemoteDomain,
+    attach_remote_domains,
+    bind_domains,
+    remote_domains,
+)
 from sitewhere_tpu.rpc.forward import HostForwarder, owning_process, split_lines
 from sitewhere_tpu.rpc.server import CallContext, RpcServer
 from sitewhere_tpu.rpc.services import RemoteDeviceManagement, bind_instance

@@ -135,6 +135,11 @@ def bind_instance(server: RpcServer, inst) -> None:
                                        "ts": time.time()},
         auth_required=False)
 
+    # ---- the remaining management domains (per-domain ApiDemux analog) -----
+    from sitewhere_tpu.rpc.domains import bind_domains
+
+    bind_domains(server, inst)
+
 
 def _active_assignment(dm, device_token: str):
     assignment = dm.get_active_assignment(device_token)

@@ -154,9 +154,13 @@ class Checkpointer(LifecycleComponent):
                 with lock if lock is not None else contextlib.nullcontext():
                     return {k: _copy_val(getattr(obj, k)) for k in keys}
 
+            # A gateway instance serves some domains through RemoteDomain
+            # facades (rpc/domains.py) — the OWNER checkpoints those
+            # stores; snapshotting a facade would capture nothing.
             stores: Dict[str, Dict[str, object]] = {
                 attr: snap_store(getattr(inst, attr), keys)
                 for attr, keys in _STORE_ATTRS.items()
+                if not getattr(getattr(inst, attr), "_remote_facade_", False)
             }
             # non-default tenant engines' service façades (the default
             # tenant's ARE the instance-level stores above)
@@ -192,17 +196,20 @@ class Checkpointer(LifecycleComponent):
                 lambda f: np.savez(f, **mirror_arrays),
             )
 
-            # 3. device-state tensors (one device→host copy per field)
-            state = inst.device_state.current
-            state_arrays = {
-                fld.name: np.asarray(getattr(state, fld.name))
-                for fld in dataclass_fields(state)
-            }
-            names["state"] = f"state-{gen:08d}.npz"
-            _atomic_write(
-                os.path.join(self.dir, names["state"]),
-                lambda f: np.savez(f, **state_arrays),
-            )
+            # 3. device-state tensors (one device→host copy per field);
+            # a remoted device_state belongs to the owning host's
+            # checkpoints, like any other facade-backed domain
+            if not getattr(inst.device_state, "_remote_facade_", False):
+                state = inst.device_state.current
+                state_arrays = {
+                    fld.name: np.asarray(getattr(state, fld.name))
+                    for fld in dataclass_fields(state)
+                }
+                names["state"] = f"state-{gen:08d}.npz"
+                _atomic_write(
+                    os.path.join(self.dir, names["state"]),
+                    lambda f: np.savez(f, **state_arrays),
+                )
 
             # 4. identity map LAST (see module docstring: a token minted
             # mid-save must never be dangling in the restored identity)
@@ -288,7 +295,10 @@ class Checkpointer(LifecycleComponent):
         # (re)creates each engine (Instance._make_tenant_engine)
         inst._engine_snapshots = stores.pop("__engines__", {})
         for attr, values in stores.items():
-            merge_store(getattr(inst, attr), values)
+            obj = getattr(inst, attr)
+            if getattr(obj, "_remote_facade_", False):
+                continue  # domain remoted since the snapshot — owner's data
+            merge_store(obj, values)
         # restored rules must rebuild their device table
         if hasattr(inst.rules, "_dirty"):
             inst.rules._dirty = True
@@ -306,6 +316,11 @@ class Checkpointer(LifecycleComponent):
         # taken (e.g. ewma_values) AND of shape changes (e.g. a different
         # EWMA scale count): mismatched fields keep their empty init
         # rather than crashing every subsequent pipeline step
+        if "state" not in names or getattr(
+                inst.device_state, "_remote_facade_", False):
+            logger.info("restored checkpoint generation %s (no local "
+                        "device-state section)", manifest.get("generation"))
+            return True
         with np.load(os.path.join(self.dir, names["state"])) as z:
             current = inst.device_state.current
             known = {

@@ -232,6 +232,20 @@ class DeviceStateManager(LifecycleComponent):
             mask = np.asarray(self.current.presence_missing)
         return [int(i) for i in np.nonzero(mask)[0]]
 
+    def missing_device_tokens(self) -> List[str]:
+        """Missing devices as TOKENS — the cross-host-safe form (dense
+        ids are meaningful only inside their minting host's identity
+        map, so the remote facade surfaces this, never the id form)."""
+        return [t for t in (self.identity.device.token_of(i)
+                            for i in self.missing_device_ids())
+                if t is not None]
+
+    def seen_since_tokens(self, since_s: int) -> List[str]:
+        """Token form of :meth:`seen_since` (see missing_device_tokens)."""
+        return [t for t in (self.identity.device.token_of(i)
+                            for i in self.seen_since(since_s))
+                if t is not None]
+
     def seen_since(self, since_s: int) -> List[int]:
         """Devices with any event at/after ``since_s``."""
         with self._lock:

@@ -27,7 +27,13 @@ from sitewhere_tpu.services.common import (
     ValidationError,
     require,
 )
-from sitewhere_tpu.web.http import RawResponse, Request, RestGateway, page_response
+from sitewhere_tpu.web.http import (
+    RawResponse,
+    Request,
+    RestGateway,
+    jsonable,
+    page_response,
+)
 
 def _enum_arg(enum_cls, raw, field: str):
     """Name ('GT'/'window_mean') or value (0) → enum member, 400 on junk
@@ -89,13 +95,21 @@ def register_routes(gw: RestGateway, inst) -> None:
     r("POST", "/api/jwt", issue_jwt, auth_required=False)
 
     # ---- users ------------------------------------------------------------
-    r("GET", "/api/users", lambda q: page_response(inst.users.list_users(q.criteria())))
-    r("POST", "/api/users", lambda q: inst.users.create_user(**q.json()))
-    r("GET", "/api/users/{name}", lambda q: inst.users.get_user(q.params["name"]))
+    # scrub: credential hashes must never reach a REST response
+    # (rpc/domains.py applies the same rule at the fabric boundary)
+    from sitewhere_tpu.rpc.domains import scrub
+
+    r("GET", "/api/users", lambda q: scrub(page_response(
+        inst.users.list_users(q.criteria()))))
+    r("POST", "/api/users",
+      lambda q: scrub(jsonable(inst.users.create_user(**q.json()))))
+    r("GET", "/api/users/{name}",
+      lambda q: scrub(jsonable(inst.users.get_user(q.params["name"]))))
     r("PUT", "/api/users/{name}",
-      lambda q: inst.users.update_user(q.params["name"], **q.json()))
+      lambda q: scrub(jsonable(
+          inst.users.update_user(q.params["name"], **q.json()))))
     r("DELETE", "/api/users/{name}",
-      lambda q: inst.users.delete_user(q.params["name"]))
+      lambda q: scrub(jsonable(inst.users.delete_user(q.params["name"]))))
     r("GET", "/api/authorities",
       lambda q: page_response(inst.users.list_granted_authorities(q.criteria())))
 
@@ -141,6 +155,9 @@ def register_routes(gw: RestGateway, inst) -> None:
       lambda q: {**inst.scripts.describe(q.params["name"]),
                  "source": inst.scripts.get_source(q.params["name"])})
 
+    def _actor(q) -> str:
+        return str((q.claims or {}).get("sub", "anonymous"))
+
     def upload_script(q):
         body = q.json()
         require("source" in body,
@@ -148,7 +165,8 @@ def register_routes(gw: RestGateway, inst) -> None:
         return inst.scripts.upload(
             q.params["name"], str(body.get("kind", "decoder")),
             str(body["source"]),
-            activate=bool(body.get("activate", True)))
+            activate=bool(body.get("activate", True)),
+            actor=_actor(q))
     # script upload is arbitrary code execution — admin only
     r("PUT", "/api/scripts/{name}", upload_script, authority="ROLE_ADMIN")
 
@@ -158,9 +176,19 @@ def register_routes(gw: RestGateway, inst) -> None:
             version = int(body["version"])
         except (KeyError, TypeError, ValueError):
             raise ValidationError("body must carry an integer 'version'")
-        return inst.scripts.activate(q.params["name"], version)
+        return inst.scripts.activate(q.params["name"], version,
+                                     actor=_actor(q))
     r("POST", "/api/scripts/{name}/activate", activate_script,
       authority="ROLE_ADMIN")
+
+    def script_audit(q):
+        try:
+            limit = int(q.q1("limit", "100"))
+        except ValueError:
+            limit = 100
+        return {"entries": inst.scripts.audit_log(limit)}
+    # who uploaded/activated what, when — admin-visible audit trail
+    r("GET", "/api/scripts-audit", script_audit, authority="ROLE_ADMIN")
 
     # ---- device types + commands + statuses -------------------------------
     r("GET", "/api/devicetypes",
@@ -533,11 +561,10 @@ def register_routes(gw: RestGateway, inst) -> None:
     # ---- device state (reference service-device-state RPCs) ---------------
     r("GET", "/api/devicestates/{token}",
       lambda q: inst.device_state.get_device_state(q.params["token"]))
+    # token form: correct on a gateway whose device_state is a remote
+    # facade (dense ids never leave their minting host)
     r("GET", "/api/devicestates",
-      lambda q: {"missing": [
-          inst.identity.device.token_of(i)
-          for i in inst.device_state.missing_device_ids()
-      ]})
+      lambda q: {"missing": inst.device_state.missing_device_tokens()})
 
     # ---- streams (service-streaming-media REST analog) --------------------
     def list_streams(q: Request):

@@ -1,0 +1,207 @@
+"""Per-domain remote facades: a gateway serves domains it does not own.
+
+Reference: web-rest consumes every management domain through per-domain
+ApiDemux channels (``ApiDemux.java:42-110`` + the ten per-domain client
+packages), so the REST gateway runs on hosts that own none of the
+stores.  Here instance B owns the stores and binds the domain surface on
+its RpcServer; instance A swaps its service attributes for
+``RemoteDomain`` facades and its REST gateway serves the full surface
+against B.
+"""
+
+import base64
+import http.client
+import json
+
+import pytest
+
+from sitewhere_tpu.instance import Instance
+from sitewhere_tpu.rpc import RpcDemux, RpcServer, bind_instance
+from sitewhere_tpu.rpc.domains import attach_remote_domains, remote_domains
+from sitewhere_tpu.services.common import EntityNotFound, SearchCriteria
+from sitewhere_tpu.web import WebServer
+from tests.test_instance import make_config
+
+
+@pytest.fixture()
+def owner_and_gateway(tmp_path):
+    """B owns the stores (+ RPC server); A is the remoted gateway."""
+    owner = Instance(make_config(tmp_path / "owner"))
+    owner.start()
+    srv = RpcServer(port=0, tokens=owner.tokens, tracer=owner.tracer)
+    bind_instance(srv, owner)
+    srv.start()
+    admin = owner.users.authenticate("admin", "password")
+    jwt = owner.tokens.mint(admin.username, admin.authorities)
+    demux = RpcDemux([srv.endpoint], token_provider=lambda: jwt)
+
+    gateway = Instance(make_config(tmp_path / "gw"))
+    gateway.start()
+    attach_remote_domains(gateway, demux)
+    yield owner, gateway, demux
+    demux.close()
+    srv.stop()
+    for inst in (gateway, owner):
+        inst.stop()
+        inst.terminate()
+
+
+class TestRemoteFacades:
+    def test_assets_remote_crud(self, owner_and_gateway):
+        owner, gw, _ = owner_and_gateway
+        at = gw.assets.create_asset_type(token="pump", name="Pump")
+        assert at.token == "pump"
+        a = gw.assets.create_asset(token="p-1", name="Pump 1",
+                                   asset_type="pump")
+        assert a.name == "Pump 1"
+        # the entity lives on the OWNER, not the gateway
+        assert owner.assets.get_asset("p-1").name == "Pump 1"
+        page = gw.assets.list_assets(SearchCriteria(page_size=10))
+        assert page.total == 1 and page.results[0].token == "p-1"
+        with pytest.raises(EntityNotFound):
+            gw.assets.get_asset("nope")
+
+    def test_schedules_and_batch_remote(self, owner_and_gateway):
+        owner, gw, _ = owner_and_gateway
+        s = gw.schedules.create_schedule(
+            token="hourly", name="Hourly", trigger_type="Cron",
+            cron="0 * * * *")
+        assert s.token == "hourly"
+        assert owner.schedules.get_schedule("hourly").name == "Hourly"
+        assert gw.schedules.list_schedules(None).total == 1
+
+        owner.device_management.create_device_type(token="sensor", name="S")
+        owner.device_management.create_device_command(
+            "sensor", token="ping", name="ping")
+        for i in range(2):
+            owner.device_management.create_device(
+                token=f"d-{i}", device_type="sensor")
+            owner.device_management.create_device_assignment(device=f"d-{i}")
+        op = gw.batch_ops.create_batch_command_invocation(
+            command_token="ping", devices=["d-0", "d-1"],
+            parameter_values={})
+        assert owner.batch_ops.get_operation(op.token) is not None
+        page = gw.batch_ops.list_elements(op.token)
+        assert page.total == 2
+
+    def test_users_tenants_remote(self, owner_and_gateway):
+        owner, gw, _ = owner_and_gateway
+        gw.users.create_granted_authority("ROLE_X")
+        u = gw.users.create_user(username="eve", password="pw2",
+                                 authorities=["ROLE_X"])
+        assert u.username == "eve"
+        # credential material never crosses the fabric
+        assert "hashed_password" not in u
+        got = gw.users.authenticate("eve", "pw2")
+        assert got.username == "eve" and got.authorities == ["ROLE_X"]
+        assert owner.users.get_user("eve").username == "eve"
+
+        t = gw.tenants.create_tenant(token="acme", name="Acme")
+        assert t.token == "acme"
+        assert owner.tenants.get_tenant("acme").name == "Acme"
+        assert gw.tenants.list_tenants(None).total >= 1
+
+    def test_device_state_remote(self, owner_and_gateway):
+        owner, gw, _ = owner_and_gateway
+        owner.device_management.create_device_type(token="sensor", name="S")
+        owner.device_management.create_device(token="dev-1",
+                                              device_type="sensor")
+        owner.device_management.create_device_assignment(device="dev-1")
+        from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
+
+        owner.dispatcher.ingest(DecodedRequest(
+            kind=RequestKind.MEASUREMENT, device_token="dev-1",
+            ts_s=1000, mtype="temp", value=5.0))
+        owner.dispatcher.flush()
+        state = gw.device_state.get_device_state("dev-1")
+        assert state["last_event_ts_s"] == 1000
+        assert gw.device_state.summary()["devices_with_state"] == 1
+
+    def test_facade_rejects_unremoted_methods(self, owner_and_gateway):
+        _, gw, demux = owner_and_gateway
+        facades = remote_domains(demux)
+        with pytest.raises(AttributeError):
+            facades["users"].hash_password("x")
+
+
+class TestGatewayRest:
+    """The full REST surface on A against stores owned by B."""
+
+    def _client(self, port, token):
+        def request(method, path, body=None):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            hdrs = {"Authorization": f"Bearer {token}"} if token else {}
+            payload = json.dumps(body).encode() if body is not None else None
+            conn.request(method, path, body=payload, headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            return resp.status, (json.loads(data) if data else None)
+        return request
+
+    def test_rest_serves_remote_domains(self, owner_and_gateway):
+        owner, gw, _ = owner_and_gateway
+        web = WebServer(gw, port=0)
+        web.start()
+        try:
+            # login on the GATEWAY authenticates against the OWNER's
+            # user store (remote authenticate + local JWT mint)
+            basic = base64.b64encode(b"admin:password").decode()
+            conn = http.client.HTTPConnection("127.0.0.1", web.port,
+                                              timeout=10)
+            conn.request("POST", "/api/jwt",
+                         headers={"Authorization": f"Basic {basic}"})
+            resp = conn.getresponse()
+            tok = json.loads(resp.read())["token"]
+            conn.close()
+            req = self._client(web.port, tok)
+
+            st, body = req("POST", "/api/assettypes",
+                           {"token": "pump", "name": "Pump"})
+            assert st == 200, body
+            st, body = req("POST", "/api/assets",
+                           {"token": "p-1", "name": "P1",
+                            "asset_type": "pump"})
+            assert st == 200, body
+            st, body = req("GET", "/api/assets")
+            assert st == 200 and body["numResults"] == 1
+            assert owner.assets.get_asset("p-1").name == "P1"
+
+            st, body = req("POST", "/api/schedules",
+                           {"token": "s1", "name": "S1",
+                            "trigger_type": "Cron",
+                            "cron": "0 * * * *"})
+            assert st == 200, body
+            st, body = req("GET", "/api/schedules")
+            assert st == 200 and body["numResults"] == 1
+
+            st, body = req("POST", "/api/tenants",
+                           {"token": "acme", "name": "Acme"})
+            assert st == 200, body
+            assert owner.tenants.get_tenant("acme").name == "Acme"
+
+            st, body = req("GET", "/api/users/admin")
+            assert st == 200 and body["username"] == "admin"
+            assert "hashed_password" not in body
+            st, body = req("GET", "/api/users/ghost")
+            assert st == 404
+        finally:
+            web.stop()
+
+    def test_gateway_jwt_minted_against_remote_users(self, owner_and_gateway):
+        """The gateway's JWT issue path authenticates remotely; a wrong
+        password is rejected by the owner."""
+        owner, gw, _ = owner_and_gateway
+        web = WebServer(gw, port=0)
+        web.start()
+        try:
+            basic = base64.b64encode(b"admin:wrong").decode()
+            conn = http.client.HTTPConnection("127.0.0.1", web.port,
+                                              timeout=10)
+            conn.request("POST", "/api/jwt",
+                         headers={"Authorization": f"Basic {basic}"})
+            resp = conn.getresponse()
+            assert resp.status in (401, 403)
+            conn.close()
+        finally:
+            web.stop()
