@@ -82,7 +82,57 @@ class ScriptManager:
         os.makedirs(self.dir, exist_ok=True)
         self._lock = threading.RLock()
         self._scripts: Dict[str, ScriptRecord] = {}
+        # Script upload/activation is arbitrary code execution — every
+        # such act is audit-logged (who/when/what version), in memory for
+        # the REST surface and appended durably to audit.jsonl.
+        # Reference: ScriptSynchronizer's versioned-script semantics; the
+        # audit trail is the part the reference lacked.
+        self._audit: List[dict] = []
+        self._audit_path = os.path.join(self.dir, "audit.jsonl")
+        self._load_audit()
         self._load_existing()
+
+    def _load_audit(self, keep: int = 1000) -> None:
+        try:
+            with open(self._audit_path) as f:
+                lines = f.readlines()
+        except OSError:
+            return
+        tail = lines[-keep:]
+        for line in tail:
+            try:
+                self._audit.append(json.loads(line))
+            except ValueError:
+                continue
+        if len(lines) > keep:
+            # compact: the retained-entry cap bounds the FILE too, so
+            # startup cost never scales with total historical volume
+            tmp = f"{self._audit_path}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "w") as f:
+                    f.writelines(tail)
+                os.replace(tmp, self._audit_path)
+            except OSError:
+                logger.warning("script audit compaction failed",
+                               exc_info=True)
+
+    def _audit_append(self, action: str, name: str, version: int,
+                      actor: str) -> None:
+        entry = {"ts_s": round(time.time(), 3), "actor": actor,
+                 "action": action, "script": name, "version": version}
+        self._audit.append(entry)
+        del self._audit[:-1000]
+        try:
+            with open(self._audit_path, "a") as f:
+                f.write(json.dumps(entry) + "\n")
+        except OSError:
+            logger.warning("script audit append failed", exc_info=True)
+
+    def audit_log(self, limit: int = 100) -> List[dict]:
+        if limit <= 0:
+            return []
+        with self._lock:
+            return list(self._audit[-limit:])
 
     # -- persistence ---------------------------------------------------------
 
@@ -171,7 +221,7 @@ class ScriptManager:
     # -- CRUD ----------------------------------------------------------------
 
     def upload(self, name: str, kind: str, source: str,
-               activate: bool = True) -> dict:
+               activate: bool = True, actor: str = "system") -> dict:
         """Store a new version (validated by compiling); optionally make
         it active immediately — the ScriptSynchronizer 'replace' semantic."""
         require(kind in KINDS, ValidationError(f"kind must be one of {KINDS}"))
@@ -189,18 +239,22 @@ class ScriptManager:
             sv = ScriptVersion(version=version, source=source,
                                created_s=time.time(), entry=entry)
             record.versions[version] = sv
+            self._audit_append("upload", name, version, actor)
             if activate or record.active_version is None:
                 record.active_version = version
+                self._audit_append("activate", name, version, actor)
             self._persist(record, sv)
             return self.describe(name)
 
-    def activate(self, name: str, version: int) -> dict:
+    def activate(self, name: str, version: int,
+                 actor: str = "system") -> dict:
         """Switch the active version (rollback/roll-forward)."""
         with self._lock:
             record = self._get(name)
             require(version in record.versions,
                     EntityNotFound(f"{name} has no version {version}"))
             record.active_version = version
+            self._audit_append("activate", name, version, actor)
             self._persist(record, record.versions[version])
             return self.describe(name)
 

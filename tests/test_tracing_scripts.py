@@ -311,3 +311,96 @@ def test_script_upload_requires_admin_authority(inst):
         assert all(s["name"] != "evil" for s in inst.scripts.list_scripts())
     finally:
         web.stop()
+
+
+def test_script_activate_requires_admin_and_audit_is_logged(inst):
+    """The whole script trust boundary: non-admin JWTs can neither
+    upload nor activate nor read the audit; every admin upload/activate
+    is audit-logged (who/when/version) and visible over REST."""
+    import http.client
+
+    from sitewhere_tpu.web import WebServer
+
+    inst.users.create_user(username="viewer2", password="viewerpw2",
+                           first_name="V", last_name="W", authorities=[])
+    web = WebServer(inst, port=0)
+    web.start()
+    try:
+        def login(user, pw):
+            c = http.client.HTTPConnection("127.0.0.1", web.port, timeout=5)
+            c.request("POST", "/api/jwt", json.dumps(
+                {"username": user, "password": pw}),
+                {"Content-Type": "application/json"})
+            tok = json.loads(c.getresponse().read())["token"]
+            c.close()
+            return tok
+
+        def call(tok, method, path, body=None):
+            c = http.client.HTTPConnection("127.0.0.1", web.port, timeout=5)
+            hdr = {"Authorization": f"Bearer {tok}",
+                   "Content-Type": "application/json"}
+            c.request(method, path,
+                      json.dumps(body) if body is not None else None, hdr)
+            r = c.getresponse()
+            data = r.read()
+            c.close()
+            return r.status, (json.loads(data) if data else None)
+
+        admin = login("admin", "password")
+        viewer = login("viewer2", "viewerpw2")
+
+        # admin seeds a script with two versions
+        st, _ = call(admin, "PUT", "/api/scripts/csv",
+                     {"kind": "decoder", "source": CSV_DECODER_V1})
+        assert st == 200
+        st, _ = call(admin, "PUT", "/api/scripts/csv",
+                     {"kind": "decoder", "source": CSV_DECODER_V1,
+                      "activate": False})
+        assert st == 200
+
+        # non-admin cannot ACTIVATE an existing version
+        st, _ = call(viewer, "POST", "/api/scripts/csv/activate",
+                     {"version": 2})
+        assert st == 403
+        assert inst.scripts.describe("csv")["active"] == 1
+
+        # non-admin cannot read the audit either
+        st, _ = call(viewer, "GET", "/api/scripts-audit")
+        assert st == 403
+
+        # admin activates; the audit shows who did what, when
+        st, _ = call(admin, "POST", "/api/scripts/csv/activate",
+                     {"version": 2})
+        assert st == 200
+        st, body = call(admin, "GET", "/api/scripts-audit")
+        assert st == 200
+        entries = body["entries"]
+        acts = [e for e in entries if e["action"] == "activate"
+                and e["script"] == "csv"]
+        ups = [e for e in entries if e["action"] == "upload"
+               and e["script"] == "csv"]
+        assert len(ups) == 2 and {e["version"] for e in ups} == {1, 2}
+        assert acts[-1]["version"] == 2
+        assert acts[-1]["actor"] == "admin"
+        assert acts[-1]["ts_s"] > 0
+    finally:
+        web.stop()
+
+
+def test_script_audit_survives_restart(tmp_path):
+    """audit.jsonl is durable: a restarted instance still shows history."""
+    inst = Instance(_cfg(tmp_path))
+    inst.start()
+    inst.scripts.upload("csv", "decoder", CSV_DECODER_V1, actor="alice")
+    inst.stop()
+    inst.terminate()
+
+    inst2 = Instance(_cfg(tmp_path))
+    inst2.start()
+    try:
+        entries = inst2.scripts.audit_log()
+        assert any(e["actor"] == "alice" and e["action"] == "upload"
+                   for e in entries)
+    finally:
+        inst2.stop()
+        inst2.terminate()
