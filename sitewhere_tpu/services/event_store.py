@@ -114,17 +114,75 @@ class EventRecord:
     received_s: int
 
 
+# Filterable columns carrying per-chunk min/max zone-maps (the Cassandra
+# denormalized-table analog: a chunk whose [min, max] excludes the wanted
+# key is skipped without touching its rows).
+_FILTER_COLUMNS = (
+    "tenant_id", "device_id", "assignment_id", "customer_id", "area_id",
+    "asset_id", "event_type", "mtype_id", "alert_code", "command_id",
+)
+
+
+# High-cardinality exact-match columns get a per-chunk Bloom filter on
+# top of the min/max bounds: random device ids never prune on range, but
+# a 128 Kbit two-hash Bloom (16 KB packed per chunk; fill ~22% at 16k
+# rows → ~5% false positives) skips almost every non-containing chunk.
+_BLOOM_COLUMNS = ("device_id", "assignment_id")
+_BLOOM_BITS = 17  # 131072-bit filter
+_H1 = 0x9E3779B97F4A7C15
+_H2 = 0xC2B2AE3D27D4EB4F
+_SHIFT = np.uint64(64 - _BLOOM_BITS)
+
+
+def _bloom_probe(want: int) -> tuple:
+    """(h1, h2) bit positions for one lookup key (pure-int: the prune
+    loop tests these against hundreds of chunks per query)."""
+    v = want & 0xFFFFFFFFFFFFFFFF
+    return (((v * _H1) & 0xFFFFFFFFFFFFFFFF) >> int(_SHIFT),
+            ((v * _H2) & 0xFFFFFFFFFFFFFFFF) >> int(_SHIFT))
+
+
 class _Chunk:
-    """An immutable, sealed columnar segment (+ prune metadata)."""
+    """An immutable, sealed columnar segment (+ zone-map prune metadata).
 
-    __slots__ = ("seq", "cols", "n", "min_ts", "max_ts")
+    ``light=True`` skips the prune metadata — the VIRTUAL chunk over the
+    unsealed buffer is rebuilt on every read call under the append lock,
+    and as the newest data it would rarely prune anyway.
+    """
 
-    def __init__(self, seq: int, cols: Dict[str, np.ndarray]):
+    __slots__ = ("seq", "cols", "n", "min_ts", "max_ts", "bounds", "blooms")
+
+    def __init__(self, seq: int, cols: Dict[str, np.ndarray],
+                 light: bool = False):
         self.seq = seq
         self.cols = cols
         self.n = len(cols["ts_s"])
         self.min_ts = int(cols["ts_s"].min()) if self.n else 0
         self.max_ts = int(cols["ts_s"].max()) if self.n else 0
+        if light:
+            self.bounds = None
+            self.blooms = {}
+            return
+        self.bounds = {
+            name: ((int(cols[name].min()), int(cols[name].max()))
+                   if self.n else (0, -1))
+            for name in _FILTER_COLUMNS
+        }
+        self.blooms = {}
+        for name in _BLOOM_COLUMNS:
+            bits = np.zeros(1 << _BLOOM_BITS, np.bool_)
+            if self.n:
+                v = cols[name].astype(np.int64).astype(np.uint64)
+                bits[(v * np.uint64(_H1)) >> _SHIFT] = True
+                bits[(v * np.uint64(_H2)) >> _SHIFT] = True
+            self.blooms[name] = np.packbits(bits)  # 16 KB, MSB-first
+
+    def may_contain(self, name: str, h1: int, h2: int) -> bool:
+        bloom = self.blooms.get(name)
+        if bloom is None:
+            return True
+        return bool(bloom[h1 >> 3] >> (7 - (h1 & 7)) & 1
+                    and bloom[h2 >> 3] >> (7 - (h2 & 7)) & 1)
 
 
 class EventStore(LifecycleComponent):
@@ -323,7 +381,7 @@ class EventStore(LifecycleComponent):
             name: np.concatenate([b[name] for b in self._buffer])
             for name in _COLUMN_NAMES
         }
-        return _Chunk(self._next_seq, merged)
+        return _Chunk(self._next_seq, merged, light=True)
 
     def add_event(self, **fields) -> EventRecord:
         """Append one event (REST create path, ``Assignments.java:428-433``).
@@ -468,63 +526,128 @@ class EventStore(LifecycleComponent):
         (e.g. ``listMeasurementsForIndex``).
         """
         criteria = criteria or SearchCriteria()
-        filters = {
-            "tenant_id": tenant_id,
-            "device_id": device_id,
-            "assignment_id": assignment_id,
-            "customer_id": customer_id,
-            "area_id": area_id,
-            "asset_id": asset_id,
-            "event_type": event_type,
-            "mtype_id": mtype_id,
-            "alert_code": alert_code,
-            "command_id": command_id,
-        }
+        active = [
+            (name, want)
+            for name, want in (
+                ("tenant_id", tenant_id), ("device_id", device_id),
+                ("assignment_id", assignment_id),
+                ("customer_id", customer_id), ("area_id", area_id),
+                ("asset_id", asset_id), ("event_type", event_type),
+                ("mtype_id", mtype_id), ("alert_code", alert_code),
+                ("command_id", command_id))
+            if want is not None
+        ]
+        t0, t1 = criteria.start_s, criteria.end_s
         with self._lock:
             chunks = list(self._chunks)
             buffered = self._buffer_chunk_locked()
         if buffered is not None:
             chunks.append(buffered)
 
-        # Fully vectorized hit collection + ordering: per-hit Python
-        # tuples and a Python sort were the 1M/s-scale weak spot (round-2
-        # verdict); only the RESULT PAGE materializes records.
-        sel_ts: List[np.ndarray] = []
-        sel_ns: List[np.ndarray] = []
+        probes = {
+            name: _bloom_probe(int(want)) for name, want in active
+            if name in _BLOOM_COLUMNS
+        }
+
+        def pruned(c: _Chunk) -> bool:
+            """Zone-map + Bloom skip (the hour-bucket/denormalized-table
+            analog)."""
+            if c.n == 0:
+                return True
+            if t0 is not None and c.max_ts < t0:
+                return True
+            if t1 is not None and c.min_ts > t1:
+                return True
+            if c.bounds is None:
+                return False  # light chunk (unsealed buffer): never pruned
+            for name, want in active:
+                lo, hi = c.bounds[name]
+                if want < lo or want > hi:
+                    return True
+                probe = probes.get(name)
+                if probe is not None and not c.may_contain(name, *probe):
+                    return True
+            return False
+
+        def match_mask(c: _Chunk) -> Optional[np.ndarray]:
+            """Row mask, or None meaning every row matches."""
+            mask = None
+            for name, want in active:
+                m = c.cols[name] == want
+                mask = m if mask is None else (mask & m)
+            if t0 is not None and c.min_ts < t0:
+                m = c.cols["ts_s"] >= t0
+                mask = m if mask is None else (mask & m)
+            if t1 is not None and c.max_ts > t1:
+                m = c.cols["ts_s"] <= t1
+                mask = m if mask is None else (mask & m)
+            return mask
+
+        # Phase 1 — exact total: a zone-map-pruned or filterless chunk
+        # counts without touching (or materializing) any row.
+        masks: List[Optional[np.ndarray]] = []
+        counts: List[int] = []
+        for c in chunks:
+            if pruned(c):
+                masks.append(None)
+                counts.append(0)
+                continue
+            mask = match_mask(c)
+            masks.append(mask)
+            counts.append(c.n if mask is None else int(np.count_nonzero(mask)))
+        total = sum(counts)
+        if total == 0:
+            return SearchResults(results=[], total=0)
+
+        # Phase 2 — newest-first page WITHOUT sorting every hit: walk
+        # chunks newest-max_ts-first and stop once the page's worst
+        # candidate is strictly newer than anything a remaining chunk
+        # could hold (chunk max_ts bounds its best key).  Only the
+        # collected candidates sort; the worst case (fully overlapping
+        # time ranges or an unlimited page) degrades to the full sort.
+        unlimited = criteria.page_size <= 0
+        # max(page, 1): SearchCriteria.slice clamps page<=0 to page 1,
+        # so the candidate budget must too (0 would make the kth-newest
+        # partition index fall out of bounds)
+        needed = total if unlimited else min(
+            total, max(criteria.page, 1) * criteria.page_size)
+        by_newest = sorted(
+            (i for i in range(len(chunks)) if counts[i]),
+            key=lambda i: chunks[i].max_ts, reverse=True)
+        sel_key: List[np.ndarray] = []
         sel_chunk: List[np.ndarray] = []
         sel_row: List[np.ndarray] = []
-        for ci, chunk in enumerate(chunks):
-            if criteria.start_s is not None and chunk.max_ts < criteria.start_s:
-                continue  # chunk prune (the hour-bucket skip analog)
-            if criteria.end_s is not None and chunk.min_ts > criteria.end_s:
-                continue
-            mask = np.ones(chunk.n, np.bool_)
-            for name, want in filters.items():
-                if want is not None:
-                    mask &= chunk.cols[name] == want
-            if criteria.start_s is not None:
-                mask &= chunk.cols["ts_s"] >= criteria.start_s
-            if criteria.end_s is not None:
-                mask &= chunk.cols["ts_s"] <= criteria.end_s
-            rows = np.nonzero(mask)[0]
-            if rows.size:
-                sel_ts.append(chunk.cols["ts_s"][rows].astype(np.int64))
-                sel_ns.append(chunk.cols["ts_ns"][rows].astype(np.int64))
-                sel_chunk.append(np.full(rows.size, ci, np.int32))
-                sel_row.append(rows.astype(np.int32))
+        collected = 0
+        for pos, ci in enumerate(by_newest):
+            chunk = chunks[ci]
+            mask = masks[ci]
+            rows = (np.arange(chunk.n, dtype=np.int64) if mask is None
+                    else np.nonzero(mask)[0])
+            # one int64 key: ts_s fits 2^31, ns < 1e9 → ts*1e9+ns < 2^63
+            key = (chunk.cols["ts_s"][rows].astype(np.int64)
+                   * 1_000_000_000 + chunk.cols["ts_ns"][rows])
+            sel_key.append(key)
+            sel_chunk.append(np.full(rows.size, ci, np.int32))
+            sel_row.append(rows.astype(np.int32))
+            collected += rows.size
+            if collected >= needed and pos + 1 < len(by_newest):
+                # kth-newest collected key vs the best key any remaining
+                # chunk could hold; > (not >=) so equal-key rows in older
+                # chunks keep their stable tie order
+                kth = np.partition(
+                    np.concatenate(sel_key), collected - needed
+                )[collected - needed]
+                next_best = (chunks[by_newest[pos + 1]].max_ts
+                             * 1_000_000_000 + 999_999_999)
+                if int(kth) > next_best:
+                    break
 
-        if not sel_ts:
-            return SearchResults(results=[], total=0)
-        ts = np.concatenate(sel_ts)
-        ns = np.concatenate(sel_ns)
+        key = np.concatenate(sel_key)
         cidx = np.concatenate(sel_chunk)
         rix = np.concatenate(sel_row)
-        # one int64 key: ts_s fits 2^31, ns < 1e9 → ts*1e9+ns < 2^63
-        key = ts * 1_000_000_000 + ns
         # newest-first; ties keep chunk/insertion order (stable, matching
-        # the previous Python sort)
-        order = np.lexsort((np.arange(key.size), -key))
-        total = int(key.size)
+        # the previous full sort)
+        order = np.lexsort((rix, cidx, -key))
         page = criteria.slice(order)
         return SearchResults(
             results=[self._record(chunks[int(cidx[i])], int(rix[i]))
