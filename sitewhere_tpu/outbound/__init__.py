@@ -20,6 +20,7 @@ from sitewhere_tpu.outbound.connectors import (
     CallbackConnector,
     FileConnector,
     HttpConnector,
+    IndexPushConnector,
     MqttOutboundConnector,
     OutboundConnector,
 )
@@ -35,6 +36,7 @@ __all__ = [
     "CallbackConnector",
     "FileConnector",
     "HttpConnector",
+    "IndexPushConnector",
     "MqttOutboundConnector",
     "OutboundConnector",
     "OutboundConnectorsManager",

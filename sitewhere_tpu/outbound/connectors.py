@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 import threading
 from typing import Callable, Dict, List, Optional
 
@@ -291,3 +292,180 @@ class MqttOutboundConnector(OutboundConnector):
                     with self._lock:
                         self.errors += 1
                     logger.exception("%s publish to %s failed", self.name, topic)
+
+
+class IndexPushConnector(HttpConnector):
+    """Push enriched events to an external search index in bulk.
+
+    Reference: ``SolrOutboundConnector``
+    (``service-outbound-connectors/.../solr/SolrOutboundConnector.java``)
+    indexes every surviving event into an external Solr core — the
+    write side of the federated-search story (the repo's own providers
+    are query-side over its own store).  This is the batched variant of
+    :class:`HttpConnector`:
+
+    - events ACCUMULATE across pipeline batches and flush as ONE bulk
+      request when ``bulk_rows`` is reached or ``bulk_interval_s``
+      elapses (the Solr client's buffered-add semantics);
+    - a failed bulk is RETAINED and retried with exponential backoff —
+      backpressure is a bounded buffer (``max_buffer_rows``); beyond it
+      the OLDEST docs drop and are counted (``dropped``), never the
+      pipeline blocked;
+    - the default wire shape is a JSON array POSTed to the URL
+      (Solr ``/update`` accepts exactly that); ``bulk_format`` swaps in
+      e.g. an Elasticsearch ``_bulk`` NDJSON builder.
+    """
+
+    def __init__(
+        self,
+        connector_id: str,
+        url: str,
+        identity=None,
+        headers: Optional[Dict[str, str]] = None,
+        bulk_rows: int = 500,
+        bulk_interval_s: float = 1.0,
+        max_buffer_rows: int = 50_000,
+        backoff_s: float = 0.5,
+        max_backoff_s: float = 30.0,
+        bulk_format: Optional[Callable[[List[dict]], bytes]] = None,
+        timeout_s: float = 10.0,
+        filters=None,
+    ):
+        super().__init__(connector_id, url, identity=identity,
+                         headers=headers, timeout_s=timeout_s,
+                         filters=filters)
+        self.bulk_rows = bulk_rows
+        self.bulk_interval_s = bulk_interval_s
+        self.max_buffer_rows = max_buffer_rows
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.bulk_format = bulk_format or (
+            lambda docs: json.dumps(docs).encode("utf-8"))
+        self._pending: List[dict] = []
+        self._inflight: set = set()
+        self._last_flush = time.monotonic()
+        self._retry_at = 0.0
+        self._cur_backoff = backoff_s
+        self.indexed = 0
+        self.dropped = 0
+        # serializes whole flushes: the interval timer and a delivery
+        # thread passing the due-check together must not post the same
+        # docs twice (also guards _conn, which is not thread-safe)
+        self._flush_lock = threading.Lock()
+        self._timer: Optional[threading.Thread] = None
+        self._timer_stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        super().start()
+        self._timer_stop.clear()
+        self._timer = threading.Thread(
+            target=self._tick, name=f"{self.name}-flusher", daemon=True)
+        self._timer.start()
+
+    def stop(self) -> None:
+        self._timer_stop.set()
+        if self._timer is not None:
+            self._timer.join(timeout=5)
+            self._timer = None
+        self._flush(force=True)  # best-effort final push
+        super().stop()
+
+    def _tick(self) -> None:
+        while not self._timer_stop.wait(max(0.05, self.bulk_interval_s / 2)):
+            try:
+                self._flush()
+            except Exception:
+                logger.exception("%s interval flush failed", self.name)
+
+    # -- delivery ------------------------------------------------------------
+
+    def deliver(self, cols: Columns, mask: np.ndarray) -> None:
+        rows = np.nonzero(mask)[0]
+        docs = [marshal_row(cols, int(r), self.identity) for r in rows]
+        with self._lock:
+            self._pending.extend(docs)
+            overflow = len(self._pending) - self.max_buffer_rows
+            if overflow > 0:
+                # drop OLDEST (the index is a derived view; newest data
+                # wins when the sink cannot keep up) — but never a doc
+                # an in-flight bulk is carrying: it is being indexed,
+                # not dropped, and the post-send identity delete must
+                # find it in place
+                keep: List[dict] = []
+                dropped = 0
+                for d in self._pending:
+                    if dropped < overflow and id(d) not in self._inflight:
+                        dropped += 1
+                        continue
+                    keep.append(d)
+                self._pending = keep
+                self.dropped += dropped
+        self._flush()
+
+    def _flush(self, force: bool = False) -> None:
+        with self._flush_lock:
+            self._flush_locked(force)
+
+    def _flush_locked(self, force: bool) -> None:
+        now = time.monotonic()
+        with self._lock:
+            n = len(self._pending)
+            due = force or n >= self.bulk_rows or (
+                n > 0 and now - self._last_flush >= self.bulk_interval_s)
+            if not due or n == 0 or (not force and now < self._retry_at):
+                return
+            batch = self._pending[:]
+            self._inflight = {id(d) for d in batch}
+        ok = False
+        try:
+            body = self.bulk_format(batch)
+            ok = self._post_bulk(body)
+        finally:
+            if ok:
+                with self._lock:
+                    # remove exactly the docs this flush sent, BY
+                    # IDENTITY: deliveries that landed mid-request stay
+                    # pending (a head-count delete would eat them)
+                    sent = self._inflight
+                    self._pending = [d for d in self._pending
+                                     if id(d) not in sent]
+                    self._inflight = set()
+                    self.indexed += len(batch)
+                    self._last_flush = now
+                    self._cur_backoff = self.backoff_s
+                    self._retry_at = 0.0
+            else:
+                with self._lock:
+                    self._inflight = set()
+                    self.errors += 1
+                    self._retry_at = now + self._cur_backoff
+                    self._cur_backoff = min(self._cur_backoff * 2,
+                                            self.max_backoff_s)
+
+    def _post_bulk(self, body: bytes) -> bool:
+        headers = {"Content-Type": "application/json", **self.headers}
+        for attempt in (0, 1):
+            if self._conn is None:
+                self._conn = self._connect()
+            try:
+                self._conn.request("POST", self._path, body=body,
+                                   headers=headers)
+                resp = self._conn.getresponse()
+                resp.read()
+                if not 200 <= resp.status < 300:
+                    logger.error("%s bulk POST %s rejected (%d)",
+                                 self.name, self._path, resp.status)
+                    return False
+                return True
+            except Exception:
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = None
+                if attempt:
+                    logger.exception("%s bulk POST %s failed", self.name,
+                                     self._path)
+        return False

@@ -395,3 +395,102 @@ def test_stomp_heartbeats_negotiated_and_sent():
     finally:
         rx.stop()
         broker.close()
+
+
+# ---------------------------------------------------------------------------
+# index-push connector (SolrOutboundConnector analog)
+# ---------------------------------------------------------------------------
+
+def test_index_push_accumulates_and_flushes_bulk():
+    """Events accumulate across pipeline batches and flush as ONE bulk
+    request at the row threshold."""
+    from sitewhere_tpu.outbound import IndexPushConnector
+
+    srv = _http_server()
+    try:
+        c = IndexPushConnector(
+            "solr", f"http://127.0.0.1:{srv.server_address[1]}/update",
+            bulk_rows=5, bulk_interval_s=3600.0)
+        # 3 rows: below threshold — nothing posted yet
+        c.process_batch(_cols(3), np.ones(3, np.bool_))
+        assert len(srv.requests) == 0
+        # 3 more: threshold crossed — one bulk of all 6
+        c.process_batch(_cols(3), np.ones(3, np.bool_))
+        assert len(srv.requests) == 1
+        docs = json.loads(srv.requests[0][2])
+        assert len(docs) == 6
+        assert c.indexed == 6 and c.errors == 0
+        c.stop()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_index_push_interval_flush_and_final_flush_on_stop():
+    from sitewhere_tpu.outbound import IndexPushConnector
+
+    srv = _http_server()
+    try:
+        c = IndexPushConnector(
+            "solr", f"http://127.0.0.1:{srv.server_address[1]}/update",
+            bulk_rows=1000, bulk_interval_s=0.1)
+        c.start()
+        c.process_batch(_cols(2), np.ones(2, np.bool_))
+        deadline = time.time() + 5
+        while not srv.requests and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(srv.requests) == 1  # interval flush
+        c.process_batch(_cols(1), np.ones(1, np.bool_))
+        c.stop()  # final best-effort flush
+        assert sum(len(json.loads(b)) for _, _, b in srv.requests) == 3
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_index_push_retries_with_backoff_without_loss():
+    """A failed bulk is retained and re-sent once the sink recovers."""
+    from sitewhere_tpu.outbound import IndexPushConnector
+
+    srv = _http_server(status=500)
+    try:
+        c = IndexPushConnector(
+            "solr", f"http://127.0.0.1:{srv.server_address[1]}/update",
+            bulk_rows=2, bulk_interval_s=3600.0, backoff_s=0.05)
+        c.process_batch(_cols(2), np.ones(2, np.bool_))
+        assert c.errors == 1 and c.indexed == 0
+        assert len(c._pending) == 2  # retained for retry
+        srv.status = 200
+        time.sleep(0.06)  # let the backoff window pass
+        c.process_batch(_cols(1), np.ones(1, np.bool_))
+        assert c.indexed == 3
+        assert len(c._pending) == 0
+        # everything arrived exactly once after recovery
+        ok = [b for _, _, b in srv.requests if len(json.loads(b)) == 3]
+        assert len(ok) == 1
+        c.stop()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_index_push_bounded_buffer_drops_oldest():
+    from sitewhere_tpu.outbound import IndexPushConnector
+
+    srv = _http_server(status=500)
+    try:
+        c = IndexPushConnector(
+            "solr", f"http://127.0.0.1:{srv.server_address[1]}/update",
+            bulk_rows=100, bulk_interval_s=3600.0, max_buffer_rows=4,
+            backoff_s=3600.0)
+        c.process_batch(_cols(3), np.ones(3, np.bool_))
+        c.process_batch(_cols(3), np.ones(3, np.bool_))
+        assert c.dropped == 2
+        assert len(c._pending) == 4
+        # the RETAINED docs are the newest ones
+        vals = [d["deviceId"] for d in c._pending]
+        assert vals == [2, 0, 1, 2]
+        c.stop()
+    finally:
+        srv.shutdown()
+        srv.server_close()
