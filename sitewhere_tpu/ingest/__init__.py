@@ -22,6 +22,7 @@ from sitewhere_tpu.ingest.decoders import (  # noqa: F401
 )
 from sitewhere_tpu.ingest.dedup import AlternateIdDeduplicator  # noqa: F401
 from sitewhere_tpu.ingest.coap import CoapServerReceiver  # noqa: F401
+from sitewhere_tpu.ingest.amqp import AmqpReceiver  # noqa: F401
 from sitewhere_tpu.ingest.stomp import StompReceiver  # noqa: F401
 from sitewhere_tpu.ingest.columnar import decode_json_lines  # noqa: F401
 from sitewhere_tpu.ingest.batcher import Batcher, BatchPlan  # noqa: F401

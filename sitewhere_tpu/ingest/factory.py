@@ -73,6 +73,13 @@ def _build_receiver(doc: Dict):
                 port=int(args.pop("port", 61613)),
                 destination=str(args.pop(
                     "destination", "/queue/sitewhere.input")), **args)
+        if kind in ("amqp", "rabbitmq"):
+            from sitewhere_tpu.ingest import amqp
+
+            return amqp.AmqpReceiver(
+                host=str(args.pop("host")),
+                port=int(args.pop("port", 5672)),
+                queue=str(args.pop("queue", "sitewhere.input")), **args)
         if kind == "coap":
             return coap.CoapServerReceiver(
                 host=str(args.pop("host", "127.0.0.1")),

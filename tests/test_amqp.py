@@ -1,0 +1,305 @@
+"""AMQP 0-9-1 receiver against a scripted mini-broker.
+
+Reference behavior covered: ``RabbitMqInboundEventReceiver.java`` —
+consume a queue over the broker's native protocol with explicit acks
+(at-least-once), reconnect on session loss.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from sitewhere_tpu.ingest.amqp import (
+    BASIC_ACK,
+    BASIC_CONSUME,
+    BASIC_CONSUME_OK,
+    BASIC_DELIVER,
+    BASIC_QOS,
+    BASIC_QOS_OK,
+    CHANNEL_OPEN,
+    CHANNEL_OPEN_OK,
+    CONNECTION_OPEN,
+    CONNECTION_OPEN_OK,
+    CONNECTION_START,
+    CONNECTION_START_OK,
+    CONNECTION_TUNE,
+    CONNECTION_TUNE_OK,
+    FRAME_BODY,
+    FRAME_HEADER,
+    FRAME_METHOD,
+    PROTOCOL_HEADER,
+    QUEUE_DECLARE,
+    QUEUE_DECLARE_OK,
+    AmqpError,
+    AmqpReceiver,
+    FrameReader,
+    field_table,
+    frame,
+    longstr,
+    method_frame,
+    parse_shortstr,
+    shortstr,
+)
+
+
+class MiniAmqpBroker:
+    """Single-queue scripted broker: full consume handshake, records
+    declares/acks/auth, pushes queued deliveries (optionally split
+    across several body frames)."""
+
+    def __init__(self, heartbeat=0, body_frame_size=0,
+                 drop_first_session=False):
+        self.heartbeat = heartbeat
+        self.body_frame_size = body_frame_size
+        self.drop_first_session = drop_first_session
+        self.acks = []
+        self.declares = []
+        self.auth = None
+        self.sessions = 0
+        self._to_send = []
+        self._lock = threading.Lock()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(4)
+        self.port = self._srv.getsockname()[1]
+        self._alive = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def push(self, payload: bytes):
+        with self._lock:
+            self._to_send.append(payload)
+
+    def close(self):
+        self._alive = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # -- server side ---------------------------------------------------------
+
+    def _loop(self):
+        while self._alive:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            self.sessions += 1
+            if self.drop_first_session and self.sessions == 1:
+                conn.close()
+                continue
+            try:
+                self._session(conn)
+            except (OSError, AmqpError):
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _recv_method(self, conn, reader, want):
+        while True:
+            for ftype, channel, payload in reader.feed(conn.recv(65536)):
+                if ftype != FRAME_METHOD:
+                    continue
+                cm = struct.unpack_from(">HH", payload, 0)
+                if cm == want:
+                    return channel, payload[4:]
+                if cm == BASIC_ACK:
+                    tag = struct.unpack_from(">Q", payload, 4)[0]
+                    self.acks.append(tag)
+                    continue
+                raise AmqpError(f"mini-broker: unexpected {cm}")
+
+    def _session(self, conn):
+        conn.settimeout(10)
+        reader = FrameReader()
+        hdr = b""
+        while len(hdr) < 8:
+            hdr += conn.recv(8 - len(hdr))
+        assert hdr == PROTOCOL_HEADER
+        conn.sendall(method_frame(0, CONNECTION_START, struct.pack(
+            ">BB", 0, 9) + field_table({}) + longstr(b"PLAIN")
+            + longstr(b"en_US")))
+        _, args = self._recv_method(conn, reader, CONNECTION_START_OK)
+        # client-properties table, then mechanism + response
+        tbl_len = struct.unpack_from(">I", args, 0)[0]
+        off = 4 + tbl_len
+        mech, off = parse_shortstr(args, off)
+        resp_len = struct.unpack_from(">I", args, off)[0]
+        self.auth = (mech, args[off + 4: off + 4 + resp_len])
+        conn.sendall(method_frame(0, CONNECTION_TUNE, struct.pack(
+            ">HIH", 2047, 131072, self.heartbeat)))
+        self._recv_method(conn, reader, CONNECTION_TUNE_OK)
+        self._recv_method(conn, reader, CONNECTION_OPEN)
+        conn.sendall(method_frame(0, CONNECTION_OPEN_OK, shortstr("")))
+        ch, _ = self._recv_method(conn, reader, CHANNEL_OPEN)
+        conn.sendall(method_frame(ch, CHANNEL_OPEN_OK, struct.pack(">I", 0)))
+        self._recv_method(conn, reader, BASIC_QOS)
+        conn.sendall(method_frame(ch, BASIC_QOS_OK))
+        _, args = self._recv_method(conn, reader, QUEUE_DECLARE)
+        qname, _ = parse_shortstr(args, 2)
+        self.declares.append(qname)
+        conn.sendall(method_frame(ch, QUEUE_DECLARE_OK, shortstr(qname)
+                                  + struct.pack(">II", 0, 0)))
+        self._recv_method(conn, reader, BASIC_CONSUME)
+        conn.sendall(method_frame(ch, BASIC_CONSUME_OK, shortstr("ctag-1")))
+
+        # deliver queued payloads; keep reading acks
+        tag = 0
+        conn.settimeout(0.05)
+        while self._alive:
+            with self._lock:
+                sendables = self._to_send[:]
+                self._to_send.clear()
+            for payload in sendables:
+                tag += 1
+                conn.sendall(method_frame(ch, BASIC_DELIVER,
+                             shortstr("ctag-1") + struct.pack(">QB", tag, 0)
+                             + shortstr("") + shortstr("rk")))
+                conn.sendall(frame(FRAME_HEADER, ch, struct.pack(
+                    ">HHQH", 60, 0, len(payload), 0)))
+                step = self.body_frame_size or len(payload) or 1
+                for lo in range(0, len(payload), step):
+                    conn.sendall(frame(FRAME_BODY, ch,
+                                       payload[lo: lo + step]))
+                if not payload:
+                    conn.sendall(frame(FRAME_BODY, ch, b""))
+            try:
+                data = conn.recv(65536)
+            except socket.timeout:
+                continue
+            if not data:
+                return
+            for ftype, _, payload in reader.feed(data):
+                if ftype == FRAME_METHOD:
+                    cm = struct.unpack_from(">HH", payload, 0)
+                    if cm == BASIC_ACK:
+                        self.acks.append(
+                            struct.unpack_from(">Q", payload, 4)[0])
+
+
+def _wait(predicate, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_consume_and_ack_after_sink_accepts():
+    broker = MiniAmqpBroker()
+    got = []
+    rx = AmqpReceiver("127.0.0.1", broker.port, queue="q1")
+    rx.sink = got.append
+    rx.start()
+    try:
+        assert _wait(lambda: broker.sessions == 1)
+        broker.push(b'{"deviceToken":"d1"}')
+        broker.push(b'{"deviceToken":"d2"}')
+        assert _wait(lambda: len(got) == 2)
+        assert got == [b'{"deviceToken":"d1"}', b'{"deviceToken":"d2"}']
+        assert _wait(lambda: broker.acks == [1, 2])
+        assert broker.declares == ["q1"]
+        assert broker.auth[0] == "PLAIN"
+        assert broker.auth[1] == b"\x00guest\x00guest"
+    finally:
+        rx.stop()
+        broker.close()
+
+
+def test_multi_frame_body_reassembled():
+    broker = MiniAmqpBroker(body_frame_size=7)
+    got = []
+    rx = AmqpReceiver("127.0.0.1", broker.port, queue="q1")
+    rx.sink = got.append
+    rx.start()
+    try:
+        payload = b"x" * 100 + b"tail"
+        assert _wait(lambda: broker.sessions == 1)
+        broker.push(payload)
+        assert _wait(lambda: got == [payload])
+        assert _wait(lambda: broker.acks == [1])
+    finally:
+        rx.stop()
+        broker.close()
+
+
+def test_rejected_payload_left_unacked():
+    """A sink failure leaves the delivery unacked (broker will redeliver
+    on reconnect) — at-least-once, never silent loss."""
+    broker = MiniAmqpBroker()
+
+    def bad_sink(payload):
+        raise RuntimeError("journal down")
+
+    rx = AmqpReceiver("127.0.0.1", broker.port, queue="q1")
+    rx.sink = bad_sink
+    rx.start()
+    try:
+        assert _wait(lambda: broker.sessions == 1)
+        broker.push(b"poison")
+        assert _wait(lambda: rx.emit_errors == 1)
+        time.sleep(0.1)
+        assert broker.acks == []
+    finally:
+        rx.stop()
+        broker.close()
+
+
+def test_reconnects_after_dropped_session():
+    broker = MiniAmqpBroker(drop_first_session=True)
+    got = []
+    rx = AmqpReceiver("127.0.0.1", broker.port, queue="q1",
+                      reconnect_delay_s=0.05)
+    rx.sink = got.append
+    rx.start()
+    try:
+        assert _wait(lambda: broker.sessions >= 2)
+        broker.push(b"after-reconnect")
+        assert _wait(lambda: got == [b"after-reconnect"])
+    finally:
+        rx.stop()
+        broker.close()
+
+
+def test_receiver_feeds_instance_pipeline(tmp_path):
+    """End-to-end: AMQP delivery → source decode → dispatcher → store."""
+    from sitewhere_tpu.ingest.sources import InboundEventSource
+    from sitewhere_tpu.ingest.decoders import JsonDecoder
+    from tests.test_instance import make_config, seed_device
+    from sitewhere_tpu.instance import Instance
+
+    inst = Instance(make_config(tmp_path))
+    inst.start()
+    broker = MiniAmqpBroker()
+    rx = AmqpReceiver("127.0.0.1", broker.port, queue="events")
+    source = InboundEventSource(
+        source_id="amqp", receivers=[rx], decoder=JsonDecoder(),
+        on_event=inst.dispatcher.ingest,
+        on_registration=inst.dispatcher.ingest_registration,
+        on_failed_decode=inst.dispatcher.ingest_failed_decode,
+    )
+    try:
+        seed_device(inst)
+        source.start()
+        assert _wait(lambda: broker.sessions == 1)
+        broker.push(
+            b'{"deviceToken":"dev-1","type":"Measurement",'
+            b'"request":{"name":"temp","value":21.5,"eventDate":1000}}')
+        assert _wait(lambda: broker.acks == [1])
+        inst.dispatcher.flush()
+        inst.event_store.flush()
+        assert inst.event_store.total_events == 1
+    finally:
+        source.stop()
+        broker.close()
+        inst.stop()
+        inst.terminate()
