@@ -392,6 +392,92 @@ class Instance(LifecycleComponent):
                 demux.set_endpoints([new_peers[p]])
         self._rpc_peers = new_peers
 
+    def apply_membership_change(self, new_peers: List[str],
+                                process_id: Optional[int] = None) -> dict:
+        """Adopt a NEW peers list whose COUNT may differ — the explicit
+        ops path for cluster grow/shrink (the config reload deliberately
+        rejects count changes; see ``_on_peers_changed``).
+
+        Sequence (reference: Kafka consumer rebalance + demux discovery
+        add/remove, ``ApiDemux.java`` DiscoveryMonitor):
+
+        1. build demuxes for the new endpoints (reusing live channels
+           for endpoints that did not move);
+        2. requeue every pending forwarded row under the new ownership
+           (:meth:`HostForwarder.apply_membership` — a departed peer's
+           spool drains to the rows' new owners);
+        3. hand off locally-owned devices whose new owner is elsewhere
+           (:func:`sitewhere_tpu.rpc.migration.migrate_out` — registry
+           rows + newest-wins DeviceState over ``migration.import``).
+
+        Returns the handoff summary.  Every host in the fleet must apply
+        the SAME list (ownership is the rendezvous hash over it).
+        """
+        from sitewhere_tpu.rpc import RpcDemux
+        from sitewhere_tpu.rpc.migration import migrate_out
+        from sitewhere_tpu.rpc.wire import parse_endpoint
+        from sitewhere_tpu.services.common import ValidationError
+
+        for ep in new_peers:
+            parse_endpoint(str(ep))
+        if process_id is None:
+            process_id = self._process_id()
+        old_n = max(len(self._rpc_peers), 1)
+        if not 0 <= process_id < len(new_peers):
+            raise ValueError(
+                f"process_id {process_id} outside new peers list")
+
+        def _system_jwt() -> str:
+            return self.tokens.mint("system", ["ROLE_ADMIN"])
+
+        old_by_endpoint = {}
+        for p, ep in enumerate(self._rpc_peers):
+            demux = self._peer_demuxes.get(p)
+            if demux is not None:
+                old_by_endpoint[ep] = demux
+        new_demuxes = {}
+        for p, ep in enumerate(new_peers):
+            if p == process_id:
+                new_demuxes[p] = None
+            elif ep in old_by_endpoint:
+                new_demuxes[p] = old_by_endpoint.pop(ep)
+            else:
+                new_demuxes[p] = RpcDemux([ep], token_provider=_system_jwt)
+
+        if self.forwarder is not None:
+            self.forwarder.apply_membership(new_demuxes,
+                                            process_id=process_id)
+        elif len(new_peers) > 1:
+            # A standalone instance has its protocol sources wired
+            # straight to the dispatcher and (usually) no RpcServer for
+            # peers to deliver to — conjuring a forwarder here would
+            # leave every attached source bypassing it, splitting device
+            # streams across hosts.  Multi-host membership starts at
+            # boot (rpc.peers); this API then grows/shrinks it.
+            raise ValidationError(
+                "this instance booted standalone (no rpc.peers); "
+                "restart it with rpc.peers + rpc.server.enabled to "
+                "join a fleet")
+        self._peer_demuxes = new_demuxes
+        self._rpc_peers = list(new_peers)
+        self.config.set("rpc.peers", list(new_peers))
+        self.config.set("rpc.process_id", process_id)
+        # closed-over demuxes for endpoints that left the fleet
+        for demux in old_by_endpoint.values():
+            try:
+                demux.close()
+            except Exception:
+                logger.exception("old peer demux close failed")
+
+        summary = migrate_out(self, old_n, len(new_peers), process_id,
+                              new_demuxes)
+        logger.info("membership change to %d peers: %s",
+                    len(new_peers), summary)
+        return summary
+
+    def _process_id(self) -> int:
+        return int(self.config.get("rpc.process_id", 0))
+
     def _packed_step_enabled(self) -> bool:
         """Config ``pipeline.packed_step`` (true/false) pins the step
         interface; the default ("auto") is backend-adaptive

@@ -188,6 +188,14 @@ class HostForwarder(LifecycleComponent):
         self._spool_readers: Dict[int, JournalReader] = {}
         self._owner_locks: Dict[int, threading.Lock] = {}
         self._spool_since: Dict[int, float] = {}
+        self._data_dir = data_dir
+        # membership generation: ownership is computed OUTSIDE the lock
+        # (split_lines is the expensive part), then buffered atomically
+        # against this counter — a membership swap mid-split makes the
+        # caller recompute instead of appending under a stale map (and
+        # possibly into a spool being retired).  In-flight LOCAL rows
+        # count as processed-before-the-change (they complete locally).
+        self._member_gen = 0
         if data_dir is not None:
             for p, demux in peer_demuxes.items():
                 if demux is None:
@@ -218,14 +226,21 @@ class HostForwarder(LifecycleComponent):
     def ingest_payload(self, payload: bytes, source_id: str = "wire") -> int:
         """Route one NDJSON payload.  Returns rows accepted LOCALLY
         (remote rows are accepted by their owner asynchronously)."""
-        by_owner = split_lines(payload, self.n_processes)
+        while True:
+            with self._lock:
+                gen, n, pid = (self._member_gen, self.n_processes,
+                               self.process_id)
+            by_owner = split_lines(payload, n)
+            local: List[bytes] = []
+            remote: Dict[int, List[bytes]] = {}
+            for owner, lines in by_owner.items():
+                if owner in (-1, pid):
+                    local.extend(lines)
+                else:
+                    remote[owner] = lines
+            if self._route_remote(remote, gen):
+                break  # else: membership changed mid-split; recompute
         accepted = 0
-        local: List[bytes] = []
-        for owner, lines in by_owner.items():
-            if owner in (-1, self.process_id):
-                local.extend(lines)
-            else:
-                self._buffer(owner, lines)
         if local:
             accepted = self.dispatcher.ingest_wire_lines(
                 b"\n".join(local), source_id=source_id)
@@ -291,38 +306,58 @@ class HostForwarder(LifecycleComponent):
             self._buffer(owner, [encode_envelope(req)])
 
     def _buffer(self, owner: int, lines: List[bytes]) -> None:
-        if self.durable:
-            # write-ahead: the spool IS the buffer, so a crash between
-            # intake and send replays these rows on restart
-            spool = self._spools.get(owner)
-            if spool is None:
-                self._dead_letter(owner, b"\n".join(lines),
-                                  "no spool for peer")
-                return
-            spool.append(b"\n".join(lines))
-            kick = False
+        """Buffer one owner's lines under the CURRENT membership (the
+        single-owner callers' form of :meth:`_route_remote`)."""
+        while True:
             with self._lock:
-                self._spool_since.setdefault(owner, time.monotonic())
-                reader = self._spool_readers[owner]
-                if reader.lag >= SPOOL_POLL_RECORDS:
-                    kick = True
-            if kick:
-                self._send_async(owner)
-            return
-        flush_now = False
+                gen = self._member_gen
+            if self._route_remote({owner: lines}, gen):
+                return
+
+    def _route_remote(self, remote: Dict[int, List[bytes]],
+                      gen: int) -> bool:
+        """Atomically buffer per-owner line lists whose ownership was
+        computed under membership generation ``gen``; False when the
+        membership changed underneath (caller must recompute owners).
+        """
+        kicks: List[int] = []
+        drops: List[tuple] = []
         with self._lock:
-            buf = self._buffers.setdefault(owner, [])
-            if not buf:
-                self._buffer_since[owner] = time.monotonic()
-            buf.extend(lines)
-            self._buffer_bytes[owner] = (
-                self._buffer_bytes.get(owner, 0)
-                + sum(len(l) + 1 for l in lines))
-            flush_now = self._buffer_bytes[owner] >= self.max_buffer_bytes
-        if flush_now:
+            if gen != self._member_gen:
+                return False
+            for owner, lines in remote.items():
+                if self.durable:
+                    # write-ahead: the spool IS the buffer, so a crash
+                    # between intake and send replays on restart.  The
+                    # append stays under the lock so a membership swap
+                    # can never retire a spool with an append in flight.
+                    spool = self._spools.get(owner)
+                    if spool is None:
+                        drops.append((owner, b"\n".join(lines),
+                                      "no spool for peer"))
+                        continue
+                    spool.append(b"\n".join(lines))
+                    self._spool_since.setdefault(owner, time.monotonic())
+                    if (self._spool_readers[owner].lag
+                            >= SPOOL_POLL_RECORDS):
+                        kicks.append(owner)
+                    continue
+                buf = self._buffers.setdefault(owner, [])
+                if not buf:
+                    self._buffer_since[owner] = time.monotonic()
+                buf.extend(lines)
+                self._buffer_bytes[owner] = (
+                    self._buffer_bytes.get(owner, 0)
+                    + sum(len(l) + 1 for l in lines))
+                if self._buffer_bytes[owner] >= self.max_buffer_bytes:
+                    kicks.append(owner)
+        for owner, payload, reason in drops:
+            self._dead_letter(owner, payload, reason)
+        for owner in kicks:
             # off the ingest caller's thread: a slow/down peer must not
             # stall the frontend that happened to fill this buffer
             self._send_async(owner)
+        return True
 
     def _drain_memory_locked(self, owner: int) -> Optional[bytes]:
         lines = self._buffers.pop(owner, None)
@@ -513,6 +548,108 @@ class HostForwarder(LifecycleComponent):
         for spool in self._spools.values():
             spool.close()
         super().stop()
+
+    def apply_membership(
+            self, peer_demuxes: Dict[int, Optional[RpcDemux]],
+            process_id: Optional[int] = None) -> int:
+        """Adopt a NEW peers map (count may change) and requeue every
+        pending row under the new ownership — the consumer-rebalance
+        analog: a departed peer's spooled rows go to their new owners
+        (or the local intake) instead of waiting for a host that will
+        never return.  Returns rows requeued.
+
+        The caller (Instance.apply_membership_change) is responsible for
+        record handoff (:mod:`sitewhere_tpu.rpc.migration`); this method
+        only moves the in-flight forwarding state.
+        """
+        # Drain-stop current senders: swap under a quiet fabric so no
+        # sender is mid-poll on a spool we are about to requeue.
+        with self._lock:
+            old_locks = list(self._owner_locks.values())
+        for lock in old_locks:
+            lock.acquire()
+        old_tails: List[tuple] = []  # (reader, journal, end_position)
+        try:
+            with self._lock:
+                pending: List[bytes] = []
+                # memory buffers
+                for owner in list(self._buffers):
+                    payload = self._drain_memory_locked(owner)
+                    if payload:
+                        pending.append(payload)
+                # durable spools: read (but do NOT commit yet) every
+                # uncommitted tail — the old offsets advance only after
+                # the rows are durably re-placed, so a crash mid-requeue
+                # replays them (at-least-once), never loses them
+                for owner, reader in list(self._spool_readers.items()):
+                    while True:
+                        records = reader.poll(SPOOL_POLL_RECORDS)
+                        if not records:
+                            break
+                        pending.extend(r for _, r in records)
+                    old_tails.append(
+                        (reader, self._spools[owner], reader.position))
+                    self._spool_since.pop(owner, None)
+
+                if process_id is not None:
+                    self.process_id = process_id
+                self.peers = dict(peer_demuxes)
+                self.n_processes = len(peer_demuxes)
+                # any split computed under the old map must recompute
+                # (see _route_remote's generation check)
+                self._member_gen += 1
+                # spools/locks for the new peer set (existing Journal
+                # objects are reused so their files stay continuous)
+                new_spools: Dict[int, Journal] = {}
+                new_readers: Dict[int, JournalReader] = {}
+                new_locks: Dict[int, threading.Lock] = {}
+                durable_root = self._data_dir
+                for p, demux in peer_demuxes.items():
+                    if demux is None:
+                        continue
+                    new_locks[p] = self._owner_locks.get(
+                        p, threading.Lock())
+                    if p in self._spools:
+                        new_spools[p] = self._spools[p]
+                        new_readers[p] = self._spool_readers[p]
+                    elif durable_root is not None:
+                        spool = Journal(durable_root, name=f"forward-{p}",
+                                        fsync_every=64,
+                                        segment_bytes=4 << 20)
+                        new_spools[p] = spool
+                        new_readers[p] = JournalReader(spool, "sender")
+                # departed peers' spools close in the finalize phase
+                # below, after their rows are durably re-placed
+                self._spools = new_spools
+                self._spool_readers = new_readers
+                self._owner_locks = new_locks
+        finally:
+            for lock in old_locks:
+                lock.release()
+
+        # Re-ingest outside every lock: rows route freshly under the new
+        # map (local rows journal in the dispatcher, remote rows spool
+        # for their new owners) — durably re-placed BEFORE the old
+        # offsets commit below.
+        requeued = 0
+        for payload in pending:
+            requeued += payload.count(b"\n") + 1
+            self.ingest_payload(payload, source_id="membership-requeue")
+        for reader, journal, end in old_tails:
+            try:
+                if end > reader.committed:
+                    reader.commit(end)
+                journal.prune(reader.committed)
+                if journal not in self._spools.values():
+                    journal.close()  # departed peer's spool, fully drained
+            except Exception:
+                logger.exception("old spool finalize failed (harmless: "
+                                 "its rows replay as duplicates)")
+        if requeued:
+            logger.info("membership change: requeued %d pending rows "
+                        "under the new ownership", requeued)
+        self.flush()
+        return requeued
 
     def metrics(self) -> Dict[str, int]:
         with self._lock:

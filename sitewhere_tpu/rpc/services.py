@@ -140,6 +140,11 @@ def bind_instance(server: RpcServer, inst) -> None:
 
     bind_domains(server, inst)
 
+    # ---- ownership migration (membership-change handoff target) -----------
+    from sitewhere_tpu.rpc.migration import bind_migration
+
+    bind_migration(server, inst)
+
 
 def _active_assignment(dm, device_token: str):
     assignment = dm.get_active_assignment(device_token)

@@ -118,6 +118,16 @@ class Config:
 
     # -- access -------------------------------------------------------------
 
+    def set(self, dotted: str, value: Any) -> None:
+        """In-process override at a dotted path (does NOT persist to the
+        config file and does NOT fire change listeners — the runtime
+        adopting state it already applied, e.g. a membership change)."""
+        parts = dotted.split(".")
+        node = self._tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+
     def get(self, dotted: str, default: Any = None) -> Any:
         node: Any = self._tree
         for part in dotted.split("."):

@@ -184,6 +184,13 @@ class PipelineDispatcher(LifecycleComponent):
             "replayed": 0, "derived_alerts": 0, "commands": 0,
         }
 
+    def step_barrier(self):
+        """The lock serializing read-state → step → commit.  Out-of-band
+        state writers (ownership migration imports) hold it so an
+        in-flight step computed from the pre-write epoch cannot clobber
+        their rows at commit time."""
+        return self._step_lock
+
     # -- ingest entry points (wired as InboundEventSource.on_event) ---------
 
     def _take(self, intake: Callable[[], object]) -> List[BatchPlan]:

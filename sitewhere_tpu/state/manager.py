@@ -226,6 +226,59 @@ class DeviceStateManager(LifecycleComponent):
             row["last_event_type"] = None
         return row
 
+    # -- migration (ownership handoff; rpc/migration.py) --------------------
+
+    def export_row(self, device_id: int) -> Dict[str, object]:
+        """One device's FULL state row as a jsonable dict (unlike
+        :meth:`get_device_state_by_id`'s REST subset, this carries every
+        field, plus shape metadata so the importer can check fit)."""
+        with self._lock:
+            s = self.current
+        require(0 <= device_id < s.capacity,
+                EntityNotFound(f"bad device id {device_id}"))
+        row = jax.device_get(jax.tree.map(lambda a: a[device_id], s))
+        out: Dict[str, object] = {
+            "_mtype_slots": s.num_mtype_slots,
+            "_ewma_scales": s.num_ewma_scales,
+        }
+        for fld in s.__dataclass_fields__:
+            v = np.asarray(getattr(row, fld))
+            out[fld] = v.tolist() if v.ndim else v.item()
+        return out
+
+    def import_row(self, device_id: int, row: Dict[str, object]) -> bool:
+        """Adopt an exported row, NEWEST-WINS: applied only when the
+        incoming ``last_event_ts_s`` is newer than what this host holds
+        (a device that already re-registered and streamed here must not
+        be rolled back).  Measurement-shape mismatches drop the per-slot
+        stats but keep the scalar columns.  Returns True if applied."""
+        with self._lock:
+            s = self.current
+            require(0 <= device_id < s.capacity,
+                    EntityNotFound(f"bad device id {device_id}"))
+            incoming = int(row.get("last_event_ts_s") or 0)
+            current_ts = int(np.asarray(s.last_event_ts_s[device_id]))
+            if incoming <= current_ts:
+                return False
+            shapes_ok = (int(row.get("_mtype_slots") or 0) ==
+                         s.num_mtype_slots
+                         and int(row.get("_ewma_scales") or 0) ==
+                         s.num_ewma_scales)
+            updates = {}
+            for fld in s.__dataclass_fields__:
+                if fld not in row:
+                    continue
+                cur = getattr(s, fld)
+                if cur.ndim > 1 and not shapes_ok:
+                    continue
+                val = jnp.asarray(np.asarray(row[fld], cur.dtype))
+                if val.shape != cur.shape[1:]:
+                    continue
+                updates[fld] = cur.at[device_id].set(val)
+            self._state = s.replace(**updates)
+            self._packed = None
+        return True
+
     def missing_device_ids(self) -> List[int]:
         """Devices currently flagged missing (vectorized scan + index copy)."""
         with self._lock:

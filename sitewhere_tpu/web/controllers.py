@@ -626,6 +626,20 @@ def register_routes(gw: RestGateway, inst) -> None:
     r("GET", "/api/search/{provider}", external_search)
     r("GET", "/api/instance/cluster", lambda q: inst.cluster_topology())
 
+    def change_membership(q):
+        body = q.json()
+        peers = body.get("peers")
+        require(isinstance(peers, list) and peers,
+                ValidationError("body must carry a non-empty 'peers' list"))
+        return inst.apply_membership_change(
+            [str(p) for p in peers],
+            process_id=(int(body["processId"])
+                        if body.get("processId") is not None else None))
+    # cluster grow/shrink (rebalance + record handoff) — every host must
+    # be told the same list; admin-only ops action
+    r("POST", "/api/instance/cluster/membership", change_membership,
+      authority="ROLE_ADMIN")
+
     # ---- self-describing API listing (reference: Swagger) -----------------
     from sitewhere_tpu.web.http import openapi_spec
 
