@@ -25,7 +25,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from sitewhere_tpu.ids import NULL_ID, IdentityMap
-from sitewhere_tpu.schema import AlertLevel, ComparisonOp, RuleKind, RuleTable
+from sitewhere_tpu.schema import (
+    AlertLevel,
+    ComparisonOp,
+    RuleKind,
+    RuleTable,
+    pow2_at_least,
+)
 from sitewhere_tpu.services.common import (
     DuplicateToken,
     EntityNotFound,
@@ -191,15 +197,20 @@ class RuleManager:
         with self._lock:
             if not self._dirty and self._table is not None:
                 return self._table
-            active = np.zeros(self.capacity, bool)
-            tenant_id = np.full(self.capacity, NULL_ID, np.int32)
-            mtype_id = np.full(self.capacity, NULL_ID, np.int32)
-            op = np.zeros(self.capacity, np.int32)
-            threshold = np.zeros(self.capacity, np.float32)
-            alert_code = np.full(self.capacity, NULL_ID, np.int32)
-            alert_level = np.zeros(self.capacity, np.int32)
-            kind = np.zeros(self.capacity, np.int32)
-            window_idx = np.zeros(self.capacity, np.int32)
+            # Size at the smallest power of two covering every used slot
+            # (slots allocate low-first): an empty/small rule set must
+            # not make every step pay the full-capacity [B, R] pass.
+            hi = (max(self._slots.values()) + 1) if self._slots else 0
+            trim = pow2_at_least(hi, cap=self.capacity)
+            active = np.zeros(trim, bool)
+            tenant_id = np.full(trim, NULL_ID, np.int32)
+            mtype_id = np.full(trim, NULL_ID, np.int32)
+            op = np.zeros(trim, np.int32)
+            threshold = np.zeros(trim, np.float32)
+            alert_code = np.full(trim, NULL_ID, np.int32)
+            alert_level = np.zeros(trim, np.int32)
+            kind = np.zeros(trim, np.int32)
+            window_idx = np.zeros(trim, np.int32)
             halflives = np.asarray(self.ewma_halflives_s, np.float32)
             # operator-facing half-lives → e-folding taus (alpha uses
             # exp(-dt/tau); after one half-life the old average must

@@ -190,6 +190,9 @@ class Checkpointer(LifecycleComponent):
                     k: np.array(getattr(mirror, k)) for k in _MIRROR_ARRAYS
                 }
                 mirror_arrays["epoch"] = np.asarray(mirror.epoch)
+                # z_hi drives the published ZoneTable's pow2 trim — a
+                # restore without it would trim restored zones away
+                mirror_arrays["z_hi"] = np.asarray(mirror.z_hi)
             names["mirror"] = f"mirror-{gen:08d}.npz"
             _atomic_write(
                 os.path.join(self.dir, names["mirror"]),
@@ -309,6 +312,10 @@ class Checkpointer(LifecycleComponent):
                 for k in _MIRROR_ARRAYS:
                     getattr(inst.mirror, k)[:] = z[k]
                 inst.mirror.epoch = int(z["epoch"])
+                # pre-z_hi snapshots: fall back to the conservative full
+                # capacity (correct, just untrimmed until zones change)
+                inst.mirror.z_hi = (int(z["z_hi"]) if "z_hi" in z.files
+                                    else inst.mirror.max_zones)
                 inst.mirror._dirty = True
                 inst.mirror._zones_dirty = True
 

@@ -32,7 +32,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import enum
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -417,6 +417,19 @@ class ZoneTable:
 def time_lt(a_s: jax.Array, a_ns: jax.Array, b_s: jax.Array, b_ns: jax.Array) -> jax.Array:
     """Lexicographic ``(s, ns) < (s, ns)`` without int64."""
     return (a_s < b_s) | ((a_s == b_s) & (a_ns < b_ns))
+
+
+def pow2_at_least(n: int, floor: int = 8, cap: Optional[int] = None) -> int:
+    """Smallest power of two >= max(n, floor), clamped to ``cap``.
+
+    Published device tables (rules, zones) trim to this size so small
+    deployments never pay full-capacity dense kernels, while the
+    power-of-2 ladder bounds recompiles to log2(capacity) variants.
+    """
+    p = floor
+    while p < n:
+        p *= 2
+    return min(p, cap) if cap is not None else p
 
 
 def as_numpy(tree: Any) -> Any:

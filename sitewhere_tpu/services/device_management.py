@@ -37,7 +37,13 @@ import numpy as np
 
 from sitewhere_tpu.ids import NULL_ID, IdentityMap
 from sitewhere_tpu.ops.geo import pad_polygon
-from sitewhere_tpu.schema import AlertLevel, AssignmentStatus, Registry, ZoneTable
+from sitewhere_tpu.schema import (
+    AlertLevel,
+    AssignmentStatus,
+    Registry,
+    ZoneTable,
+    pow2_at_least as _pow2_at_least,
+)
 from sitewhere_tpu.services.common import (
     DuplicateToken,
     Entity,
@@ -234,6 +240,10 @@ class RegistryMirror:
         self.z_area = np.full(max_zones, NULL_ID, np.int32)
         self.z_verts = np.zeros((max_zones, max_verts, 2), np.float32)
         self.z_nvert = np.zeros(max_zones, np.int32)
+        # highest zone slot ever written + 1: the published table trims
+        # to the next power of two above this (zone ids mint low-first),
+        # so the dense [B, Z, V] geofence never pays for empty capacity
+        self.z_hi = 0
         self.z_condition = np.zeros(max_zones, np.int32)
         self.z_alert_code = np.full(max_zones, NULL_ID, np.int32)
         self.z_alert_level = np.full(max_zones, AlertLevel.WARNING, np.int32)
@@ -310,6 +320,7 @@ class RegistryMirror:
             self.z_condition[zone_id] = condition
             self.z_alert_code[zone_id] = alert_code
             self.z_alert_level[zone_id] = alert_level
+            self.z_hi = max(self.z_hi, zone_id + 1)
             self._zones_dirty = True
 
     def clear_zone_row(self, zone_id: int) -> None:
@@ -355,15 +366,21 @@ class RegistryMirror:
             if not self._zones_dirty and self._zones_cache is not None:
                 return self._zones_cache
             self._zones_dirty = False
+            # Trim to the smallest power of two covering every written
+            # slot (zone ids mint low-first, so the prefix is complete):
+            # an empty/small zone set must not make every pipeline step
+            # pay the full-capacity dense [B, Z, V] geofence.  Power-of-2
+            # sizing bounds recompiles at log2(capacity) shape variants.
+            z = _pow2_at_least(self.z_hi, cap=self.max_zones)
             self._zones_cache = ZoneTable(
-                active=jnp.asarray(self.z_active),
-                tenant_id=jnp.asarray(self.z_tenant),
-                area_id=jnp.asarray(self.z_area),
-                verts=jnp.asarray(self.z_verts),
-                nvert=jnp.asarray(self.z_nvert),
-                condition=jnp.asarray(self.z_condition),
-                alert_code=jnp.asarray(self.z_alert_code),
-                alert_level=jnp.asarray(self.z_alert_level),
+                active=jnp.asarray(self.z_active[:z]),
+                tenant_id=jnp.asarray(self.z_tenant[:z]),
+                area_id=jnp.asarray(self.z_area[:z]),
+                verts=jnp.asarray(self.z_verts[:z]),
+                nvert=jnp.asarray(self.z_nvert[:z]),
+                condition=jnp.asarray(self.z_condition[:z]),
+                alert_code=jnp.asarray(self.z_alert_code[:z]),
+                alert_level=jnp.asarray(self.z_alert_level[:z]),
             )
             return self._zones_cache
 

@@ -274,3 +274,37 @@ def test_dead_letter_retention_at_checkpoint(tmp_path):
     finally:
         a.stop()
         a.terminate()
+
+
+def test_zone_trim_survives_restore(tmp_path):
+    """z_hi (the published ZoneTable's pow2 trim bound) must persist:
+    a restored instance with zones beyond the trim floor must keep
+    firing their geofences."""
+    from tests.test_instance import make_config
+
+    inst = Instance(make_config(tmp_path))
+    inst.start()
+    dm = inst.device_management
+    dm.create_device_type(token="sensor", name="S")
+    dm.create_area_type(token="at", name="AT")
+    dm.create_area(token="area", area_type="at", name="A")
+    n_zones = 12  # beyond the pow2 trim floor of 8
+    for i in range(n_zones):
+        dm.create_zone(token=f"z-{i}", area="area", name=f"Z{i}",
+                       bounds=[(0.0, 0.0), (0.0, 10.0), (10.0, 10.0),
+                               (10.0, 0.0)])
+    inst.checkpointer.save()
+    inst.stop()
+    inst.terminate()
+
+    inst2 = Instance(make_config(tmp_path))
+    inst2.start()
+    try:
+        zones = inst2.mirror.publish_zones()
+        import numpy as np
+
+        assert zones.capacity >= n_zones
+        assert int(np.asarray(zones.active).sum()) == n_zones
+    finally:
+        inst2.stop()
+        inst2.terminate()
