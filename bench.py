@@ -194,10 +194,11 @@ def bench_pipeline() -> None:
     registry, state, rules, zones = build_tables(capacity, n_active)
     raw = host_batches(width, n_active, n_batches=8)
 
-    # Step interface is backend-adaptive, mirroring the dispatcher: on
-    # TPU the packed form (11 buffers/call instead of ~110) removes the
-    # per-call dispatch tax; the CPU backend materializes the packs as
-    # real memcpys and measures faster per-column (pipeline/packed.py).
+    # PURE-step interface choice (backend-adaptive; pipeline/packed.py):
+    # on TPU the packed form (11 buffers/call instead of ~110) removes
+    # the per-call dispatch tax; for a bare CPU step the repack memcpys
+    # make per-column faster.  The shipped DISPATCHER defaults packed on
+    # every backend — config 2 measures that path as deployed.
     use_packed = packed_step_default()
     if use_packed:
         tables = jax.jit(pack_tables)(registry, rules, zones)
@@ -516,7 +517,7 @@ def bench_multitenant() -> None:
 
     now = jnp.int32(1_753_800_000 + 10_000)
     missing_after = jnp.int32(3600)
-    use_packed = packed_step_default()  # mirror the dispatcher's choice
+    use_packed = packed_step_default()  # pure-step choice (see config 1)
     if use_packed:
         tables = jax.jit(pack_tables)(registry, rules, zones)
         carry = jax.jit(pack_state)(state)

@@ -216,10 +216,11 @@ class Instance(LifecycleComponent):
             resolve_alert=self.identity.alert_type.mint,
             invocations=self.identity.invocation,
             deadline_ms=float(self.config["pipeline.deadline_ms"]),
-            # Single-chip on TPU: emit plans in the packed wire form so
-            # the dispatcher drives the ~11-buffer packed step (the
-            # sharded step consumes per-column EventBatch plans; the CPU
-            # backend measures faster per-column — packed_step_default).
+            # Single-chip: emit plans in the packed wire form so the
+            # dispatcher drives the ~11-buffer packed step — the default
+            # on EVERY backend (_packed_step_enabled: the dispatcher's
+            # many-output egress favors packed even on CPU).  The
+            # sharded step consumes per-column EventBatch plans instead.
             emit_packed=(self.mesh is None and self._packed_step_enabled()),
         )
         self.dispatcher = self.add_child(PipelineDispatcher(
@@ -480,16 +481,22 @@ class Instance(LifecycleComponent):
 
     def _packed_step_enabled(self) -> bool:
         """Config ``pipeline.packed_step`` (true/false) pins the step
-        interface; the default ("auto") is backend-adaptive
-        (:func:`~sitewhere_tpu.pipeline.packed.packed_step_default`)."""
+        interface; the default is ON for the dispatcher on every
+        backend.  The PURE step is backend-adaptive (CPU pays the
+        repack; ``packed_step_default``), but the dispatcher's egress
+        fetches many output buffers per step, which the packed [10, B]
+        block collapses — measured on CPU: dispatcher path 253k → 327k
+        events/s, p99 15 → 13.5 ms; on TPU it also removes the ~30 ms
+        per-call dispatch tax."""
         cfg = self.config.get("pipeline.packed_step", "auto")
         if isinstance(cfg, bool):
             return cfg
         if str(cfg).lower() in ("true", "false"):
             return str(cfg).lower() == "true"
-        from sitewhere_tpu.pipeline.packed import packed_step_default
+        from sitewhere_tpu.pipeline.packed import packed_env_override
 
-        return packed_step_default()
+        env = packed_env_override()
+        return True if env is None else env
 
     def _tenant_dense_id(self, token: str) -> int:
         return self.identity.tenant.mint(token)

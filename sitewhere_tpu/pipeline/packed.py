@@ -201,22 +201,37 @@ def packed_pipeline_step(
     return pack_state(new_state), *pack_outputs(out)
 
 
-def packed_step_default() -> bool:
-    """Whether the dispatcher should drive the packed interface.
-
-    Backend-adaptive (same spirit as the sort-vs-scatter winner choice in
-    ``ops/scatter.py``): on TPU the per-call win (~100 fewer buffers per
-    step; dispatch cost scales with buffer count, ~30 ms/step measured
-    through a network-attached chip) dwarfs the repack's ~20 MB of fused
-    HBM traffic, while the CPU backend materializes the packs as real
-    memcpys and measures ~25% SLOWER per call — so CPU stays on the
-    per-column interface.  ``SW_TPU_PACKED_STEP=0/1`` overrides.
-    """
+def packed_env_override() -> Optional[bool]:
+    """``SW_TPU_PACKED_STEP`` as a tristate (None = unset) — the ONE
+    parser for every consumer, so the dispatcher default and the pure-
+    step choice can never disagree on what the variable means."""
     import os
 
     env = os.environ.get("SW_TPU_PACKED_STEP")
+    if env is None:
+        return None
+    return env.strip().lower() not in ("0", "false", "")
+
+
+def packed_step_default() -> bool:
+    """Interface choice for the PURE step (bench microbenchmarks).
+
+    Backend-adaptive (same spirit as the sort-vs-scatter winner choice
+    in ``ops/scatter.py``): on TPU the per-call win (~100 fewer buffers
+    per step; dispatch cost scales with buffer count, ~30 ms/step
+    measured through a network-attached chip) dwarfs the repack's
+    ~20 MB of fused HBM traffic, while the CPU backend materializes the
+    packs as real memcpys and measures ~25% SLOWER per bare call.
+
+    The DISPATCHER defaults packed on EVERY backend regardless
+    (``Instance._packed_step_enabled``): its egress fetches many output
+    buffers per step, which the packed [10, B] block collapses —
+    measured faster on CPU too.  ``SW_TPU_PACKED_STEP=0/1`` overrides
+    both.
+    """
+    env = packed_env_override()
     if env is not None:
-        return env not in ("0", "false", "")
+        return env
     import jax
 
     return jax.default_backend() == "tpu"

@@ -215,10 +215,12 @@ def test_packed_chain_donation():
 class TestPackedDispatcher:
     """The Instance dispatcher driving the packed step end-to-end.
 
-    CPU CI defaults to the per-column interface (packed_step_default), so
-    these force ``pipeline.packed_step`` on and re-run the key dispatcher
-    flows: persistence+state, derived-alert re-injection (PackedView's
-    host-side reconstruction), and auto-registration replay.
+    Packed is the dispatcher default on every backend; these PIN it on
+    via ``pipeline.packed_step`` (immune to env overrides) and run the
+    key dispatcher flows: persistence+state, derived-alert re-injection
+    (PackedView's host-side reconstruction), and auto-registration
+    replay.  ``test_per_column_dispatcher_still_works`` covers the
+    pinned-off branch.
     """
 
     @pytest.fixture()
@@ -334,3 +336,36 @@ class TestPackedDispatcher:
             "dev-1")["presence_missing"]
         assert instance.device_state.get_device_state(
             "dev-2")["presence_missing"]
+
+
+def test_per_column_dispatcher_still_works(tmp_path):
+    """pipeline.packed_step=False pins the per-column interface (the
+    sharded path's form) — kept covered now that packed is the single-
+    chip default."""
+    from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+
+    cfg = Config({
+        "instance": {"id": "percol-test",
+                     "data_dir": str(tmp_path / "data")},
+        "pipeline": {"width": 64, "registry_capacity": 1024,
+                     "mtype_slots": 4, "deadline_ms": 5.0,
+                     "n_shards": 1, "packed_step": False},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+    }, apply_env=False)
+    inst = Instance(cfg)
+    inst.start()
+    try:
+        assert not inst.batcher.emit_packed
+        inst.device_management.create_device_type(token="sensor", name="S")
+        inst.device_management.create_device(token="d", device_type="sensor")
+        inst.device_management.create_device_assignment(device="d")
+        inst.dispatcher.ingest(DecodedRequest(
+            kind=RequestKind.MEASUREMENT, device_token="d",
+            ts_s=1000, mtype="temp", value=1.0))
+        inst.dispatcher.flush()
+        assert inst.dispatcher.metrics_snapshot()["accepted"] == 1
+    finally:
+        inst.stop()
+        inst.terminate()
