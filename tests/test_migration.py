@@ -251,3 +251,70 @@ def test_kill_host_replace_with_new_loses_nothing(tmp_path):
         if replacement is not None:
             replacement.stop()
             replacement.terminate()
+
+
+@pytest.mark.slow
+def test_membership_change_over_rest(tmp_path):
+    """The ops surface: POST /api/instance/cluster/membership applies
+    the change (admin-only) and returns the handoff summary."""
+    import base64
+    import http.client
+
+    from sitewhere_tpu.web import WebServer
+
+    ports = [free_port(), free_port(), free_port()]
+    peers2 = [f"127.0.0.1:{p}" for p in ports[:2]]
+    peers3 = [f"127.0.0.1:{p}" for p in ports]
+    insts = [make_inst(tmp_path, p, ports, peers2) for p in range(2)]
+    for inst in insts:
+        inst.start()
+    toks = tokens_owned_by(0, 2, count=20)
+    seed(insts[0], toks)
+    third = None
+    web = WebServer(insts[0], port=0)
+    web.start()
+    try:
+        third = make_inst(tmp_path, 2, ports, peers3)
+        third.start()
+        third.device_management.create_device_type(token="sensor", name="S")
+
+        def req(method, path, body=None, auth=None):
+            conn = http.client.HTTPConnection("127.0.0.1", web.port,
+                                              timeout=15)
+            hdrs = {"Authorization": auth} if auth else {}
+            conn.request(method, path,
+                         json.dumps(body).encode() if body else None, hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            return resp.status, (json.loads(data) if data else None)
+
+        basic = base64.b64encode(b"admin:password").decode()
+        st, body = req("POST", "/api/jwt", auth=f"Basic {basic}")
+        admin = f"Bearer {body['token']}"
+
+        # non-admin cannot rebalance the cluster
+        insts[0].users.create_user(username="viewer", password="pw123456",
+                                   authorities=[])
+        st, body = req("POST", "/api/jwt", auth="Basic " + base64.b64encode(
+            b"viewer:pw123456").decode())
+        viewer = f"Bearer {body['token']}"
+        st, _ = req("POST", "/api/instance/cluster/membership",
+                    {"peers": peers3}, auth=viewer)
+        assert st == 403
+
+        # host 1 applies directly; host 0 over REST
+        insts[1].apply_membership_change(peers3)
+        st, body = req("POST", "/api/instance/cluster/membership",
+                       {"peers": peers3}, auth=admin)
+        assert st == 200, body
+        moving = [t for t in toks if owning_process(t, 3) == 2]
+        assert body["planned"] == len(moving)
+        assert body["failed"] == 0
+        for t in moving:
+            assert third.device_management.get_device(t) is not None
+    finally:
+        web.stop()
+        for inst in insts + ([third] if third else []):
+            inst.stop()
+            inst.terminate()
