@@ -216,12 +216,12 @@ class Instance(LifecycleComponent):
             resolve_alert=self.identity.alert_type.mint,
             invocations=self.identity.invocation,
             deadline_ms=float(self.config["pipeline.deadline_ms"]),
-            # Single-chip: emit plans in the packed wire form so the
-            # dispatcher drives the ~11-buffer packed step — the default
-            # on EVERY backend (_packed_step_enabled: the dispatcher's
-            # many-output egress favors packed even on CPU).  The
-            # sharded step consumes per-column EventBatch plans instead.
-            emit_packed=(self.mesh is None and self._packed_step_enabled()),
+            # Emit plans in the packed wire form so the dispatcher
+            # drives the ~11-buffer packed step — the default on EVERY
+            # backend and on the mesh (_packed_step_enabled: the
+            # dispatcher's many-output egress favors packed even on CPU;
+            # on a mesh, per-call placement scales with buffer count).
+            emit_packed=self._packed_step_enabled(),
         )
         self.dispatcher = self.add_child(PipelineDispatcher(
             batcher=self.batcher,

@@ -129,6 +129,93 @@ def build_sharded_step(mesh: Mesh, donate: bool = True):
     return jax.jit(mapped, donate_argnums=(1,) if donate else ())
 
 
+def build_sharded_packed_step(mesh: Mesh):
+    """The packed interface over the mesh (the multi-chip deployment
+    form): same local-step semantics as :func:`build_sharded_step`, but
+    the per-step host surface is the packed buffer set — batch crosses
+    as ``[12, B] + [4, B]`` sharded on axis 1, state rides as two wide
+    planes, outputs as one ``[10, B]`` block + psum-ed metrics.  Per-
+    call placement cost on a mesh scales with buffer count × hosts, so
+    this is the packed step's ~10× buffer reduction where it matters
+    most.  NO donation: the carry is the state manager's live epoch.
+    """
+    from sitewhere_tpu.pipeline.packed import (
+        pack_outputs,
+        pack_state,
+        unpack_batch,
+        unpack_state,
+        unpack_tables,
+    )
+
+    tables_specs = _packed_tables_specs()
+    # PackedState carries static pytree metadata (slot counts), so its
+    # spec is a bare PREFIX — both leaves shard the same way on axis 1.
+    state_specs = _PACKED_STATE_SPEC
+    in_specs = (tables_specs, state_specs,
+                P(None, SHARD_AXIS), P(None, SHARD_AXIS))
+    out_specs = (state_specs, P(None, SHARD_AXIS), P(), P(SHARD_AXIS))
+
+    def local_step(tables, ps, bi, bf):
+        registry, rules, zones = unpack_tables(tables)
+        state = unpack_state(ps)
+        batch = unpack_batch(bi, bf)
+
+        rows_local = registry.capacity
+        offset = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int32) * rows_local
+        local_ids = jnp.where(batch.device_id >= 0,
+                              batch.device_id - offset, -1)
+        new_state, out = pipeline_step(
+            registry, state, rules, zones,
+            batch.replace(device_id=local_ids))
+        oi, metrics, present = pack_outputs(out)
+        metrics = jax.lax.psum(metrics, SHARD_AXIS)
+        # derived-alert/enrich ids in `oi` are table indices (replicated
+        # tables → already global); device ids never leave the host cols
+        return pack_state(new_state), oi, metrics, present
+
+    mapped = shard_map(
+        local_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+# The packed-mesh sharding layout lives HERE, once: the shard_map specs
+# and every host-side placement read these, so they cannot drift.
+_PACKED_STATE_SPEC = P(None, SHARD_AXIS)
+
+
+def _packed_tables_specs():
+    from sitewhere_tpu.pipeline.packed import PackedTables
+
+    return PackedTables(
+        reg_i=P(None, SHARD_AXIS),   # registry shards by capacity
+        rules_i=P(), rules_f=P(), taus=P(),   # small broadcast tables
+        zones_i=P(), zones_v=P(),
+    )
+
+
+def place_packed_batch(mesh: Mesh, bi, bf):
+    """Device-put one packed wire batch sharded along its width axis."""
+    s = NamedSharding(mesh, _PACKED_STATE_SPEC)
+    return jax.device_put(bi, s), jax.device_put(bf, s)
+
+
+def place_packed_tables(mesh: Mesh, t):
+    """Device-put a PackedTables with its canonical mesh shardings."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        t, _packed_tables_specs())
+
+
+def place_packed_state(mesh: Mesh, ps):
+    """Device-put a PackedState sharded by capacity (no-op once the
+    epoch already carries the sharding, i.e. after the first step)."""
+    s = NamedSharding(mesh, _PACKED_STATE_SPEC)
+    return ps.replace(si=jax.device_put(ps.si, s),
+                      sf=jax.device_put(ps.sf, s))
+
+
 def place_inputs(
     mesh: Mesh,
     registry: Registry,

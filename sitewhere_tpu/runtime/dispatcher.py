@@ -111,31 +111,36 @@ class PipelineDispatcher(LifecycleComponent):
             # Multi-chip: shard_map step over the mesh (Kafka-partitioning
             # analog, SURVEY.md §2.4) — the batcher already routes each row
             # to the sub-batch of the shard owning its registry block.
-            from sitewhere_tpu.pipeline.sharded import build_sharded_step
+            # When the batcher emits packed plans, the packed mesh form
+            # runs instead (per-call placement cost on a mesh scales with
+            # buffer count × hosts; see build_sharded_packed_step).
+            from sitewhere_tpu.pipeline.sharded import (
+                build_sharded_packed_step,
+                build_sharded_step,
+            )
 
             self._step = build_sharded_step(mesh, donate=False)
+            self._packed_step = build_sharded_packed_step(mesh)
         else:
             self._step = jax.jit(pipeline_step)
             # Single-chip fast path: the packed step moves ~11 buffers per
             # call instead of ~110 — per-call dispatch scales with buffer
             # count, which measured ~30 ms/step at width 131k through a
             # network-attached chip (pipeline/packed.py).  Used whenever
-            # the batcher emits packed plans; the donated PackedState is
-            # the device-resident steady-state carry.
-            from sitewhere_tpu.pipeline.packed import (
-                pack_tables,
-                packed_pipeline_step,
-            )
+            # the batcher emits packed plans.  NO donation: the carry
+            # passed in is the state manager's LIVE epoch — donating it
+            # would leave concurrent readers (checkpointer, presence
+            # sweep, REST queries) holding deleted buffers until
+            # commit_packed lands.  Donation is for private carries
+            # (bench loops); here XLA just allocates fresh output
+            # buffers (~3 MB/step, HBM-trivial).
+            from sitewhere_tpu.pipeline.packed import packed_pipeline_step
 
-            # NO donation: the carry passed in is the state manager's
-            # LIVE epoch — donating it would leave concurrent readers
-            # (checkpointer, presence sweep, REST queries) holding
-            # deleted buffers until commit_packed lands.  Donation is for
-            # private carries (bench loops); here XLA just allocates
-            # fresh output buffers (~3 MB/step, HBM-trivial).
             self._packed_step = jax.jit(packed_pipeline_step)
-            self._pack_tables = jax.jit(pack_tables)
-            self._tables_cache: Optional[tuple] = None
+        from sitewhere_tpu.pipeline.packed import pack_tables
+
+        self._pack_tables = jax.jit(pack_tables)
+        self._tables_cache: Optional[tuple] = None
         # Identity-keyed cache of mesh-placed epochs: providers return the
         # same object while clean, so steady-state steps reuse the resident
         # sharded arrays instead of re-placing every step.
@@ -495,7 +500,10 @@ class PipelineDispatcher(LifecycleComponent):
 
     def _tables_packed(self):
         """PackedTables for the current provider epochs, identity-cached
-        (re-packs only when a registry/rule/zone epoch actually changed)."""
+        (re-packs only when a registry/rule/zone epoch actually changed).
+        On a mesh the pack is placed with its canonical shardings
+        (registry plane sharded by capacity, broadcast tables
+        replicated) so steady-state steps reuse the resident buffers."""
         reg = self.registry_provider()
         rules = self.rules_provider()
         zones = self.zones_provider()
@@ -503,6 +511,10 @@ class PipelineDispatcher(LifecycleComponent):
         if c is not None and c[0] is reg and c[1] is rules and c[2] is zones:
             return c[3]
         t = self._pack_tables(reg, rules, zones)
+        if self.mesh is not None:
+            from sitewhere_tpu.pipeline.sharded import place_packed_tables
+
+            t = place_packed_tables(self.mesh, t)
         self._tables_cache = (reg, rules, zones, t)
         return t
 
@@ -512,16 +524,26 @@ class PipelineDispatcher(LifecycleComponent):
         trace.record("batch.assemble", plan.max_wait_s,
                      rows=plan.n_events, fill=round(plan.fill, 3))
         with self._step_lock:
-            if self.mesh is None and plan.packed_i is not None:
+            if plan.packed_i is not None:
                 from sitewhere_tpu.pipeline.packed import PackedView
 
                 tables = self._tables_packed()
-                ps = self.state_manager.current_packed
+                epoch = self.state_manager.current_packed
+                ps = epoch
+                bi, bf = plan.packed_i, plan.packed_f
+                if self.mesh is not None:
+                    from sitewhere_tpu.pipeline.sharded import (
+                        place_packed_batch,
+                        place_packed_state,
+                    )
+
+                    bi, bf = place_packed_batch(self.mesh, bi, bf)
+                    ps = place_packed_state(self.mesh, ps)
                 with trace.span("step.dispatch").tag("rows", plan.n_events):
                     new_ps, oi, metrics, present = self._packed_step(
-                        tables, ps, plan.packed_i, plan.packed_f)
+                        tables, ps, bi, bf)
                     self.state_manager.commit_packed(
-                        new_ps, present_now=present, read_epoch=ps)
+                        new_ps, present_now=present, read_epoch=epoch)
                 out = PackedView(oi, metrics, present)
                 self.steps += 1
                 prev, self._inflight = (

@@ -132,3 +132,79 @@ def test_sharded_state_stays_sharded(mesh8):
     new_state2, _ = step(s_reg, new_state, s_rules, s_zones,
                          place_batch(mesh8, batch))
     assert int(new_state2.last_event_ts_s[3]) == 1000
+
+
+def test_sharded_packed_matches_single_chip(mesh8):
+    """The packed mesh form (deployment config): same outputs and state
+    as the single-chip unpacked step, through the [C, B]-sharded wire
+    interface."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sitewhere_tpu.pipeline.packed import (
+        PackedView,
+        pack_batch_host,
+        pack_state,
+        pack_tables,
+        unpack_state,
+    )
+    from sitewhere_tpu.pipeline.sharded import (
+        build_sharded_packed_step,
+        place_packed_batch,
+    )
+    from sitewhere_tpu.schema import as_numpy
+
+    rows = [
+        measurement(device=3, mtype=0, value=75.0, ts=1000),
+        measurement(device=9, mtype=0, value=25.0, ts=1000),
+        location(device=17, lon=5.0, lat=5.0, ts=1000),
+        location(device=25, lon=50.0, lat=5.0, ts=1000),
+        measurement(device=63, mtype=1, value=1.0, ts=1000),
+        measurement(device=200, ts=1000),
+    ]
+    batch = route_rows(rows)
+
+    reg = make_registry(capacity=CAP, n_devices=CAP)
+    rules = threshold_rule(RuleTable.empty(4), 0, mtype=0, op=0,
+                           threshold=50.0, alert_code=200)
+    zones = square_zone(ZoneTable.empty(4), 0, 0, 0, 10, 10, alert_code=100)
+    ref_state, ref_out = jax.jit(pipeline_step)(
+        reg, DeviceState.empty(CAP), rules, zones, batch)
+    ref = as_numpy(ref_out)
+
+    # packed + placed inputs
+    tables = pack_tables(reg, rules, zones)
+    tables = tables.replace(
+        reg_i=jax.device_put(tables.reg_i,
+                             NamedSharding(mesh8, P(None, "shard"))))
+    ps = pack_state(DeviceState.empty(CAP))
+    ps = ps.replace(
+        si=jax.device_put(ps.si, NamedSharding(mesh8, P(None, "shard"))),
+        sf=jax.device_put(ps.sf, NamedSharding(mesh8, P(None, "shard"))))
+    cols = {f: np.asarray(getattr(as_numpy(batch), f))
+            for f in batch.__dataclass_fields__}
+    bi, bf = pack_batch_host(cols, WIDTH)
+    bi, bf = place_packed_batch(mesh8, bi, bf)
+
+    step = build_sharded_packed_step(mesh8)
+    new_ps, oi, metrics, present = step(tables, ps, bi, bf)
+
+    view = PackedView(oi, metrics, present)
+    np.testing.assert_array_equal(np.asarray(ref.accepted), view.accepted)
+    np.testing.assert_array_equal(np.asarray(ref.unregistered),
+                                  view.unregistered)
+    np.testing.assert_array_equal(np.asarray(ref.rule_id), view.rule_id)
+    np.testing.assert_array_equal(np.asarray(ref.zone_id), view.zone_id)
+    np.testing.assert_array_equal(np.asarray(ref.area_id), view.area_id)
+    np.testing.assert_array_equal(np.asarray(ref.present_now),
+                                  np.asarray(view.present_now))
+    got_state = unpack_state(new_ps)
+    for f in ("last_event_ts_s", "last_values", "last_lat",
+              "last_event_type"):
+        np.testing.assert_array_equal(np.asarray(getattr(ref_state, f)),
+                                      np.asarray(getattr(got_state, f)),
+                                      err_msg=f)
+    m = view.metrics
+    assert int(m.processed) == 6 and int(m.accepted) == 5
+    assert int(m.threshold_alerts) == 1 and int(m.zone_alerts) == 1
+    # steady-state: the packed carry keeps its sharding
+    assert new_ps.si.sharding == ps.si.sharding
