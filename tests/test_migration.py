@@ -318,3 +318,48 @@ def test_membership_change_over_rest(tmp_path):
         for inst in insts + ([third] if third else []):
             inst.stop()
             inst.terminate()
+
+
+def test_forwarder_memory_mode_requeue(tmp_path):
+    """apply_membership in memory-only mode (no data_dir): buffered rows
+    for a departed peer re-route under the new map instead of waiting
+    forever or dead-lettering."""
+    from sitewhere_tpu.rpc.forward import HostForwarder
+
+    class FakeDispatcher:
+        def __init__(self):
+            self.lines = []
+
+        def ingest_wire_lines(self, payload, source_id="x"):
+            lines = [l for l in payload.split(b"\n") if l.strip()]
+            self.lines.extend(lines)
+            return len(lines)
+
+    disp = FakeDispatcher()
+    # P=3, this host is 0; peers 1 and 2 have no demux (None) so their
+    # rows just buffer (memory mode, never flushed during the test)
+    fwd = HostForwarder(disp, process_id=0,
+                        peer_demuxes={0: None, 1: None, 2: None},
+                        deadline_ms=60_000.0)
+    toks = {p: tokens_owned_by(p, 3, count=4) for p in range(3)}
+    lines = [json.dumps({"deviceToken": t, "type": "Measurement",
+                         "request": {"name": "x", "value": 1,
+                                     "eventDate": 1}}).encode()
+             for p in range(3) for t in toks[p]]
+    fwd.ingest_payload(b"\n".join(lines))
+    assert len(disp.lines) == 4              # only host-0 rows local
+    assert sum(len(v) for v in fwd._buffers.values()) == 8
+
+    # membership shrinks to [0, 1]: peer 2's buffered rows re-split —
+    # each becomes local or peer-1-owned under the NEW 2-way map
+    requeued = fwd.apply_membership({0: None, 1: None}, process_id=0)
+    assert requeued == 8
+    expect_local = [t for p in (1, 2) for t in toks[p]
+                    if owning_process(t, 2) == 0]
+    got_tokens = sorted(json.loads(l)["deviceToken"]
+                        for l in disp.lines[4:])
+    assert got_tokens == sorted(expect_local)
+    # the rest sit buffered for peer 1 under the new map
+    buffered = sum(len(v) for v in fwd._buffers.values())
+    assert buffered == 8 - len(expect_local)
+    assert fwd.dead_lettered == 0
