@@ -16,7 +16,11 @@ What it shows:
    in-place, host 1's rows spool and ship over the fabric;
 3. federated search and cluster topology read across BOTH hosts from
    either one;
-4. a command invoked on host 0 for a host-1 device routes to the owner.
+4. a command invoked on host 0 for a host-1 device routes to the owner;
+5. the fleet GROWS to three hosts (``apply_membership_change``, the
+   ``POST /api/instance/cluster/membership`` ops action): devices whose
+   new rendezvous owner is the joiner are handed off — registry rows,
+   assignment, newest-wins device state — and fresh traffic follows.
 """
 
 import os
@@ -112,7 +116,34 @@ assert result["host"] == "host-1"
 insts[1].event_store.flush()
 assert fed.search().total == 41
 
-for inst in insts:
+# --- the fleet grows: a third host joins, ownership rebalances ----------
+port3 = free_port()
+peers3 = peers + [f"127.0.0.1:{port3}"]
+third = Instance(Config({
+    "instance": {"id": "host-2", "data_dir": f"{tmp}/host2"},
+    "pipeline": {"width": 128, "registry_capacity": 1024,
+                 "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+    "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+    "rpc": {"server": {"enabled": True, "host": "127.0.0.1",
+                       "port": port3},
+            "process_id": 2, "peers": peers3,
+            "forward_deadline_ms": 10.0},
+    "security": {"jwt_secret": "demo-shared-secret"},
+}, apply_env=False))
+third.start()
+third.device_management.create_device_type(token="sensor", name="Sensor")
+
+summaries = [inst.apply_membership_change(peers3) for inst in insts]
+moved = sum(s["moved"] for s in summaries)
+print(f"membership 2 -> 3 hosts: {moved} device(s) handed off "
+      f"(rendezvous remaps ~1/(P+1) of the fleet)")
+for p in range(2):
+    if owning_process(tok[p], 3) == 2:
+        st = third.device_state.get_device_state(tok[p])
+        print(f"  {tok[p]} now answers on host-2 "
+              f"(last_event_ts={st['last_event_ts_s']})")
+
+for inst in insts + [third]:
     inst.stop()
     inst.terminate()
 print("multihost demo OK")
