@@ -331,23 +331,42 @@ def _mask_grid(mask: int, n: int) -> np.ndarray:
     return _MASKS[mask](r, c)
 
 
+def _run_penalty(grid: np.ndarray) -> int:
+    """Rule 1 over rows: sum of (3 + len - 5) for same-color runs >= 5."""
+    rows, n = grid.shape
+    change = np.ones((rows, n), bool)
+    change[:, 1:] = grid[:, 1:] != grid[:, :-1]
+    # run id per cell, disambiguated across rows; bincount = run lengths
+    ids = np.cumsum(change, axis=1) + (
+        np.arange(rows)[:, None] * (n + 1))
+    lengths = np.bincount(ids.ravel())
+    runs = lengths[lengths >= 5]
+    return int((runs - 2).sum())  # 3 + len - 5
+
+
+def _finder_penalty(grid: np.ndarray) -> int:
+    """Rule 3 over rows: 40 per 1011101 core with 4 light modules on a
+    side (truncated border windows do not count, matching the spec)."""
+    from numpy.lib.stride_tricks import sliding_window_view
+
+    # border sentinel 2: never equal to light (0), so a flank that runs
+    # off the symbol edge cannot satisfy the 4-light requirement
+    pad = np.pad(grid.astype(np.int8), ((0, 0), (4, 4)),
+                 constant_values=2)
+    win = sliding_window_view(pad, 15, axis=1)  # [rows, n - 6, 15]
+    pat = np.array([1, 0, 1, 1, 1, 0, 1], np.int8)
+    core = (win[:, :, 4:11] == pat).all(axis=2)
+    before = (win[:, :, 0:4] == 0).all(axis=2)
+    after = (win[:, :, 11:15] == 0).all(axis=2)
+    return 40 * int((core & (before | after)).sum())
+
+
 def _penalty(mat: np.ndarray) -> int:
     """The four penalty rules of spec §8.8.2 (vectorized)."""
     n = mat.shape[0]
     score = 0
     # rule 1: runs of >= 5 same-color modules, rows and columns
-    for grid in (mat, mat.T):
-        for row in grid:
-            run = 1
-            for i in range(1, n):
-                if row[i] == row[i - 1]:
-                    run += 1
-                else:
-                    if run >= 5:
-                        score += 3 + run - 5
-                    run = 1
-            if run >= 5:
-                score += 3 + run - 5
+    score += _run_penalty(mat) + _run_penalty(mat.T)
     # rule 2: 2x2 blocks of same color
     same = (
         (mat[:-1, :-1] == mat[:-1, 1:])
@@ -356,20 +375,7 @@ def _penalty(mat: np.ndarray) -> int:
     )
     score += 3 * int(same.sum())
     # rule 3: finder-like 1011101 pattern with 4 light modules on either side
-    pat = np.array([1, 0, 1, 1, 1, 0, 1], dtype=np.uint8)
-    light4 = np.zeros(4, dtype=np.uint8)
-    for grid in (mat, mat.T):
-        for row in grid:
-            row = np.asarray(row)
-            for i in range(n - 6):
-                if not np.array_equal(row[i : i + 7], pat):
-                    continue
-                before = row[max(0, i - 4) : i]
-                after = row[i + 7 : i + 11]
-                if (len(before) == 4 and np.array_equal(before, light4)) or (
-                    len(after) == 4 and np.array_equal(after, light4)
-                ):
-                    score += 40
+    score += _finder_penalty(mat) + _finder_penalty(mat.T)
     # rule 4: dark-module proportion deviation from 50%
     dark_pct = 100.0 * mat.sum() / (n * n)
     score += 10 * int(abs(dark_pct - 50) // 5)

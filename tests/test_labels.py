@@ -141,3 +141,58 @@ class TestManager:
         assert len(pngs) == 4
         sizes = {read_png_size(p) for p in pngs}
         assert len(sizes) == 1  # uniform version ⇒ uniform image size
+
+
+def test_penalty_matches_naive_reference():
+    """The vectorized mask penalty must score exactly like a literal
+    reading of spec 8.8.2 — a drift would silently change mask choices
+    (still decodable, but no longer the spec-optimal symbol)."""
+    import numpy as np
+
+    from sitewhere_tpu.labels.qr import _penalty
+
+    def naive(mat):
+        n = mat.shape[0]
+        score = 0
+        for grid in (mat, mat.T):
+            for row in grid:
+                run = 1
+                for i in range(1, n):
+                    if row[i] == row[i - 1]:
+                        run += 1
+                    else:
+                        if run >= 5:
+                            score += 3 + run - 5
+                        run = 1
+                if run >= 5:
+                    score += 3 + run - 5
+        same = ((mat[:-1, :-1] == mat[:-1, 1:])
+                & (mat[:-1, :-1] == mat[1:, :-1])
+                & (mat[:-1, :-1] == mat[1:, 1:]))
+        score += 3 * int(same.sum())
+        pat = [1, 0, 1, 1, 1, 0, 1]
+        for grid in (mat, mat.T):
+            for row in grid:
+                for i in range(n - 6):
+                    if list(row[i:i + 7]) != pat:
+                        continue
+                    before = row[max(0, i - 4):i]
+                    after = row[i + 7:i + 11]
+                    if (len(before) == 4 and not before.any()) or (
+                            len(after) == 4 and not after.any()):
+                        score += 40
+        dark_pct = 100.0 * mat.sum() / (n * n)
+        score += 10 * int(abs(dark_pct - 50) // 5)
+        return score
+
+    rng = np.random.default_rng(3)
+    for trial in range(30):
+        n = int(rng.integers(21, 46))
+        mat = (rng.random((n, n)) < rng.uniform(0.2, 0.8)).astype(np.uint8)
+        assert _penalty(mat) == naive(mat), trial
+    # craft a matrix with finder patterns at edges (flank truncation)
+    mat = np.zeros((21, 21), np.uint8)
+    mat[0, :7] = [1, 0, 1, 1, 1, 0, 1]       # truncated before-flank
+    mat[5, 4:11] = [1, 0, 1, 1, 1, 0, 1]     # full light flank both sides
+    mat[20, 14:21] = [1, 0, 1, 1, 1, 0, 1]   # truncated after-flank
+    assert _penalty(mat) == naive(mat)
