@@ -494,3 +494,31 @@ def test_index_push_bounded_buffer_drops_oldest():
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_index_push_custom_bulk_format():
+    """An Elasticsearch-style _bulk NDJSON builder plugs in unchanged."""
+    from sitewhere_tpu.outbound import IndexPushConnector
+
+    def es_bulk(docs):
+        lines = []
+        for d in docs:
+            lines.append(json.dumps({"index": {"_index": "events"}}))
+            lines.append(json.dumps(d))
+        return ("\n".join(lines) + "\n").encode()
+
+    srv = _http_server()
+    try:
+        c = IndexPushConnector(
+            "es", f"http://127.0.0.1:{srv.server_address[1]}/_bulk",
+            bulk_rows=2, bulk_interval_s=3600.0, bulk_format=es_bulk)
+        c.process_batch(_cols(2), np.ones(2, np.bool_))
+        assert len(srv.requests) == 1
+        body = srv.requests[0][2].decode().strip().split("\n")
+        assert len(body) == 4  # action+doc per event
+        assert json.loads(body[0]) == {"index": {"_index": "events"}}
+        assert json.loads(body[1])["deviceId"] == 0
+        c.stop()
+    finally:
+        srv.shutdown()
+        srv.server_close()
