@@ -1,0 +1,122 @@
+"""The bench supervisor's final stdout line must fit the driver's tail.
+
+The external driver that records bench output keeps only a bounded (~2KB)
+tail of stdout and parses the LAST line.  Round 4's headline was lost to
+exactly this: a 3.6KB final line got its front (metric/value/backend)
+clipped off and recorded as unparseable.  These tests pin the compaction
+contract: whatever the summary accumulates — cached provenance, attempt
+records, the attached CPU-fallback doc — the final line stays under
+``bench._FINAL_MAX_BYTES`` and keeps the essential fields intact.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _round4_shaped_summary():
+    """A summary doc shaped like round 4's 3.6KB worst case."""
+    configs = {
+        "1": {"metric": "pipeline_events_per_sec_per_chip",
+              "value": 2807355.0, "unit": "events/s", "vs_baseline": 2.807,
+              "backend": "tpu-cached",
+              "cache_captured_at": "2026-07-30T08:40:00Z"},
+        "2": {"metric": "pipeline_events_per_sec_per_chip",
+              "value": 232000.0, "unit": "events/s", "vs_baseline": 0.232,
+              "backend": "cpu-fallback", "latency_p50_ms": 12.088,
+              "latency_p99_ms": 12.203, "latency_target_met": False},
+        "3": {"metric": "analytics_events_per_sec_per_chip",
+              "value": 3539591.6, "unit": "events/s", "vs_baseline": 3.54,
+              "backend": "cpu-fallback"},
+        "4": {"metric": "multitenant_events_per_sec_per_chip",
+              "value": 377955.5, "unit": "events/s", "vs_baseline": 0.378,
+              "backend": "cpu-fallback"},
+        "5": {"metric": "media_label_ops_per_sec", "value": 40193.7,
+              "unit": "ops/s", "stream_mb_per_sec": 163.8,
+              "qr_labels_per_sec": 196.3},
+    }
+    return {
+        "metric": "pipeline_events_per_sec_per_chip", "value": 2807355.0,
+        "unit": "events/s", "vs_baseline": 2.807, "batch_width": 131072,
+        "backend": "tpu-cached", "geo_pallas": True, "host_rtt_ms": 71.0,
+        "note": "n" * 160,
+        "cache_captured_at": "2026-07-30T08:40:00Z",
+        "cache_git_sha": "5a5217c (round 3 mid-round; pre-dates the packed "
+                         "step interface)",
+        "cache_attempts": [{"phase": "cpu-fallback", "rc": 0,
+                            "reason": "exit", "elapsed_s": 9.5}] * 3,
+        "cache_source": "s" * 200,
+        "cpu_fallback": {"metric": "pipeline_events_per_sec_per_chip",
+                         "value": 500000.0, "note": "z" * 120},
+        "configs": configs,
+        "device_latency_target_met": None,
+        "latency_p99_ms": 12.203, "latency_target_met": False,
+        "latency_backend": "cpu-fallback",
+        "latency_path": "dispatcher bytes-in -> egress-out "
+                        "(config 2, backend=cpu-fallback)",
+        "attempts": [{"phase": "tunnel-probe", "rc": -1,
+                      "reason": "timeout after 75s", "elapsed_s": 75.1,
+                      "tpu": False, "stderr_tail": "w" * 300}]
+                    + [{"phase": f"c{c}-{k}", "rc": 0, "reason": "exit",
+                        "elapsed_s": 7.0, "stderr_tail": "e" * 200}
+                       for c in range(1, 6) for k in ("cpu", "tpu")],
+    }
+
+
+def test_round4_worst_case_fits_and_keeps_essentials():
+    doc = _round4_shaped_summary()
+    assert len(json.dumps(doc)) > 2000  # genuinely past the driver wall
+    compact = bench._compact_final(doc)
+    line = json.dumps(compact)
+    assert len(line) <= bench._FINAL_MAX_BYTES
+    # essentials survive
+    assert compact["metric"] == "pipeline_events_per_sec_per_chip"
+    assert compact["value"] == 2807355.0
+    assert compact["unit"] == "events/s"
+    assert compact["vs_baseline"] == 2.807
+    assert compact["backend"] == "tpu-cached"
+    assert "git_sha" in compact
+    # the bulky fields are gone
+    for key in ("attempts", "cache_attempts", "cpu_fallback", "note",
+                "cache_source"):
+        assert key not in compact
+    # per-config summary survives in compact form (no per-entry metric)
+    assert set(compact["configs"]) == {"1", "2", "3", "4", "5"}
+    assert "metric" not in compact["configs"]["1"]
+    assert compact["configs"]["2"]["latency_p99_ms"] == 12.203
+    # the whole line round-trips
+    assert json.loads(line) == compact
+
+
+def test_pathological_doc_still_fits():
+    """Even absurd inflation cannot push the final line past the wall."""
+    doc = _round4_shaped_summary()
+    doc["configs"] = {str(k): {"value": float(k), "unit": "u" * 50,
+                               "vs_baseline": 1.0, "backend": "b" * 40,
+                               "cache_captured_at": "T" * 30}
+                      for k in range(1, 30)}
+    compact = bench._compact_final(doc)
+    assert len(json.dumps(compact)) <= bench._FINAL_MAX_BYTES
+    assert compact["metric"] == "pipeline_events_per_sec_per_chip"
+    assert compact["value"] == 2807355.0
+
+
+def test_minimal_doc_passes_through():
+    doc = {"metric": "m", "value": 1.0, "unit": "events/s",
+           "vs_baseline": 0.5, "backend": "tpu"}
+    compact = bench._compact_final(doc)
+    for k, v in doc.items():
+        assert compact[k] == v
+
+
+@pytest.mark.parametrize("budget", [bench._FINAL_MAX_BYTES])
+def test_wall_is_below_driver_tail(budget):
+    """The driver keeps ~2000 bytes; our wall must leave slack for the
+    newline and any trailing partial diagnostics."""
+    assert budget <= 1500
