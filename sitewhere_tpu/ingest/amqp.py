@@ -29,7 +29,8 @@ import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
 
 from sitewhere_tpu.ingest.sources import Receiver, logger
 
@@ -62,6 +63,7 @@ BASIC_CONSUME = (60, 20)
 BASIC_CONSUME_OK = (60, 21)
 BASIC_DELIVER = (60, 60)
 BASIC_ACK = (60, 80)
+BASIC_NACK = (60, 120)
 
 
 class AmqpError(Exception):
@@ -169,7 +171,17 @@ class AmqpReceiver(Receiver):
         self._sock: Optional[socket.socket] = None
         self.connects = 0
         self.acked = 0
+        self.nacked = 0
         self.emit_errors = 0
+        # consecutive sink failures → escalating pre-nack delay, so a
+        # persistently failing sink (nack → broker requeues near the
+        # head → instant redelivery to this sole consumer) degrades to a
+        # slow retry loop, not a CPU-burning redeliver/nack spin
+        self._nack_streak = 0
+        # Frames parsed past the one a handshake step awaited (the broker
+        # may coalesce e.g. consume-ok + the first deliver into one TCP
+        # segment); _consume drains these before its first recv.
+        self._pending: Deque[Tuple[int, int, bytes]] = deque()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -200,10 +212,16 @@ class AmqpReceiver(Receiver):
     def _expect(self, sock: socket.socket, reader: FrameReader,
                 cm: Tuple[int, int]) -> bytes:
         """Read frames until the wanted method arrives on any channel;
-        heartbeats are tolerated, anything else is a protocol error."""
-        pending: List[Tuple[int, int, bytes]] = []
+        heartbeats are tolerated, anything else is a protocol error.
+
+        Frames the broker coalesced into the same TCP segment AFTER the
+        awaited method (e.g. a basic.deliver right behind consume-ok)
+        stay on ``self._pending`` for the consume loop — returning
+        mid-batch must not drop them, or they would sit unacked at the
+        broker forever while eating prefetch window."""
         while True:
-            for ftype, channel, payload in pending:
+            while self._pending:
+                ftype, channel, payload = self._pending.popleft()
                 if ftype == FRAME_HEARTBEAT:
                     continue
                 if ftype != FRAME_METHOD or len(payload) < 4:
@@ -219,7 +237,7 @@ class AmqpReceiver(Receiver):
             data = sock.recv(65536)
             if not data:
                 raise AmqpError("broker closed during handshake")
-            pending = reader.feed(data)
+            self._pending.extend(reader.feed(data))
 
     def _connect(self) -> Tuple[socket.socket, FrameReader, float]:
         sock = socket.create_connection((self.host, self.port), timeout=10)
@@ -235,6 +253,7 @@ class AmqpReceiver(Receiver):
     def _handshake(self, sock) -> Tuple[socket.socket, FrameReader, float]:
         sock.settimeout(10)
         reader = FrameReader()
+        self._pending.clear()  # nothing carried over from a dead session
         sock.sendall(PROTOCOL_HEADER)
         self._expect(sock, reader, CONNECTION_START)
         response = b"\x00" + self.username.encode() + b"\x00" + \
@@ -324,14 +343,20 @@ class AmqpReceiver(Receiver):
                 if now - last_tx >= heartbeat:
                     sock.sendall(frame(FRAME_HEARTBEAT, 0, b""))
                     last_tx = now
-            try:
-                data = sock.recv(65536)
-            except socket.timeout:
-                continue
-            if not data:
-                raise AmqpError("connection closed by broker")
-            last_rx = time.monotonic()
-            for ftype, channel, payload in reader.feed(data):
+            if self._pending:
+                # deliveries the handshake's _expect already parsed
+                frames = list(self._pending)
+                self._pending.clear()
+            else:
+                try:
+                    data = sock.recv(65536)
+                except socket.timeout:
+                    continue
+                if not data:
+                    raise AmqpError("connection closed by broker")
+                last_rx = time.monotonic()
+                frames = reader.feed(data)
+            for ftype, channel, payload in frames:
                 if ftype == FRAME_HEARTBEAT:
                     continue
                 if ftype == FRAME_METHOD:
@@ -368,14 +393,31 @@ class AmqpReceiver(Receiver):
                 last_tx: float) -> float:
         """Sink the payload; ack ONLY on acceptance (redelivery covers a
         crash; a poison payload dead-letters in the sink and is acked so
-        it does not loop forever)."""
+        it does not loop forever).
+
+        A sink that RAISES (transient failure: journal full, downstream
+        stall) gets ``basic.nack`` with requeue — leaving the delivery
+        unacked would strand it until connection close and, after
+        ``prefetch`` such failures, stall the consumer forever on an
+        otherwise-healthy session.  Consecutive failures back off
+        (50 ms doubling to 1 s) before the nack, because the broker
+        redelivers a requeued message to this sole consumer immediately."""
         try:
             self._emit(payload)
         except Exception:
             self.emit_errors += 1
-            logger.exception("%s: sink rejected payload; leaving unacked",
-                             self.name)
-            return last_tx
+            self.nacked += 1
+            self._nack_streak += 1
+            logger.exception("%s: sink rejected payload; nack + requeue "
+                             "(streak %d)", self.name, self._nack_streak)
+            delay = min(0.05 * (2 ** min(self._nack_streak - 1, 10)), 1.0)
+            self._stop_evt.wait(delay)
+            # bits: 0x01 multiple, 0x02 requeue → requeue only
+            sock.sendall(method_frame(
+                self.CHANNEL, BASIC_NACK,
+                struct.pack(">QB", delivery_tag, 0x02)))
+            return time.monotonic()
+        self._nack_streak = 0
         sock.sendall(method_frame(
             self.CHANNEL, BASIC_ACK,
             struct.pack(">QB", delivery_tag, 0)))

@@ -257,16 +257,20 @@ class HostForwarder(LifecycleComponent):
         locally."""
         from sitewhere_tpu.ingest.decoders import encode_envelope
 
-        local = []
-        remote: Dict[int, List[bytes]] = {}
-        for req in reqs:
-            owner = owning_process(req.device_token, self.n_processes)
-            if owner == self.process_id:
-                local.append(req)
-            else:
-                remote.setdefault(owner, []).append(encode_envelope(req))
-        for owner, lines in remote.items():
-            self._buffer(owner, lines)
+        while True:
+            with self._lock:
+                gen, n, pid = (self._member_gen, self.n_processes,
+                               self.process_id)
+            local = []
+            remote: Dict[int, List[bytes]] = {}
+            for req in reqs:
+                owner = owning_process(req.device_token, n)
+                if owner == pid:
+                    local.append(req)
+                else:
+                    remote.setdefault(owner, []).append(encode_envelope(req))
+            if self._route_remote(remote, gen):
+                break  # else: membership changed mid-split; recompute
         if local:
             # A split payload must NOT journal whole here: replaying it
             # would re-ingest the remote rows on the wrong host.  Journal
@@ -285,11 +289,16 @@ class HostForwarder(LifecycleComponent):
         where the device's shard lives)."""
         from sitewhere_tpu.ingest.decoders import encode_envelope
 
-        owner = owning_process(req.device_token, self.n_processes)
-        if owner == self.process_id:
-            self.dispatcher.ingest_registration(req, payload)
-        else:
-            self._buffer(owner, [encode_envelope(req)])
+        while True:
+            with self._lock:
+                gen, n, pid = (self._member_gen, self.n_processes,
+                               self.process_id)
+            owner = owning_process(req.device_token, n)
+            if owner == pid:
+                self.dispatcher.ingest_registration(req, payload)
+                return
+            if self._route_remote({owner: [encode_envelope(req)]}, gen):
+                return  # else: membership changed; recompute the owner
 
     def ingest_host_request(self, req, payload: bytes = b"") -> None:
         """Host-plane requests (device streams) route like registrations:
@@ -298,21 +307,17 @@ class HostForwarder(LifecycleComponent):
         it through ``on_host_request`` (set by the instance)."""
         from sitewhere_tpu.ingest.decoders import encode_envelope
 
-        owner = owning_process(req.device_token, self.n_processes)
-        if owner == self.process_id:
-            if self.on_host_request is not None:
-                self.on_host_request(req, payload)
-        else:
-            self._buffer(owner, [encode_envelope(req)])
-
-    def _buffer(self, owner: int, lines: List[bytes]) -> None:
-        """Buffer one owner's lines under the CURRENT membership (the
-        single-owner callers' form of :meth:`_route_remote`)."""
         while True:
             with self._lock:
-                gen = self._member_gen
-            if self._route_remote({owner: lines}, gen):
+                gen, n, pid = (self._member_gen, self.n_processes,
+                               self.process_id)
+            owner = owning_process(req.device_token, n)
+            if owner == pid:
+                if self.on_host_request is not None:
+                    self.on_host_request(req, payload)
                 return
+            if self._route_remote({owner: [encode_envelope(req)]}, gen):
+                return  # else: membership changed; recompute the owner
 
     def _route_remote(self, remote: Dict[int, List[bytes]],
                       gen: int) -> bool:

@@ -136,7 +136,7 @@ def import_devices(inst, docs: List[dict]) -> dict:
                         a[ref] = None
                 try:
                     dm.create_device_assignment(
-                        token=str(a.get("token") or None) or None,
+                        token=(str(a["token"]) if a.get("token") else None),
                         device=token,
                         customer=a.get("customer"),
                         area=a.get("area"),

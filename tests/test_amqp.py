@@ -14,6 +14,7 @@ import pytest
 
 from sitewhere_tpu.ingest.amqp import (
     BASIC_ACK,
+    BASIC_NACK,
     BASIC_CONSUME,
     BASIC_CONSUME_OK,
     BASIC_DELIVER,
@@ -51,11 +52,13 @@ class MiniAmqpBroker:
     across several body frames)."""
 
     def __init__(self, heartbeat=0, body_frame_size=0,
-                 drop_first_session=False):
+                 drop_first_session=False, coalesce_first_delivery=False):
         self.heartbeat = heartbeat
         self.body_frame_size = body_frame_size
         self.drop_first_session = drop_first_session
+        self.coalesce_first_delivery = coalesce_first_delivery
         self.acks = []
+        self.nacks = []
         self.declares = []
         self.auth = None
         self.sessions = 0
@@ -149,10 +152,23 @@ class MiniAmqpBroker:
         conn.sendall(method_frame(ch, QUEUE_DECLARE_OK, shortstr(qname)
                                   + struct.pack(">II", 0, 0)))
         self._recv_method(conn, reader, BASIC_CONSUME)
-        conn.sendall(method_frame(ch, BASIC_CONSUME_OK, shortstr("ctag-1")))
-
-        # deliver queued payloads; keep reading acks
         tag = 0
+        ok = method_frame(ch, BASIC_CONSUME_OK, shortstr("ctag-1"))
+        if self.coalesce_first_delivery:
+            # one TCP segment: consume-ok + every already-queued delivery
+            # (what a real broker's socket can do under load)
+            with self._lock:
+                sendables = self._to_send[:]
+                self._to_send.clear()
+            for payload in sendables:
+                tag += 1
+                ok += self._delivery_frames(ch, tag, payload)
+        conn.sendall(ok)
+
+        # deliver queued payloads; keep reading acks.  Nacked-with-requeue
+        # deliveries go back on the queue and REDELIVER immediately under
+        # a fresh tag, like RabbitMQ does for a sole consumer.
+        unacked = {}
         conn.settimeout(0.05)
         while self._alive:
             with self._lock:
@@ -160,17 +176,8 @@ class MiniAmqpBroker:
                 self._to_send.clear()
             for payload in sendables:
                 tag += 1
-                conn.sendall(method_frame(ch, BASIC_DELIVER,
-                             shortstr("ctag-1") + struct.pack(">QB", tag, 0)
-                             + shortstr("") + shortstr("rk")))
-                conn.sendall(frame(FRAME_HEADER, ch, struct.pack(
-                    ">HHQH", 60, 0, len(payload), 0)))
-                step = self.body_frame_size or len(payload) or 1
-                for lo in range(0, len(payload), step):
-                    conn.sendall(frame(FRAME_BODY, ch,
-                                       payload[lo: lo + step]))
-                if not payload:
-                    conn.sendall(frame(FRAME_BODY, ch, b""))
+                unacked[tag] = payload
+                conn.sendall(self._delivery_frames(ch, tag, payload))
             try:
                 data = conn.recv(65536)
             except socket.timeout:
@@ -181,8 +188,29 @@ class MiniAmqpBroker:
                 if ftype == FRAME_METHOD:
                     cm = struct.unpack_from(">HH", payload, 0)
                     if cm == BASIC_ACK:
-                        self.acks.append(
-                            struct.unpack_from(">Q", payload, 4)[0])
+                        t = struct.unpack_from(">Q", payload, 4)[0]
+                        self.acks.append(t)
+                        unacked.pop(t, None)
+                    elif cm == BASIC_NACK:
+                        t, bits = struct.unpack_from(">QB", payload, 4)
+                        self.nacks.append((t, bits))
+                        body = unacked.pop(t, None)
+                        if body is not None and bits & 0x02:
+                            with self._lock:
+                                self._to_send.append(body)
+
+    def _delivery_frames(self, ch, tag, payload):
+        out = method_frame(ch, BASIC_DELIVER,
+                           shortstr("ctag-1") + struct.pack(">QB", tag, 0)
+                           + shortstr("") + shortstr("rk"))
+        out += frame(FRAME_HEADER, ch, struct.pack(
+            ">HHQH", 60, 0, len(payload), 0))
+        step = self.body_frame_size or len(payload) or 1
+        for lo in range(0, len(payload), step):
+            out += frame(FRAME_BODY, ch, payload[lo: lo + step])
+        if not payload:
+            out += frame(FRAME_BODY, ch, b"")
+        return out
 
 
 def _wait(predicate, timeout=5.0):
@@ -232,9 +260,11 @@ def test_multi_frame_body_reassembled():
         broker.close()
 
 
-def test_rejected_payload_left_unacked():
-    """A sink failure leaves the delivery unacked (broker will redeliver
-    on reconnect) — at-least-once, never silent loss."""
+def test_rejected_payload_nacked_with_requeue():
+    """A sink failure nacks the delivery with requeue — leaving it
+    unacked would strand it until connection close and eventually stall
+    the consumer once ``prefetch`` failures accumulate.  At-least-once,
+    never silent loss: no ack is ever sent for a failed payload."""
     broker = MiniAmqpBroker()
 
     def bad_sink(payload):
@@ -246,9 +276,98 @@ def test_rejected_payload_left_unacked():
     try:
         assert _wait(lambda: broker.sessions == 1)
         broker.push(b"poison")
-        assert _wait(lambda: rx.emit_errors == 1)
+        assert _wait(lambda: rx.emit_errors >= 1)
+        assert _wait(lambda: len(broker.nacks) >= 1)
+        assert broker.nacks[0] == (1, 0x02)  # requeue bit set
         time.sleep(0.1)
-        assert broker.acks == []
+        assert broker.acks == []  # never acked a failed payload
+        assert rx.nacked >= 1
+    finally:
+        rx.stop()
+        broker.close()
+
+
+def test_prefetch_window_survives_sink_failures():
+    """Regression for the stall ADVICE flagged: with prefetch=2, more
+    than two consecutive sink failures would freeze a consumer that
+    never nacks.  With nack+requeue every delivery is eventually
+    redelivered and lands once the sink recovers — nothing stalls,
+    nothing is lost."""
+    broker = MiniAmqpBroker()
+    got = []
+    fail = [True]
+
+    def flaky_sink(payload):
+        if fail[0]:
+            raise RuntimeError("transient")
+        got.append(payload)
+
+    rx = AmqpReceiver("127.0.0.1", broker.port, queue="q1", prefetch=2)
+    rx.sink = flaky_sink
+    rx.start()
+    try:
+        assert _wait(lambda: broker.sessions == 1)
+        for i in range(4):  # > prefetch consecutive failures
+            broker.push(b"fail-%d" % i)
+        assert _wait(lambda: rx.emit_errors >= 4)
+        assert _wait(lambda: len(broker.nacks) >= 4)
+        fail[0] = False
+        broker.push(b"good")
+        # the sink recovered: the requeued deliveries AND the new one all
+        # land (at-least-once), and everything delivered gets acked
+        assert _wait(lambda: sorted(got) == sorted(
+            [b"fail-0", b"fail-1", b"fail-2", b"fail-3", b"good"]),
+            timeout=10.0)
+        assert _wait(lambda: len(broker.acks) == 5)
+        assert rx._nack_streak == 0  # streak resets on success
+    finally:
+        rx.stop()
+        broker.close()
+
+
+def test_persistent_sink_failure_backs_off_not_spins():
+    """A sink that keeps failing must not turn nack+redeliver into a
+    tight spin: the escalating pre-nack delay (50 ms doubling to 1 s)
+    bounds the retry rate to a handful per second."""
+    broker = MiniAmqpBroker()
+
+    def dead_sink(payload):
+        raise RuntimeError("persistently down")
+
+    rx = AmqpReceiver("127.0.0.1", broker.port, queue="q1")
+    rx.sink = dead_sink
+    rx.start()
+    try:
+        assert _wait(lambda: broker.sessions == 1)
+        broker.push(b"poison")
+        assert _wait(lambda: rx.emit_errors >= 1)
+        time.sleep(1.0)
+        # with backoff 50+100+200+400+800ms ≈ 5 attempts fit in ~1.5s;
+        # without it the redeliver loop would spin hundreds of times
+        assert rx.emit_errors <= 8
+        assert rx._nack_streak >= 2  # it IS being redelivered + retried
+    finally:
+        rx.stop()
+        broker.close()
+
+
+def test_delivery_coalesced_with_consume_ok_not_dropped():
+    """Regression for the frame-drop ADVICE flagged: a delivery the
+    broker coalesces into the same TCP segment as basic.consume-ok must
+    reach the sink and be acked, not die inside the handshake parser."""
+    broker = MiniAmqpBroker(coalesce_first_delivery=True)
+    broker.push(b"early-bird")  # queued BEFORE the receiver connects
+    broker.push(b"second")
+    got = []
+    rx = AmqpReceiver("127.0.0.1", broker.port, queue="q1")
+    rx.sink = got.append
+    rx.start()
+    try:
+        assert _wait(lambda: got == [b"early-bird", b"second"])
+        assert _wait(lambda: broker.acks == [1, 2])
+        # and the session keeps working for normal deliveries after
+        broker.push(b"third")
+        assert _wait(lambda: b"third" in got)
     finally:
         rx.stop()
         broker.close()
