@@ -67,6 +67,19 @@ def _build_receiver(doc: Dict):
                 host=str(args.pop("host")),
                 port=int(args.pop("port", 1883)),
                 topic=str(args.pop("topic", "sitewhere/input")), **args)
+        if kind in ("mqtt-broker", "hosted-mqtt"):
+            # hosts an in-process broker: devices connect directly, no
+            # external middleware (ActiveMQBrokerEventReceiver analog)
+            from sitewhere_tpu.ingest import mqtt_broker
+
+            return mqtt_broker.MqttBrokerReceiver(
+                host=str(args.pop("host", "127.0.0.1")),
+                # the conventional MQTT port: devices must be able to
+                # find the hosted broker without reading logs (an
+                # ephemeral port would move every restart)
+                port=int(args.pop("port", 1883)),
+                topic_filter=str(args.pop(
+                    "topic_filter", "sitewhere/input/#")), **args)
         if kind == "stomp":
             return stomp.StompReceiver(
                 host=str(args.pop("host")),
