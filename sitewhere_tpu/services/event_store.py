@@ -163,13 +163,20 @@ def _bloom_member(name: str) -> str:
 
 
 class _ChunkPruned(Exception):
-    """A lazy read raced retention: the chunk file is gone.
+    """A lazy read found the chunk file gone.
 
     Sealed columns used to be memory-resident, which made chunk-list
     snapshots prune-safe by construction; with lazy loading the readers
     must handle the file vanishing mid-read (query retries on a fresh
     snapshot, scans skip the expired chunk, id lookups report the id
-    expired)."""
+    expired).  Carries the seq so the store can self-heal when the file
+    vanished OUTSIDE ``prune_older_than`` (manual deletion, disk fault)
+    — the chunk is then discarded from the list, keeping the query
+    retry loop genuinely bounded by the chunk count."""
+
+    def __init__(self, seq: int):
+        super().__init__(seq)
+        self.seq = seq
 
 
 class _ColumnCache:
@@ -188,6 +195,10 @@ class _ColumnCache:
     def __init__(self, max_bytes: int):
         self.max_bytes = int(max_bytes)
         self._od: "OrderedDict[Tuple[int, str], np.ndarray]" = OrderedDict()
+        # pruned seqs (never reused: the seq high-water marker only goes
+        # up) — rejects a put() racing drop_seq(), which would otherwise
+        # park a dead column in the LRU that no reader ever asks for
+        self._dead: set = set()
         self._lock = threading.Lock()
         self.bytes = 0
         self.loads = 0
@@ -204,6 +215,8 @@ class _ColumnCache:
 
     def put(self, key: Tuple[int, str], arr: np.ndarray) -> None:
         with self._lock:
+            if key[0] in self._dead:
+                return
             old = self._od.pop(key, None)
             if old is not None:
                 self.bytes -= old.nbytes
@@ -215,8 +228,9 @@ class _ColumnCache:
                 self.evictions += 1
 
     def drop_seq(self, seq: int) -> None:
-        """Forget a pruned chunk's columns."""
+        """Forget a pruned chunk's columns (and refuse late arrivals)."""
         with self._lock:
+            self._dead.add(seq)
             for key in [k for k in self._od if k[0] == seq]:
                 self.bytes -= self._od.pop(key).nbytes
 
@@ -285,6 +299,23 @@ class _Chunk:
         self._cache = cache
         self._cols = None
 
+    def _load_members(self, names: List[str]) -> Dict[str, np.ndarray]:
+        """One npz open covering every requested member (a cold chunk
+        must not pay a zip-directory parse per column)."""
+        out: Dict[str, np.ndarray] = {}
+        try:
+            with np.load(self._path) as data:
+                files = set(data.files)
+                for name in names:
+                    if name in files:
+                        out[name] = data[name]
+                    else:  # forward-compat: absent column → default
+                        out[name] = np.full(self.n, NULL_ID,
+                                            dict(COLUMNS)[name])
+        except FileNotFoundError:
+            raise _ChunkPruned(self.seq) from None
+        return out
+
     def col(self, name: str) -> np.ndarray:
         """One column's array, loading (and caching) it if not resident."""
         if self._cols is not None:
@@ -293,21 +324,30 @@ class _Chunk:
         arr = self._cache.get(key)
         if arr is None:
             self._cache.loads += 1
-            try:
-                with np.load(self._path) as data:
-                    if name in data.files:
-                        arr = data[name]
-                    else:  # forward-compat: absent column → default
-                        dtype = dict(COLUMNS)[name]
-                        arr = np.full(self.n, NULL_ID, dtype)
-            except FileNotFoundError:
-                raise _ChunkPruned(self.seq) from None
+            arr = self._load_members([name])[name]
             self._cache.put(key, arr)
         return arr
 
     def materialize(self) -> Dict[str, np.ndarray]:
-        """Every column (scan API) — loaded via the cache when lazy."""
-        return {name: self.col(name) for name in _COLUMN_NAMES}
+        """Every column (scan/page API) — via the cache when lazy, with
+        ONE file open for all the columns a cold chunk is missing."""
+        if self._cols is not None:
+            return dict(self._cols)
+        out: Dict[str, np.ndarray] = {}
+        missing: List[str] = []
+        for name in _COLUMN_NAMES:
+            arr = self._cache.get((self.seq, name))
+            if arr is None:
+                missing.append(name)
+            else:
+                out[name] = arr
+        if missing:
+            self._cache.loads += 1
+            loaded = self._load_members(missing)
+            for name, arr in loaded.items():
+                self._cache.put((self.seq, name), arr)
+                out[name] = arr
+        return out
 
     def may_contain(self, name: str, h1: int, h2: int) -> bool:
         bloom = self.blooms.get(name)
@@ -424,8 +464,35 @@ class EventStore(LifecycleComponent):
             if name not in cols:
                 cols[name] = np.full(len(cols["ts_s"]), NULL_ID, dtype)
         chunk = _Chunk(seq, cols)
+        try:
+            # persist the rebuilt metadata so this full read happens ONCE,
+            # not on every boot (same atomic seal path flush() uses)
+            self._write_chunk_file(path, cols, chunk)
+        except OSError:
+            logger.exception("could not upgrade chunk %d metadata", seq)
         chunk.detach(path, self._cache)
         return chunk
+
+    def _write_chunk_file(self, path: str, cols: Dict[str, np.ndarray],
+                          chunk: _Chunk) -> None:
+        """Atomically write one sealed chunk: columns + prune metadata,
+        fsync'd before the rename and the rename made durable."""
+        meta = {
+            _META_CORE: np.asarray(
+                [_META_VERSION, chunk.n, chunk.min_ts, chunk.max_ts],
+                np.int64),
+            _META_BOUNDS: np.asarray(
+                [chunk.bounds[name] for name in _FILTER_COLUMNS], np.int64),
+        }
+        for bname, bloom in chunk.blooms.items():
+            meta[_bloom_member(bname)] = bloom
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **cols, **meta)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._fsync_dir()
 
     def _write_marker(self) -> None:
         """Durably record the seq high-water mark (fsync before rename:
@@ -605,32 +672,15 @@ class EventStore(LifecycleComponent):
                     seq = self._next_seq
                     # prune metadata computed once, WHILE the columns are
                     # in memory, and persisted with them — a restart then
-                    # reads ~33 KB/chunk instead of the columns
+                    # reads ~33 KB/chunk instead of the columns.  The
+                    # write fsyncs before the atomic seal: checkpoint-time
+                    # journal reclaim deletes raw records below the
+                    # committed offset on the premise that sealed chunks
+                    # are durable — without the fsync a power loss could
+                    # tear the chunk after the journal copy is gone.
                     chunk = _Chunk(seq, part)
-                    meta = {
-                        _META_CORE: np.asarray(
-                            [_META_VERSION, chunk.n, chunk.min_ts,
-                             chunk.max_ts], np.int64),
-                        _META_BOUNDS: np.asarray(
-                            [chunk.bounds[name] for name in _FILTER_COLUMNS],
-                            np.int64),
-                    }
-                    for bname, bloom in chunk.blooms.items():
-                        meta[_bloom_member(bname)] = bloom
                     path = os.path.join(self.dir, f"events-{seq:010d}.npz")
-                    tmp = f"{path}.tmp.{os.getpid()}"
-                    with open(tmp, "wb") as f:
-                        np.savez(f, **part, **meta)
-                        # fsync before the seal: checkpoint-time journal
-                        # reclaim deletes the raw records below the
-                        # committed offset on the premise that sealed
-                        # chunks are durable — without the fsync a power
-                        # loss could tear the chunk after the journal
-                        # copy is already gone.
-                        f.flush()
-                        os.fsync(f.fileno())
-                    os.replace(tmp, path)  # atomic seal: no torn chunks
-                    self._fsync_dir()      # …and make the rename durable
+                    self._write_chunk_file(path, part, chunk)
                     self._next_seq += 1
                     # release the resident columns: ``part`` slices view
                     # the whole merged buffer, so caching them would pin
@@ -708,8 +758,25 @@ class EventStore(LifecycleComponent):
         while True:
             try:
                 return self._query_once(criteria, **kwargs)
-            except _ChunkPruned:
+            except _ChunkPruned as e:
+                self._discard_vanished(e.seq)
                 continue
+
+    def _discard_vanished(self, seq: int) -> None:
+        """Drop a chunk whose file is gone but which is still listed —
+        a file deleted outside ``prune_older_than`` would otherwise make
+        every retry hit the same chunk forever (livelock)."""
+        path = os.path.join(self.dir, f"events-{seq:010d}.npz")
+        if os.path.exists(path):
+            return  # normal retention race: the fresh snapshot excludes it
+        with self._lock:
+            before = len(self._chunks)
+            self._chunks = [c for c in self._chunks if c.seq != seq]
+            if len(self._chunks) != before:
+                logger.warning(
+                    "event chunk %d vanished outside retention; discarded",
+                    seq)
+        self._cache.drop_seq(seq)
 
     def _query_once(
         self,

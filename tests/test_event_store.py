@@ -564,3 +564,60 @@ def test_get_event_on_vanished_chunk_reports_expired(tmp_path):
     store._cache.drop_seq(0)
     with pytest.raises(EntityNotFound):
         store.get_event(event_id(0, 3))
+
+
+def test_pre_metadata_upgrade_persists_once(tmp_path):
+    """Opening a legacy chunk rebuilds AND persists its metadata, so the
+    full-column read happens once, not on every boot."""
+    import os
+    store = EventStore(str(tmp_path), flush_rows=100, flush_interval_s=10)
+    store.append_columns(make_cols(64, ts0=5000))
+    store.flush()
+    fname = [f for f in os.listdir(store.dir) if f.endswith(".npz")][0]
+    path = os.path.join(store.dir, fname)
+    with np.load(path) as data:
+        cols = {k: data[k] for k in data.files if not k.startswith("_")}
+    with open(path, "wb") as f:
+        np.savez(f, **cols)  # strip metadata = legacy format
+
+    EventStore(str(tmp_path), flush_rows=100, flush_interval_s=10)
+    with np.load(path) as data:  # upgraded in place
+        assert "_meta_core" in data.files
+        assert "_bloom_device_id" in data.files
+    # the next boot takes the metadata-only path: no column loads
+    third = EventStore(str(tmp_path), flush_rows=100, flush_interval_s=10)
+    assert third.cache_stats()["loads"] == 0
+    assert third.query(device_id=3).total == 1
+
+
+def test_cache_rejects_put_after_drop_seq(tmp_path):
+    """A column load racing retention must not park dead bytes in the
+    LRU after drop_seq ran."""
+    from sitewhere_tpu.services.event_store import _ColumnCache
+    cache = _ColumnCache(1 << 20)
+    cache.put((0, "ts_s"), np.arange(10))
+    cache.drop_seq(0)
+    cache.put((0, "value"), np.arange(100))  # late arrival: rejected
+    assert cache.bytes == 0
+    assert cache.get((0, "value")) is None
+    cache.put((1, "ts_s"), np.arange(10))  # other seqs unaffected
+    assert cache.get((1, "ts_s")) is not None
+
+
+def test_query_self_heals_externally_deleted_chunk(tmp_path):
+    """A chunk file deleted outside retention (disk fault, operator rm)
+    must not livelock query(): the store discards the vanished chunk
+    and answers from the rest."""
+    import os
+    store = EventStore(str(tmp_path), flush_rows=100, flush_interval_s=10)
+    store.append_columns(make_cols(10, ts0=1000))
+    store.flush()
+    store.append_columns(make_cols(10, ts0=9000))
+    store.flush()
+    # delete chunk 0 behind the store's back; it stays in _chunks
+    os.unlink(os.path.join(store.dir, "events-0000000000.npz"))
+    store._cache.drop_seq(0)
+    res = store.query(device_id=3)  # would spin forever without healing
+    assert res.total == 1
+    assert res.results[0].ts_s == 9003
+    assert len(store._chunks) == 1  # vanished chunk discarded
