@@ -237,6 +237,7 @@ class Instance(LifecycleComponent):
             dead_letters=self.dead_letters,
             resolve_tenant=self._tenant_dense_id,
             on_host_request=self._on_host_request,
+            inflight_depth=int(self.config.get("pipeline.inflight_depth", 0)),
             mesh=self.mesh,
             journal_reader=JournalReader(self.ingest_journal, "pipeline"),
             recovery_decoder=recovery_decoder,

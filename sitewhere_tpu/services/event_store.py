@@ -397,6 +397,23 @@ class EventStore(LifecycleComponent):
         self._next_seq = 0
         self._flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Writer→flusher handoff: append_columns signals instead of
+        # sealing inline, so the dispatcher's egress thread never pays the
+        # npz write + fsyncs (measured up to ~16 ms/seal on the wire-path
+        # p99).  The inline safety valve below bounds the buffer if the
+        # flusher ever falls behind.
+        self._flush_wake = threading.Event()
+        # Files sealed with deferred durability (chunks + marker) not yet
+        # fsync'd — settled by _sync_durable at explicit flush()/prune
+        # points.  Guarded by _lock.
+        self._unsynced_paths: set = set()
+        # Serializes flush()'s two-phase seal across threads (writer
+        # valve, background flusher, commit gate); _lock is only held for
+        # the memory-side phases inside it.
+        self._flush_io = threading.Lock()
+        # Chunks published to _chunks whose npz write failed — columns
+        # still attached; retried by the next flush.  Guarded by _lock.
+        self._unwritten: List[tuple] = []
         self._load_existing()
 
     # -- lifecycle ----------------------------------------------------------
@@ -408,7 +425,25 @@ class EventStore(LifecycleComponent):
                 continue
             seq = int(m.group(1))
             path = os.path.join(self.dir, fname)
-            self._chunks.append(self._open_chunk(seq, path))
+            try:
+                chunk = self._open_chunk(seq, path)
+            except Exception:
+                # A torn chunk file must not stop the store from booting:
+                # deferred-fsync seals rename before their content fsync,
+                # so a power loss can leave garbage at the canonical name.
+                # Quarantine it (keep the bytes for forensics) and move
+                # on — the rows are covered by at-least-once journal
+                # replay, because the offset covering them can only have
+                # committed AFTER a sync flush made the chunk durable.
+                logger.exception(
+                    "chunk %d unreadable; quarantining %s", seq, path)
+                try:
+                    os.replace(path, path + ".corrupt")
+                except OSError:
+                    pass
+                self._next_seq = max(self._next_seq, seq + 1)
+                continue
+            self._chunks.append(chunk)
             self._next_seq = max(self._next_seq, seq + 1)
         # high-water marker: retention may have pruned EVERY chunk file,
         # and seqs must never regress — a reissued event id would resolve
@@ -474,9 +509,17 @@ class EventStore(LifecycleComponent):
         return chunk
 
     def _write_chunk_file(self, path: str, cols: Dict[str, np.ndarray],
-                          chunk: _Chunk) -> None:
-        """Atomically write one sealed chunk: columns + prune metadata,
-        fsync'd before the rename and the rename made durable."""
+                          chunk: _Chunk, sync: bool = True) -> None:
+        """Atomically write one sealed chunk: columns + prune metadata.
+
+        ``sync=False`` defers the fsyncs: the write stays atomic (tmp +
+        rename) but durability is settled later by :meth:`_sync_durable`.
+        Routine seals use this — the at-least-once premise only requires
+        a chunk to be DURABLE before the journal offset covering its rows
+        is committed (the commit gate's explicit ``flush()``), not at
+        seal time, and per-seal fsyncs measured as the single largest
+        cost on the wire path (they also stall the ingest journal's
+        writes through the filesystem journal)."""
         meta = {
             _META_CORE: np.asarray(
                 [_META_VERSION, chunk.n, chunk.min_ts, chunk.max_ts],
@@ -489,22 +532,50 @@ class EventStore(LifecycleComponent):
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             np.savez(f, **cols, **meta)
-            f.flush()
-            os.fsync(f.fileno())
+            if sync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
-        self._fsync_dir()
+        if sync:
+            self._fsync_dir()
+        else:
+            self._unsynced_paths.add(path)
 
-    def _write_marker(self) -> None:
-        """Durably record the seq high-water mark (fsync before rename:
-        the marker is what keeps seqs from regressing after retention
-        prunes every chunk, so it must survive power loss)."""
+    def _write_marker(self, sync: bool = True) -> None:
+        """Record the seq high-water mark (the marker is what keeps seqs
+        from regressing after retention prunes every chunk).  With
+        ``sync=False`` durability is deferred to :meth:`_sync_durable`;
+        boot recovers a stale marker from the chunk files themselves, so
+        the marker only MUST be durable before a prune unlinks chunks."""
         marker = os.path.join(self.dir, "next-seq")
         tmp = f"{marker}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(str(self._next_seq))
-            f.flush()
-            os.fsync(f.fileno())
+            if sync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, marker)
+        if sync:
+            self._fsync_dir()
+        else:
+            self._unsynced_paths.add(marker)
+
+    def _sync_durable(self) -> None:
+        """Settle deferred durability: fsync every async-sealed file, then
+        the directory once.  Called under ``_lock``."""
+        if not self._unsynced_paths:
+            return
+        for path in list(self._unsynced_paths):
+            try:
+                fd = os.open(path, os.O_RDONLY)
+            except FileNotFoundError:
+                self._unsynced_paths.discard(path)  # pruned before syncing
+                continue
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            self._unsynced_paths.discard(path)
         self._fsync_dir()
 
     def _fsync_dir(self) -> None:
@@ -533,6 +604,7 @@ class EventStore(LifecycleComponent):
 
     def stop(self) -> None:
         self._stop.set()
+        self._flush_wake.set()
         if self._flusher is not None:
             self._flusher.join(timeout=5)
             self._flusher = None
@@ -540,7 +612,11 @@ class EventStore(LifecycleComponent):
         super().stop()
 
     def _flush_loop(self) -> None:
-        while not self._stop.wait(self.flush_interval_s / 2):
+        while not self._stop.is_set():
+            self._flush_wake.wait(timeout=self.flush_interval_s / 2)
+            self._flush_wake.clear()
+            if self._stop.is_set():
+                break
             with self._lock:
                 due = self._buffered_rows > 0 and (
                     self._buffered_rows >= self.flush_rows
@@ -548,7 +624,7 @@ class EventStore(LifecycleComponent):
                 )
             if due:
                 try:
-                    self.flush()
+                    self.flush(sync=False)
                 except Exception:  # transient I/O failure must not kill the
                     # flusher; the buffer is retained and retried next tick.
                     logger.exception("event flush failed; will retry")
@@ -609,7 +685,17 @@ class EventStore(LifecycleComponent):
             self._buffered_rows += n
             rows = self._buffered_rows
         if rows >= self.flush_rows:
-            self.flush()
+            # Seal on the flusher thread — the writer only signals, so the
+            # dispatcher's egress never pays the npz write + fsyncs.  The
+            # inline flush is a safety valve: past 4× the threshold the
+            # writer pays the seal itself, bounding memory if the flusher
+            # falls behind (commit-gate callers still flush() explicitly).
+            # Without a running flusher (unstarted store) seal inline as
+            # before.
+            if self._flusher is None or rows >= 4 * self.flush_rows:
+                self.flush(sync=False)
+            else:
+                self._flush_wake.set()
         return n
 
     def _buffer_chunk_locked(self) -> Optional[_Chunk]:
@@ -647,56 +733,98 @@ class EventStore(LifecycleComponent):
             **{name: row[name][0].item() for name in _COLUMN_NAMES},
         )
 
-    def flush(self) -> int:
-        """Seal the buffer into durable chunk(s).  Returns rows flushed.
+    def flush(self, sync: bool = True) -> int:
+        """Seal the buffer into chunk(s).  Returns rows sealed.
 
-        A buffer larger than the per-chunk id space is split across several
-        chunks rather than dropped; the buffer is only cleared after every
-        chunk is durably sealed, so an I/O failure leaves the unsealed
-        remainder buffered for retry.
+        Two phases so appends/readers never wait on file IO: under
+        ``_lock`` the buffer is merged and turned into _Chunk objects
+        (memory-only: zone maps + blooms, columns stay attached) that are
+        published to ``_chunks`` immediately — reads serve them from the
+        resident columns meanwhile.  The npz writes then happen OUTSIDE
+        ``_lock`` (serialized by ``_flush_io``); each written chunk
+        detaches to its file, and a write failure parks the chunk on a
+        retry list the next flush drains.  ``sync=True`` (explicit
+        callers: the dispatcher's commit gate, shutdown) settles every
+        deferred fsync before returning and raises if any chunk is still
+        unwritten — the durability point the journal-reclaim premise
+        needs.  ``sync=False`` (the background flusher) keeps all IO off
+        the writer's p99.
         """
         max_rows = (1 << _ROW_BITS) - 1
-        with self._lock:
-            if not self._buffer:
+        with self._flush_io:
+            with self._lock:
+                retry = list(self._unwritten)
+                self._unwritten = []
+                new = []
+                if self._buffer:
+                    merged = {
+                        name: np.concatenate([b[name] for b in self._buffer])
+                        for name in _COLUMN_NAMES
+                    }
+                    total = len(merged["ts_s"])
+                    done = 0
+                    try:
+                        for lo in range(0, total, max_rows):
+                            part = {k: v[lo : lo + max_rows]
+                                    for k, v in merged.items()}
+                            # prune metadata computed once, WHILE the
+                            # columns are in memory, and persisted with
+                            # them — a restart then reads ~33 KB/chunk
+                            # instead of the columns
+                            chunk = _Chunk(self._next_seq, part)
+                            path = os.path.join(
+                                self.dir, f"events-{chunk.seq:010d}.npz")
+                            self._chunks.append(chunk)
+                            new.append((chunk, part, path))
+                            self._next_seq += 1
+                            done += len(part["ts_s"])
+                    finally:
+                        remainder = {k: v[done:] for k, v in merged.items()}
+                        self._buffer = (
+                            [remainder] if len(remainder["ts_s"]) else []
+                        )
+                        self._buffered_rows = total - done
+                if new:
+                    # once per flush, not per chunk: boot recovers a stale
+                    # marker from the chunk files themselves
+                    self._write_marker(sync=False)
                 self._last_flush = time.monotonic()
-                return 0
-            merged = {
-                name: np.concatenate([b[name] for b in self._buffer])
-                for name in _COLUMN_NAMES
-            }
-            total = len(merged["ts_s"])
-            flushed = 0
-            try:
-                for lo in range(0, total, max_rows):
-                    part = {k: v[lo : lo + max_rows] for k, v in merged.items()}
-                    seq = self._next_seq
-                    # prune metadata computed once, WHILE the columns are
-                    # in memory, and persisted with them — a restart then
-                    # reads ~33 KB/chunk instead of the columns.  The
-                    # write fsyncs before the atomic seal: checkpoint-time
-                    # journal reclaim deletes raw records below the
-                    # committed offset on the premise that sealed chunks
-                    # are durable — without the fsync a power loss could
-                    # tear the chunk after the journal copy is gone.
-                    chunk = _Chunk(seq, part)
-                    path = os.path.join(self.dir, f"events-{seq:010d}.npz")
-                    self._write_chunk_file(path, part, chunk)
-                    self._next_seq += 1
-                    # release the resident columns: ``part`` slices view
-                    # the whole merged buffer, so caching them would pin
-                    # it — reads reload (and LRU-cache) from the file
-                    chunk.detach(path, self._cache)
-                    self._chunks.append(chunk)
-                    flushed += len(part["ts_s"])
-                    self._write_marker()
-            finally:
-                if flushed:
-                    remainder = {k: v[flushed:] for k, v in merged.items()}
-                    self._buffer = (
-                        [remainder] if len(remainder["ts_s"]) else []
-                    )
-                    self._buffered_rows = total - flushed
-                self._last_flush = time.monotonic()
+            flushed = sum(len(p["ts_s"]) for _, p, _ in new)
+
+            # Phase 2: file IO with _lock released.  Journal reclaim
+            # deletes raw records below the committed offset on the
+            # premise that sealed chunks are durable by COMMIT time: the
+            # commit gate flushes sync=True, which settles the deferred
+            # fsyncs (and refuses on any unwritten chunk) first.
+            failed = []
+            for chunk, part, path in retry + new:
+                try:
+                    self._write_chunk_file(path, part, chunk, sync=False)
+                except OSError:
+                    logger.exception("chunk %d seal failed; will retry",
+                                     chunk.seq)
+                    failed.append((chunk, part, path))
+                    continue
+                with self._lock:
+                    if any(c is chunk for c in self._chunks):
+                        # release the resident columns: reads reload (and
+                        # LRU-cache) from the file from here on
+                        chunk.detach(path, self._cache)
+                    else:
+                        # retention pruned it while being written — don't
+                        # resurrect the file at next boot
+                        self._unsynced_paths.discard(path)
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+            with self._lock:
+                self._unwritten = failed + self._unwritten
+                if sync:
+                    self._sync_durable()
+            if sync and failed:
+                raise OSError(
+                    f"{len(failed)} chunk(s) not durably sealed")
             return flushed
 
     # -- reads --------------------------------------------------------------
@@ -713,22 +841,32 @@ class EventStore(LifecycleComponent):
         expired Cassandra hour bucket, never a row-level rewrite.
         Event ids inside pruned chunks become unresolvable, as expired
         ids do in any TTL'd store.  Returns rows removed."""
-        removed = 0
         with self._lock:
-            keep: List[_Chunk] = []
-            for chunk in self._chunks:
-                if chunk.n and chunk.max_ts < cutoff_s:
-                    removed += chunk.n
-                    self._cache.drop_seq(chunk.seq)
-                    path = os.path.join(self.dir,
-                                        f"events-{chunk.seq:010d}.npz")
-                    try:
-                        os.unlink(path)
-                    except FileNotFoundError:
-                        pass
-                else:
-                    keep.append(chunk)
-            self._chunks = keep
+            doomed = {id(c): c for c in self._chunks
+                      if c.n and c.max_ts < cutoff_s}
+            if not doomed:
+                return 0
+            # Seqs must never regress: make the high-water marker durable
+            # BEFORE any chunk file disappears (boot recovers a stale
+            # marker from chunk files — which are about to be gone).
+            for chunk in doomed.values():
+                self._unsynced_paths.discard(
+                    os.path.join(self.dir, f"events-{chunk.seq:010d}.npz"))
+            self._write_marker(sync=True)
+            removed = 0
+            for chunk in doomed.values():
+                removed += chunk.n
+                self._cache.drop_seq(chunk.seq)
+                try:
+                    os.unlink(os.path.join(
+                        self.dir, f"events-{chunk.seq:010d}.npz"))
+                except FileNotFoundError:
+                    pass
+            self._chunks = [c for c in self._chunks if id(c) not in doomed]
+            # an expired chunk still awaiting its npz write must not be
+            # rewritten by the next flush
+            self._unwritten = [e for e in self._unwritten
+                               if id(e[0]) not in doomed]
         return removed
 
     def get_event(self, eid: int) -> EventRecord:

@@ -5,6 +5,9 @@ Cassandra-style denormalized index queries, and Kafka-offset-style restart
 recovery (events survive process restart).
 """
 
+import os
+import time
+
 import numpy as np
 import pytest
 
@@ -621,3 +624,84 @@ def test_query_self_heals_externally_deleted_chunk(tmp_path):
     assert res.total == 1
     assert res.results[0].ts_s == 9003
     assert len(store._chunks) == 1  # vanished chunk discarded
+
+
+def test_deferred_fsync_settled_by_explicit_flush(tmp_path):
+    """Routine seals defer durability; flush(sync=True) settles it.
+
+    The at-least-once premise: chunks need fsync only before the journal
+    offset covering their rows commits (the commit gate calls flush()).
+    """
+    store = EventStore(str(tmp_path), flush_rows=10_000, flush_interval_s=10)
+    store.append_columns(make_cols(50))
+    store.flush(sync=False)
+    # sealed atomically (file exists, readable) but durability deferred
+    assert len(store._chunks) == 1
+    assert store._unsynced_paths  # chunk + marker pending fsync
+    rec = store.get_event(event_id(0, 7))
+    assert rec.device_id == 7
+    store.flush()  # the commit-gate call
+    assert not store._unsynced_paths
+
+
+def test_started_store_seals_on_flusher_thread(tmp_path):
+    """append_columns past flush_rows signals the background flusher
+    instead of sealing on the writer thread (egress p99 protection)."""
+    store = EventStore(str(tmp_path), flush_rows=16, flush_interval_s=0.05)
+    store.start()
+    try:
+        store.append_columns(make_cols(40))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not store._chunks:
+            time.sleep(0.01)
+        assert store._chunks and store._chunks[0].n == 40
+        assert store.total_events == 40
+    finally:
+        store.stop()
+    # stop() runs a sync flush: everything durable
+    assert not store._unsynced_paths
+
+
+def test_prune_settles_marker_before_unlink(tmp_path):
+    """Seqs must not regress: prune writes the high-water marker durably
+    BEFORE chunk files disappear (boot recovers a stale marker from the
+    chunk files themselves — which prune deletes)."""
+    store = EventStore(str(tmp_path), flush_rows=10_000, flush_interval_s=10,
+                       retention_s=60)
+    store.append_columns(make_cols(30, ts0=1000))
+    store.flush(sync=False)
+    assert store._unsynced_paths
+    removed = store.prune_older_than(10_000)
+    assert removed == 30
+    assert not store._chunks
+    # marker no longer pending, and a fresh store resumes past seq 0
+    marker = os.path.join(store.dir, "next-seq")
+    assert int(open(marker).read()) == 1
+    store2 = EventStore(str(tmp_path), flush_rows=10_000)
+    assert store2._next_seq == 1
+
+
+def test_torn_chunk_quarantined_at_boot(tmp_path):
+    """A power loss mid-deferred-seal can leave garbage at the canonical
+    chunk name (rename lands before the content fsync).  Boot must
+    quarantine it and keep going — the rows are journal-covered because
+    their offset can only commit after a sync flush."""
+    store = EventStore(str(tmp_path), flush_rows=10_000, flush_interval_s=10)
+    store.append_columns(make_cols(20))
+    store.flush()
+    store.append_columns(make_cols(30, ts0=5000))
+    store.flush()
+    # tear the SECOND chunk: truncated npz, as delayed allocation leaves it
+    torn = os.path.join(store.dir, "events-0000000001.npz")
+    with open(torn, "wb") as f:
+        f.write(b"PK\x03\x04garbage")
+    store2 = EventStore(str(tmp_path))
+    assert len(store2._chunks) == 1          # healthy chunk loads
+    assert store2._chunks[0].n == 20
+    assert store2._next_seq == 2             # seq does NOT regress
+    assert os.path.exists(torn + ".corrupt")  # quarantined, not deleted
+    assert not os.path.exists(torn)
+    # the store keeps working past the quarantine
+    store2.append_columns(make_cols(5, ts0=9000))
+    store2.flush()
+    assert store2._chunks[-1].seq == 2

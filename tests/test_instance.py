@@ -177,6 +177,32 @@ class TestDispatchLoop:
         assert (instance.device_state.get_device_state("dev-1")
                 ["last_event_ts_s"] == 1000)
 
+    def test_deep_inflight_window_equivalent(self, tmp_path):
+        """inflight_depth=8 (the TPU default — dispatch-latency hiding)
+        must produce identical store/state/metrics results to the CPU
+        default of 1, and flush() must drain the whole window."""
+        inst = Instance(make_config(tmp_path, inflight_depth=8))
+        inst.start()
+        try:
+            assert inst.dispatcher.inflight_depth == 8
+            seed_device(inst)
+            # several full plans (width 64) so the window actually fills
+            for i in range(300):
+                inst.dispatcher.ingest(
+                    measurement("dev-1", float(i), ts=1000 + i))
+            inst.dispatcher.flush()
+            snap = inst.dispatcher.metrics_snapshot()
+            assert snap["processed"] == 300
+            assert snap["accepted"] == 300
+            assert len(inst.dispatcher._inflight) == 0
+            state = inst.device_state.get_device_state("dev-1")
+            assert state["last_event_ts_s"] == 1299
+            inst.event_store.flush()
+            assert inst.event_store.total_events == 300
+        finally:
+            inst.stop()
+            inst.terminate()
+
     def test_background_loop_respects_deadline(self, tmp_path):
         inst = Instance(make_config(tmp_path, deadline_ms=10.0))
         inst.start()

@@ -127,9 +127,12 @@ def test_client_id_takeover_replaces_old_session():
         assert _wait(lambda: broker.session_count == 1)
         second = MqttClient("127.0.0.1", broker.port, client_id="same-id")
         second.connect()
+        # wait for the second CONNECT to be processed FIRST — session_count
+        # is 1 both before and after the takeover, so waiting on it alone
+        # races the broker's accept loop
+        assert _wait(lambda: broker.connects == 2)
         # old socket is closed by the broker (MQTT-3.1.4-2)
         assert _wait(lambda: broker.session_count == 1)
-        assert broker.connects == 2
         second.publish("t", b"alive")
         second.disconnect()
         first.disconnect()
