@@ -370,8 +370,10 @@ def bench_dispatcher() -> None:
     lines_per_payload = 512 if reduced else 1024
     # 512 full-profile payloads ≈ 523k events: at ≥1M ev/s the timed
     # region still spans ~0.5 s — long enough to amortize the in-flight
-    # window fill/drain and give a stable p99 sample set.
-    n_payloads = 16 if reduced else 512
+    # window fill/drain and give a stable p99 sample set.  The reduced
+    # profile's 64×512 ≈ 32k events serve the same purpose at CPU rates
+    # (a 16-payload run measured only ~30 ms and swung 2× run-to-run).
+    n_payloads = 64 if reduced else 512
     tmp = tempfile.mkdtemp(prefix="swbench-")
     cfg = Config({
         "instance": {"id": "bench", "data_dir": os.path.join(tmp, "data")},
@@ -431,6 +433,9 @@ def bench_dispatcher() -> None:
             rtts.append(time.perf_counter() - t4)
         rtt_ms = float(np.median(rtts)) * 1e3
 
+        # Single self-pacing feeder: an open-loop multi-thread burst was
+        # tried and measured WORSE (GIL-bound intake contention + every
+        # row pre-queued turns queueing delay into the latency number).
         t0 = time.perf_counter()
         for r in range(1, n_payloads):
             inst.dispatcher.ingest_wire_lines(payloads[r])
