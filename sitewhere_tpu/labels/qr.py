@@ -19,6 +19,7 @@ Reed-Solomon syndromes are zero, and returns the original payload.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -38,37 +39,56 @@ for _i in range(255):
 for _i in range(255, 512):
     _EXP[_i] = _EXP[_i - 255]
 
+# Plain-list twins of the GF tables: python-int indexing of a list is
+# several times faster than extracting numpy scalars, and the RS inner
+# loop is pure scalar work.
+_EXP_L: List[int] = _EXP.tolist()
+_LOG_L: List[int] = _LOG.tolist()
+
 
 def _gf_mul(a: int, b: int) -> int:
     if a == 0 or b == 0:
         return 0
-    return int(_EXP[_LOG[a] + _LOG[b]])
+    return _EXP_L[_LOG_L[a] + _LOG_L[b]]
 
 
-def _rs_generator(n_ec: int) -> List[int]:
-    """Generator polynomial coefficients (descending powers), monic."""
+@functools.lru_cache(maxsize=None)
+def _rs_generator(n_ec: int) -> Tuple[int, ...]:
+    """Generator polynomial coefficients (descending powers), monic.
+
+    Cached: there is one polynomial per EC-codeword count, and computing
+    it cost more than the per-block division it feeds.
+    """
     gen = [1]
     for i in range(n_ec):
         nxt = [0] * (len(gen) + 1)
         for j, c in enumerate(gen):
             nxt[j] ^= _gf_mul(c, 1)
-            nxt[j + 1] ^= _gf_mul(c, int(_EXP[i]))
+            nxt[j + 1] ^= _gf_mul(c, _EXP_L[i])
         gen = nxt
-    return gen
+    return tuple(gen)
+
+
+@functools.lru_cache(maxsize=None)
+def _rs_generator_logs(n_ec: int) -> Tuple[int, ...]:
+    """log of each non-leading generator coefficient (-1 for zero)."""
+    return tuple(_LOG_L[g] if g else -1 for g in _rs_generator(n_ec)[1:])
 
 
 def rs_ecc(data: bytes, n_ec: int) -> bytes:
     """Reed-Solomon error-correction codewords for ``data``."""
-    gen = _rs_generator(n_ec)
+    glog = _rs_generator_logs(n_ec)
     rem = [0] * n_ec
+    exp, rng = _EXP_L, range(n_ec)
     for byte in data:
         factor = byte ^ rem[0]
         rem = rem[1:] + [0]
         if factor:
-            lf = int(_LOG[factor])
-            for i in range(n_ec):
-                if gen[i + 1]:
-                    rem[i] ^= int(_EXP[lf + _LOG[gen[i + 1]]])
+            lf = _LOG_L[factor]
+            for i in rng:
+                lg = glog[i]
+                if lg >= 0:
+                    rem[i] ^= exp[lf + lg]
     return bytes(rem)
 
 
@@ -291,14 +311,15 @@ def _function_patterns(version: int) -> Tuple[np.ndarray, np.ndarray]:
     return mat, res
 
 
-def _place_data(mat: np.ndarray, res: np.ndarray, codewords: bytes) -> None:
-    """Zigzag placement, two columns at a time, right→left, skipping col 6."""
-    n = mat.shape[0]
-    bits = []
-    for byte in codewords:
-        for i in range(7, -1, -1):
-            bits.append((byte >> i) & 1)
-    idx = 0
+@functools.lru_cache(maxsize=None)
+def _placement_order(version: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Data-cell coordinates in zigzag placement order (two columns at a
+    time, right→left, skipping timing col 6) — a pure function of the
+    version's reserved grid, so computed once."""
+    _, res = _function_patterns(version)
+    n = res.shape[0]
+    rr: List[int] = []
+    cc: List[int] = []
     col = n - 1
     upward = True
     while col > 0:
@@ -308,10 +329,27 @@ def _place_data(mat: np.ndarray, res: np.ndarray, codewords: bytes) -> None:
         for r in rows:
             for c in (col, col - 1):
                 if not res[r, c]:
-                    mat[r, c] = bits[idx] if idx < len(bits) else 0
-                    idx += 1
+                    rr.append(r)
+                    cc.append(c)
         upward = not upward
         col -= 2
+    r_arr, c_arr = np.asarray(rr, np.intp), np.asarray(cc, np.intp)
+    # frozen: these are shared cache singletons — a caller mutating one
+    # would silently corrupt every later encode/decode for this version
+    r_arr.flags.writeable = False
+    c_arr.flags.writeable = False
+    return r_arr, c_arr
+
+
+def _place_data(mat: np.ndarray, res: np.ndarray, codewords: bytes) -> None:
+    """Zigzag placement via the cached per-version order; cells past the
+    codeword bits are the spec's remainder bits (zero)."""
+    del res  # the cached order already encodes the reserved grid
+    r_idx, c_idx = _placement_order((mat.shape[0] - 17) // 4)
+    bits = np.unpackbits(np.frombuffer(codewords, np.uint8))
+    k = min(len(bits), len(r_idx))
+    mat[r_idx[:k], c_idx[:k]] = bits[:k]
+    mat[r_idx[k:], c_idx[k:]] = 0
 
 
 _MASKS = [
@@ -329,6 +367,17 @@ _MASKS = [
 def _mask_grid(mask: int, n: int) -> np.ndarray:
     r, c = np.indices((n, n))
     return _MASKS[mask](r, c)
+
+
+@functools.lru_cache(maxsize=None)
+def _mask_stack(n: int) -> np.ndarray:
+    """All 8 mask grids for symbol size ``n`` as one [8, n, n] stack
+    (cached: mask patterns depend only on coordinates; frozen because
+    the cache entry is shared by every encode at this size)."""
+    r, c = np.indices((n, n))
+    stack = np.stack([_MASKS[m](r, c) for m in range(8)])
+    stack.flags.writeable = False
+    return stack
 
 
 def _run_penalty(grid: np.ndarray) -> int:
@@ -379,6 +428,73 @@ def _penalty(mat: np.ndarray) -> int:
     # rule 4: dark-module proportion deviation from 50%
     dark_pct = 100.0 * mat.sum() / (n * n)
     score += 10 * int(abs(dark_pct - 50) // 5)
+    return score
+
+
+def _run_penalty_all(grids: np.ndarray) -> np.ndarray:
+    """Rule 1 over rows for a [m, R, n] stack → per-matrix totals [m]."""
+    m, rows, n = grids.shape
+    g = grids.reshape(m * rows, n)
+    change = np.ones((m * rows, n), bool)
+    change[:, 1:] = g[:, 1:] != g[:, :-1]
+    ids = np.cumsum(change, axis=1) + (
+        np.arange(m * rows)[:, None] * (n + 1))
+    lengths = np.bincount(ids.ravel(), minlength=m * rows * (n + 1) + 1)
+    contrib = np.where(lengths >= 5, lengths - 2, 0)
+    # id space is strided (n+1) per row: fold back to per-row, then per-mask
+    per_row = contrib[: m * rows * (n + 1)].reshape(m * rows, n + 1).sum(1)
+    return per_row.reshape(m, rows).sum(1)
+
+
+def _finder_penalty_all(grids: np.ndarray) -> np.ndarray:
+    """Rule 3 over rows for a [m, R, n] stack → per-matrix totals [m].
+
+    Slice algebra instead of a 15-wide window view: the core 1011101 is
+    seven shifted slices ANDed, the 4-light flanks are prefix-sum range
+    queries — no [.., 15]-materialized comparison arrays.  Window i
+    (i in [0, n-6)) covers padded columns [i, i+15); border sentinel 2
+    keeps a flank that runs off the symbol edge from counting as light,
+    matching the truncated-window rule of the spec."""
+    m, rows, n = grids.shape
+    w = n - 6  # window positions per row
+    g = np.pad(grids.astype(np.int8), ((0, 0), (0, 0), (4, 4)),
+               constant_values=2)
+    eq1 = g == 1
+    eq0 = g == 0
+
+    def s(a: np.ndarray, off: int) -> np.ndarray:
+        # padded column (i+4)+off for every window position i
+        return a[:, :, 4 + off: 4 + off + w]
+
+    core = (s(eq1, 0) & s(eq0, 1) & s(eq1, 2) & s(eq1, 3)
+            & s(eq1, 4) & s(eq0, 5) & s(eq1, 6))
+    # exclusive prefix sums of light cells: range [a, b) light-count is
+    # cp[b] - cp[a]; flanks are [i, i+4) and [i+11, i+15)
+    cp = np.zeros((m, rows, g.shape[2] + 1), np.int32)
+    np.cumsum(eq0, axis=2, out=cp[:, :, 1:])
+    before = (cp[:, :, 4: 4 + w] - cp[:, :, 0: w]) == 4
+    after = (cp[:, :, 15: 15 + w] - cp[:, :, 11: 11 + w]) == 4
+    return 40 * (core & (before | after)).sum(axis=(1, 2))
+
+
+def _penalty_all(mats: np.ndarray) -> np.ndarray:
+    """§8.8.2 penalties for a [m, n, n] stack of candidate matrices at
+    once — one set of numpy calls instead of m of them (mask selection
+    evaluates all 8 masks; the per-call overhead dominated at n≤57).
+    Pinned equal to per-matrix :func:`_penalty` by tests."""
+    m, n, _ = mats.shape
+    score = _run_penalty_all(mats) + _run_penalty_all(
+        mats.transpose(0, 2, 1))
+    same = (
+        (mats[:, :-1, :-1] == mats[:, :-1, 1:])
+        & (mats[:, :-1, :-1] == mats[:, 1:, :-1])
+        & (mats[:, :-1, :-1] == mats[:, 1:, 1:])
+    )
+    score = score + 3 * same.sum(axis=(1, 2))
+    score = score + _finder_penalty_all(mats) + _finder_penalty_all(
+        mats.transpose(0, 2, 1))
+    dark_pct = 100.0 * mats.sum(axis=(1, 2)) / (n * n)
+    score = score + 10 * (np.abs(dark_pct - 50) // 5).astype(np.int64)
     return score
 
 
@@ -447,18 +563,21 @@ def encode(payload: bytes | str, level: str = "M",
     base, res = _function_patterns(version)
     _place_data(base, res, codewords)
 
-    best: Tuple[int, int, np.ndarray] = None  # (penalty, mask, matrix)
-    masks = range(8) if mask is None else [mask]
-    for m in masks:
+    n = base.shape[0]
+    if mask is not None:
         mat = base.copy()
-        flip = _mask_grid(m, mat.shape[0]) & ~res
+        flip = _mask_grid(mask, n) & ~res
         mat[flip] ^= 1
-        _write_format(mat, level, m)
+        _write_format(mat, level, mask)
         _write_version(mat, version)
-        p = _penalty(mat)
-        if best is None or p < best[0]:
-            best = (p, m, mat)
-    return best[2]
+        return mat
+    # all 8 candidates as one stack; penalties vectorized across the
+    # mask axis (_penalty_all) — selection was the encoder's hot loop
+    stack = np.where(_mask_stack(n) & ~res, base ^ 1, base)
+    for m in range(8):
+        _write_format(stack[m], level, m)
+        _write_version(stack[m], version)
+    return stack[int(np.argmin(_penalty_all(stack)))]
 
 
 # --------------------------------------------------------------------------
@@ -497,20 +616,10 @@ def decode_matrix(mat: np.ndarray) -> bytes:
     flip = _mask_grid(mask, n) & ~res
     unmasked[flip] ^= 1
 
-    # extract bits in placement order
-    bits: List[int] = []
-    col = n - 1
-    upward = True
-    while col > 0:
-        if col == 6:
-            col -= 1
-        rows = range(n - 1, -1, -1) if upward else range(n)
-        for r in rows:
-            for c in (col, col - 1):
-                if not res[r, c]:
-                    bits.append(int(unmasked[r, c]))
-        upward = not upward
-        col -= 2
+    # extract bits in placement order — the SAME cached order encode
+    # placed them in, so the two sides cannot drift
+    r_idx, c_idx = _placement_order(version)
+    bits = unmasked[r_idx, c_idx].tolist()
     total = sum(count * tot for count, tot, _ in _BLOCKS[(level, version)])
     codewords = bytearray()
     for i in range(total):

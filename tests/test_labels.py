@@ -196,3 +196,38 @@ def test_penalty_matches_naive_reference():
     mat[5, 4:11] = [1, 0, 1, 1, 1, 0, 1]     # full light flank both sides
     mat[20, 14:21] = [1, 0, 1, 1, 1, 0, 1]   # truncated after-flank
     assert _penalty(mat) == naive(mat)
+
+
+def test_penalty_all_matches_per_matrix():
+    """The mask-axis-vectorized penalty (what encode's selection uses)
+    must score every candidate exactly like the per-matrix _penalty
+    (itself pinned to the literal spec-8.8.2 reference above)."""
+    import numpy as np
+
+    from sitewhere_tpu.labels.qr import _penalty, _penalty_all
+
+    rng = np.random.default_rng(7)
+    for n in (21, 25, 33, 45, 57):
+        stack = (rng.random((8, n, n)) < rng.uniform(0.2, 0.8)).astype(
+            np.uint8)
+        vec = _penalty_all(stack)
+        for m in range(8):
+            assert int(vec[m]) == _penalty(stack[m]), (n, m)
+
+
+def test_encode_mask_selection_unchanged():
+    """Stacked all-masks selection must pick the same (first-minimum)
+    mask the per-mask loop did: explicit-mask encodes of all 8 bracket
+    the selected one."""
+    import numpy as np
+
+    from sitewhere_tpu.labels.qr import _penalty, encode
+
+    for payload in ("dev-1", "https://sitewhere-tpu.local/devices/dev-42",
+                    "x" * 100):
+        auto = encode(payload)
+        scores = []
+        for m in range(8):
+            scores.append(_penalty(encode(payload, mask=m)))
+        best = int(np.argmin(scores))
+        assert np.array_equal(auto, encode(payload, mask=best))
