@@ -275,11 +275,21 @@ class PackedView:
         self.present_now = present_now
         self._oi = None
         self._metrics = None
+        self._metrics_host = None
+
+    def _fetch(self) -> None:
+        """Materialize BOTH host copies in one device_get: it starts the
+        copies for every leaf before blocking on any, so a
+        network-attached chip charges one RTT for the pair even when the
+        dispatcher's dispatch-time copy_to_host_async was a no-op."""
+        oi, metrics = jax.device_get((self._oi_dev, self._metrics_dev))
+        self._oi = np.asarray(oi)
+        self._metrics_host = np.asarray(metrics)
 
     @property
     def oi(self) -> np.ndarray:
         if self._oi is None:
-            self._oi = np.asarray(self._oi_dev)
+            self._fetch()
         return self._oi
 
     def _row(self, name: str) -> np.ndarray:
@@ -309,7 +319,9 @@ class PackedView:
     @property
     def metrics(self) -> StepMetrics:
         if self._metrics is None:
-            v = np.asarray(self._metrics_dev)
+            if self._metrics_host is None:
+                self._fetch()
+            v = self._metrics_host
             self._metrics = StepMetrics(
                 by_type=v[len(METRIC_SCALARS):],
                 **{f: v[i] for i, f in enumerate(METRIC_SCALARS)})
