@@ -36,7 +36,9 @@ timeout, so the supervisor is designed for a hostile clock:
     exists within the first minute no matter what happens later.
   * Every attempt's diagnostic is flushed to stderr the moment it ends.
   * TPU attempts get a per-attempt timeout (SW_BENCH_TIMEOUT_S, default
-    120s) inside a total budget (SW_BENCH_TOTAL_BUDGET_S, default 330s).
+    120s; config 1's TPU attempts default to 240s — it compiles two
+    programs) inside a total budget (SW_BENCH_TOTAL_BUDGET_S, default
+    330s single-config / 520s all-configs).
   * SIGTERM/SIGINT dump the best-so-far result line before dying.
   * The LAST stdout line is always the authoritative doc: the TPU number
     when one landed, else the labelled CPU fallback, else a value=0
@@ -188,8 +190,14 @@ def bench_pipeline() -> None:
     reduced = os.environ.get("SW_BENCH_FORCE_CPU") == "1"
     capacity, n_active = 16384, 10000
     width = 16_384 if reduced else 131_072
-    iters = 10 if reduced else 100
-    lat_iters = 10 if reduced else 50
+    # Full-profile counts sized so a LIVE tunnel attempt fits the
+    # supervisor's per-attempt budget (round-4's 100/50 profile measured
+    # 249 s with two compiles + ~9 MB/step batch transfers — a default
+    # 120 s cap would kill the attempt and waste the window): 40 async
+    # steps still time 5.2M events, 15 fetch-forced samples pin the
+    # RTT-bound host percentiles.
+    iters = 10 if reduced else 40
+    lat_iters = 10 if reduced else 24
     chain_k = 16 if reduced else 256
     registry, state, rules, zones = build_tables(capacity, n_active)
     raw = host_batches(width, n_active, n_batches=8)
@@ -339,7 +347,10 @@ def bench_pipeline() -> None:
             else None),
         "device_step_ms": round(device_step_ms, 4),
         "host_step_p50_ms": round(p50, 3),
+        # with n=lat_iters samples the upper percentile interpolates
+        # between the two worst — publish n so it reads as what it is
         "host_step_p99_ms": round(p99, 3),
+        "host_step_samples": lat_iters,
         "host_rtt_ms": round(rtt * 1e3, 3),
         "latency_target_met": bool(device_step_ms < 10.0),
         "batch_width": width,
@@ -915,6 +926,17 @@ def supervise_config(config: int, base_env, deadline: float,
     """
     metric = _METRIC_BY_CONFIG[config]
     attempt_s = float(os.environ.get("SW_BENCH_TIMEOUT_S", "120"))
+    # The headline config compiles TWO programs (step + the chained
+    # device-latency probe); a live attempt measured ~100-250 s.  Let
+    # ITS TPU attempts run past the base cap (Phase 1's CPU fallback
+    # keeps the base cap — a wedged fallback must not eat the window the
+    # override exists to protect).  The config deadline still bounds the
+    # attempt: under default budgets it allows ~170-200 s, which the
+    # trimmed full profile fits; raise SW_BENCH_TOTAL_BUDGET_S to give
+    # it the full 240.
+    tpu_attempt_s = attempt_s
+    if config == 1 and os.environ.get("SW_BENCH_TIMEOUT_S") is None:
+        tpu_attempt_s = 240.0
     extra = [f"--config={config}"]
 
     def record(kind, rc, err, reason, t_s):
@@ -961,7 +983,7 @@ def supervise_config(config: int, base_env, deadline: float,
     while (tunnel_ok and attempt < tpu_attempts
            and time.monotonic() + 45 < deadline):
         attempt += 1
-        budget = min(attempt_s, deadline - time.monotonic() - 5)
+        budget = min(tpu_attempt_s, deadline - time.monotonic() - 5)
         t0 = time.monotonic()
         rc, out, err, reason = _run_child(extra, base_env, budget)
         doc = _last_json_line(out) if rc == 0 else None
