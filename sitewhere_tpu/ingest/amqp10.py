@@ -1,0 +1,721 @@
+"""AMQP 1.0 receiver: Azure Event Hubs ingest without SDK dependencies.
+
+Reference: ``service-event-sources/src/main/java/com/sitewhere/sources/
+azure/EventHubInboundEventReceiver.java`` consumes an Event Hub through
+the Azure ``EventProcessorHost`` SDK (per-partition receivers, consumer
+groups, offset checkpoints).  Event Hubs speak AMQP 1.0 on the wire
+(ISO/IEC 19464 / OASIS amqp-core-v1.0), a DIFFERENT protocol from the
+0-9-1 RabbitMQ client in :mod:`sitewhere_tpu.ingest.amqp` — this module
+is a from-scratch consume-side AMQP 1.0 client covering the subset an
+Event Hub partition receiver needs:
+
+- the type system: fixed/variable-width primitives, composite lists,
+  maps, symbols, described types (encoder + decoder, round-trip tested);
+- SASL PLAIN / ANONYMOUS negotiation (frame type 1), then the AMQP
+  protocol header and ``open``/``begin``/``attach`` bring-up;
+- a receiver link per partition (``{hub}/ConsumerGroups/{group}/
+  Partitions/{n}``) with explicit ``flow`` link-credit (topped up at
+  half-window, the prefetch analog), multi-frame transfer reassembly
+  (``more`` flag), and ``disposition(accepted)`` settlement AFTER the
+  sink accepts — crash-before-ack redelivers, the at-least-once contract
+  the reference gets from EventProcessorHost checkpointing;
+- offset checkpoints: each message's ``x-opt-offset`` annotation is
+  persisted per partition (JSON sidecar, atomic rename) and resume
+  attaches with the Event-Hub selector filter
+  (``amqp.annotation.x-opt-offset > '<last>'``) so a reconnect does not
+  replay the whole partition;
+- idle-timeout keepalive (empty frames) honoring the peer's ``open``
+  value, capped-exponential reconnect per partition.
+
+Consume-side only, like the 0-9-1 client: command egress goes through
+the MQTT/CoAP/HTTP destinations and outbound connectors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import uuid as _uuid
+from typing import Dict, List, Optional, Tuple
+
+from sitewhere_tpu.ingest.sources import Receiver, logger
+
+AMQP_HEADER = b"AMQP\x00\x01\x00\x00"
+SASL_HEADER = b"AMQP\x03\x01\x00\x00"
+
+FRAME_AMQP = 0
+FRAME_SASL = 1
+
+# performative / section / outcome descriptor codes (amqp-core v1.0)
+OPEN, BEGIN, ATTACH, FLOW, TRANSFER = 0x10, 0x11, 0x12, 0x13, 0x14
+DISPOSITION, DETACH, END, CLOSE = 0x15, 0x16, 0x17, 0x18
+SASL_MECHANISMS, SASL_INIT, SASL_OUTCOME = 0x40, 0x41, 0x44
+SOURCE, TARGET = 0x28, 0x29
+ACCEPTED = 0x24
+SEC_HEADER, SEC_DELIVERY_ANN, SEC_MESSAGE_ANN = 0x70, 0x71, 0x72
+SEC_PROPERTIES, SEC_APP_PROPERTIES = 0x73, 0x74
+SEC_DATA, SEC_SEQUENCE, SEC_VALUE, SEC_FOOTER = 0x75, 0x76, 0x77, 0x78
+
+# Event Hubs annotation / filter names
+OFFSET_ANNOTATION = "x-opt-offset"
+SELECTOR_FILTER = "apache.org:selector-filter:string"
+
+
+class Amqp10Error(Exception):
+    """Protocol violation or peer-initiated close."""
+
+
+# --------------------------------------------------------------------------
+# Type system
+
+
+class Symbol(str):
+    """AMQP symbol (encoded 0xA3/0xB3) — distinct from string on the wire."""
+
+
+class Described:
+    """A described value: ``descriptor`` applied to ``value``."""
+
+    __slots__ = ("descriptor", "value")
+
+    def __init__(self, descriptor, value):
+        self.descriptor = descriptor
+        self.value = value
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"Described({self.descriptor!r}, {self.value!r})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Described)
+                and other.descriptor == self.descriptor
+                and other.value == self.value)
+
+
+class _Uint(int):
+    """Force uint encoding (performative fields like handle/credit)."""
+
+
+class _Ulong(int):
+    """Force ulong encoding (descriptor codes)."""
+
+
+def encode_value(v) -> bytes:
+    """Encode one AMQP value (the subset the client emits)."""
+    if v is None:
+        return b"\x40"
+    if isinstance(v, Described):
+        return b"\x00" + encode_value(v.descriptor) + encode_value(v.value)
+    if isinstance(v, bool):
+        return b"\x41" if v else b"\x42"
+    if isinstance(v, _Ulong):
+        if v == 0:
+            return b"\x44"
+        if v < 256:
+            return b"\x53" + bytes([v])
+        return b"\x80" + struct.pack(">Q", v)
+    if isinstance(v, _Uint):
+        if v == 0:
+            return b"\x43"
+        if v < 256:
+            return b"\x52" + bytes([v])
+        return b"\x70" + struct.pack(">I", v)
+    if isinstance(v, int):
+        # plain ints encode as long (covers the signed range we use)
+        if -128 <= v < 128:
+            return b"\x55" + struct.pack(">b", v)
+        return b"\x81" + struct.pack(">q", v)
+    if isinstance(v, Symbol):
+        raw = v.encode("ascii")
+        if len(raw) < 256:
+            return b"\xa3" + bytes([len(raw)]) + raw
+        return b"\xb3" + struct.pack(">I", len(raw)) + raw
+    if isinstance(v, str):
+        raw = v.encode("utf-8")
+        if len(raw) < 256:
+            return b"\xa1" + bytes([len(raw)]) + raw
+        return b"\xb1" + struct.pack(">I", len(raw)) + raw
+    if isinstance(v, (bytes, bytearray)):
+        raw = bytes(v)
+        if len(raw) < 256:
+            return b"\xa0" + bytes([len(raw)]) + raw
+        return b"\xb0" + struct.pack(">I", len(raw)) + raw
+    if isinstance(v, float):
+        return b"\x82" + struct.pack(">d", v)
+    if isinstance(v, (list, tuple)):
+        if not v:
+            return b"\x45"
+        body = b"".join(encode_value(x) for x in v)
+        count = len(v)
+        if len(body) + 1 < 256 and count < 256:
+            return b"\xc0" + bytes([len(body) + 1, count]) + body
+        return (b"\xd0" + struct.pack(">II", len(body) + 4, count) + body)
+    if isinstance(v, dict):
+        body = b"".join(
+            encode_value(k) + encode_value(val) for k, val in v.items())
+        count = 2 * len(v)
+        if len(body) + 1 < 256 and count < 256:
+            return b"\xc1" + bytes([len(body) + 1, count]) + body
+        return b"\xd1" + struct.pack(">II", len(body) + 4, count) + body
+    raise Amqp10Error(f"cannot encode {type(v).__name__}")
+
+
+def decode_value(buf: bytes, off: int) -> Tuple[object, int]:
+    """Decode one AMQP value; returns (value, next_offset)."""
+    code = buf[off]
+    off += 1
+    if code == 0x00:  # described
+        descriptor, off = decode_value(buf, off)
+        value, off = decode_value(buf, off)
+        return Described(descriptor, value), off
+    if code == 0x40:
+        return None, off
+    if code == 0x41:
+        return True, off
+    if code == 0x42:
+        return False, off
+    if code == 0x56:
+        return buf[off] != 0, off + 1
+    if code == 0x43:
+        return 0, off
+    if code == 0x44:
+        return 0, off
+    if code in (0x50, 0x52, 0x53):  # ubyte / smalluint / smallulong
+        return buf[off], off + 1
+    if code in (0x51, 0x54, 0x55):  # byte / smallint / smalllong
+        return struct.unpack_from(">b", buf, off)[0], off + 1
+    if code == 0x60:
+        return struct.unpack_from(">H", buf, off)[0], off + 2
+    if code == 0x61:
+        return struct.unpack_from(">h", buf, off)[0], off + 2
+    if code == 0x70:
+        return struct.unpack_from(">I", buf, off)[0], off + 4
+    if code == 0x71:
+        return struct.unpack_from(">i", buf, off)[0], off + 4
+    if code == 0x72:
+        return struct.unpack_from(">f", buf, off)[0], off + 4
+    if code in (0x80, 0x83):  # ulong / timestamp(ms)
+        return struct.unpack_from(">Q", buf, off)[0], off + 8
+    if code == 0x81:
+        return struct.unpack_from(">q", buf, off)[0], off + 8
+    if code == 0x82:
+        return struct.unpack_from(">d", buf, off)[0], off + 8
+    if code == 0x98:
+        return _uuid.UUID(bytes=buf[off:off + 16]), off + 16
+    if code in (0xA0, 0xA1, 0xA3):
+        n = buf[off]
+        raw = buf[off + 1:off + 1 + n]
+        off += 1 + n
+    elif code in (0xB0, 0xB1, 0xB3):
+        n = struct.unpack_from(">I", buf, off)[0]
+        raw = buf[off + 4:off + 4 + n]
+        off += 4 + n
+    else:
+        raw = None
+    if raw is not None:
+        if code in (0xA0, 0xB0):
+            return bytes(raw), off
+        if code in (0xA3, 0xB3):
+            return Symbol(raw.decode("ascii")), off
+        return raw.decode("utf-8"), off
+    if code in (0x45, 0xC0, 0xD0):  # lists
+        if code == 0x45:
+            return [], off
+        if code == 0xC0:
+            size, count = buf[off], buf[off + 1]
+            off += 2
+        else:
+            size, count = struct.unpack_from(">II", buf, off)
+            off += 8
+        out: List[object] = []
+        for _ in range(count):
+            item, off = decode_value(buf, off)
+            out.append(item)
+        return out, off
+    if code in (0xC1, 0xD1):  # maps
+        if code == 0xC1:
+            _, count = buf[off], buf[off + 1]
+            off += 2
+        else:
+            _, count = struct.unpack_from(">II", buf, off)
+            off += 8
+        d: Dict[object, object] = {}
+        for _ in range(count // 2):
+            k, off = decode_value(buf, off)
+            val, off = decode_value(buf, off)
+            d[k] = val
+        return d, off
+    raise Amqp10Error(f"unsupported type code 0x{code:02x}")
+
+
+def performative(code: int, fields: List[object]) -> bytes:
+    """Encode a performative: described list with a ulong descriptor."""
+    return b"\x00" + encode_value(_Ulong(code)) + encode_value(list(fields))
+
+
+def amqp_frame(channel: int, body: bytes, ftype: int = FRAME_AMQP) -> bytes:
+    return struct.pack(">IBBH", 8 + len(body), 2, ftype, channel) + body
+
+
+EMPTY_FRAME = struct.pack(">IBBH", 8, 2, FRAME_AMQP, 0)  # keepalive
+
+
+class FrameReader:
+    """Incremental AMQP 1.0 framing: 4-byte size + DOFF + type + channel."""
+
+    def __init__(self, max_frame: int = 16 << 20):
+        self._buf = bytearray()
+        self.max_frame = max_frame
+
+    def feed(self, data: bytes) -> List[Tuple[int, int, bytes]]:
+        self._buf.extend(data)
+        frames: List[Tuple[int, int, bytes]] = []
+        while len(self._buf) >= 8:
+            size, doff, ftype, channel = struct.unpack_from(">IBBH", self._buf)
+            if size < 8 or size > self.max_frame:
+                raise Amqp10Error(f"bad frame size {size}")
+            if len(self._buf) < size:
+                break
+            body = bytes(self._buf[4 * doff:size])
+            del self._buf[:size]
+            frames.append((ftype, channel, body))
+        return frames
+
+
+def parse_frame_body(body: bytes) -> Tuple[Optional[Described], bytes]:
+    """Split a frame body into (performative, trailing payload bytes).
+
+    Empty (keepalive) frames return (None, b"")."""
+    if not body:
+        return None, b""
+    perf, off = decode_value(body, 0)
+    if not isinstance(perf, Described):
+        raise Amqp10Error("frame body is not a performative")
+    return perf, body[off:]
+
+
+def parse_message(payload: bytes) -> Tuple[bytes, Dict[object, object]]:
+    """Parse a bare message's sections → (body bytes, message annotations).
+
+    ``data`` sections concatenate; an ``amqp-value`` string body encodes
+    as UTF-8.  Unknown sections are skipped by construction (every
+    section is one described value)."""
+    off = 0
+    body = b""
+    annotations: Dict[object, object] = {}
+    while off < len(payload):
+        section, off = decode_value(payload, off)
+        if not isinstance(section, Described):
+            raise Amqp10Error("message section is not described")
+        code = section.descriptor
+        if code == SEC_MESSAGE_ANN and isinstance(section.value, dict):
+            annotations = section.value
+        elif code == SEC_DATA:
+            body += section.value if isinstance(section.value, bytes) else b""
+        elif code == SEC_VALUE:
+            v = section.value
+            if isinstance(v, bytes):
+                body += v
+            elif isinstance(v, str):
+                body += v.encode("utf-8")
+    return body, annotations
+
+
+# --------------------------------------------------------------------------
+# Receiver
+
+
+def _field(fields: List[object], i: int, default=None):
+    return fields[i] if i < len(fields) else default
+
+
+class EventHubReceiver(Receiver):
+    """Consume Event-Hub-style AMQP 1.0 partitions.
+
+    One link per partition at ``{hub}/ConsumerGroups/{group}/
+    Partitions/{n}``; per-partition offset checkpoints in
+    ``checkpoint_dir`` (when set) make reconnects resume instead of
+    replaying (the EventProcessorHost lease/checkpoint analog,
+    EventHubInboundEventReceiver.java)."""
+
+    def __init__(self, host: str, port: int = 5672,
+                 event_hub: str = "sitewhere",
+                 consumer_group: str = "$default",
+                 partitions: int = 1,
+                 username: str = "", password: str = "",
+                 sasl: str = "anonymous",
+                 credit: int = 64,
+                 idle_timeout_s: float = 30.0,
+                 checkpoint_dir: Optional[str] = None,
+                 reconnect_delay_s: float = 0.5,
+                 max_reconnect_delay_s: float = 30.0):
+        super().__init__(name=f"eventhub-receiver:{host}:{port}/{event_hub}")
+        self.host, self.port = host, port
+        self.event_hub = event_hub
+        self.consumer_group = consumer_group
+        self.partitions = int(partitions)
+        self.username, self.password = username, password
+        self.sasl = sasl.lower()
+        if self.sasl not in ("plain", "anonymous", "none"):
+            raise ValueError(f"sasl must be plain/anonymous/none: {sasl!r}")
+        self.credit = int(credit)
+        self.idle_timeout_s = float(idle_timeout_s)
+        self.checkpoint_dir = checkpoint_dir
+        self.reconnect_delay_s = reconnect_delay_s
+        self.max_reconnect_delay_s = max_reconnect_delay_s
+        self._alive = False
+        self._stop_evt = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._socks: Dict[int, socket.socket] = {}
+        self.connects = 0
+        self.accepted = 0
+        self.emit_errors = 0
+        self._offsets: Dict[int, str] = {}
+        # one lock for all partition threads: the checkpoint file is
+        # shared, and json.dump over a dict another thread mutates raises
+        self._ckpt_lock = threading.Lock()
+        self._ckpt_dirty = False
+        if checkpoint_dir:
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            self._load_offsets()
+
+    # -- checkpoints ---------------------------------------------------------
+
+    def _ckpt_path(self) -> str:
+        return os.path.join(self.checkpoint_dir,
+                            f"eventhub-{self.event_hub}.json")
+
+    def _load_offsets(self) -> None:
+        try:
+            with open(self._ckpt_path()) as f:
+                raw = json.load(f)
+            self._offsets = {int(k): str(v) for k, v in raw.items()}
+        except (OSError, ValueError):
+            self._offsets = {}
+
+    def _save_offsets(self) -> None:
+        if not self.checkpoint_dir:
+            return
+        path = self._ckpt_path()
+        with self._ckpt_lock:
+            snapshot = {str(k): v for k, v in self._offsets.items()}
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(snapshot, f)
+            os.replace(tmp, path)
+            self._ckpt_dirty = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._alive = True
+        self._stop_evt.clear()
+        for p in range(self.partitions):
+            t = threading.Thread(target=self._partition_loop, args=(p,),
+                                 daemon=True, name=f"{self.name}[{p}]")
+            self._threads.append(t)
+            t.start()
+        super().start()
+
+    def stop(self) -> None:
+        self._alive = False
+        self._stop_evt.set()
+        for sock in list(self._socks.values()):
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+        if self._ckpt_dirty:
+            try:
+                self._save_offsets()
+            except OSError:
+                logger.exception("%s: final checkpoint save failed", self.name)
+        super().stop()
+
+    # -- session -------------------------------------------------------------
+
+    def _recv_performative(self, sock, reader, pending,
+                           want: int) -> Tuple[Described, bytes, int]:
+        """Read frames until the wanted performative arrives; keepalives
+        are tolerated, ``close`` raises.  Coalesced frames after the
+        wanted one stay on ``pending`` (the 0-9-1 lesson: returning
+        mid-batch must not drop them)."""
+        while True:
+            while pending:
+                ftype, channel, body = pending.pop(0)
+                perf, payload = parse_frame_body(body)
+                if perf is None:
+                    continue
+                code = perf.descriptor
+                if code == CLOSE:
+                    err = _field(perf.value, 0)
+                    raise Amqp10Error(f"peer closed: {err!r}")
+                if code != want:
+                    raise Amqp10Error(
+                        f"expected 0x{want:02x}, got 0x{code:02x}")
+                return perf, payload, channel
+            data = sock.recv(65536)
+            if not data:
+                raise Amqp10Error("peer closed during bring-up")
+            pending.extend(reader.feed(data))
+
+    def _sasl_handshake(self, sock, reader) -> None:
+        sock.sendall(SASL_HEADER)
+        pending: List[Tuple[int, int, bytes]] = []
+        header = b""
+        while len(header) < 8:
+            chunk = sock.recv(8 - len(header))
+            if not chunk:
+                raise Amqp10Error("peer closed during SASL header")
+            header += chunk
+        if header != SASL_HEADER:
+            raise Amqp10Error(f"unexpected SASL header {header!r}")
+        self._recv_performative(sock, reader, pending, SASL_MECHANISMS)
+        if self.sasl == "plain":
+            init = b"\x00" + self.username.encode() + b"\x00" \
+                + self.password.encode()
+            mech = Symbol("PLAIN")
+        else:
+            init = b""
+            mech = Symbol("ANONYMOUS")
+        sock.sendall(amqp_frame(
+            0, performative(SASL_INIT, [mech, init]), FRAME_SASL))
+        outcome, _, _ = self._recv_performative(
+            sock, reader, pending, SASL_OUTCOME)
+        code = _field(outcome.value, 0, 1)
+        if code != 0:
+            raise Amqp10Error(f"SASL failed: code {code}")
+        if pending:
+            raise Amqp10Error("unexpected frames after SASL outcome")
+
+    def _attach_source(self, partition: int) -> Described:
+        address = (f"{self.event_hub}/ConsumerGroups/{self.consumer_group}"
+                   f"/Partitions/{partition}")
+        # source list: address, durable, expiry-policy, timeout, dynamic,
+        # dynamic-node-properties, distribution-mode, filter, ...
+        fields: List[object] = [address, None, None, None, None, None, None]
+        offset = self._offsets.get(partition)
+        if offset is not None:
+            # Event-Hub resume filter: replay only past the checkpoint
+            fields.append({
+                Symbol(SELECTOR_FILTER): Described(
+                    Symbol(SELECTOR_FILTER),
+                    f"amqp.annotation.{OFFSET_ANNOTATION} > '{offset}'"),
+            })
+        return Described(_Ulong(SOURCE), fields)
+
+    def _bring_up(self, partition: int):
+        sock = socket.create_connection((self.host, self.port), timeout=10)
+        try:
+            reader = FrameReader()
+            if self.sasl != "none":
+                self._sasl_handshake(sock, reader)
+                reader = FrameReader()  # fresh framing after SASL layer
+            sock.sendall(AMQP_HEADER)
+            header = b""
+            while len(header) < 8:
+                chunk = sock.recv(8 - len(header))
+                if not chunk:
+                    raise Amqp10Error("peer closed during AMQP header")
+                header += chunk
+            if header != AMQP_HEADER:
+                raise Amqp10Error(f"unexpected AMQP header {header!r}")
+            pending: List[Tuple[int, int, bytes]] = []
+            container = f"sitewhere-tpu-{os.getpid()}-{partition}"
+            # open: container-id, hostname, max-frame-size, channel-max,
+            # idle-time-out(ms)
+            sock.sendall(amqp_frame(0, performative(OPEN, [
+                container, self.host, _Uint(1 << 20), _Uint(0),
+                _Uint(int(self.idle_timeout_s * 1000))])))
+            open_perf, _, _ = self._recv_performative(
+                sock, reader, pending, OPEN)
+            peer_idle_ms = _field(open_perf.value, 4)
+            # begin: remote-channel, next-outgoing-id, incoming-window,
+            # outgoing-window
+            sock.sendall(amqp_frame(0, performative(BEGIN, [
+                None, _Uint(0), _Uint(2048), _Uint(2048)])))
+            self._recv_performative(sock, reader, pending, BEGIN)
+            # attach: name, handle, role(true=receiver), snd-settle-mode,
+            # rcv-settle-mode(0=first), source, target, unsettled,
+            # incomplete-unsettled, initial-delivery-count
+            link_name = f"{container}-link"
+            # rcv-settle-mode None = first (settle on our disposition)
+            sock.sendall(amqp_frame(0, performative(ATTACH, [
+                link_name, _Uint(0), True, None, None,
+                self._attach_source(partition),
+                Described(_Ulong(TARGET), [container])])))
+            attach, _, _ = self._recv_performative(
+                sock, reader, pending, ATTACH)
+            # broker's initial-delivery-count seeds our flow bookkeeping
+            idc = _field(attach.value, 9, 0) or 0
+            return sock, reader, pending, int(idc), peer_idle_ms
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+
+    def _send_flow(self, sock, delivery_count: int, credit: int,
+                   next_incoming: int) -> None:
+        # flow: next-incoming-id, incoming-window, next-outgoing-id,
+        # outgoing-window, handle, delivery-count, link-credit
+        sock.sendall(amqp_frame(0, performative(FLOW, [
+            _Uint(next_incoming), _Uint(2048), _Uint(0), _Uint(2048),
+            _Uint(0), _Uint(delivery_count), _Uint(credit)])))
+
+    def _settle(self, sock, delivery_id: int) -> None:
+        # disposition: role(true=receiver), first, last, settled, state
+        sock.sendall(amqp_frame(0, performative(DISPOSITION, [
+            True, _Uint(delivery_id), None, True,
+            Described(_Ulong(ACCEPTED), [])])))
+
+    # -- the consume loop ----------------------------------------------------
+
+    def _partition_loop(self, partition: int) -> None:
+        delay = self.reconnect_delay_s
+        while self._alive:
+            try:
+                sock, reader, pending, idc, peer_idle_ms = (
+                    self._bring_up(partition))
+            except Exception as e:
+                if not self._alive:
+                    return
+                logger.warning("%s[%d]: connect failed: %s",
+                               self.name, partition, e)
+                if self._stop_evt.wait(delay):
+                    return
+                delay = min(delay * 2, self.max_reconnect_delay_s)
+                continue
+            self._socks[partition] = sock
+            self.connects += 1
+            delay = self.reconnect_delay_s
+            try:
+                self._consume(sock, reader, pending, partition, idc,
+                              peer_idle_ms)
+            except Exception as e:
+                # broader than (OSError, Amqp10Error): a malformed frame
+                # surfaces as struct.error/IndexError/UnicodeDecodeError
+                # from the decode layer, and a dead partition thread is
+                # strictly worse than a reconnect
+                if self._alive:
+                    logger.warning("%s[%d]: session dropped: %s",
+                                   self.name, partition, e)
+            finally:
+                self._socks.pop(partition, None)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if self._ckpt_dirty:
+                    try:
+                        self._save_offsets()
+                    except OSError:
+                        logger.exception("%s[%d]: checkpoint save failed",
+                                         self.name, partition)
+            if self._alive and self._stop_evt.wait(delay):
+                return
+
+    def _consume(self, sock, reader, pending, partition: int,
+                 delivery_count: int, peer_idle_ms) -> None:
+        credit = self.credit
+        self._send_flow(sock, delivery_count, credit, 0)
+        keepalive = (peer_idle_ms / 1000.0 / 2.0
+                     if peer_idle_ms else self.idle_timeout_s)
+        sock.settimeout(max(0.2, keepalive))
+        last_send = time.monotonic()
+        assembling: Dict[int, bytes] = {}  # delivery-id → partial payload
+        next_incoming = 0
+        while self._alive:
+            frames = list(pending)
+            pending.clear()
+            if not frames:
+                try:
+                    data = sock.recv(65536)
+                except socket.timeout:
+                    if time.monotonic() - last_send >= keepalive:
+                        sock.sendall(EMPTY_FRAME)
+                        last_send = time.monotonic()
+                    continue
+                if not data:
+                    raise Amqp10Error("peer closed")
+                frames = reader.feed(data)
+            for ftype, channel, body in frames:
+                perf, payload = parse_frame_body(body)
+                if perf is None:
+                    continue  # keepalive
+                code = perf.descriptor
+                if code == CLOSE:
+                    raise Amqp10Error(f"peer closed: {_field(perf.value, 0)!r}")
+                if code in (DETACH, END):
+                    raise Amqp10Error(f"peer detached (0x{code:02x})")
+                if code == FLOW:
+                    continue
+                if code != TRANSFER:
+                    continue
+                # every transfer FRAME consumes one session transfer-id,
+                # continuations included — next-incoming-id must track
+                # frames, not deliveries, or the advertised window
+                # drifts one id per split transfer
+                next_incoming += 1
+                fields = perf.value
+                delivery_id = _field(fields, 1)
+                settled = bool(_field(fields, 4, False))
+                more = bool(_field(fields, 5, False))
+                if delivery_id is None:
+                    # continuation transfers may omit delivery-id
+                    delivery_id = next(iter(assembling), None)
+                if delivery_id is None:
+                    raise Amqp10Error("transfer without delivery-id")
+                assembling[delivery_id] = (
+                    assembling.get(delivery_id, b"") + payload)
+                if more:
+                    continue
+                message = assembling.pop(delivery_id)
+                delivery_count += 1
+                credit -= 1
+                self._handle_message(sock, partition, delivery_id,
+                                     settled, message)
+                if credit <= self.credit // 2:
+                    credit = self.credit
+                    self._send_flow(sock, delivery_count, credit,
+                                    next_incoming)
+                    last_send = time.monotonic()
+            if self._ckpt_dirty:
+                self._save_offsets()
+
+    def _handle_message(self, sock, partition: int, delivery_id: int,
+                        settled: bool, message: bytes) -> None:
+        body, annotations = parse_message(message)
+        try:
+            self._emit(body)
+        except Exception:
+            # The sink journals before returning; a failure here is a
+            # local fault — leave the delivery unsettled so the broker
+            # redelivers after reconnect (at-least-once).
+            self.emit_errors += 1
+            logger.exception("%s[%d]: sink rejected delivery %d",
+                             self.name, partition, delivery_id)
+            raise Amqp10Error("sink failure; recycling for redelivery")
+        # Checkpoint BEFORE settling: the sink has accepted (journaled)
+        # the message, so it counts as processed even if the settle dies
+        # with the socket — the resume filter then suppresses the
+        # redelivery a lost disposition would otherwise cause.  The dict
+        # updates here; the file write batches per recv burst (_consume)
+        # + session end, not per message.
+        self.accepted += 1
+        offset = annotations.get(Symbol(OFFSET_ANNOTATION))
+        if offset is None:
+            offset = annotations.get(OFFSET_ANNOTATION)
+        if offset is not None:
+            with self._ckpt_lock:
+                self._offsets[partition] = str(offset)
+                self._ckpt_dirty = True
+        if not settled:
+            self._settle(sock, delivery_id)

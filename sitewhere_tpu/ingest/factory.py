@@ -93,6 +93,14 @@ def _build_receiver(doc: Dict):
                 host=str(args.pop("host")),
                 port=int(args.pop("port", 5672)),
                 queue=str(args.pop("queue", "sitewhere.input")), **args)
+        if kind in ("eventhub", "amqp10"):
+            from sitewhere_tpu.ingest import amqp10
+
+            return amqp10.EventHubReceiver(
+                host=str(args.pop("host")),
+                port=int(args.pop("port", 5672)),
+                event_hub=str(args.pop("event_hub", "sitewhere")),
+                **args)
         if kind == "coap":
             return coap.CoapServerReceiver(
                 host=str(args.pop("host", "127.0.0.1")),
