@@ -6,6 +6,7 @@ recovery (events survive process restart).
 """
 
 import os
+import threading
 import time
 
 import numpy as np
@@ -705,3 +706,103 @@ def test_torn_chunk_quarantined_at_boot(tmp_path):
     store2.append_columns(make_cols(5, ts0=9000))
     store2.flush()
     assert store2._chunks[-1].seq == 2
+
+
+def test_unwritten_chunk_retry_and_sync_refusal(tmp_path, monkeypatch):
+    """A failed npz write parks the chunk on the retry list: its rows
+    stay readable (columns attached), flush(sync=True) REFUSES (the
+    commit gate must not commit past it), and the next flush writes the
+    file and detaches."""
+    store = EventStore(str(tmp_path), flush_rows=10_000, flush_interval_s=10)
+    store.append_columns(make_cols(25))
+    real = EventStore._write_chunk_file
+    boom = {"n": 0}
+
+    def failing(self, path, cols, chunk, sync=True):
+        boom["n"] += 1
+        raise OSError("disk full")
+
+    monkeypatch.setattr(EventStore, "_write_chunk_file", failing)
+    with pytest.raises(OSError):
+        store.flush()  # sync=True: must refuse on the unwritten chunk
+    assert boom["n"] == 1
+    assert len(store._unwritten) == 1
+    # rows are still fully readable from the attached columns
+    assert store.total_events == 25
+    assert store.get_event(event_id(0, 7)).device_id == 7
+    assert store.query(device_id=7).total == 1
+    assert not os.path.exists(
+        os.path.join(store.dir, "events-0000000000.npz"))
+
+    monkeypatch.setattr(EventStore, "_write_chunk_file", real)
+    assert store.flush() == 0  # no NEW rows; retries the parked chunk
+    assert not store._unwritten
+    assert os.path.exists(
+        os.path.join(store.dir, "events-0000000000.npz"))
+    # the retried chunk detached and survives a reopen
+    store2 = EventStore(str(tmp_path))
+    assert store2.total_events == 25
+    assert store2.get_event(event_id(0, 7)).device_id == 7
+
+
+def test_concurrent_flush_prune_read_stress(tmp_path):
+    """Writer + background flusher + retention prune + readers hammer
+    the two-phase flush concurrently; every surviving row stays
+    readable and accounting never goes negative."""
+    store = EventStore(str(tmp_path), flush_rows=64, flush_interval_s=0.01,
+                       retention_s=10_000)
+    store.start()
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                store.query(SearchCriteria(page_size=5))
+                store.total_events
+            except Exception as e:  # pragma: no cover - failure surface
+                errors.append(e)
+                return
+
+    def pruner():
+        while not stop.is_set():
+            try:
+                store.prune_older_than(int(time.time()) - 10_000)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    threads.append(threading.Thread(target=pruner))
+    for t in threads:
+        t.start()
+    try:
+        now = int(time.time())
+        total_new = 0
+        for i in range(60):
+            n = 17 + (i % 13)
+            if i % 3 == 0:
+                # expired rows: the concurrent pruner genuinely removes
+                # these chunks WHILE flush phase 2 may be writing them,
+                # exercising the pruned-mid-write unlink + the doomed
+                # _unwritten filter
+                store.append_columns(make_cols(n, ts0=now - 20_000))
+            else:
+                store.append_columns(make_cols(n, ts0=now))
+                total_new += n
+        store.flush()
+        # drain any expired chunks the racing pruner didn't get to;
+        # a chunk that mixed old+new rows straddles the cutoff and is
+        # rightly kept whole, so assert on the NEW rows' integrity, not
+        # an exact total
+        store.prune_older_than(int(time.time()) - 10_000)
+        res = store.query(SearchCriteria(start_s=now, page_size=10**6))
+        assert res.total == total_new
+        assert store.total_events >= total_new
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        store.stop()
+    assert not errors, errors
