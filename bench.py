@@ -1105,8 +1105,18 @@ def _update_summary(results: dict, all_configs: bool) -> None:
                 if v.get(f) is not None}
             for k, v in results.items()}
         c2 = results.get(2)
+        # A cached c2 line may predate the host_rtt_ms field; the
+        # headline's own RTT probe measured the same tunnel, so it
+        # stands in when the latency path ran on a TPU backend.
+        c2_rtt = (c2 or {}).get("host_rtt_ms")
+        if (c2_rtt is None and c2
+                and str(c2.get("backend", "")).startswith("tpu")
+                and str(head.get("backend", "")).startswith("tpu")):
+            # only a TPU-backed headline measured the same tunnel — a
+            # CPU-fallback headline's local RTT must not stand in
+            c2_rtt = head.get("host_rtt_ms")
         if (c2 and c2.get("latency_p99_ms") is not None
-                and (c2.get("host_rtt_ms") or 0) > 5.0):
+                and (c2_rtt or 0) > 5.0):
             # The <10 ms target cannot be met THROUGH a network-attached
             # chip: every plan's egress fetch pays ≥1 RTT.  Label it so
             # the p99 reads against the measured RTT, not as a framework
