@@ -139,6 +139,13 @@ class MqttClient:
         self._packet_id = 0
         self._suback = threading.Event()
         self._lock = threading.Lock()
+        # QoS-1 publishes outstanding (pid → sent): the publisher half of
+        # at-least-once — disconnect() drains these so closing the socket
+        # can never race the broker out of handling a still-buffered
+        # publish (an early close RSTs the connection and poisons the
+        # broker's receive buffer).
+        self._unacked: set = set()
+        self._acked = threading.Condition(self._lock)
         self._last_send = time.monotonic()
 
     # -- connection ---------------------------------------------------------
@@ -168,6 +175,10 @@ class MqttClient:
         # inbound traffic (MQTT keepalive counts CLIENT→server packets).
         sock.settimeout(max(0.5, min(self.keepalive / 4, 10.0)))
         self._sock = sock
+        # clean session: a pid a dead prior session never got acked can
+        # never be acked by THIS session — carrying it over would stall
+        # every later drain_publishes for its full timeout
+        self._unacked.clear()
         self._alive = True
         self._last_send = time.monotonic()
         self._pump = threading.Thread(target=self._pump_loop, daemon=True,
@@ -175,6 +186,10 @@ class MqttClient:
         self._pump.start()
 
     def disconnect(self) -> None:
+        if self._sock is not None and self._alive:
+            # publisher-side at-least-once: don't close under in-flight
+            # QoS-1 publishes (see _unacked)
+            self.drain_publishes(timeout=5.0)
         self._alive = False
         if self._sock is not None:
             try:
@@ -215,9 +230,29 @@ class MqttClient:
         if self._sock is None:
             raise MqttError("not connected")
         with self._lock:
-            write_publish(self._sock, topic, payload, qos,
-                          self._next_packet_id(), retain)
+            pid = self._next_packet_id()
+            if qos:
+                self._unacked.add(pid)
+            try:
+                write_publish(self._sock, topic, payload, qos, pid, retain)
+            except BaseException:
+                # never sent → never acked: leaking the pid would stall
+                # every later drain_publishes/disconnect for its timeout
+                self._unacked.discard(pid)
+                raise
             self._last_send = time.monotonic()
+
+    def drain_publishes(self, timeout: float = 5.0) -> bool:
+        """Wait until every QoS-1 publish has been PUBACKed (or timeout);
+        returns True when fully drained."""
+        deadline = time.monotonic() + timeout
+        with self._acked:
+            while self._unacked:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._alive:
+                    return not self._unacked
+                self._acked.wait(left)
+        return True
 
     # -- inbound pump -------------------------------------------------------
 
@@ -231,6 +266,16 @@ class MqttClient:
                 self._last_send = now
 
     def _pump_loop(self) -> None:
+        try:
+            self._pump_packets()
+        finally:
+            # a dead pump can never see another PUBACK: wake any drain
+            # waiter immediately instead of letting it sleep its timeout
+            with self._acked:
+                self._alive = False
+                self._acked.notify_all()
+
+    def _pump_packets(self) -> None:
         while self._alive and self._sock is not None:
             try:
                 self._maybe_ping()
@@ -259,6 +304,11 @@ class MqttClient:
                         )
             elif ptype == SUBACK:
                 self._suback.set()
+            elif ptype == PUBACK:
+                if len(body) >= 2:  # short body: tolerate, don't kill pump
+                    (pid,) = struct.unpack_from(">H", body, 0)
+                    with self._acked:
+                        self._unacked.discard(pid)
+                        self._acked.notify_all()
             elif ptype == PINGRESP:
                 pass
-            # PUBACK for our QoS1 publishes: fire-and-forget at-least-once.

@@ -258,10 +258,10 @@ class MqttBroker:
         topic, payload, qos, pid = parse_publish(flags, body)
         if qos > 1:
             raise MqttError("QoS 2 not supported by the hosted broker")
-        if qos == 1:
-            with session.lock:
-                session.sock.sendall(
-                    bytes([PUBACK << 4, 2]) + struct.pack(">H", pid))
+        # Deliver FIRST, ack LAST (at-least-once): a device that fires
+        # its publishes and closes immediately can make the PUBACK send
+        # fail with EPIPE — the message it successfully delivered must
+        # not be dropped with the session.
         self.published += 1
         for tap in self.on_publish:
             try:
@@ -269,6 +269,13 @@ class MqttBroker:
             except Exception:
                 logger.exception("mqtt broker tap failed for topic %s",
                                  topic)
+        # ack after the taps (the at-least-once state that matters) but
+        # BEFORE subscriber fan-out: a stalled subscriber's full send
+        # buffer must not block the publisher's PUBACK
+        if qos == 1:
+            with session.lock:
+                session.sock.sendall(
+                    bytes([PUBACK << 4, 2]) + struct.pack(">H", pid))
         self._fanout(topic, payload, qos, exclude=None)
 
     def _fanout(self, topic: str, payload: bytes, qos: int,

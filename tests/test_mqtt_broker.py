@@ -259,3 +259,28 @@ def test_factory_builds_hosted_broker_source():
     rx = sources[0].receivers[0]
     assert isinstance(rx, MqttBrokerReceiver)
     assert rx.topic_filter == "fleet/#"
+
+
+def test_burst_publish_then_disconnect_loses_nothing():
+    """A device that fires N QoS-1 publishes and immediately disconnects
+    must lose none: the client drains outstanding PUBACKs before closing
+    (publisher-side at-least-once), and the broker delivers to its taps
+    BEFORE acking, so an EPIPE on the ack can never drop a message."""
+    broker = MqttBroker()
+    broker.start()
+    seen = []
+    broker.on_publish.append(lambda t, p: seen.append(p))
+    try:
+        for round_no in range(5):
+            c = MqttClient("127.0.0.1", broker.port,
+                           client_id=f"burst-{round_no}")
+            c.connect()
+            for i in range(20):
+                c.publish("fleet/burst/events",
+                          b"m%d-%d" % (round_no, i), qos=1)
+            c.disconnect()  # immediately — no settling sleep
+        assert _wait(lambda: len(seen) == 100)
+        assert seen == [b"m%d-%d" % (r, i)
+                        for r in range(5) for i in range(20)]
+    finally:
+        broker.stop()
