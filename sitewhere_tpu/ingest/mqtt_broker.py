@@ -208,6 +208,13 @@ class MqttBroker:
         # keepalive enforcement: 1.5x grace, else drop the session
         conn.settimeout(keepalive * self.max_keepalive_grace
                         if keepalive else None)
+        # Bounded SENDS even for keepalive-0 (blocking-mode) sessions: a
+        # subscriber that stops reading fills its buffers, and an
+        # unbounded sendall to it would wedge whichever publisher thread
+        # is fanning out (SO_SNDTIMEO only applies in blocking mode; the
+        # keepalive>0 path's settimeout already bounds sends).
+        conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                        struct.pack("ll", 5, 0))
         conn.sendall(bytes([CONNACK << 4, 2, 0, 0]))  # session-present=0
         self.connects += 1
         return session

@@ -40,7 +40,7 @@ import os
 import re
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -197,8 +197,12 @@ class _ColumnCache:
         self._od: "OrderedDict[Tuple[int, str], np.ndarray]" = OrderedDict()
         # pruned seqs (never reused: the seq high-water marker only goes
         # up) — rejects a put() racing drop_seq(), which would otherwise
-        # park a dead column in the LRU that no reader ever asks for
+        # park a dead column in the LRU that no reader ever asks for.
+        # Bounded: the race window is one in-flight column load, so only
+        # RECENT tombstones matter; older ones expire FIFO (an unbounded
+        # set inside the memory-bounding feature would be ironic).
         self._dead: set = set()
+        self._dead_order: deque = deque()
         self._lock = threading.Lock()
         self.bytes = 0
         self.loads = 0
@@ -230,7 +234,11 @@ class _ColumnCache:
     def drop_seq(self, seq: int) -> None:
         """Forget a pruned chunk's columns (and refuse late arrivals)."""
         with self._lock:
-            self._dead.add(seq)
+            if seq not in self._dead:
+                self._dead.add(seq)
+                self._dead_order.append(seq)
+                while len(self._dead_order) > 1024:
+                    self._dead.discard(self._dead_order.popleft())
             for key in [k for k in self._od if k[0] == seq]:
                 self.bytes -= self._od.pop(key).nbytes
 
@@ -318,8 +326,11 @@ class _Chunk:
 
     def col(self, name: str) -> np.ndarray:
         """One column's array, loading (and caching) it if not resident."""
-        if self._cols is not None:
-            return self._cols[name]
+        # local capture: readers run lock-free while the flusher's
+        # detach() may null _cols between a check and a use
+        cols = self._cols
+        if cols is not None:
+            return cols[name]
         key = (self.seq, name)
         arr = self._cache.get(key)
         if arr is None:
@@ -331,8 +342,9 @@ class _Chunk:
     def materialize(self) -> Dict[str, np.ndarray]:
         """Every column (scan/page API) — via the cache when lazy, with
         ONE file open for all the columns a cold chunk is missing."""
-        if self._cols is not None:
-            return dict(self._cols)
+        cols = self._cols  # local capture: see col()
+        if cols is not None:
+            return dict(cols)
         out: Dict[str, np.ndarray] = {}
         missing: List[str] = []
         for name in _COLUMN_NAMES:
@@ -753,8 +765,6 @@ class EventStore(LifecycleComponent):
         max_rows = (1 << _ROW_BITS) - 1
         with self._flush_io:
             with self._lock:
-                retry = list(self._unwritten)
-                self._unwritten = []
                 new = []
                 if self._buffer:
                     merged = {
@@ -775,6 +785,13 @@ class EventStore(LifecycleComponent):
                             path = os.path.join(
                                 self.dir, f"events-{chunk.seq:010d}.npz")
                             self._chunks.append(chunk)
+                            # registered as unwritten in the SAME critical
+                            # section that publishes the chunk: no failure
+                            # below can strand a published chunk off the
+                            # retry list (a stranded chunk would let the
+                            # commit gate report durable-success for rows
+                            # that exist nowhere on disk)
+                            self._unwritten.append((chunk, part, path))
                             new.append((chunk, part, path))
                             self._next_seq += 1
                             done += len(part["ts_s"])
@@ -784,10 +801,17 @@ class EventStore(LifecycleComponent):
                             [remainder] if len(remainder["ts_s"]) else []
                         )
                         self._buffered_rows = total - done
+                work = list(self._unwritten)
                 if new:
                     # once per flush, not per chunk: boot recovers a stale
-                    # marker from the chunk files themselves
-                    self._write_marker(sync=False)
+                    # marker from the chunk files themselves.  Non-fatal:
+                    # a failed marker write must not abort the seal work
+                    # queued above (it is itself recoverable from the
+                    # chunk files at boot).
+                    try:
+                        self._write_marker(sync=False)
+                    except OSError:
+                        logger.exception("next-seq marker write failed")
                 self._last_flush = time.monotonic()
             flushed = sum(len(p["ts_s"]) for _, p, _ in new)
 
@@ -797,7 +821,7 @@ class EventStore(LifecycleComponent):
             # commit gate flushes sync=True, which settles the deferred
             # fsyncs (and refuses on any unwritten chunk) first.
             failed = []
-            for chunk, part, path in retry + new:
+            for chunk, part, path in work:
                 try:
                     self._write_chunk_file(path, part, chunk, sync=False)
                 except OSError:
@@ -819,7 +843,13 @@ class EventStore(LifecycleComponent):
                         except OSError:
                             pass
             with self._lock:
-                self._unwritten = failed + self._unwritten
+                # entries stayed registered throughout; release the ones
+                # whose files landed (failed ones remain for retry — as
+                # do any a concurrent prune already filtered out)
+                written = ({id(e[0]) for e in work}
+                           - {id(e[0]) for e in failed})
+                self._unwritten = [e for e in self._unwritten
+                                   if id(e[0]) not in written]
                 if sync:
                     self._sync_durable()
             if sync and failed:

@@ -91,8 +91,15 @@ def test_instance_boots_config_sources_and_ingests(tmp_path):
         with socket.create_connection(("127.0.0.1", rx.port), timeout=5) as s:
             s.sendall(struct.pack(">I", len(payload)) + payload)
         assert _wait(lambda: src.decoded_count >= 1)
-        inst.dispatcher.flush()
-        assert inst.event_store.total_events == 1
+
+        # decoded_count can tick before the row lands in the batcher
+        # (the source thread is mid-forward), so a single flush may run
+        # too early under load — flush-and-check until it lands
+        def settled():
+            inst.dispatcher.flush()
+            return inst.event_store.total_events == 1
+
+        assert _wait(settled)
     finally:
         inst.stop()
         inst.terminate()

@@ -296,18 +296,25 @@ def test_device_streams_over_wire_source(tmp_path):
 
         rx = inst.sources[0].receivers[0]
 
-        def send(doc):
-            payload = _json.dumps(doc).encode()
+        def send(*docs):
+            # ONE connection for ordered payloads: separate connections
+            # land on separate ThreadingTCPServer handler threads, whose
+            # scheduling does not preserve send order (a chunk racing
+            # ahead of its stream-create dead-letters) — per-connection
+            # ordering is the contract a streaming device actually has
+            payload = b"".join(
+                struct.pack(">I", len(p)) + p
+                for p in (_json.dumps(d).encode() for d in docs))
             with socket.create_connection(("127.0.0.1", rx.port),
                                           timeout=5) as s:
-                s.sendall(struct.pack(">I", len(payload)) + payload)
+                s.sendall(payload)
 
         send({"deviceToken": "cam-1", "type": "DeviceStream",
-              "request": {"streamId": "clip-1", "contentType": "video/mp4"}})
-        send({"deviceToken": "cam-1", "type": "StreamData",
+              "request": {"streamId": "clip-1", "contentType": "video/mp4"}},
+             {"deviceToken": "cam-1", "type": "StreamData",
               "request": {"streamId": "clip-1", "sequenceNumber": 0,
-                          "data": base64.b64encode(b"AB").decode()}})
-        send({"deviceToken": "cam-1", "type": "StreamData",
+                          "data": base64.b64encode(b"AB").decode()}},
+             {"deviceToken": "cam-1", "type": "StreamData",
               "request": {"streamId": "clip-1", "sequenceNumber": 1,
                           "data": base64.b64encode(b"CD").decode()}})
 
