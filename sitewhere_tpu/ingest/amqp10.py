@@ -540,12 +540,7 @@ class EventHubReceiver(Receiver):
             if self.sasl != "none":
                 self._sasl_handshake(sock)
             sock.sendall(AMQP_HEADER)
-            header = b""
-            while len(header) < 8:
-                chunk = sock.recv(8 - len(header))
-                if not chunk:
-                    raise Amqp10Error("peer closed during AMQP header")
-                header += chunk
+            header = self._read_exact(sock, 8)
             if header != AMQP_HEADER:
                 raise Amqp10Error(f"unexpected AMQP header {header!r}")
             pending: List[Tuple[int, int, bytes]] = []

@@ -301,7 +301,13 @@ class MqttBroker:
                                   s.next_packet_id() if out_qos else 0)
                 self.delivered += 1
             except OSError:
-                pass  # reader thread notices and reaps the session
+                # A send failure/timeout means the subscriber is dead or
+                # not reading (full buffers) — and a timed-out sendall
+                # may have written a PARTIAL frame, corrupting its
+                # stream.  Close the socket so its reader thread reaps
+                # the session; otherwise every future matching publish
+                # would stall the full send timeout on it, forever.
+                s.close()
 
     def _handle_subscribe(self, session: _Session, body: bytes) -> None:
         (pid,) = struct.unpack_from(">H", body, 0)

@@ -78,11 +78,14 @@ class MiniEventHub:
     """Server side of the AMQP 1.0 subset, one partition link."""
 
     def __init__(self, messages=None, expect_plain=None, drop_after=None,
-                 split_transfer=False):
+                 split_transfer=False, pipeline_after_sasl=False):
         self.messages = list(messages or [])
         self.expect_plain = expect_plain  # (user, password) or None
         self.drop_after = drop_after      # close socket after N transfers
         self.split_transfer = split_transfer
+        # coalesce sasl-outcome + the AMQP protocol header into ONE send
+        # (AMQP 1.0 permits the server to pipeline the next layer)
+        self.pipeline_after_sasl = pipeline_after_sasl
         self.sock = socket.socket()
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind(("127.0.0.1", 0))
@@ -159,13 +162,19 @@ class MiniEventHub:
                 assert str(mech) == "PLAIN"
                 user, pw = self.expect_plain
                 assert resp == b"\x00" + user.encode() + b"\x00" + pw.encode()
-            conn.sendall(amqp_frame(0, performative(
-                SASL_OUTCOME, [0, None]), FRAME_SASL))
+            outcome = amqp_frame(0, performative(
+                SASL_OUTCOME, [0, None]), FRAME_SASL)
+            if self.pipeline_after_sasl:
+                # one segment: outcome + our AMQP header, pipelined
+                conn.sendall(outcome + AMQP_HEADER)
+            else:
+                conn.sendall(outcome)
             reader = FrameReader()
             pending = []
             header = conn.recv(8)
         assert header == AMQP_HEADER, header
-        conn.sendall(AMQP_HEADER)
+        if not self.pipeline_after_sasl:
+            conn.sendall(AMQP_HEADER)
         self._recv_perf(conn, reader, pending, OPEN)
         conn.sendall(amqp_frame(0, performative(OPEN, [
             "mini-eventhub", None, _Uint(1 << 20), _Uint(0), _Uint(30000)])))
@@ -406,6 +415,23 @@ def test_sink_failure_leaves_unsettled_and_recycles(tmp_path):
         assert _wait(lambda: seen == [b"bad", b"good"])
         assert r.emit_errors == 1
         assert broker.sessions >= 2
+    finally:
+        r.stop()
+        broker.close()
+
+
+def test_server_pipelining_amqp_header_after_sasl(tmp_path):
+    """AMQP 1.0 permits the server to pipeline its protocol header (and
+    beyond) behind sasl-outcome in one TCP segment; the SASL phase must
+    not consume or misparse bytes past the outcome frame boundary."""
+    broker = MiniEventHub(messages=[b"pipelined"], pipeline_after_sasl=True)
+    seen = []
+    r = make_receiver(broker, tmp_path)
+    r.sink = seen.append
+    r.start()
+    try:
+        assert _wait(lambda: seen == [b"pipelined"])
+        assert broker.sessions == 1  # no failed connect/reconnect spin
     finally:
         r.stop()
         broker.close()
