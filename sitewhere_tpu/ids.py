@@ -22,6 +22,25 @@ from typing import Dict, Iterable, List, Optional
 NULL_ID = -1
 
 
+def _tt_set(table, token: str, hid: int) -> None:
+    """Mirror one mapping into a C TokenTable, skipping tokens that are
+    not UTF-8-encodable (lone surrogates).  Such tokens can never match
+    on the resolved wire path anyway — the C scanner only accepts strict
+    UTF-8 payload bytes and bails on escape sequences — so omitting them
+    just routes their (impossible) lines through the Python fallback."""
+    try:
+        table.set(token, hid)
+    except UnicodeEncodeError:
+        pass
+
+
+def _tt_discard(table, token: str) -> None:
+    try:
+        table.discard(token)
+    except UnicodeEncodeError:
+        pass
+
+
 def stable_hash64(token: str) -> int:
     """Collision-safe 64-bit content hash of a token.
 
@@ -49,6 +68,9 @@ class HandleSpace:
         self._token_to_id: Dict[str, int] = {}
         self._id_to_token: List[Optional[str]] = []
         self._free: List[int] = []
+        # C-side mirror for the resolved wire scanner (built lazily by
+        # native_table(); every mutator keeps it in sync under _lock).
+        self._native = None
 
     def __len__(self) -> int:
         return len(self._token_to_id)
@@ -87,6 +109,8 @@ class HandleSpace:
                 )
             self._id_to_token.append(token)
         self._token_to_id[token] = hid
+        if self._native is not None:
+            _tt_set(self._native, token, hid)
         return hid
 
     def free(self, token: str) -> None:
@@ -96,6 +120,32 @@ class HandleSpace:
             if hid != NULL_ID:
                 self._id_to_token[hid] = None
                 self._free.append(hid)
+                if self._native is not None:
+                    _tt_discard(self._native, token)
+
+    def native_table(self):
+        """C-side byte->id mirror for the resolved wire scanner, or None.
+
+        Built lazily on first use (the device space is the only one the
+        wire path resolves at rate); after that every mint/free keeps it
+        in sync, so the scanner's lookups match ``lookup`` exactly.  The
+        scanner resolves GIL-held and mutators run GIL-held too, so no
+        extra synchronization is needed on the C side.
+        """
+        if self._native is not None:
+            return self._native
+        from sitewhere_tpu.native import load_swwire
+
+        mod = load_swwire()
+        if mod is None or not hasattr(mod, "TokenTable"):
+            return None
+        with self._lock:
+            if self._native is None:
+                table = mod.TokenTable()
+                for token, hid in self._token_to_id.items():
+                    _tt_set(table, token, hid)
+                self._native = table
+        return self._native
 
     def token_of(self, hid: int) -> Optional[str]:
         """Reverse lookup (host-side only, e.g. for REST responses)."""
@@ -134,6 +184,21 @@ class HandleSpace:
             }
             self._free = [hid for hid, t in enumerate(self._id_to_token)
                           if t is None]
+            if self._native is not None:
+                # Build a fully-populated replacement and SWAP — readers
+                # (the dispatcher re-fetches per payload) see a complete
+                # old or complete new table, matching the atomicity of
+                # the _token_to_id dict assignment above.  An in-place
+                # clear()+set() rebuild would expose an empty/partial
+                # table to a concurrent resolved decode.
+                from sitewhere_tpu.native import load_swwire
+
+                mod = load_swwire()
+                table = mod.TokenTable() if mod is not None else None
+                if table is not None:
+                    for token, hid in self._token_to_id.items():
+                        _tt_set(table, token, hid)
+                self._native = table
 
 
 class IdentityMap:

@@ -66,8 +66,32 @@ _KIND_EXACT.update({
 })
 
 
+def space_of(resolve_device):
+    """The HandleSpace behind a bound ``lookup`` resolver, else None.
+
+    Only ``HandleSpace.lookup`` itself qualifies — a caller passing e.g.
+    ``mint`` (or any other callable) keeps its semantics and the
+    pre-resolved fast paths stay off.
+    """
+    from sitewhere_tpu.ids import HandleSpace
+
+    owner = getattr(resolve_device, "__self__", None)
+    if isinstance(owner, HandleSpace) \
+            and getattr(resolve_device, "__func__", None) \
+            is HandleSpace.lookup:
+        return owner
+    return None
+
+
+def n_rows(columns: Dict[str, object]) -> int:
+    """Event-row count of a decoded column dict, resolved or not."""
+    return len(columns["device_id"] if "device_id" in columns
+               else columns["device_token"])
+
+
 def decode_json_lines(
     payload: bytes,
+    device_space=None,
 ) -> Tuple[Dict[str, object], List[DecodedRequest]]:
     """Decode one NDJSON (or JSON-array) wire payload columnar-ly.
 
@@ -84,7 +108,21 @@ def decode_json_lines(
     :class:`DecodeError` if the payload as a whole cannot be parsed; a
     malformed individual line raises too (the whole payload dead-letters,
     matching the reference's per-payload failed-decode contract).
+
+    With ``device_space`` (the HandleSpace the caller would resolve
+    ``device_token`` against), homogeneous measurement payloads take the
+    C scanner's RESOLVED form: ``columns`` then carries ``device_id``
+    (int32, NULL_ID for unknown tokens — the step flags those rows
+    unregistered and egress replays them from the journal) instead of
+    ``device_token``, and ``mtype_uniq``/``mtype_idx`` instead of a
+    per-row ``mtype`` list; :func:`resolve_columns` understands both
+    shapes.  Token strings are never materialized for registered
+    devices — the dominant per-line cost of the unresolved path.
     """
+    if device_space is not None:
+        resolved = _native_decode_resolved(payload, device_space)
+        if resolved is not None:
+            return resolved
     native = _native_decode(payload)
     if native is not None:
         return native
@@ -97,6 +135,49 @@ def decode_json_lines(
         # must dead-letter like any other decode failure, never escape
         # into the receiver thread (scalar-path contract, decoders.py).
         raise DecodeError(f"bad wire batch: {e}") from e
+
+
+def _native_decode_resolved(
+    payload: bytes,
+    device_space,
+) -> Optional[Tuple[Dict[str, object], List[DecodedRequest]]]:
+    """C fast path with device tokens resolved in C (TokenTable mirror).
+
+    Same strictness contract as :func:`_native_decode`'s measurement
+    scanner — any shape deviation returns None and the caller falls
+    through to the unresolved native path, then pure Python.
+    """
+    from sitewhere_tpu.native import load_swwire
+
+    mod = load_swwire()
+    if mod is None or not hasattr(mod, "decode_measurement_lines_resolved") \
+            or not isinstance(payload, bytes) or payload[:1] == b"[":
+        return None
+    table = device_space.native_table()
+    if table is None:
+        return None
+    out = mod.decode_measurement_lines_resolved(payload, table)
+    if out is None:
+        return None
+    ids_b, uniq_names, idx_b, values_b, ts_b, us_b = out
+    # copy: frombuffer views are read-only and the batcher may rewrite
+    # device_id in place for out-of-range rows (2 KB per 512-line payload)
+    device_id = np.frombuffer(ids_b, np.int32).copy()
+    n = len(device_id)
+    ts_s, ts_ns = _split_epoch(np.frombuffer(ts_b, np.float64))
+    zeros = np.zeros(n, np.float32)
+    return {
+        "device_id": device_id,
+        "event_type": np.zeros(n, np.int32),  # all MEASUREMENT
+        "ts_s": ts_s, "ts_ns": ts_ns,
+        "mtype_uniq": uniq_names,
+        "mtype_idx": np.frombuffer(idx_b, np.int32),
+        "value": np.frombuffer(values_b, np.float64).astype(np.float32),
+        "lat": zeros, "lon": zeros, "elevation": zeros,
+        "alert_code": np.full(n, NULL_ID, np.int32),
+        "alert_level": np.zeros(n, np.int32),
+        "update_state": np.frombuffer(us_b, np.uint8).astype(np.bool_),
+    }, []
 
 
 def _native_decode(
@@ -401,27 +482,28 @@ def resolve_columns(
     Hot-path shape: device tokens resolve through the HandleSpace's bulk
     lookup when available (one C-level listcomp instead of a Python
     callable per token), and name columns memoize per payload (a fleet
-    payload typically carries a handful of measurement names).
+    payload typically carries a handful of measurement names).  Columns
+    the C resolved scanner already mapped (``device_id``, ``alert_code``,
+    ``mtype_uniq``/``mtype_idx``) pass through; only the unique names are
+    minted here — the HandleSpace stays the one authority for handles.
     """
-    from sitewhere_tpu.ids import HandleSpace
-
-    tokens = columns["device_token"]
-    n = len(tokens)
+    n = n_rows(columns)
     out: Dict[str, np.ndarray] = {
         k: columns[k]
         for k in ("event_type", "ts_s", "ts_ns", "value", "lat", "lon",
                   "elevation", "alert_level", "update_state")
     }
-    owner = getattr(resolve_device, "__self__", None)
-    if isinstance(owner, HandleSpace) \
-            and getattr(resolve_device, "__func__", None) \
-            is HandleSpace.lookup:
-        # only substitute the bulk form for lookup itself — a caller
-        # passing e.g. HandleSpace.mint must keep its semantics
-        out["device_id"] = np.asarray(owner.lookup_many(tokens), np.int32)
+    if "device_id" in columns:
+        out["device_id"] = np.asarray(columns["device_id"], np.int32)
     else:
-        out["device_id"] = np.fromiter(
-            (resolve_device(t) for t in tokens), np.int32, n)
+        tokens = columns["device_token"]
+        owner = space_of(resolve_device)
+        if owner is not None:
+            out["device_id"] = np.asarray(
+                owner.lookup_many(tokens), np.int32)
+        else:
+            out["device_id"] = np.fromiter(
+                (resolve_device(t) for t in tokens), np.int32, n)
 
     def memoized(names, resolve) -> np.ndarray:
         mapping = {
@@ -429,8 +511,17 @@ def resolve_columns(
         }
         return np.asarray([mapping[m] for m in names], np.int32)
 
-    out["mtype_id"] = memoized(columns["mtype"], resolve_mtype)
-    out["alert_code"] = memoized(columns["alert_type"], resolve_alert)
+    if "mtype_uniq" in columns:
+        uniq_ids = np.asarray(
+            [resolve_mtype(u) for u in columns["mtype_uniq"]], np.int32)
+        out["mtype_id"] = (uniq_ids[columns["mtype_idx"]] if len(uniq_ids)
+                           else np.full(n, NULL_ID, np.int32))
+    else:
+        out["mtype_id"] = memoized(columns["mtype"], resolve_mtype)
+    if "alert_code" in columns:
+        out["alert_code"] = np.asarray(columns["alert_code"], np.int32)
+    else:
+        out["alert_code"] = memoized(columns["alert_type"], resolve_alert)
     origins = columns.get("origin")
     if origins is not None and invocations is not None:
         from sitewhere_tpu.schema import EventType

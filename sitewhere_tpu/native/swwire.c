@@ -1221,10 +1221,354 @@ fail:
     return out; /* NULL propagates the error */
 }
 
+/* ---- TokenTable: byte-keyed token -> dense-id hash ------------------
+ *
+ * The wire scanner's per-line cost after the C scan was Python object
+ * churn: one PyUnicode per device token plus one dict.get against the
+ * HandleSpace map (~0.45 ms per 512-line payload, ~35% of intake).
+ * This table mirrors one HandleSpace (ids.py) as raw byte keys so the
+ * resolved scanner below maps token slices straight to int32 handles —
+ * token strings are never materialized for registered devices.
+ *
+ * Concurrency contract: every mutator is a Python method (GIL held) and
+ * every reader runs GIL-held too (the resolved scanner looks up in its
+ * phase-2 materialization, never inside Py_BEGIN_ALLOW_THREADS), so no
+ * C-side lock is needed and a reader can never see a torn entry.
+ */
+
+typedef struct {
+    char *key;        /* owned copy; NULL = empty, TT_TOMB = tombstone */
+    Py_ssize_t len;
+    uint32_t hash;
+    int32_t id;
+} tt_entry;
+
+static char tt_tomb_sentinel;
+#define TT_TOMB (&tt_tomb_sentinel)
+
+typedef struct {
+    PyObject_HEAD
+    tt_entry *slots;
+    Py_ssize_t nslots;  /* power of two */
+    Py_ssize_t used;    /* live entries */
+    Py_ssize_t fill;    /* live + tombstones */
+} TokenTableObject;
+
+static uint32_t tt_hash(const char *p, Py_ssize_t n) {
+    uint32_t h = 2166136261u; /* FNV-1a */
+    for (Py_ssize_t i = 0; i < n; i++) {
+        h ^= (unsigned char)p[i];
+        h *= 16777619u;
+    }
+    return h;
+}
+
+/* Find the slot for (p,len,h): returns a live match, or the first
+ * insertable slot (empty or tombstone) seen on the probe path. */
+static tt_entry *tt_probe(TokenTableObject *t, const char *p,
+                          Py_ssize_t len, uint32_t h) {
+    Py_ssize_t mask = t->nslots - 1;
+    size_t perturb = h;
+    Py_ssize_t i = (Py_ssize_t)(h & (uint32_t)mask);
+    tt_entry *avail = NULL;
+    for (;;) {
+        tt_entry *e = &t->slots[i];
+        if (e->key == NULL)
+            return avail ? avail : e;
+        if (e->key == TT_TOMB) {
+            if (!avail) avail = e;
+        } else if (e->hash == h && e->len == len &&
+                   memcmp(e->key, p, (size_t)len) == 0) {
+            return e;
+        }
+        perturb >>= 5;
+        i = (Py_ssize_t)((i * 5 + 1 + perturb) & (size_t)mask);
+    }
+}
+
+static int32_t tt_find(TokenTableObject *t, const char *p, Py_ssize_t len) {
+    tt_entry *e = tt_probe(t, p, len, tt_hash(p, len));
+    return (e->key != NULL && e->key != TT_TOMB) ? e->id : -1;
+}
+
+static int tt_grow(TokenTableObject *t) {
+    /* Size from LIVE entries, not current slots: pure tombstone churn
+     * (free+mint cycles at a stable fleet size) then rebuilds at the
+     * same — or smaller — size instead of doubling without bound.
+     * Post-rebuild load (used/nn) stays under 2/3, so the insert that
+     * triggered the grow proceeds without an immediate re-grow. */
+    Py_ssize_t nn = 1024;
+    tt_entry *old = t->slots, *ns;
+    Py_ssize_t on = t->nslots;
+    while (nn * 2 < (t->used + 1) * 3) nn *= 2;
+    ns = (tt_entry *)calloc((size_t)nn, sizeof(tt_entry));
+    if (!ns) return -1;
+    t->slots = ns;
+    t->nslots = nn;
+    t->fill = t->used;
+    for (Py_ssize_t i = 0; i < on; i++) {
+        tt_entry *e = &old[i];
+        if (e->key == NULL || e->key == TT_TOMB) continue;
+        tt_entry *dst = tt_probe(t, e->key, e->len, e->hash);
+        *dst = *e;
+    }
+    free(old);
+    return 0;
+}
+
+static int tt_set(TokenTableObject *t, const char *p, Py_ssize_t len,
+                  int32_t id) {
+    if ((t->fill + 1) * 3 >= t->nslots * 2 && tt_grow(t) != 0)
+        return -1;
+    uint32_t h = tt_hash(p, len);
+    tt_entry *e = tt_probe(t, p, len, h);
+    if (e->key != NULL && e->key != TT_TOMB) {
+        e->id = id; /* re-set: update in place */
+        return 0;
+    }
+    char *copy = (char *)malloc(len ? (size_t)len : 1);
+    if (!copy) return -1;
+    memcpy(copy, p, (size_t)len);
+    if (e->key == NULL) t->fill++;
+    e->key = copy;
+    e->len = len;
+    e->hash = h;
+    e->id = id;
+    t->used++;
+    return 0;
+}
+
+static void tt_discard(TokenTableObject *t, const char *p, Py_ssize_t len) {
+    tt_entry *e = tt_probe(t, p, len, tt_hash(p, len));
+    if (e->key != NULL && e->key != TT_TOMB) {
+        free(e->key);
+        e->key = TT_TOMB;
+        e->len = 0;
+        t->used--;
+    }
+}
+
+/* Accept str (UTF-8) or bytes keys. 0 ok, -1 error (exception set). */
+static int tt_key_arg(PyObject *obj, const char **p, Py_ssize_t *len) {
+    if (PyUnicode_Check(obj)) {
+        *p = PyUnicode_AsUTF8AndSize(obj, len);
+        return *p ? 0 : -1;
+    }
+    if (PyBytes_Check(obj))
+        return PyBytes_AsStringAndSize(obj, (char **)p, len);
+    PyErr_SetString(PyExc_TypeError, "token must be str or bytes");
+    return -1;
+}
+
+static PyObject *TokenTable_new(PyTypeObject *type, PyObject *args,
+                                PyObject *kwds) {
+    TokenTableObject *t = (TokenTableObject *)type->tp_alloc(type, 0);
+    if (!t) return NULL;
+    t->nslots = 1024;
+    t->used = t->fill = 0;
+    t->slots = (tt_entry *)calloc((size_t)t->nslots, sizeof(tt_entry));
+    if (!t->slots) {
+        Py_DECREF(t);
+        return PyErr_NoMemory();
+    }
+    return (PyObject *)t;
+}
+
+static void TokenTable_dealloc(TokenTableObject *t) {
+    for (Py_ssize_t i = 0; i < t->nslots; i++) {
+        char *k = t->slots[i].key;
+        if (k != NULL && k != TT_TOMB) free(k);
+    }
+    free(t->slots);
+    Py_TYPE(t)->tp_free((PyObject *)t);
+}
+
+static PyObject *TokenTable_set(TokenTableObject *t, PyObject *args) {
+    PyObject *key;
+    int id;
+    if (!PyArg_ParseTuple(args, "Oi", &key, &id)) return NULL;
+    const char *p; Py_ssize_t len;
+    if (tt_key_arg(key, &p, &len) != 0) return NULL;
+    if (tt_set(t, p, len, (int32_t)id) != 0) return PyErr_NoMemory();
+    Py_RETURN_NONE;
+}
+
+static PyObject *TokenTable_discard(TokenTableObject *t, PyObject *key) {
+    const char *p; Py_ssize_t len;
+    if (tt_key_arg(key, &p, &len) != 0) return NULL;
+    tt_discard(t, p, len);
+    Py_RETURN_NONE;
+}
+
+static PyObject *TokenTable_get(TokenTableObject *t, PyObject *key) {
+    const char *p; Py_ssize_t len;
+    if (tt_key_arg(key, &p, &len) != 0) return NULL;
+    return PyLong_FromLong((long)tt_find(t, p, len));
+}
+
+static PyObject *TokenTable_clear(TokenTableObject *t, PyObject *ignored) {
+    for (Py_ssize_t i = 0; i < t->nslots; i++) {
+        char *k = t->slots[i].key;
+        if (k != NULL && k != TT_TOMB) free(k);
+        t->slots[i].key = NULL;
+        t->slots[i].len = 0;
+    }
+    t->used = t->fill = 0;
+    Py_RETURN_NONE;
+}
+
+static Py_ssize_t TokenTable_len(TokenTableObject *t) { return t->used; }
+
+static PyMethodDef TokenTable_methods[] = {
+    {"set", (PyCFunction)TokenTable_set, METH_VARARGS,
+     "set(token, id) — insert or update one mapping."},
+    {"discard", (PyCFunction)TokenTable_discard, METH_O,
+     "discard(token) — remove a mapping if present."},
+    {"get", (PyCFunction)TokenTable_get, METH_O,
+     "get(token) -> id, or -1 (NULL_ID) when absent."},
+    {"clear", (PyCFunction)TokenTable_clear, METH_NOARGS,
+     "Remove every mapping."},
+    {NULL, NULL, 0, NULL},
+};
+
+static PySequenceMethods TokenTable_as_sequence = {
+    .sq_length = (lenfunc)TokenTable_len,
+};
+
+static PyTypeObject TokenTableType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "_swwire.TokenTable",
+    .tp_basicsize = sizeof(TokenTableObject),
+    .tp_dealloc = (destructor)TokenTable_dealloc,
+    .tp_as_sequence = &TokenTable_as_sequence,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "Byte-keyed token -> int32 handle map for the resolved "
+              "wire scanner (HandleSpace mirror).",
+    .tp_methods = TokenTable_methods,
+    .tp_new = TokenTable_new,
+};
+
+/* ---- decode_measurement_lines_resolved ------------------------------
+ *
+ * Same strictness contract as decode_measurement_lines (shared
+ * scan_lines), but returns device ids resolved through a TokenTable
+ * (unknown token -> -1 == NULL_ID: the jitted step flags the row
+ * unregistered and egress replays it from the journal by payload_ref,
+ * so the token string is never needed) and measurement names deduped to
+ * (uniques, int32 index) — the only Python strings created are the few
+ * distinct names a fleet payload carries.
+ *
+ * Returns (ids i32, uniq_names list[str], name_idx i32, values f64,
+ *          ts f64, update u8) or None (bail -> caller falls back).
+ */
+
+#define UNIQ_CAP 256
+
+static PyObject *decode_measurement_lines_resolved(PyObject *self,
+                                                   PyObject *args) {
+    PyObject *payload;
+    TokenTableObject *table;
+    if (!PyArg_ParseTuple(args, "SO!", &payload, &TokenTableType, &table))
+        return NULL;
+    Py_buffer view;
+    if (PyObject_GetBuffer(payload, &view, PyBUF_SIMPLE) != 0) return NULL;
+    const char *buf = (const char *)view.buf;
+    Py_ssize_t n = view.len;
+
+    sbuf toks = {0}, nms = {0};
+    dbuf values = {0}, tss = {0};
+    bbuf us = {0};
+    int rc;
+    int32_t *ids = NULL, *nidx = NULL;
+    PyObject *uniq = NULL, *out = NULL;
+
+    Py_BEGIN_ALLOW_THREADS
+    rc = scan_lines(buf, n, &toks, &nms, &values, &tss, &us);
+    Py_END_ALLOW_THREADS
+    if (rc == 1) goto bail;
+    if (rc == -1) { PyErr_NoMemory(); goto fail; }
+    if (toks.len == 0) goto bail; /* preserve the empty-payload error */
+
+    {
+        Py_ssize_t count = toks.len;
+        slice uq_sl[UNIQ_CAP];
+        int uq_n = 0;
+        ids = (int32_t *)malloc((size_t)count * sizeof(int32_t));
+        nidx = (int32_t *)malloc((size_t)count * sizeof(int32_t));
+        if (!ids || !nidx) { PyErr_NoMemory(); goto fail; }
+        /* GIL held: table mutators (HandleSpace mint/free) also hold it,
+         * so lookups can't race a resize. */
+        for (Py_ssize_t i = 0; i < count; i++) {
+            ids[i] = tt_find(table, toks.data[i].p, toks.data[i].len);
+            slice s = nms.data[i];
+            int m = 0;
+            for (; m < uq_n; m++)
+                if (uq_sl[m].len == s.len &&
+                    memcmp(uq_sl[m].p, s.p, (size_t)s.len) == 0)
+                    break;
+            if (m == uq_n) {
+                if (uq_n == UNIQ_CAP) goto bail; /* wild payload: fall back */
+                uq_sl[uq_n++] = s;
+            }
+            nidx[i] = m;
+        }
+        uniq = PyList_New(uq_n);
+        if (!uniq) goto fail;
+        for (int m = 0; m < uq_n; m++) {
+            PyObject *o = PyUnicode_DecodeUTF8(uq_sl[m].p, uq_sl[m].len, NULL);
+            if (!o) goto fail;
+            PyList_SET_ITEM(uniq, m, o);
+        }
+        {
+            PyObject *ib = PyBytes_FromStringAndSize(
+                (const char *)ids, count * (Py_ssize_t)sizeof(int32_t));
+            PyObject *xb = PyBytes_FromStringAndSize(
+                (const char *)nidx, count * (Py_ssize_t)sizeof(int32_t));
+            PyObject *v = PyBytes_FromStringAndSize(
+                (const char *)values.data,
+                values.len * (Py_ssize_t)sizeof(double));
+            PyObject *t = PyBytes_FromStringAndSize(
+                (const char *)tss.data, tss.len * (Py_ssize_t)sizeof(double));
+            PyObject *u = PyBytes_FromStringAndSize(
+                (const char *)us.data, us.len);
+            if (ib && xb && v && t && u)
+                out = PyTuple_Pack(6, ib, uniq, xb, v, t, u);
+            Py_XDECREF(ib); Py_XDECREF(xb); Py_XDECREF(v);
+            Py_XDECREF(t); Py_XDECREF(u);
+        }
+        Py_DECREF(uniq);
+        free(ids); free(nidx);
+        free(toks.data); free(nms.data);
+        free(values.data); free(tss.data); free(us.data);
+        PyBuffer_Release(&view);
+        return out; /* NULL propagates the MemoryError */
+    }
+
+bail:
+    free(ids); free(nidx);
+    free(toks.data); free(nms.data);
+    free(values.data); free(tss.data); free(us.data);
+    PyBuffer_Release(&view);
+    Py_RETURN_NONE;
+
+fail:
+    Py_XDECREF(uniq);
+    free(ids); free(nidx);
+    free(toks.data); free(nms.data);
+    free(values.data); free(tss.data); free(us.data);
+    PyBuffer_Release(&view);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"decode_measurement_lines", decode_measurement_lines, METH_O,
      "Scan NDJSON measurement envelopes into column buffers; None = "
      "shape mismatch, caller must fall back to the Python decoder."},
+    {"decode_measurement_lines_resolved",
+     decode_measurement_lines_resolved, METH_VARARGS,
+     "Scan NDJSON measurement envelopes with device tokens resolved "
+     "through a TokenTable (unknown -> -1) and names deduped to "
+     "(uniques, index); None = shape mismatch, caller falls back."},
     {"decode_event_lines", decode_event_lines, METH_O,
      "Scan NDJSON measurement/location/alert envelopes into column "
      "buffers, splitting registration lines out as raw bytes; None = "
@@ -1240,4 +1584,16 @@ static struct PyModuleDef module = {
     "Native NDJSON wire decoder (measurement fast path).", -1, methods,
 };
 
-PyMODINIT_FUNC PyInit__swwire(void) { return PyModule_Create(&module); }
+PyMODINIT_FUNC PyInit__swwire(void) {
+    if (PyType_Ready(&TokenTableType) < 0) return NULL;
+    PyObject *m = PyModule_Create(&module);
+    if (!m) return NULL;
+    Py_INCREF(&TokenTableType);
+    if (PyModule_AddObject(m, "TokenTable",
+                           (PyObject *)&TokenTableType) < 0) {
+        Py_DECREF(&TokenTableType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
+}

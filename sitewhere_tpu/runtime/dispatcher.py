@@ -294,12 +294,15 @@ class PipelineDispatcher(LifecycleComponent):
         """
         from sitewhere_tpu.ingest.columnar import (
             decode_json_lines,
+            n_rows,
             resolve_columns,
+            space_of,
         )
         from sitewhere_tpu.ingest.decoders import DecodeError
 
         try:
-            columns, host_reqs = decode_json_lines(payload)
+            columns, host_reqs = decode_json_lines(
+                payload, device_space=space_of(self.batcher.resolve_device))
         except DecodeError as e:
             self.ingest_failed_decode(payload, source_id, e)
             return 0
@@ -325,7 +328,7 @@ class PipelineDispatcher(LifecycleComponent):
                     "device_token": req.device_token,
                     "payload_ref": int(ref),
                 })
-        n = len(columns["device_token"])
+        n = n_rows(columns)
         if n == 0:
             return 0
         cols = resolve_columns(

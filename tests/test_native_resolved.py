@@ -1,0 +1,219 @@
+"""C-side token resolution (TokenTable + resolved scanner): equivalence
+with the unresolved path, HandleSpace mirror consistency, bail contract.
+
+The resolved tier is PURELY an accelerator: for any payload it accepts,
+``decode_json_lines(payload, device_space=s)`` + ``resolve_columns`` must
+produce bit-identical batch columns to the unresolved path; anything else
+must fall back (never diverge).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.ids import NULL_ID, HandleSpace
+from sitewhere_tpu.ingest import columnar
+from sitewhere_tpu.native import load_swwire
+
+pytestmark = pytest.mark.skipif(
+    load_swwire() is None, reason="native toolchain unavailable")
+
+
+def _line(token, value, ts=1_753_800_000, name="temp", extra=None):
+    req = {"name": name, "value": value, "eventDate": ts}
+    req.update(extra or {})
+    return json.dumps({"deviceToken": token, "type": "Measurement",
+                       "request": req}, separators=(",", ":"))
+
+
+def _spaces(n_devices=50):
+    dev = HandleSpace("device", 1 << 12)
+    mt = HandleSpace("mtype", 1 << 8)
+    al = HandleSpace("alert_type", 1 << 8)
+    for i in range(n_devices):
+        dev.mint(f"dev-{i}")
+    return dev, mt, al
+
+
+def _resolve_both(payload, dev, mt, al):
+    """(resolved-path columns, unresolved-path columns) for one payload."""
+    res_cols, res_host = columnar.decode_json_lines(payload,
+                                                    device_space=dev)
+    res = columnar.resolve_columns(res_cols, dev.lookup, mt.mint, al.mint)
+    raw_cols, raw_host = columnar.decode_json_lines(payload)
+    raw = columnar.resolve_columns(raw_cols, dev.lookup, mt.mint, al.mint)
+    assert res_host == raw_host == []
+    return res_cols, res, raw
+
+
+# ---------------------------------------------------------------------------
+# TokenTable
+# ---------------------------------------------------------------------------
+
+def test_token_table_basics():
+    mod = load_swwire()
+    t = mod.TokenTable()
+    assert len(t) == 0
+    assert t.get("a") == NULL_ID
+    t.set("a", 7)
+    t.set(b"b", 9)
+    assert (t.get("a"), t.get(b"a"), t.get("b")) == (7, 7, 9)
+    assert len(t) == 2
+    t.set("a", 11)  # update in place
+    assert t.get("a") == 11 and len(t) == 2
+    t.discard("a")
+    assert t.get("a") == NULL_ID and len(t) == 1
+    t.discard("missing")  # no-op
+    t.set("a", 3)  # tombstone slot reused
+    assert t.get("a") == 3 and len(t) == 2
+    t.clear()
+    assert len(t) == 0 and t.get("b") == NULL_ID
+
+
+def test_token_table_resize_many():
+    mod = load_swwire()
+    t = mod.TokenTable()
+    n = 10_000
+    for i in range(n):
+        t.set(f"token-{i}", i)
+    assert len(t) == n
+    for i in range(0, n, 97):
+        assert t.get(f"token-{i}") == i
+    # churn through deletions + re-inserts (tombstone pressure)
+    for i in range(0, n, 2):
+        t.discard(f"token-{i}")
+    assert len(t) == n // 2
+    for i in range(0, n, 2):
+        t.set(f"token-{i}", i + 1)
+    assert t.get("token-0") == 1 and t.get("token-9998") == 9999
+    assert t.get("token-1") == 1  # odd entries untouched
+
+
+def test_token_table_rejects_bad_key():
+    mod = load_swwire()
+    t = mod.TokenTable()
+    with pytest.raises(TypeError):
+        t.set(123, 1)
+    with pytest.raises(TypeError):
+        t.get(None)
+
+
+# ---------------------------------------------------------------------------
+# HandleSpace mirror
+# ---------------------------------------------------------------------------
+
+def test_handle_space_mirror_tracks_mint_free_and_restore():
+    dev = HandleSpace("device", 1 << 10)
+    a = dev.mint("a")
+    table = dev.native_table()
+    assert table is not None and table.get("a") == a
+    # mint AFTER the table exists
+    b = dev.mint("b")
+    assert table.get("b") == b
+    dev.free("a")
+    assert table.get("a") == NULL_ID
+    # checkpoint-restore SWAPS in a fully-built replacement (readers see
+    # a complete old or complete new table, never a partial rebuild)
+    state = dev.to_dict()["id_to_token"]
+    dev.mint("c")
+    dev.load_state(state)
+    restored = dev.native_table()
+    assert restored is not table
+    assert restored.get("c") == NULL_ID
+    assert restored.get("b") == b
+
+
+def test_handle_space_mirror_skips_unencodable_tokens():
+    # json.loads can yield str tokens that are not UTF-8-encodable (lone
+    # surrogates, e.g. via auto-registration of a hostile token).  The
+    # mirror must skip them — they can never appear on the resolved wire
+    # path (the C scanner only accepts strict UTF-8 bytes) — and mint()
+    # must not raise after committing the Python-side map.
+    dev = HandleSpace("device", 1 << 10)
+    bad = json.loads('"\\udc80bad"')
+    dev.mint(bad)
+    table = dev.native_table()  # build AFTER the bad token exists
+    assert table is not None and len(table) == 0
+    good = dev.mint("good")  # mint after build: mirrored
+    assert table.get("good") == good
+    bad2 = json.loads('"\\udc81worse"')
+    hid = dev.mint(bad2)  # mint a bad token after build: skipped, no raise
+    assert dev.lookup(bad2) == hid
+    dev.free(bad2)  # free of a skipped token: no raise
+    assert dev.lookup(bad2) == NULL_ID
+
+
+# ---------------------------------------------------------------------------
+# Resolved decode equivalence
+# ---------------------------------------------------------------------------
+
+def test_resolved_matches_unresolved_path():
+    dev, mt, al = _spaces()
+    rng = np.random.default_rng(1)
+    lines = [
+        _line(f"dev-{i % 50}", float(rng.uniform(-50, 150)),
+              ts=1_753_800_000 + i, name=("temp" if i % 3 else "rpm"))
+        for i in range(300)
+    ]
+    lines.append(_line("dev-1", 1.0, extra={"updateState": False}))
+    lines.append(_line("dev-2", 2.0, ts=1_753_800_000_123))  # epoch millis
+    lines.append(_line("unknown-dev", 3.0))  # unregistered -> NULL_ID
+    payload = "\n".join(lines).encode()
+
+    res_cols, res, raw = _resolve_both(payload, dev, mt, al)
+    assert "device_id" in res_cols and "device_token" not in res_cols
+    for k in ("device_id", "mtype_id", "alert_code", "event_type",
+              "ts_s", "ts_ns", "alert_level", "update_state"):
+        np.testing.assert_array_equal(res[k], raw[k], err_msg=k)
+    np.testing.assert_allclose(res["value"], raw["value"], rtol=1e-6)
+    assert res["device_id"][-1] == NULL_ID
+
+
+def test_resolved_mints_new_measurement_names():
+    dev, mt, al = _spaces(3)
+    payload = "\n".join(
+        _line("dev-0", float(i), name=f"sensor-{i % 5}") for i in range(40)
+    ).encode()
+    res_cols, res, raw = _resolve_both(payload, dev, mt, al)
+    assert sorted(res_cols["mtype_uniq"]) == sorted(
+        f"sensor-{i}" for i in range(5))
+    np.testing.assert_array_equal(res["mtype_id"], raw["mtype_id"])
+    assert len(mt) == 5  # minted exactly the uniques
+
+
+def test_resolved_sees_devices_minted_after_table_build():
+    dev, mt, al = _spaces(1)
+    dev.native_table()
+    late = dev.mint("late-device")
+    cols, _ = columnar.decode_json_lines(
+        _line("late-device", 9.0).encode(), device_space=dev)
+    assert cols["device_id"][0] == late
+
+
+@pytest.mark.parametrize("payload", [
+    # non-measurement kinds -> resolved scanner bails, family scanner takes it
+    b'{"deviceToken":"dev-0","type":"Location",'
+    b'"request":{"latitude":1.0,"longitude":2.0}}',
+    # JSON array form -> python path
+    b'[{"deviceToken":"dev-0","type":"Measurement",'
+    b'"request":{"name":"t","value":1}}]',
+])
+def test_resolved_bails_keep_token_shape(payload):
+    dev, mt, al = _spaces(3)
+    cols, _ = columnar.decode_json_lines(payload, device_space=dev)
+    assert "device_token" in cols and "device_id" not in cols
+    out = columnar.resolve_columns(cols, dev.lookup, mt.mint, al.mint)
+    assert out["device_id"][0] == dev.lookup("dev-0")
+
+
+def test_resolved_registration_line_falls_back_to_host_path():
+    dev, mt, al = _spaces(2)
+    payload = (_line("dev-0", 1.0) + "\n" + json.dumps({
+        "deviceToken": "new-dev", "type": "RegisterDevice",
+        "request": {"deviceTypeToken": "sensor"}})).encode()
+    cols, host = columnar.decode_json_lines(payload, device_space=dev)
+    # mixed payload: the resolved scanner bails (registration line), the
+    # family scanner splits the host line out — behavior unchanged
+    assert len(host) == 1 and host[0].device_token == "new-dev"
+    assert "device_token" in cols
