@@ -385,23 +385,8 @@ def bench_dispatcher() -> None:
     # profile's 64×512 ≈ 32k events serve the same purpose at CPU rates
     # (a 16-payload run measured only ~30 ms and swung 2× run-to-run).
     n_payloads = 64 if reduced else 512
-    tmp = tempfile.mkdtemp(prefix="swbench-")
-    cfg = Config({
-        "instance": {"id": "bench", "data_dir": os.path.join(tmp, "data")},
-        "pipeline": {"width": width, "registry_capacity": 16384,
-                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
-        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
-        "journal": {"fsync_every": 4096, "segment_bytes": 256 << 20},
-    }, apply_env=False)
-    inst = Instance(cfg)
-    inst.start()
+    inst = _wire_bench_instance(n_devices, width, 5.0)
     try:
-        inst.device_management.create_device_type(token="sensor", name="Sensor")
-        dm = inst.device_management
-        for i in range(n_devices):
-            dm.create_device(token=f"d-{i}", device_type="sensor")
-            dm.create_device_assignment(device=f"d-{i}")
-
         rng = np.random.default_rng(0)
 
         # Pre-build raw NDJSON wire payloads — the bytes a fleet would
@@ -456,6 +441,18 @@ def bench_dispatcher() -> None:
         events_per_sec = n / (t1 - t0)
         snap = inst.dispatcher.metrics_snapshot()
         p99 = snap.get("latency_p99_ms")
+
+        # Latency-tuned profile (co-located backends only: through a
+        # network tunnel every egress fetch pays >=1 RTT and the result
+        # would measure the tunnel, not the framework): the throughput
+        # profile's p99 is dominated by its 5 ms batching deadline, so a
+        # deployment that cares about BASELINE.md's <10 ms p99 would run
+        # a tighter deadline and smaller plans.  Reported as separate
+        # latency_tuned_* fields — the throughput row stands unchanged.
+        tuned = None
+        if rtt_ms < 5.0:
+            tuned = _dispatcher_tuned_latency(payloads, events_per_sec,
+                                              n_devices=n_devices)
         emit({
             "metric": "dispatcher_events_per_sec_per_chip",
             "value": round(events_per_sec, 1),
@@ -472,10 +469,112 @@ def bench_dispatcher() -> None:
             "accepted": int(snap["accepted"]),
             "steps": int(snap["steps"]),
             "backend": _jax.default_backend(),
+            **({"latency_tuned_p99_ms": tuned["p99_ms"],
+                "latency_tuned_target_met": bool(tuned["p99_ms"] < 10.0),
+                "latency_tuned_deadline_ms": tuned["deadline_ms"],
+                "latency_tuned_events_per_sec": tuned["events_per_sec"]}
+               if tuned else {}),
         })
     finally:
         inst.stop()
         inst.terminate()
+
+
+def _wire_bench_instance(n_devices: int, width: int, deadline_ms: float):
+    """One started Instance with ``n_devices`` registered+assigned
+    sensors — the shared bring-up for the dispatcher-path profiles (the
+    throughput and tuned-latency regions MUST register the same fleet:
+    a token the payload carries but the instance never minted resolves
+    NULL_ID and silently shrinks the measured load)."""
+    import tempfile
+
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+
+    tmp = tempfile.mkdtemp(prefix="swbench-")
+    cfg = Config({
+        "instance": {"id": "bench", "data_dir": os.path.join(tmp, "data")},
+        "pipeline": {"width": width, "registry_capacity": 16384,
+                     "mtype_slots": 4, "deadline_ms": deadline_ms,
+                     "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+        "journal": {"fsync_every": 4096, "segment_bytes": 256 << 20},
+    }, apply_env=False)
+    inst = Instance(cfg)
+    inst.start()
+    inst.device_management.create_device_type(token="sensor", name="Sensor")
+    dm = inst.device_management
+    for i in range(n_devices):
+        dm.create_device(token=f"d-{i}", device_type="sensor")
+        dm.create_device_assignment(device=f"d-{i}")
+    return inst
+
+
+def _dispatcher_tuned_latency(payloads, capacity_eps, n_devices=2_000,
+                              deadline_ms=3.5, width=4096, util=0.5):
+    """One short wire-path region tuned for latency instead of
+    throughput: tighter batching deadline, smaller plans, and — the part
+    that makes the p99 a property of the PIPELINE rather than of a
+    saturated queue — a PACED feeder offering ``util`` of the measured
+    throughput capacity.  (The throughput region drives at saturation,
+    so its p99 is queueing delay by Little's law; no deployment runs a
+    latency-sensitive path at 100% utilization.)  Returns
+    {p99_ms, p50_ms, events_per_sec, deadline_ms, offered_util} or
+    None on error."""
+    inst = None
+    try:
+        inst = _wire_bench_instance(n_devices, width, deadline_ms)
+        inst.dispatcher.ingest_wire_lines(payloads[0])  # warm-up compile
+        inst.dispatcher.flush()
+        # (128-row payloads were tried for smoother arrivals and measured
+        # WORSE: 4x the per-payload fixed intake cost cuts capacity, and
+        # 4x the plans/s saturates the per-plan step budget — the p99
+        # went up, not down.  The throughput profile's payload size —
+        # 512 rows reduced, 1024 full — stands.)
+        paced = payloads[1:]
+        rows_per_payload = payloads[0].count(b"\n") + 1
+        # Phase A — measure THIS instance's capacity (width/deadline
+        # differ from the throughput profile's, so its capacity does
+        # too; pacing against the wrong ceiling leaves the queue
+        # saturated and the p99 meaningless).
+        burst = paced[:max(32, len(paced) // 4)]
+        tb = time.perf_counter()
+        for p in burst:
+            inst.dispatcher.ingest_wire_lines(p)
+        inst.dispatcher.flush()
+        cap = rows_per_payload * len(burst) / (time.perf_counter() - tb)
+        cap = min(cap, capacity_eps) if capacity_eps else cap
+        inst.dispatcher.latencies_s.clear()
+        # Phase B — paced at util of measured capacity; fresh samples.
+        gap_s = rows_per_payload / max(cap * util, 1.0)
+        t0 = time.perf_counter()
+        for i, p in enumerate(paced):
+            # drift-corrected pacing: each payload has an absolute due
+            # time, so a slow payload doesn't permanently lower the rate
+            due = t0 + i * gap_s
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            inst.dispatcher.ingest_wire_lines(p)
+        inst.dispatcher.flush()
+        dt = time.perf_counter() - t0
+        snap = inst.dispatcher.metrics_snapshot()
+        if snap.get("latency_p99_ms") is None:
+            return None
+        n = rows_per_payload * len(paced)
+        return {"p99_ms": snap["latency_p99_ms"],
+                "p50_ms": snap.get("latency_p50_ms"),
+                "events_per_sec": round(n / dt, 1),
+                "deadline_ms": deadline_ms,
+                "offered_util": util}
+    except Exception as e:  # diagnostic only — never sink the main row
+        _emit_now({"diagnostic": True, "tuned_latency_error": str(e)},
+                  sys.stderr)
+        return None
+    finally:
+        if inst is not None:
+            inst.stop()
+            inst.terminate()
 
 
 # ---------------------------------------------------------------------------
@@ -815,7 +914,8 @@ _FINAL_DROP = ("attempts", "cache_attempts", "cpu_fallback", "note",
                "cache_source")
 
 _CFG_KEEP = ("value", "unit", "vs_baseline", "backend", "latency_p99_ms",
-             "latency_target_met", "host_rtt_ms", "stream_mb_per_sec",
+             "latency_target_met", "latency_tuned_p99_ms",
+             "latency_tuned_target_met", "host_rtt_ms", "stream_mb_per_sec",
              "qr_labels_per_sec", "cache_captured_at")
 
 
@@ -1123,6 +1223,7 @@ def _update_summary(results: dict, all_configs: bool) -> None:
             str(k): {f: v.get(f) for f in (
                 "metric", "value", "unit", "vs_baseline", "backend",
                 "latency_p50_ms", "latency_p99_ms", "latency_target_met",
+                "latency_tuned_p99_ms", "latency_tuned_target_met",
                 "host_rtt_ms", "device_step_ms", "device_events_per_sec",
                 "cache_captured_at", "stream_mb_per_sec",
                 "qr_labels_per_sec")
@@ -1156,6 +1257,13 @@ def _update_summary(results: dict, all_configs: bool) -> None:
             head["latency_backend"] = c2.get("backend")
             head["latency_path"] = ("dispatcher bytes-in -> egress-out "
                                     f"(config 2, backend={c2.get('backend')})")
+        if c2 and c2.get("latency_tuned_p99_ms") is not None:
+            # co-located latency-tuned profile (tighter deadline, paced
+            # offered load): the <10 ms half of the target judged where
+            # RTT permits it
+            head["latency_tuned_p99_ms"] = c2["latency_tuned_p99_ms"]
+            head["latency_tuned_target_met"] = bool(
+                c2["latency_tuned_p99_ms"] < 10.0)
     _SUP["summary"] = head
 
 
