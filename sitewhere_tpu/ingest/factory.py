@@ -177,6 +177,26 @@ def build_sources(docs: List[Dict], scripts=None) -> List:
                     f"unknown dedup option(s): {sorted(unknown)}")
             dedup = AlternateIdDeduplicator(
                 window=int(dedup_doc.get("window", 1 << 20)))
+        raw_wire = bool(doc.get("raw_wire", False))
+        if raw_wire and dedup is not None:
+            # must fail BOOT: the raw lane never consults the
+            # deduplicator, so accepting both would silently disable a
+            # configured dedup window
+            raise ValidationError(
+                f"source {source_id!r}: raw_wire bypasses the decoder "
+                "and dedup — remove the dedup block or raw_wire")
+        if raw_wire and str(doc.get("decoder", "json")).lower() not in (
+                "json", "jsonlines", "batch"):
+            # same principle for the decoder: the raw lane feeds payloads
+            # to the NDJSON columnar decode (which also accepts single
+            # envelopes and JSON arrays — the json/jsonlines/batch wire
+            # shapes), so a binary or script decoder here would be
+            # silently disabled and every payload would dead-letter
+            raise ValidationError(
+                f"source {source_id!r}: raw_wire handles JSON wire "
+                f"shapes only — decoder {doc.get('decoder')!r} would "
+                "never run")
         out.append(InboundEventSource(
-            source_id, receivers, decoder, deduplicator=dedup))
+            source_id, receivers, decoder, deduplicator=dedup,
+            raw_wire=raw_wire))
     return out

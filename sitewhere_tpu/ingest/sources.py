@@ -70,6 +70,7 @@ class InboundEventSource(LifecycleComponent):
         on_failed_decode: Optional[FailedDecode] = None,
         on_host_request: Optional[Forward] = None,
         on_events: Optional[Callable[[List[DecodedRequest], bytes], None]] = None,
+        raw_wire: bool = False,
     ):
         super().__init__(name=f"event-source:{source_id}")
         self.source_id = source_id
@@ -84,6 +85,19 @@ class InboundEventSource(LifecycleComponent):
         self.on_registration = on_registration
         self.on_failed_decode = on_failed_decode
         self.on_host_request = on_host_request
+        # Raw wire lane (opt-in, config `"raw_wire": true`): NDJSON
+        # payloads skip this source's scalar decoder entirely and go to
+        # ``on_wire_payload`` (PipelineDispatcher.ingest_wire_lines, or
+        # the forwarder's owner-splitting ingest_payload in multi-host
+        # topologies) — one C columnar decode + in-scanner token
+        # resolution per payload instead of json.loads per line.  The
+        # wire lane handles registration/host-plane lines and dead-
+        # letters failed payloads itself.  Differences a deployment opts
+        # into: no source-level deduplication (``dedup`` config is
+        # rejected with it) and per-request ``metadata.tenant`` routing
+        # is not applied (wire rows land in the default tenant).
+        self.raw_wire = raw_wire
+        self.on_wire_payload: Optional[Callable[[bytes, str], int]] = None
         self.decoded_count = 0
         self.failed_count = 0
         self.duplicate_count = 0
@@ -99,6 +113,22 @@ class InboundEventSource(LifecycleComponent):
         failures dead-letter; forward-target failures are logged and
         counted (a broken sink must not kill the receiver).
         """
+        if self.raw_wire and self.on_wire_payload is not None:
+            try:
+                self.decoded_count += self.on_wire_payload(
+                    payload, self.source_id)
+            except DecodeError as e:
+                # same observable failure path as the scalar decoder:
+                # the source's counter ticks and its on_failed_decode
+                # dead-letters the payload (once)
+                self.failed_count += 1
+                if self.on_failed_decode is not None:
+                    self.on_failed_decode(payload, self.source_id, e)
+            except Exception:
+                self.failed_count += 1
+                logger.exception(
+                    "raw wire forward failed for source %s", self.source_id)
+            return
         try:
             requests = self.decoder(payload)
         except DecodeError as e:

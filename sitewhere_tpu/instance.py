@@ -641,6 +641,14 @@ class Instance(LifecycleComponent):
                     [req], payload))
             if hasattr(source, "on_events"):
                 source.on_events = self.forwarder.ingest_requests
+            if getattr(source, "raw_wire", False):
+                # raw lane, multi-host form: owner-split the NDJSON
+                # lines and ship remote rows to their owning host;
+                # decode errors come back to the source for its
+                # failure accounting
+                source.on_wire_payload = (
+                    lambda p, sid: self.forwarder.ingest_payload(
+                        p, sid, raise_on_decode_error=True))
             source.on_registration = self.forwarder.ingest_registration
             # stream requests route to the device's owning host, which
             # handles them via its local _on_host_request
@@ -651,6 +659,13 @@ class Instance(LifecycleComponent):
             if hasattr(source, "on_events"):
                 # batch forward: one columnar call per wire payload
                 source.on_events = self.dispatcher.ingest_many
+            if getattr(source, "raw_wire", False):
+                # raw lane: C columnar decode + in-scanner token
+                # resolution, no per-line json.loads; decode errors come
+                # back to the source for its failure accounting
+                source.on_wire_payload = (
+                    lambda p, sid: self.dispatcher.ingest_wire_lines(
+                        p, sid, raise_on_decode_error=True))
             source.on_registration = self.dispatcher.ingest_registration
         source.on_failed_decode = self.dispatcher.ingest_failed_decode
         if getattr(source, "on_host_request", None) is None \

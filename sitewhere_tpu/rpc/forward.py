@@ -223,9 +223,15 @@ class HostForwarder(LifecycleComponent):
 
     # -- intake --------------------------------------------------------------
 
-    def ingest_payload(self, payload: bytes, source_id: str = "wire") -> int:
+    def ingest_payload(self, payload: bytes, source_id: str = "wire",
+                       raise_on_decode_error: bool = False) -> int:
         """Route one NDJSON payload.  Returns rows accepted LOCALLY
-        (remote rows are accepted by their owner asynchronously)."""
+        (remote rows are accepted by their owner asynchronously).
+
+        ``raise_on_decode_error`` passes through to the local columnar
+        decode (see ``PipelineDispatcher.ingest_wire_lines``): malformed
+        lines split to the local bucket, so a raw_wire source's failure
+        accounting works unchanged in multi-host topologies."""
         while True:
             with self._lock:
                 gen, n, pid = (self._member_gen, self.n_processes,
@@ -243,7 +249,8 @@ class HostForwarder(LifecycleComponent):
         accepted = 0
         if local:
             accepted = self.dispatcher.ingest_wire_lines(
-                b"\n".join(local), source_id=source_id)
+                b"\n".join(local), source_id=source_id,
+                raise_on_decode_error=raise_on_decode_error)
             with self._lock:
                 self.local_rows += accepted
         return accepted

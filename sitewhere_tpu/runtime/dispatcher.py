@@ -281,8 +281,8 @@ class PipelineDispatcher(LifecycleComponent):
         for plan in self._take(lambda: self.batcher.add_arrays(**columns)):
             self._run_plan(plan)
 
-    def ingest_wire_lines(self, payload: bytes,
-                          source_id: str = "wire") -> int:
+    def ingest_wire_lines(self, payload: bytes, source_id: str = "wire",
+                          raise_on_decode_error: bool = False) -> int:
         """Columnar NDJSON wire intake: bytes → column arrays → batcher.
 
         The true 1M events/sec edge (round-2 verdict weak #2): ONE
@@ -304,6 +304,12 @@ class PipelineDispatcher(LifecycleComponent):
             columns, host_reqs = decode_json_lines(
                 payload, device_space=space_of(self.batcher.resolve_device))
         except DecodeError as e:
+            # raise_on_decode_error: a raw_wire source wants the error
+            # back so ITS failure counter ticks and ITS on_failed_decode
+            # dead-letters (once) — same observable path as the scalar
+            # decoder's failures
+            if raise_on_decode_error:
+                raise
             self.ingest_failed_decode(payload, source_id, e)
             return 0
         # Decode validated the payload — journal once (at-least-once).
