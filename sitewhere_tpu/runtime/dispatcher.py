@@ -334,6 +334,15 @@ class PipelineDispatcher(LifecycleComponent):
                     "device_token": req.device_token,
                     "payload_ref": int(ref),
                 })
+        return self._ingest_resolved_columns(columns, ref)
+
+    def _ingest_resolved_columns(self, columns, ref: int) -> int:
+        """Resolve one decoded column dict and queue its rows (shared by
+        live wire intake and columnar journal replay — replay's
+        equivalence argument depends on both using THIS code: rows get
+        ``ref`` as payload_ref and land in the default tenant)."""
+        from sitewhere_tpu.ingest.columnar import n_rows, resolve_columns
+
         n = n_rows(columns)
         if n == 0:
             return 0
@@ -475,6 +484,13 @@ class PipelineDispatcher(LifecycleComponent):
             JsonLinesDecoder,
         )
 
+        # With the DEFAULT decoder, C-scanner-accepted payloads replay
+        # columnar-ly (the strict scanners bail on metadata/extras, so
+        # anything they accept is bit-identical under both paths — the
+        # scalar decoder keeps handling everything else, including
+        # per-request metadata tenants).  A custom recovery decoder
+        # disables the fast path outright.
+        use_columnar = decoder is None and self.recovery_decoder is None
         decoder = decoder or self.recovery_decoder or JsonLinesDecoder()
         reader.seek(reader.committed)
         n = 0
@@ -487,6 +503,11 @@ class PipelineDispatcher(LifecycleComponent):
                 if upto is not None and offset >= upto:
                     done = True
                     break
+                if use_columnar:
+                    fast = self._replay_columnar(payload, offset)
+                    if fast is not None:
+                        n += fast
+                        continue
                 try:
                     reqs = decoder(payload)
                 except DecodeError as e:
@@ -510,6 +531,36 @@ class PipelineDispatcher(LifecycleComponent):
                         n, reader.committed)
         self.flush()
         return n
+
+    def _replay_columnar(self, payload: bytes, offset: int) -> Optional[int]:
+        """Replay one journal record through the C columnar lane, or
+        None when the STRICT measurement scanner doesn't accept it —
+        the caller falls back to the scalar decoder.  Only the
+        measurement scanner qualifies: it bails on ANY unknown request
+        key, so a payload it accepts carries no ``metadata`` and the
+        scalar decoder would produce bit-identical rows (default
+        tenant, no alternate ids).  The family scanner is deliberately
+        NOT used here — it skips unknown request keys, so it would
+        accept a metadata-carrying payload and silently drop the
+        per-request tenant the scalar replay honors.  Rows keep the
+        original ``offset`` as payload_ref and the payload is NOT
+        re-journaled."""
+        from sitewhere_tpu.ingest.columnar import (
+            _native_decode_resolved,
+            space_of,
+        )
+
+        space = space_of(self.batcher.resolve_device)
+        if space is None:
+            return None
+        # the scanner BAILS (None) on anything malformed or non-
+        # measurement rather than raising, so every error case lands on
+        # the scalar path, which owns dead-lettering
+        out = _native_decode_resolved(payload, space)
+        if out is None:
+            return None
+        columns, _host = out
+        return self._ingest_resolved_columns(columns, offset)
 
     # -- one step -----------------------------------------------------------
 
