@@ -147,6 +147,8 @@ class Instance(LifecycleComponent):
             self.data_dir,
             flush_interval_s=0.25,
             retention_s=self.config.get("events.retention_s"),
+            resident_bytes=int(self.config.get(
+                "events.resident_bytes", 256 << 20)),
         ))
         self.streams = self.add_child(DeviceStreamManagement(self.data_dir))
         self.stream_manager = self.add_child(DeviceStreamManager(
