@@ -147,8 +147,7 @@ class Instance(LifecycleComponent):
             self.data_dir,
             flush_interval_s=0.25,
             retention_s=self.config.get("events.retention_s"),
-            resident_bytes=int(self.config.get(
-                "events.resident_bytes", 256 << 20)),
+            resident_bytes=int(self.config["events.resident_bytes"]),
         ))
         self.streams = self.add_child(DeviceStreamManagement(self.data_dir))
         self.stream_manager = self.add_child(DeviceStreamManager(

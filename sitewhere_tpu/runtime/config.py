@@ -62,7 +62,7 @@ DEFAULTS: Dict[str, Any] = {
                 "prune_after_checkpoint": False},
     # events.retention_s: event-time retention window for the columnar
     # store, enforced chunk-at-a-time (0 = keep forever)
-    "events": {"retention_s": 0},
+    "events": {"retention_s": 0, "resident_bytes": 256 << 20},
     "presence": {"scan_interval_s": 600.0, "missing_after_s": 8 * 3600.0},
     "api": {"host": "127.0.0.1", "port": 8080, "jwt_ttl_s": 3600},
     "metrics": {"report_interval_s": 20.0},

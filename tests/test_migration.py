@@ -330,7 +330,8 @@ def test_forwarder_memory_mode_requeue(tmp_path):
         def __init__(self):
             self.lines = []
 
-        def ingest_wire_lines(self, payload, source_id="x"):
+        def ingest_wire_lines(self, payload, source_id="x",
+                              raise_on_decode_error=False):
             lines = [l for l in payload.split(b"\n") if l.strip()]
             self.lines.extend(lines)
             return len(lines)

@@ -284,3 +284,88 @@ def test_burst_publish_then_disconnect_loses_nothing():
                         for r in range(5) for i in range(20)]
     finally:
         broker.stop()
+
+
+def test_command_delivery_through_hosted_broker(tmp_path):
+    """The no-middleware fleet story is BIDIRECTIONAL: a device connected
+    to the instance's HOSTED broker publishes telemetry in and receives
+    command invocations back over the same broker socket, then
+    acknowledges — closing the invocation↔response correlation loop with
+    no external middleware anywhere."""
+    import json as _json
+    import queue
+
+    from sitewhere_tpu.commands import (
+        CommandDestination,
+        JsonCommandEncoder,
+        MqttDeliveryProvider,
+        TopicParameterExtractor,
+    )
+    from sitewhere_tpu.ingest.decoders import JsonDecoder
+    from sitewhere_tpu.ingest.sources import InboundEventSource
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.schema import EventType
+    from tests.test_instance import make_config
+
+    inst = Instance(make_config(tmp_path))
+    inst.start()
+    rx = MqttBrokerReceiver(topic_filter="sitewhere/input/#")
+    source = InboundEventSource(
+        source_id="hosted-mqtt", receivers=[rx], decoder=JsonDecoder(),
+        on_event=inst.dispatcher.ingest,
+        on_registration=inst.dispatcher.ingest_registration,
+        on_failed_decode=inst.dispatcher.ingest_failed_decode,
+    )
+    dev = None
+    try:
+        dm = inst.device_management
+        dm.create_device_type(token="s", name="S")
+        dm.create_device_command("s", token="reboot", name="Reboot",
+                                 namespace="sw")
+        dm.create_device(token="dev-1", device_type="s")
+        a = dm.create_device_assignment(device="dev-1")
+        source.start()
+
+        # command delivery LOOPS BACK through the hosted broker
+        inst.commands.add_destination(CommandDestination(
+            "hosted-mqtt", JsonCommandEncoder(), TopicParameterExtractor(),
+            MqttDeliveryProvider("127.0.0.1", rx.port)))
+
+        got: "queue.Queue" = queue.Queue()
+        dev = MqttClient("127.0.0.1", rx.port, client_id="dev-1")
+        dev.on_message = lambda topic, payload: got.put((topic, payload))
+        dev.connect()
+        dev.subscribe("sitewhere/command/dev-1", qos=0)
+
+        out = inst.create_command_invocation(a.token, "reboot")
+        inv_token = out["token"]
+        topic, payload = got.get(timeout=10)
+        assert topic == "sitewhere/command/dev-1"
+        doc = _json.loads(payload)
+        assert doc["command"] == "Reboot"
+        assert doc["invocation"] == inv_token
+
+        # the device acknowledges over the SAME broker
+        dev.publish("sitewhere/input/dev-1", _json.dumps({
+            "deviceToken": "dev-1", "type": "commandResponse",
+            "request": {"originatingEventId": inv_token,
+                        "response": "rebooted",
+                        "eventDate": 1_753_800_300}}).encode(), qos=1)
+
+        def correlated():
+            inst.dispatcher.flush()
+            handle = inst.identity.invocation.lookup(inv_token)
+            if handle < 0:
+                return False
+            return inst.event_store.query(
+                command_id=handle,
+                event_type=int(EventType.COMMAND_RESPONSE)).total == 1
+
+        assert _wait(correlated, timeout=10)
+        assert inst.commands.delivered == 1
+    finally:
+        if dev is not None:
+            dev.disconnect()
+        source.stop()
+        inst.stop()
+        inst.terminate()
