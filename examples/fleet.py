@@ -17,7 +17,10 @@ What it shows, end to end:
    scripted mini-hub, standing in for an Azure Event Hubs partition —
    with per-partition offset checkpoints;
 3. both streams land in the SAME pipeline: decode → journal → batcher
-   → fused step → store/state, queried back at the end.
+   → fused step → store/state, queried back at the end;
+4. the loop runs BOTH ways with no middleware: a command invocation is
+   delivered back to a connected device over the SAME hosted broker,
+   the device acknowledges, and the ack correlates to the invocation.
 """
 
 import os
@@ -74,10 +77,13 @@ def main() -> None:
     try:
         dm = inst.device_management
         dm.create_device_type(token="sensor", name="Sensor")
+        dm.create_device_command("sensor", token="reboot", name="Reboot",
+                                 namespace="fleet")
+        assignments = {}
         for name in ([f"edge-{i}" for i in range(8)]
                      + [f"cloud-{i}" for i in range(4)]):
             dm.create_device(token=name, device_type="sensor")
-            dm.create_device_assignment(device=name)
+            assignments[name] = dm.create_device_assignment(device=name)
 
         broker_port = inst.sources[0].receivers[0].broker.port
         print(f"hosted MQTT broker on :{broker_port}; "
@@ -126,6 +132,49 @@ def main() -> None:
         print(f"edge-3 last event ts: {state['last_event_ts_s']}")
         ckpt = os.path.join(tmp, "ckpt", "eventhub-hub.json")
         print(f"eventhub checkpoint: {open(ckpt).read()}")
+
+        # 4. commands flow the other way over the SAME hosted broker
+        import queue
+
+        from sitewhere_tpu.commands import (
+            CommandDestination,
+            JsonCommandEncoder,
+            MqttDeliveryProvider,
+            TopicParameterExtractor,
+        )
+        from sitewhere_tpu.schema import EventType
+
+        inst.commands.add_destination(CommandDestination(
+            "hosted-mqtt", JsonCommandEncoder(), TopicParameterExtractor(),
+            MqttDeliveryProvider("127.0.0.1", broker_port)))
+        got: "queue.Queue" = queue.Queue()
+        dev = MqttClient("127.0.0.1", broker_port, client_id="edge-0")
+        dev.on_message = lambda topic, payload: got.put(payload)
+        dev.connect()
+        dev.subscribe("sitewhere/command/edge-0", qos=0)
+        out = inst.create_command_invocation(
+            assignments["edge-0"].token, "reboot")
+        cmd = json.loads(got.get(timeout=10))
+        print(f"edge-0 received command {cmd['command']!r} "
+              f"(invocation {cmd['invocation'][:8]}…)")
+        dev.publish("fleet/edge-0/events", json.dumps({
+            "deviceToken": "edge-0", "type": "commandResponse",
+            "request": {"originatingEventId": out["token"],
+                        "response": "rebooted",
+                        "eventDate": int(time.time())}}).encode(), qos=1)
+        dev.disconnect()
+        deadline = time.monotonic() + 10
+        correlated = False
+        while time.monotonic() < deadline and not correlated:
+            inst.dispatcher.flush()
+            handle = inst.identity.invocation.lookup(out["token"])
+            correlated = handle >= 0 and inst.event_store.query(
+                command_id=handle,
+                event_type=int(EventType.COMMAND_RESPONSE)).total >= 1
+            if not correlated:
+                time.sleep(0.05)
+        assert correlated, "device ack never correlated to the invocation"
+        print("command acknowledged and correlated to its invocation")
     finally:
         inst.stop()
         inst.terminate()
