@@ -360,3 +360,102 @@ def test_native_event_extras_are_skipped_like_python():
     py, _ = _python_decode(payload)
     assert native["device_token"] == py["device_token"]
     np.testing.assert_allclose(native["value"], py["value"])
+
+
+def test_fuzz_mutated_payloads_never_crash_and_never_diverge():
+    """Randomized mutation fuzz over the C scanners: for any byte
+    soup, the native tier must either BAIL (None) or produce exactly
+    what the pure-Python columnar decoder produces — and never crash.
+    Mutations: byte flips, truncations, splices of valid JSON lines,
+    duplicated keys, random unicode, deep nesting."""
+    import json as _json
+
+    rng = np.random.default_rng(0xC0FFEE)
+    mod = load_swwire()
+    table = mod.TokenTable()
+    for i in range(64):
+        table.set(f"dev-{i}", i)
+
+    def valid_line():
+        kind = rng.choice(["Measurement", "Location", "Alert",
+                           "RegisterDevice"])
+        req = {"eventDate": int(rng.integers(0, 2_000_000_000))}
+        if kind == "Measurement":
+            req.update(name="m" + str(rng.integers(0, 5)),
+                       value=float(rng.normal()))
+        elif kind == "Location":
+            req.update(latitude=float(rng.uniform(-90, 90)),
+                       longitude=float(rng.uniform(-180, 180)))
+        elif kind == "Alert":
+            req.update(type="t", level=str(rng.choice(
+                ["info", "warning", "error", "critical"])))
+        else:
+            req.update(deviceTypeToken="sensor")
+        return _json.dumps({
+            "deviceToken": f"dev-{rng.integers(0, 64)}",
+            "type": str(kind), "request": req})
+
+    def mutate(payload: bytes) -> bytes:
+        b = bytearray(payload)
+        op = rng.integers(0, 6)
+        if op == 0 and b:  # flip random bytes
+            for _ in range(int(rng.integers(1, 8))):
+                b[int(rng.integers(0, len(b)))] = int(rng.integers(0, 256))
+        elif op == 1 and b:  # truncate
+            del b[int(rng.integers(0, len(b))):]
+        elif op == 2:  # splice random bytes in
+            pos = int(rng.integers(0, len(b) + 1))
+            b[pos:pos] = bytes(rng.integers(0, 256, int(rng.integers(1, 16)),
+                                            dtype=np.uint8))
+        elif op == 3:  # duplicate a random slice (repeated keys etc.)
+            if len(b) > 4:
+                lo = int(rng.integers(0, len(b) - 2))
+                hi = int(rng.integers(lo + 1, len(b)))
+                b[hi:hi] = b[lo:hi]
+        elif op == 4:  # deep nesting injection
+            b += b'\n{"deviceToken":"d","type":"Measurement","request":' \
+                 + b'{' * int(rng.integers(1, 40)) + b'}'
+        # op 5: leave as-is
+        return bytes(b)
+
+    checked = accepted = 0
+    for trial in range(400):
+        lines = [valid_line() for _ in range(int(rng.integers(1, 6)))]
+        payload = "\n".join(lines).encode()
+        if trial % 3:
+            payload = mutate(payload)
+        # 1. must never crash — all three scanners over arbitrary bytes
+        mod.decode_measurement_lines(payload)
+        mod.decode_event_lines(payload)
+        mod.decode_measurement_lines_resolved(payload, table)
+        checked += 1
+        # 2. whatever the PRODUCTION native tier accepts — measurement
+        # scanner first, family scanner second, exactly as
+        # _native_decode tries them — must match the pure-Python decode
+        # (None = bail is always allowed)
+        native, host_n = columnar._native_decode(payload) or (None, None)
+        if native is None:
+            continue
+        try:
+            py, host_p = _python_decode(payload)
+        except Exception as e:
+            raise AssertionError(
+                f"native accepted what python rejects: {payload!r}: {e}")
+        assert len(host_n) == len(host_p)
+        assert native["device_token"] == py["device_token"], payload
+        if not native["device_token"]:
+            continue  # host-only payload: no event columns to compare
+        assert native["mtype"] == py["mtype"], payload
+        assert native["alert_type"] == py["alert_type"], payload
+        for col in ("event_type", "ts_s", "ts_ns", "alert_level",
+                    "update_state"):
+            np.testing.assert_array_equal(
+                np.asarray(native[col]), np.asarray(py[col]),
+                err_msg=f"{col}: {payload!r}")
+        for col in ("value", "lat", "lon", "elevation"):
+            np.testing.assert_allclose(
+                np.asarray(native[col], np.float64),
+                np.asarray(py[col], np.float64), rtol=1e-6,
+                err_msg=f"{col}: {payload!r}")
+        accepted += 1
+    assert checked == 400 and accepted > 30  # fuzz actually exercised both
