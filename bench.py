@@ -171,6 +171,53 @@ def emit(doc: dict) -> None:
 # config 1: headline fused pipeline step (throughput + latency)
 # ---------------------------------------------------------------------------
 
+def measure_rtt(samples: int = 5) -> float:
+    """Median dispatch round-trip of a trivial jitted program (seconds).
+    ~0.1 ms co-located; ~70 ms through the bench tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    trivial = jax.jit(lambda x: x + 1)
+    int(trivial(jnp.int32(0)))
+    rtts = []
+    for _ in range(samples):
+        t = time.perf_counter()
+        int(trivial(jnp.int32(0)))
+        rtts.append(time.perf_counter() - t)
+    return float(np.median(rtts))
+
+
+def packed_chain(tables, staged, chain_k: int):
+    """K packed steps chained in ONE compiled program cycling the staged
+    batches (phase-C device-latency methodology): one host round-trip
+    covers K steps, and the returned acc folds a reduction over every
+    output leg so XLA cannot dead-code-eliminate the work.  Shared by
+    config 1's phase C and tools/width_sweep.py so the sweep always
+    measures exactly what the bench measures."""
+    import jax
+    import jax.numpy as jnp
+
+    from sitewhere_tpu.pipeline.packed import packed_pipeline_step
+
+    stacked_i = jnp.stack([b for b, _ in staged])
+    stacked_f = jnp.stack([f for _, f in staged])
+    n = len(staged)
+
+    @jax.jit
+    def chain(c):
+        def body(i, cr):
+            c, acc = cr
+            k = i % n
+            bi = jax.lax.dynamic_index_in_dim(stacked_i, k, keepdims=False)
+            bf = jax.lax.dynamic_index_in_dim(stacked_f, k, keepdims=False)
+            c, oi, metrics, present = packed_pipeline_step(tables, c, bi, bf)
+            acc = acc + metrics.sum() + oi.sum() + present.sum()
+            return c, acc
+        return jax.lax.fori_loop(0, chain_k, body, (c, jnp.int32(0)))
+
+    return chain
+
+
 def bench_pipeline() -> None:
     import jax
     import jax.numpy as jnp
@@ -281,21 +328,7 @@ def bench_pipeline() -> None:
     # folds in a reduction over EVERY output leg so XLA cannot
     # dead-code-eliminate the rule/geofence/enrichment work.
     if use_packed:
-        stacked_i = jnp.stack([b for b, _ in staged])
-        stacked_f = jnp.stack([f for _, f in staged])
-
-        @jax.jit
-        def chain(c):
-            def body(i, cr):
-                c, acc = cr
-                k = i % len(staged)
-                bi = jax.lax.dynamic_index_in_dim(stacked_i, k, keepdims=False)
-                bf = jax.lax.dynamic_index_in_dim(stacked_f, k, keepdims=False)
-                c, oi, metrics, present = packed_pipeline_step(
-                    tables, c, bi, bf)
-                acc = acc + metrics.sum() + oi.sum() + present.sum()
-                return c, acc
-            return jax.lax.fori_loop(0, chain_k, body, (c, jnp.int32(0)))
+        chain = packed_chain(tables, staged, chain_k)
     else:
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *staged)
 
@@ -317,14 +350,7 @@ def bench_pipeline() -> None:
                 return c, acc
             return jax.lax.fori_loop(0, chain_k, body, (c, jnp.int32(0)))
 
-    trivial = jax.jit(lambda x: x + 1)
-    int(trivial(jnp.int32(0)))
-    rtts = []
-    for _ in range(5):
-        t4 = time.perf_counter()
-        int(trivial(jnp.int32(0)))
-        rtts.append(time.perf_counter() - t4)
-    rtt = float(np.median(rtts))
+    rtt = measure_rtt()
 
     carry, probe = chain(carry)  # compile
     int(probe)
@@ -382,9 +408,11 @@ def bench_dispatcher() -> None:
     # 512 full-profile payloads ≈ 523k events: at ≥1M ev/s the timed
     # region still spans ~0.5 s — long enough to amortize the in-flight
     # window fill/drain and give a stable p99 sample set.  The reduced
-    # profile's 64×512 ≈ 32k events serve the same purpose at CPU rates
-    # (a 16-payload run measured only ~30 ms and swung 2× run-to-run).
-    n_payloads = 64 if reduced else 512
+    # profile uses 128×512 ≈ 65k events: a 16-payload run measured only
+    # ~30 ms and swung 2× run-to-run, and 64 payloads (~0.15 s) still
+    # spread 240-450k across runs — ~0.3-0.5 s halves that variance for
+    # the one CPU-fallback number the driver records.
+    n_payloads = 128 if reduced else 512
     inst = _wire_bench_instance(n_devices, width, 5.0)
     try:
         rng = np.random.default_rng(0)
@@ -414,20 +442,12 @@ def bench_dispatcher() -> None:
         inst.dispatcher.latencies_s.clear()
 
         import jax as _jax
-        import jax.numpy as _jnp
 
         # Dispatch-RTT probe: on a co-located host this is ~0.1 ms; the
         # bench tunnel measures ~70 ms, which lower-bounds any per-plan
         # latency at ~2×RTT regardless of the framework — the breakdown
         # fields below let the p99 be read against it honestly.
-        trivial = _jax.jit(lambda x: x + 1)
-        int(trivial(_jnp.int32(0)))
-        rtts = []
-        for _ in range(5):
-            t4 = time.perf_counter()
-            int(trivial(_jnp.int32(0)))
-            rtts.append(time.perf_counter() - t4)
-        rtt_ms = float(np.median(rtts)) * 1e3
+        rtt_ms = measure_rtt() * 1e3
 
         # Single self-pacing feeder: an open-loop multi-thread burst was
         # tried and measured WORSE (GIL-bound intake contention + every

@@ -1,69 +1,47 @@
 """Device-side packed-step latency vs batch width (config-1 phase-C
-methodology: K chained steps in one compiled program, one fetch, RTT
-subtracted).  Run on any backend; widths via argv (defaults cover the
-config-1/2 operating points).  Reproduces TPU_EVIDENCE_r05.md §7.
+methodology via bench.py's SHARED helpers — packed_chain + measure_rtt —
+so the sweep always measures exactly what the bench measures).
+Run on any backend; widths via argv.  Reproduces TPU_EVIDENCE_r05.md §7.
 
     python tools/width_sweep.py [width ...]
 """
+import os
 import sys
 import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-sys.path.insert(0, __import__("os").path.dirname(
-    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
-import jax
-import jax.numpy as jnp
+import jax  # noqa: E402
 
-import bench
-from sitewhere_tpu.pipeline.packed import (
+import bench  # noqa: E402
+from sitewhere_tpu.pipeline.packed import (  # noqa: E402
     pack_batch_host,
     pack_state,
     pack_tables,
-    packed_pipeline_step,
 )
 
 print("backend:", jax.default_backend(), flush=True)
 capacity, n_active = 16384, 10000
 chain_k = 64
+n_batches = 4
 registry, state, rules, zones = bench.build_tables(capacity, n_active)
 tables = jax.jit(pack_tables)(registry, rules, zones)
+pack_state_fn = jax.jit(pack_state)  # one jit wrapper: state is
+# width-independent, so every width reuses the same compiled pack
 
-trivial = jax.jit(lambda x: x + 1)
-int(trivial(jnp.int32(0)))
-rtts = []
-for _ in range(5):
-    t = time.perf_counter()
-    int(trivial(jnp.int32(0)))
-    rtts.append(time.perf_counter() - t)
-rtt = float(np.median(rtts))
+rtt = bench.measure_rtt()
 print(f"rtt_ms={rtt*1e3:.1f}", flush=True)
 
 widths = tuple(int(a) for a in sys.argv[1:]) or (
     4_096, 16_384, 131_072, 262_144)
 for width in widths:
     try:
-        raw = bench.host_batches(width, n_active, n_batches=4)
+        raw = bench.host_batches(width, n_active, n_batches=n_batches)
         staged = [tuple(jax.device_put(a) for a in pack_batch_host(b, width))
                   for b in raw]
         jax.block_until_ready(staged)
-        carry = jax.jit(pack_state)(state)
-        stacked_i = jnp.stack([b for b, _ in staged])
-        stacked_f = jnp.stack([f for _, f in staged])
-
-        @jax.jit
-        def chain(c, si=stacked_i, sf=stacked_f):
-            def body(i, cr):
-                c, acc = cr
-                k = i % 4
-                bi = jax.lax.dynamic_index_in_dim(si, k, keepdims=False)
-                bf = jax.lax.dynamic_index_in_dim(sf, k, keepdims=False)
-                c, oi, metrics, present = packed_pipeline_step(
-                    tables, c, bi, bf)
-                acc = acc + metrics.sum() + oi.sum() + present.sum()
-                return c, acc
-            return jax.lax.fori_loop(0, chain_k, body, (c, jnp.int32(0)))
-
+        carry = pack_state_fn(state)
+        chain = bench.packed_chain(tables, staged, chain_k)
         carry, probe = chain(carry)
         int(probe)  # compile + settle
         best = None
@@ -71,13 +49,19 @@ for width in widths:
             t0 = time.perf_counter()
             carry, probe = chain(carry)
             int(probe)
-            dt = time.perf_counter() - t0 - rtt
+            # same clamp as bench.py phase C: on a co-located backend
+            # the whole chain can finish in under one startup-probe RTT
+            dt = max(0.0, time.perf_counter() - t0 - rtt)
             step_ms = dt / chain_k * 1e3
             if best is None or step_ms < best:
                 best = step_ms
-        print(f"width={width} step_ms={best:.3f} "
-              f"device_eps={width/best*1e3/1e6:.2f}M", flush=True)
-        del staged, stacked_i, stacked_f, carry
+        if best > 0:
+            print(f"width={width} step_ms={best:.3f} "
+                  f"device_eps={width/best*1e3/1e6:.2f}M", flush=True)
+        else:
+            print(f"width={width} step_ms<rtt (chain faster than the "
+                  f"RTT probe resolution)", flush=True)
+        del staged, carry, chain
     except Exception as e:
         print(f"width={width} FAILED: {type(e).__name__}: {str(e)[:200]}",
               flush=True)
