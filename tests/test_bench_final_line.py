@@ -137,3 +137,21 @@ def test_store_cache_keeps_best_tpu_capture(tmp_path, monkeypatch):
     c = json.load(open(bench.CACHE_PATH))
     assert c["m"]["doc"]["value"] == 250000.0
     assert "latest" not in c["m"]
+
+
+def test_cached_doc_surfaces_latest_when_keep_best_retained(tmp_path,
+                                                            monkeypatch):
+    """When keep-best retained an older capture, the emitted cached line
+    must carry latest_value/latest_git_sha so a cross-SHA regression
+    stays visible to the reader."""
+    monkeypatch.setattr(bench, "CACHE_PATH", str(tmp_path / "cache.json"))
+    bench._store_cache("m", {"value": 177011.7, "backend": "tpu"}, [])
+    bench._store_cache("m", {"value": 104104.6, "backend": "tpu"}, [])
+    doc = bench._cached_doc("m")
+    assert doc["value"] == 177011.7
+    assert doc["backend"] == "tpu-cached"
+    assert doc["latest_value"] == 104104.6
+    assert "latest_captured_at" in doc
+    # no retained-best -> no latest_* noise
+    bench._store_cache("m2", {"value": 5.0, "backend": "tpu"}, [])
+    assert "latest_value" not in bench._cached_doc("m2")
