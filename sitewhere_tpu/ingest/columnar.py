@@ -356,7 +356,19 @@ def _ts_columns(reqs: List[dict]) -> Tuple[np.ndarray, np.ndarray]:
 def _split_epoch(raw: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Shared float64-epoch → (ts_s, ts_ns) split (millis heuristic) —
     ONE implementation so the native and Python paths can't drift."""
+    if not np.isfinite(raw).all():
+        # json.loads parses "1e999" (and the Infinity/NaN literals) to
+        # non-finite floats; the scalar path's int(inf) is a decode
+        # error, so the columnar path must dead-letter too instead of
+        # silently storing an int64-min timestamp (fuzz-found)
+        raise DecodeError("non-finite eventDate/timestamp")
     raw = np.where(raw > 1e11, raw / 1e3, raw)  # epoch millis
+    if ((raw >= float(1 << 31)) | (raw <= -float(1 << 31) - 1.0)).any():
+        # int32 epoch-seconds schema: reject instead of silently
+        # truncating — the bound mirrors the scalar path's
+        # truncate-toward-zero int(value) + [-2^31, 2^31) check exactly,
+        # so int32-min itself stays accepted on both paths
+        raise DecodeError("eventDate out of range")
     ts_s = raw.astype(np.int64)
     ts_ns = np.round((raw - ts_s) * 1e9).astype(np.int64)
     return ts_s.astype(np.int32), ts_ns.astype(np.int32)

@@ -134,6 +134,11 @@ def _parse_ts(value) -> Tuple[int, int]:
         if value > 1e11:  # epoch millis
             value = value / 1000.0
         s = int(value)
+        if not -(1 << 31) <= s < (1 << 31):
+            # the schema stores epoch seconds as int32; a huge finite
+            # literal would otherwise escape as OverflowError at the
+            # batcher's column conversion (fuzz-found crash vector)
+            raise DecodeError(f"eventDate out of range: {value!r}")
         return s, int(round((value - s) * 1e9))
     if isinstance(value, str):
         try:
@@ -142,6 +147,8 @@ def _parse_ts(value) -> Tuple[int, int]:
             raise DecodeError(f"bad eventDate {value!r}") from e
         ts = dt.timestamp()
         s = int(ts)
+        if not -(1 << 31) <= s < (1 << 31):
+            raise DecodeError(f"eventDate out of range: {value!r}")
         return s, int(round((ts - s) * 1e9))
     raise DecodeError(f"bad eventDate {value!r}")
 
@@ -151,10 +158,13 @@ def _decode_one(token: str, kind_name: str, req: dict) -> DecodedRequest:
         return _decode_one_inner(token, kind_name, req)
     except DecodeError:
         raise
-    except (ValueError, TypeError, KeyError) as e:
-        # Malformed field values (float("abc"), int(None), …) must become
-        # DecodeError so sources dead-letter them instead of the exception
-        # killing the receiver thread.
+    except (ValueError, TypeError, KeyError, OverflowError) as e:
+        # Malformed field values (float("abc"), int(None), and the
+        # OverflowError from int(inf) — json.loads parses "1e999" and
+        # the "Infinity" literal to float inf) must become DecodeError
+        # so sources dead-letter them instead of the exception killing
+        # the receiver thread.  Fuzz-found: an eventDate of 1e999 on
+        # any scalar-path line escaped here as OverflowError.
         raise DecodeError(f"bad field in {kind_name!r} request: {e}") from e
 
 
@@ -195,9 +205,12 @@ def _decode_one_inner(token: str, kind_name: str, req: dict) -> DecodedRequest:
             level = _LEVEL_ALIASES.get(level.lower())
             if level is None:
                 raise DecodeError(f"bad alert level {req.get('level')!r}")
+        level = int(level)
+        if not -(1 << 31) <= level < (1 << 31):
+            raise DecodeError(f"alert level out of range: {level!r}")
         return DecodedRequest(
             alert_type=str(req.get("type", req.get("alertType", "alert"))),
-            alert_level=int(level),
+            alert_level=level,
             alert_message=req.get("message"),
             **common,
         )

@@ -87,6 +87,11 @@ static int parse_number(cursor *c, double *out) {
     char *endp;
     *out = strtod(c->p, &endp);
     if (endp != q) return -1; /* also guards a comma-decimal locale */
+    /* grammatical but overflowing literals ("1e999") parse to inf,
+     * which the Python scalar path dead-letters (int(inf) is a decode
+     * error) — bail so every tier rejects non-finite numbers alike
+     * (fuzz-found divergence) */
+    if (*out - *out != 0.0) return -1; /* inf/nan without math.h */
     c->p = q;
     return 0;
 }

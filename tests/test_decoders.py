@@ -204,3 +204,42 @@ def test_bad_field_values_become_decode_errors():
             "deviceToken": "t", "type": "DeviceLocation",
             "request": {"latitude": "north", "longitude": 0},
         }).encode())
+
+
+def test_overflow_timestamps_and_levels_dead_letter_not_crash():
+    """Fuzz-found crash vectors: json.loads parses 1e999 to inf and
+    accepts huge finite literals; int(inf) raised OverflowError THROUGH
+    the decoder into receiver threads, and huge-but-finite values blew
+    up later at the batcher's int32 conversion.  Every such payload must
+    be a DecodeError on every path — scalar, columnar, native."""
+    import pytest
+
+    from sitewhere_tpu.ingest.columnar import decode_json_lines
+    from sitewhere_tpu.ingest.decoders import DecodeError, JsonDecoder
+
+    bad_lines = [
+        # inf / nan spellings json.loads accepts
+        '{"deviceToken":"d","type":"Measurement",'
+        '"request":{"name":"t","value":1,"eventDate":1e999}}',
+        '{"deviceToken":"d","type":"Measurement",'
+        '"request":{"name":"t","value":1,"eventDate":Infinity}}',
+        '{"deviceToken":"d","type":"Measurement",'
+        '"request":{"name":"t","value":1,"eventDate":NaN}}',
+        # finite but outside the int32 epoch-seconds schema
+        '{"deviceToken":"d","type":"Measurement",'
+        '"request":{"name":"t","value":1,"eventDate":1e20}}',
+        # ISO date beyond int32 epoch seconds
+        '{"deviceToken":"d","type":"Measurement",'
+        '"request":{"name":"t","value":1,"eventDate":"9999-01-01"}}',
+        # alert level outside int32
+        '{"deviceToken":"d","type":"Alert",'
+        '"request":{"type":"x","level":99999999999999,"eventDate":1000}}',
+        # registration line with inf eventDate (host-plane path)
+        '{"deviceToken":"d","type":"RegisterDevice",'
+        '"request":{"deviceTypeToken":"s","eventDate":1e999}}',
+    ]
+    for line in bad_lines:
+        with pytest.raises(DecodeError):
+            JsonDecoder()(line.encode())
+        with pytest.raises(DecodeError):
+            decode_json_lines(line.encode())

@@ -432,8 +432,16 @@ def test_fuzz_mutated_payloads_never_crash_and_never_diverge():
         # 2. whatever the PRODUCTION native tier accepts — measurement
         # scanner first, family scanner second, exactly as
         # _native_decode tries them — must match the pure-Python decode
-        # (None = bail is always allowed)
-        native, host_n = columnar._native_decode(payload) or (None, None)
+        # (None = bail is always allowed; a DecodeError means the C scan
+        # accepted the shape but a shared value check rejected it — the
+        # Python path must then reject the payload too)
+        from sitewhere_tpu.ingest.decoders import DecodeError
+        try:
+            native, host_n = columnar._native_decode(payload) or (None, None)
+        except DecodeError:
+            with pytest.raises(Exception):
+                T_py, _ = _python_decode(payload)
+            continue
         if native is None:
             continue
         try:
