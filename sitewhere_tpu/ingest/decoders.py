@@ -126,6 +126,18 @@ class DecodedRequest:
         return None
 
 
+def _ts_from_seconds(value: float) -> Tuple[int, int]:
+    """Epoch-SECONDS float → (ts_s, ts_ns) with the int32 schema check.
+
+    No epoch-millis heuristic: callers whose wire format DEFINES the
+    field as seconds (the binary framing) must not reinterpret corrupt
+    values in (1e11, ~2.1e12] as milliseconds — they dead-letter."""
+    s = int(value)  # OverflowError (inf) / ValueError (nan) → DecodeError
+    if not -(1 << 31) <= s < (1 << 31):
+        raise DecodeError(f"timestamp out of range: {value!r}")
+    return s, int(round((value - s) * 1e9))
+
+
 def _parse_ts(value) -> Tuple[int, int]:
     """Accept epoch seconds (int/float), epoch millis (int > 1e11), or ISO."""
     if value is None:
@@ -482,8 +494,12 @@ class BinaryDecoder:
             pos += token_len
             (ts,) = _BIN_TS.unpack_from(payload, pos)
             pos += _BIN_TS.size
-            ts_s = int(ts)
-            ts_ns = int(round((ts - ts_s) * 1e9))
+            # range/finiteness checks: wire bytes can encode inf/nan
+            # or out-of-int32 floats, which must dead-letter like the
+            # JSON paths, never escape as OverflowError (seconds-only:
+            # the binary field is DEFINED as epoch seconds, so no
+            # millis heuristic)
+            ts_s, ts_ns = _ts_from_seconds(ts)
             kind = RequestKind(kind)
             if kind == RequestKind.MEASUREMENT:
                 name_len, value = _BIN_MEAS.unpack_from(payload, pos)
@@ -524,7 +540,8 @@ class BinaryDecoder:
                     )
                 ]
             raise DecodeError(f"unsupported binary kind {int(kind)}")
-        except (struct.error, UnicodeDecodeError, ValueError) as e:
+        except (struct.error, UnicodeDecodeError, ValueError,
+                OverflowError) as e:
             raise DecodeError(f"bad binary payload: {e}") from e
 
     @staticmethod

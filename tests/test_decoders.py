@@ -243,3 +243,32 @@ def test_overflow_timestamps_and_levels_dead_letter_not_crash():
             JsonDecoder()(line.encode())
         with pytest.raises(DecodeError):
             decode_json_lines(line.encode())
+
+
+def test_binary_decoder_rejects_non_finite_and_out_of_range_ts():
+    """Same overflow class via the binary framing: wire bytes can encode
+    inf/nan/huge float64 timestamps — they must dead-letter, not escape
+    as OverflowError or crash later at the int32 column conversion."""
+    import math
+
+    from sitewhere_tpu.ingest.decoders import (
+        _BIN_HEAD,
+        _BIN_MAGIC,
+        _BIN_MEAS,
+        _BIN_TS,
+    )
+
+    def frame(ts):
+        token = b"d-1"
+        head = _BIN_HEAD.pack(_BIN_MAGIC, int(RequestKind.MEASUREMENT),
+                              len(token))
+        name = b"t"
+        return (head + token + _BIN_TS.pack(ts)
+                + _BIN_MEAS.pack(len(name), 1.0) + name)
+
+    assert BinaryDecoder()(frame(1_753_800_000.5))[0].ts_s == 1_753_800_000
+    # 5e11 sits in the JSON millis-heuristic band — the binary field is
+    # DEFINED as seconds, so it must dead-letter, not decode as 1985
+    for bad in (math.inf, -math.inf, math.nan, 1e20, 5e11):
+        with pytest.raises(DecodeError):
+            BinaryDecoder()(frame(bad))
