@@ -566,13 +566,15 @@ def _dispatcher_tuned_latency(payloads, capacity_eps, n_devices=2_000,
         cap = rows_per_payload * len(burst) / (time.perf_counter() - tb)
         cap = min(cap, capacity_eps) if capacity_eps else cap
         # Phase B — paced at util of measured capacity; fresh samples.
-        # Two attempts, best p99 kept (labelled): the p99 of a ~1 s
-        # region sits right at this host's scheduler-noise floor
-        # (measured 9.6/9.8/11.3 ms across identical runs), and the
-        # driver records exactly one invocation.
+        # Two attempts, WORST p99 kept: a tail-latency claim judged on
+        # the best of N is optimistically biased (the p99 of a ~1 s
+        # region sits right at this host's scheduler-noise floor —
+        # measured 9.6/9.8/11.3 ms across identical runs), so the
+        # reported number is the one every attempt met, and all
+        # attempts' p99s ride along for transparency.
         gap_s = rows_per_payload / max(cap * util, 1.0)
-        best = None
-        sampled = 0
+        worst = None
+        attempt_p99s = []
         for attempt in range(2):
             inst.dispatcher.latencies_s.clear()
             t0 = time.perf_counter()
@@ -590,18 +592,19 @@ def _dispatcher_tuned_latency(payloads, capacity_eps, n_devices=2_000,
             snap = inst.dispatcher.metrics_snapshot()
             if snap.get("latency_p99_ms") is None:
                 continue
-            sampled += 1
             n = rows_per_payload * len(paced)
             doc = {"p99_ms": snap["latency_p99_ms"],
                    "p50_ms": snap.get("latency_p50_ms"),
                    "events_per_sec": round(n / dt, 1),
                    "deadline_ms": deadline_ms,
                    "offered_util": util}
-            if best is None or doc["p99_ms"] < best["p99_ms"]:
-                best = doc
-        if best is not None:
-            best["attempts"] = sampled  # measurements actually compared
-        return best
+            attempt_p99s.append(doc["p99_ms"])
+            if worst is None or doc["p99_ms"] > worst["p99_ms"]:
+                worst = doc
+        if worst is not None:
+            worst["attempts"] = len(attempt_p99s)
+            worst["attempt_p99_ms"] = attempt_p99s  # every measurement
+        return worst
     except Exception as e:  # diagnostic only — never sink the main row
         _emit_now({"diagnostic": True, "tuned_latency_error": str(e)},
                   sys.stderr)
@@ -874,6 +877,25 @@ def _load_cache() -> dict:
         return {}
 
 
+# Keep-best is ONLY sound for metrics where larger is better: retaining
+# the max of a lower-is-better (latency-style) metric would pin an
+# optimistic capture forever.  The allowlist is explicit — a new metric
+# does not get keep-best semantics by accident.
+_KEEP_BEST_METRICS = frozenset({
+    "pipeline_events_per_sec_per_chip",
+    "dispatcher_events_per_sec_per_chip",
+    "analytics_events_per_sec_per_chip",
+    "multitenant_events_per_sec_per_chip",
+    "media_label_ops_per_sec",
+})
+
+# A fresh value this far below the retained doc is a suspected code
+# regression, not tunnel noise (noise measured ~1.7x on identical code;
+# the marker trips well inside that so real regressions can't hide
+# behind keep-best).
+_REGRESSION_RATIO = 0.5
+
+
 def _store_cache(metric: str, doc: dict, attempts: list) -> None:
     cache = _load_cache()
     entry = {
@@ -883,7 +905,8 @@ def _store_cache(metric: str, doc: dict, attempts: list) -> None:
         "attempts": attempts,
     }
     prev = cache.get(metric)
-    if (isinstance(prev, dict)
+    if (metric in _KEEP_BEST_METRICS
+            and isinstance(prev, dict)
             and isinstance(prev.get("doc"), dict)
             and str(prev["doc"].get("backend", "")).startswith("tpu")
             and isinstance(prev["doc"].get("value"), (int, float))
@@ -897,6 +920,13 @@ def _store_cache(metric: str, doc: dict, attempts: list) -> None:
         # honest, nothing is discarded.
         prev["latest"] = entry
         cache[metric] = prev
+        if doc["value"] < _REGRESSION_RATIO * prev["doc"]["value"]:
+            _emit_now({"diagnostic": True, "REGRESSION_SUSPECTED": metric,
+                       "retained_value": prev["doc"]["value"],
+                       "latest_value": doc["value"],
+                       "retained_git_sha": (prev.get("git_sha") or "")[:12],
+                       "latest_git_sha": (entry.get("git_sha") or "")[:12]},
+                      sys.stderr)
     else:
         cache[metric] = entry
     tmp = CACHE_PATH + ".tmp"
@@ -928,6 +958,13 @@ def _cached_doc(metric: str):
         doc["latest_value"] = latest["doc"].get("value")
         doc["latest_git_sha"] = (latest.get("git_sha") or "")[:12]
         doc["latest_captured_at"] = latest.get("captured_at")
+        if (isinstance(doc.get("latest_value"), (int, float))
+                and isinstance(doc.get("value"), (int, float))
+                and doc["latest_value"] < _REGRESSION_RATIO * doc["value"]):
+            # the freshest run is materially below what keep-best
+            # retained — flag it on the doc itself so the headline
+            # cannot silently mask a code regression
+            doc["regression_suspected"] = True
     return doc
 
 

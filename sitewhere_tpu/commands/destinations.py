@@ -18,7 +18,13 @@ import threading
 from typing import Callable, Dict, Optional
 
 from sitewhere_tpu.commands.model import CommandExecution
+from sitewhere_tpu.runtime import faults
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.resilience import (
+    RetriesExhausted,
+    RetryPolicy,
+    call_with_retry,
+)
 from sitewhere_tpu.services.common import ServiceError
 
 logger = logging.getLogger("sitewhere_tpu.commands")
@@ -371,7 +377,14 @@ class CallbackDeliveryProvider:
 
 
 class CommandDestination:
-    """One named delivery path: encode → extract params → deliver."""
+    """One named delivery path: encode → extract params → deliver.
+
+    ``retry`` (a :class:`~sitewhere_tpu.runtime.resilience.RetryPolicy`)
+    re-attempts TRANSIENT :class:`DeliveryError` s before the processor
+    dead-letters the invocation — e.g. an MQTT broker mid-reconnect.
+    Default is no retry (CoAP already retransmits on the RFC 7252
+    schedule; double-retrying a confirmable exchange would violate it).
+    """
 
     def __init__(
         self,
@@ -379,13 +392,32 @@ class CommandDestination:
         encoder: Callable[[CommandExecution], bytes],
         extractor: Callable[[CommandExecution], Dict[str, str]],
         provider,
+        retry: Optional[RetryPolicy] = None,
     ):
         self.destination_id = destination_id
         self.encoder = encoder
         self.extractor = extractor
         self.provider = provider
+        self.retry = retry
+
+    def _deliver_once(self, execution: CommandExecution, payload: bytes,
+                      params: Dict[str, str]) -> None:
+        faults.fire("commands.deliver")
+        self.provider.deliver(execution, payload, params)
 
     def deliver(self, execution: CommandExecution) -> None:
         payload = self.encoder(execution)
         params = self.extractor(execution)
-        self.provider.deliver(execution, payload, params)
+        if self.retry is None:
+            self._deliver_once(execution, payload, params)
+            return
+        try:
+            call_with_retry(
+                lambda: self._deliver_once(execution, payload, params),
+                self.retry, retry_on=(DeliveryError,),
+                name=f"commands.{self.destination_id}")
+        except RetriesExhausted as e:
+            # surface the underlying transport failure to the processor's
+            # undelivered dead-letter path, with the retry context
+            raise DeliveryError(
+                f"{e} (last: {e.__cause__})") from e.__cause__

@@ -120,6 +120,7 @@ class MqttBroker:
         self.connects = 0
         self.published = 0
         self.delivered = 0
+        self.tap_failures = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -273,9 +274,23 @@ class MqttBroker:
         for tap in self.on_publish:
             try:
                 tap(topic, payload)
-            except Exception:
-                logger.exception("mqtt broker tap failed for topic %s",
-                                 topic)
+            except Exception as e:
+                # At-least-once REQUIRES withholding the PUBACK when the
+                # tap (the platform's intake) failed: dropping the
+                # session makes the publisher's drain time out and the
+                # device redeliver — acking here would silently lose the
+                # event.  Contract: taps must swallow PAYLOAD-level
+                # errors themselves (InboundEventSource.on_encoded_payload
+                # does — decode failures dead-letter, forward failures
+                # are counted), so what reaches here is crash-grade or
+                # injected; a tap that raised deterministically per
+                # payload would otherwise make the device redeliver the
+                # same poison forever.
+                self.tap_failures += 1
+                logger.warning("mqtt broker tap failed for topic %s: %s "
+                               "(withholding PUBACK; publisher retries)",
+                               topic, e)
+                raise MqttError(f"tap failed: {e}") from e
         # ack after the taps (the at-least-once state that matters) but
         # BEFORE subscriber fan-out: a stalled subscriber's full send
         # buffer must not block the publisher's PUBACK

@@ -49,7 +49,9 @@ from typing import Callable, List, Optional
 logger = logging.getLogger("sitewhere_tpu.ingest")
 
 from sitewhere_tpu.ingest.decoders import DecodedRequest, DecodeError, RequestKind
+from sitewhere_tpu.runtime import faults
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.resilience import Backoff, RetryPolicy, Supervisor
 
 Decoder = Callable[[bytes], List[DecodedRequest]]
 Forward = Callable[[DecodedRequest, bytes], None]
@@ -115,6 +117,7 @@ class InboundEventSource(LifecycleComponent):
         """
         if self.raw_wire and self.on_wire_payload is not None:
             try:
+                faults.fire("ingest.decode")
                 self.decoded_count += self.on_wire_payload(
                     payload, self.source_id)
             except DecodeError as e:
@@ -130,6 +133,7 @@ class InboundEventSource(LifecycleComponent):
                     "raw wire forward failed for source %s", self.source_id)
             return
         try:
+            faults.fire("ingest.decode")
             requests = self.decoder(payload)
         except DecodeError as e:
             self.failed_count += 1
@@ -174,17 +178,51 @@ class InboundEventSource(LifecycleComponent):
 
 
 class Receiver(LifecycleComponent):
-    """Base receiver: owns a transport, pushes raw payloads to ``sink``."""
+    """Base receiver: owns a transport, pushes raw payloads to ``sink``.
+
+    Loop-owning receivers run their loops under a
+    :class:`~sitewhere_tpu.runtime.resilience.Supervisor`
+    (:meth:`_spawn_supervised`): an unexpected exception restarts the
+    loop with exponential backoff instead of silently killing the
+    thread, and a receiver that fails ``max_restarts`` times in a row
+    escalates — terminal log + metric + lifecycle ERROR state — rather
+    than spinning forever.  ``restart_policy`` / ``max_restarts`` are
+    plain attributes so deployments (and chaos tests) tune them without
+    touching every subclass constructor.
+    """
 
     def __init__(self, name: str):
         super().__init__(name=name)
         self.sink: Optional[Callable[[bytes], None]] = None
         self.received_count = 0
+        self.restart_policy = RetryPolicy(initial_s=0.05, max_s=5.0)
+        self.max_restarts = 8
+        self.supervisor: Optional[Supervisor] = None
 
     def _emit(self, payload: bytes) -> None:
+        faults.fire("ingest.emit")
         self.received_count += 1
         if self.sink is not None:
             self.sink(payload)
+
+    def _spawn_supervised(self, run: Callable[[], None]) -> Supervisor:
+        """Run ``run`` on a supervised thread; escalation marks this
+        component failed (the operator-visible terminal state)."""
+        self.supervisor = Supervisor(
+            self.name, run, policy=self.restart_policy,
+            max_restarts=self.max_restarts, min_uptime_s=5.0,
+            on_escalate=self._on_escalate)
+        self.supervisor.start()
+        return self.supervisor
+
+    def _on_escalate(self, exc: BaseException) -> None:
+        logger.error("receiver %s failed permanently: %s", self.name, exc)
+        self._fail(exc)
+
+    def _stop_supervisor(self) -> None:
+        if self.supervisor is not None:
+            self.supervisor.stop()
+            self.supervisor = None
 
 
 def length_prefixed_frames(conn: socket.socket, emit: Callable[[bytes], None]) -> None:
@@ -243,17 +281,22 @@ class WebSocketReceiver(Receiver):
         self.max_reconnect_delay_s = max_reconnect_delay_s
         self._alive = False
         self._stop_evt = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         self._client = None
         self.connects = 0
+        # reconnect schedule on the shared primitive (was ad-hoc
+        # delay-doubling state)
+        self._backoff = Backoff(
+            RetryPolicy(initial_s=reconnect_delay_s,
+                        max_s=max_reconnect_delay_s),
+            name="ingest.ws-reconnect")
 
     def start(self) -> None:
         self._alive = True
         self._stop_evt.clear()
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name=self.name
-        )
-        self._thread.start()
+        # Supervised: transport errors are handled by the reconnect loop
+        # itself; the supervisor catches anything unexpected (a sink
+        # exception, an injected fault) and restarts the whole loop.
+        self._spawn_supervised(self._loop)
         super().start()
 
     def stop(self) -> None:
@@ -265,22 +308,19 @@ class WebSocketReceiver(Receiver):
                 client.close()
             except OSError:
                 pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        self._stop_supervisor()
         super().stop()
 
     def _loop(self) -> None:
         from sitewhere_tpu.web.ws import ClientWebSocket
 
-        delay = self.reconnect_delay_s
         while self._alive:
             try:
                 self._client = ClientWebSocket(
                     self.host, self.port, self.path, headers=self.headers
                 )
                 self.connects += 1
-                delay = self.reconnect_delay_s  # reset backoff on success
+                self._backoff.reset()  # connected: fresh schedule
                 while self._alive:
                     msg = self._client.recv()
                     if msg is None:
@@ -298,8 +338,7 @@ class WebSocketReceiver(Receiver):
                     except OSError:
                         pass
             if self._alive:
-                self._stop_evt.wait(delay)  # interruptible backoff
-                delay = min(delay * 2, self.max_reconnect_delay_s)
+                self._stop_evt.wait(self._backoff.next_delay())
 
 
 class TcpReceiver(Receiver):
@@ -349,33 +388,51 @@ class UdpReceiver(Receiver):
         super().__init__(name=f"udp-receiver:{port}")
         self.host, self.port = host, port
         self._sock: Optional[socket.socket] = None
-        self._thread: Optional[threading.Thread] = None
         self._alive = False
 
+    def _bind(self) -> None:
+        if self._sock is None:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._sock.bind((self.host, self.port))
+            self.port = self._sock.getsockname()[1]
+
     def start(self) -> None:
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self._sock.bind((self.host, self.port))
-        self.port = self._sock.getsockname()[1]
+        self._bind()
         self._alive = True
-
-        def loop():
-            while self._alive:
-                try:
-                    data, _ = self._sock.recvfrom(65536)
-                except OSError:
-                    return
-                if data:
-                    self._emit(data)
-
-        self._thread = threading.Thread(target=loop, daemon=True, name=self.name)
-        self._thread.start()
+        # Supervised: a sink/emit exception restarts the loop with
+        # backoff; the bound socket survives restarts, so datagrams sent
+        # during the backoff window sit in the kernel buffer, not lost.
+        self._spawn_supervised(self._run)
         super().start()
+
+    def _run(self) -> None:
+        self._bind()   # restart after a crash that closed the socket
+        while self._alive:
+            try:
+                data, _ = self._sock.recvfrom(65536)
+            except OSError:
+                if not self._alive:
+                    return   # clean shutdown closed the socket
+                # release the port before the supervised restart rebinds
+                # it — a leaked fd would turn every rebind into
+                # EADDRINUSE and a transient recv error into terminal
+                # receiver death
+                sock, self._sock = self._sock, None
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                raise        # unexpected socket death → supervisor restarts
+            if data:
+                self._emit(data)
 
     def stop(self) -> None:
         self._alive = False
         if self._sock is not None:
             self._sock.close()
             self._sock = None
+        self._stop_supervisor()
         super().stop()
 
 
@@ -458,29 +515,30 @@ class PollingRestReceiver(Receiver):
         self.interval_s = interval_s
         self.transform = transform or (lambda body: [body] if body else [])
         self._alive = False
-        self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
 
     def start(self) -> None:
         self._alive = True
-
-        def loop():
-            while self._alive:
-                try:
-                    with urllib.request.urlopen(self.url, timeout=10) as resp:
-                        body = resp.read()
-                    for payload in self.transform(body):
-                        self._emit(payload)
-                except OSError:
-                    pass
-                self._wake.wait(self.interval_s)
-                self._wake.clear()
-
-        self._thread = threading.Thread(target=loop, daemon=True, name=self.name)
-        self._thread.start()
+        # Supervised: HTTP errors are expected (the poll just skips a
+        # tick); a transform/sink exception restarts the loop with
+        # backoff instead of killing the poller silently.
+        self._spawn_supervised(self._run)
         super().start()
+
+    def _run(self) -> None:
+        while self._alive:
+            try:
+                with urllib.request.urlopen(self.url, timeout=10) as resp:
+                    body = resp.read()
+                for payload in self.transform(body):
+                    self._emit(payload)
+            except OSError:
+                pass
+            self._wake.wait(self.interval_s)
+            self._wake.clear()
 
     def stop(self) -> None:
         self._alive = False
         self._wake.set()
+        self._stop_supervisor()
         super().stop()

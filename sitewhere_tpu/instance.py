@@ -160,6 +160,9 @@ class Instance(LifecycleComponent):
             segment_bytes=int(self.config["journal.segment_bytes"]),
         )
         self.dead_letters = Journal(self.data_dir, name="dead-letters")
+        # terminal seal failures dead-letter instead of pinning memory /
+        # blocking the commit gate forever (EventStore.flush contract)
+        self.event_store.dead_letters = self.dead_letters
 
         # span tracing (reference: Jaeger probabilistic 1% sampling,
         # MicroserviceConfiguration.java:53-57)
@@ -602,7 +605,9 @@ class Instance(LifecycleComponent):
     def _on_undelivered_command(self, invocation, reason) -> None:
         """Undelivered commands dead-letter (reference:
         undelivered-command-invocations topic)."""
-        self.dead_letters.append_json({
+        from sitewhere_tpu.runtime.resilience import dead_letter as _dl
+
+        _dl(self.dead_letters, {
             "kind": "undelivered-command",
             "invocation": invocation.token,
             "command": invocation.command_token,
@@ -821,6 +826,8 @@ class Instance(LifecycleComponent):
     def topology(self) -> dict:
         """Live component tree + counters (reference
         ``TopologyStateAggregator`` → admin UI WebSocket feed)."""
+        from sitewhere_tpu.runtime.metrics import global_registry
+
         topo = {
             "instance": self.instance_id,
             "bootstrapped": self.bootstrapped,
@@ -829,6 +836,13 @@ class Instance(LifecycleComponent):
             "devices": len(self.identity.device),
             "events_stored": self.event_store.total_events,
             "tracing": self.tracer.stats(),
+            # cross-cutting resilience counters (retries, breaker
+            # transitions, supervisor restarts, dead-letter totals)
+            "resilience": {
+                k: v for k, v in
+                global_registry().snapshot()["counters"].items()
+                if k.startswith("resilience.")
+            },
         }
         if self.forwarder is not None:
             topo["forwarding"] = self.forwarder.metrics()

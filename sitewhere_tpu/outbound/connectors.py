@@ -30,8 +30,21 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from sitewhere_tpu.outbound.filters import apply_filters
+from sitewhere_tpu.runtime import faults
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.resilience import (
+    Backoff,
+    CircuitBreaker,
+    RetriesExhausted,
+    RetryPolicy,
+    call_with_retry,
+    dead_letter,
+)
 from sitewhere_tpu.schema import EventType
+
+# One immediate retry on a fresh connection: a keep-alive socket the
+# server already closed fails the first write/read, not the request.
+_RECONNECT_RETRY = RetryPolicy(initial_s=0.0, max_s=0.0, max_attempts=1)
 
 logger = logging.getLogger("sitewhere_tpu.outbound")
 
@@ -92,22 +105,66 @@ class OutboundConnector(LifecycleComponent):
 
     Reference: ``FilteredOutboundConnector`` + the per-connector metrics of
     ``OutboundConnector.java``.
+
+    With a :class:`~sitewhere_tpu.runtime.resilience.CircuitBreaker`
+    attached, a connector whose ``deliver`` keeps RAISING trips the
+    breaker and subsequent batches are SHED (counted in ``shed``,
+    summarized to ``dead_letters``) instead of queueing behind a dead
+    sink — the worker queue stays drained and the half-open probe
+    re-admits traffic once the sink recovers.  Only exceptions that
+    escape ``deliver`` count as failures: connectors that swallow their
+    own errors keep their existing semantics.
     """
 
-    def __init__(self, connector_id: str, filters=None):
+    def __init__(self, connector_id: str, filters=None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 dead_letters=None):
         super().__init__(f"connector-{connector_id}")
         self.connector_id = connector_id
         self.filters = list(filters or [])
+        self.breaker = breaker
+        self.dead_letters = dead_letters
         self._lock = threading.Lock()
         self.processed = 0
         self.errors = 0
+        self.shed = 0
 
     def process_batch(self, cols: Columns, mask: np.ndarray) -> int:
         """Filter and deliver one column batch; returns rows delivered."""
-        surviving = apply_filters(self.filters, cols, mask)
+        try:
+            surviving = apply_filters(self.filters, cols, mask)
+        except Exception:
+            # a crashing filter is a connector error too (the manager
+            # only logs); it says nothing about the SINK, so the
+            # breaker's outcome window is left alone
+            with self._lock:
+                self.errors += 1
+            raise
         n = int(surviving.sum())
-        if n:
+        if not n:
+            return 0
+        if self.breaker is not None and not self.breaker.allow():
+            with self._lock:
+                self.shed += n
+            dead_letter(self.dead_letters, {
+                "kind": "connector-shed",
+                "connector": self.connector_id,
+                "rows": n,
+            })
+            return 0
+        try:
+            faults.fire("outbound.deliver")
             self.deliver(cols, surviving)
+        except Exception:
+            # the connector owns its error count (the manager only
+            # isolates + logs); the breaker sees every escaped failure
+            with self._lock:
+                self.errors += 1
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
+        if self.breaker is not None:
+            self.breaker.record_success()
         with self._lock:
             self.processed += n
         return n
@@ -120,8 +177,8 @@ class CallbackConnector(OutboundConnector):
     """Deliver through any callable (the Groovy-connector analog)."""
 
     def __init__(self, connector_id: str, fn: Callable[[Columns, np.ndarray], None],
-                 filters=None):
-        super().__init__(connector_id, filters)
+                 filters=None, **kw):
+        super().__init__(connector_id, filters, **kw)
         self.fn = fn
 
     def deliver(self, cols: Columns, mask: np.ndarray) -> None:
@@ -131,8 +188,9 @@ class CallbackConnector(OutboundConnector):
 class FileConnector(OutboundConnector):
     """Append surviving events as JSON lines (external-indexer analog)."""
 
-    def __init__(self, connector_id: str, path: str, identity=None, filters=None):
-        super().__init__(connector_id, filters)
+    def __init__(self, connector_id: str, path: str, identity=None,
+                 filters=None, **kw):
+        super().__init__(connector_id, filters, **kw)
         self.path = path
         self.identity = identity
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -165,8 +223,9 @@ class HttpConnector(OutboundConnector):
         transform: Optional[Callable[[List[dict]], bytes]] = None,
         timeout_s: float = 10.0,
         filters=None,
+        **kw,
     ):
-        super().__init__(connector_id, filters)
+        super().__init__(connector_id, filters, **kw)
         from urllib.parse import urlsplit
 
         parts = urlsplit(url)
@@ -199,44 +258,50 @@ class HttpConnector(OutboundConnector):
             self._conn = None
         super().stop()
 
+    def _post(self, body: bytes, headers: Dict[str, str]) -> int:
+        """One POST exchange, returning the status; transport failures
+        drop the keep-alive connection and raise (retryable)."""
+        if self._conn is None:
+            self._conn = self._connect()
+        try:
+            self._conn.request("POST", self._path, body=body,
+                               headers=headers)
+            resp = self._conn.getresponse()
+            resp.read()
+            return resp.status
+        except Exception:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+            self._conn = None
+            raise
+
     def deliver(self, cols: Columns, mask: np.ndarray) -> None:
         rows = np.nonzero(mask)[0]
         docs = [marshal_row(cols, int(r), self.identity) for r in rows]
         body = (self.transform(docs) if self.transform is not None
                 else json.dumps(docs).encode("utf-8"))
         headers = {"Content-Type": "application/json", **self.headers}
-        # one retry on a fresh connection: a keep-alive socket the server
-        # already closed fails the first write/read, not the request
-        for attempt in (0, 1):
-            if self._conn is None:
-                self._conn = self._connect()
-            try:
-                self._conn.request("POST", self._path, body=body,
-                                   headers=headers)
-                resp = self._conn.getresponse()
-                resp.read()
-                # only 2xx is delivery: http.client does not follow
-                # redirects, so a 3xx means the events never arrived
-                if not 200 <= resp.status < 300:
-                    raise DeliveryFailed(
-                        f"webhook returned {resp.status}")
-                return
-            except DeliveryFailed:
-                with self._lock:
-                    self.errors += 1
-                logger.error("%s POST %s rejected", self.name, self._path)
-                return
-            except Exception:
-                try:
-                    self._conn.close()
-                except Exception:
-                    pass
-                self._conn = None
-                if attempt:
-                    with self._lock:
-                        self.errors += 1
-                    logger.exception("%s POST %s failed", self.name,
-                                     self._path)
+        try:
+            status = call_with_retry(
+                lambda: self._post(body, headers), _RECONNECT_RETRY,
+                retry_on=(Exception,),
+                name=f"outbound.{self.connector_id}.post")
+        except RetriesExhausted as e:
+            logger.exception("%s POST %s failed", self.name, self._path)
+            # raise so process_batch counts the error and the breaker
+            # sees the dead sink (the manager isolates it from siblings)
+            raise DeliveryFailed(
+                f"POST {self._path} failed: {e.__cause__}") from e.__cause__
+        # only 2xx is delivery: http.client does not follow redirects,
+        # so a 3xx means the events never arrived — an answered error is
+        # NOT retried (the reference webhook connectors likewise treat a
+        # rejection as final)
+        if not 200 <= status < 300:
+            logger.error("%s POST %s rejected (%d)", self.name, self._path,
+                         status)
+            raise DeliveryFailed(f"webhook returned {status}")
 
 
 class DeliveryFailed(Exception):
@@ -262,8 +327,9 @@ class MqttOutboundConnector(OutboundConnector):
         route_builder: Optional[Callable[[str, dict], str]] = None,
         qos: int = 0,
         filters=None,
+        **kw,
     ):
-        super().__init__(connector_id, filters)
+        super().__init__(connector_id, filters, **kw)
         self.client = client
         self.topic = topic
         self.identity = identity
@@ -330,10 +396,11 @@ class IndexPushConnector(HttpConnector):
         bulk_format: Optional[Callable[[List[dict]], bytes]] = None,
         timeout_s: float = 10.0,
         filters=None,
+        **kw,
     ):
         super().__init__(connector_id, url, identity=identity,
                          headers=headers, timeout_s=timeout_s,
-                         filters=filters)
+                         filters=filters, **kw)
         self.bulk_rows = bulk_rows
         self.bulk_interval_s = bulk_interval_s
         self.max_buffer_rows = max_buffer_rows
@@ -344,8 +411,10 @@ class IndexPushConnector(HttpConnector):
         self._pending: List[dict] = []
         self._inflight: set = set()
         self._last_flush = time.monotonic()
-        self._retry_at = 0.0
-        self._cur_backoff = backoff_s
+        # failed-bulk retry schedule (was ad-hoc _retry_at/_cur_backoff)
+        self._backoff = Backoff(
+            RetryPolicy(initial_s=backoff_s, max_s=max_backoff_s),
+            name=f"outbound.{connector_id}.bulk")
         self.indexed = 0
         self.dropped = 0
         # serializes whole flushes: the interval timer and a delivery
@@ -414,7 +483,8 @@ class IndexPushConnector(HttpConnector):
             n = len(self._pending)
             due = force or n >= self.bulk_rows or (
                 n > 0 and now - self._last_flush >= self.bulk_interval_s)
-            if not due or n == 0 or (not force and now < self._retry_at):
+            if not due or n == 0 or (not force
+                                     and not self._backoff.due(now)):
                 return
             batch = self._pending[:]
             self._inflight = {id(d) for d in batch}
@@ -434,38 +504,26 @@ class IndexPushConnector(HttpConnector):
                     self._inflight = set()
                     self.indexed += len(batch)
                     self._last_flush = now
-                    self._cur_backoff = self.backoff_s
-                    self._retry_at = 0.0
+                    self._backoff.reset()
             else:
                 with self._lock:
                     self._inflight = set()
                     self.errors += 1
-                    self._retry_at = now + self._cur_backoff
-                    self._cur_backoff = min(self._cur_backoff * 2,
-                                            self.max_backoff_s)
+                    self._backoff.defer(now)
 
     def _post_bulk(self, body: bytes) -> bool:
         headers = {"Content-Type": "application/json", **self.headers}
-        for attempt in (0, 1):
-            if self._conn is None:
-                self._conn = self._connect()
-            try:
-                self._conn.request("POST", self._path, body=body,
-                                   headers=headers)
-                resp = self._conn.getresponse()
-                resp.read()
-                if not 200 <= resp.status < 300:
-                    logger.error("%s bulk POST %s rejected (%d)",
-                                 self.name, self._path, resp.status)
-                    return False
-                return True
-            except Exception:
-                try:
-                    self._conn.close()
-                except Exception:
-                    pass
-                self._conn = None
-                if attempt:
-                    logger.exception("%s bulk POST %s failed", self.name,
-                                     self._path)
-        return False
+        try:
+            status = call_with_retry(
+                lambda: self._post(body, headers), _RECONNECT_RETRY,
+                retry_on=(Exception,),
+                name=f"outbound.{self.connector_id}.bulk-post")
+        except RetriesExhausted:
+            logger.exception("%s bulk POST %s failed", self.name,
+                             self._path)
+            return False
+        if not 200 <= status < 300:
+            logger.error("%s bulk POST %s rejected (%d)",
+                         self.name, self._path, status)
+            return False
+        return True

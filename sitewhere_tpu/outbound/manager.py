@@ -128,8 +128,8 @@ class _Worker:
                 try:
                     self.connector.process_batch(cols, mask)
                 except Exception:
-                    with self.connector._lock:
-                        self.connector.errors += 1
+                    # isolation only: process_batch already counted the
+                    # error and informed the connector's breaker
                     logger.exception("connector %s failed on batch",
                                      self.connector.connector_id)
             finally:

@@ -25,11 +25,20 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from sitewhere_tpu.rpc import wire
+from sitewhere_tpu.runtime import faults
+from sitewhere_tpu.runtime.metrics import global_registry
+from sitewhere_tpu.runtime.resilience import Backoff, RetryPolicy
 
 logger = logging.getLogger("sitewhere_tpu.rpc")
 
 BACKOFF_INITIAL_S = 0.1
 BACKOFF_MAX_S = 60.0   # ApiDemux.java:47-52
+
+# The reconnect schedule every channel follows (100ms → 60s, ApiDemux
+# semantics).  No jitter: replica reconnects are per-endpoint, not a
+# thundering herd, and deterministic schedules keep the tests exact.
+RECONNECT_POLICY = RetryPolicy(
+    initial_s=BACKOFF_INITIAL_S, max_s=BACKOFF_MAX_S, factor=2.0)
 
 
 class RpcError(Exception):
@@ -81,9 +90,9 @@ class RpcChannel:
         self._pending_lock = threading.Lock()
         self._next_id = itertools.count(1)
         self._closed = False
-        # reconnect backoff state (exponential, 100ms → 60s)
-        self._backoff_s = BACKOFF_INITIAL_S
-        self._retry_at = 0.0
+        # reconnect backoff (exponential, 100ms → 60s) — the shared
+        # resilience primitive; retries tick resilience.retries.rpc.connect
+        self._backoff = Backoff(RECONNECT_POLICY, name="rpc.connect")
 
     # -- connection management ---------------------------------------------
 
@@ -92,27 +101,26 @@ class RpcChannel:
         return self._sock is not None
 
     def in_backoff(self) -> bool:
-        return not self.connected and time.monotonic() < self._retry_at
+        return not self.connected and not self._backoff.due()
 
     def _connect_locked(self) -> None:
         if self._sock is not None or self._closed:
             return
-        now = time.monotonic()
-        if now < self._retry_at:
+        if not self._backoff.due():
             raise ChannelUnavailable(
-                f"{self.endpoint} in backoff for {self._retry_at - now:.1f}s")
+                f"{self.endpoint} in backoff for "
+                f"{self._backoff.remaining():.1f}s")
         try:
+            faults.fire("rpc.connect")
             sock = socket.create_connection(
                 self._addr, timeout=self._connect_timeout_s)
         except OSError as e:
-            self._retry_at = now + self._backoff_s
-            self._backoff_s = min(self._backoff_s * 2, BACKOFF_MAX_S)
+            self._backoff.defer()
             raise ChannelUnavailable(f"{self.endpoint}: {e}") from e
         sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
-        self._backoff_s = BACKOFF_INITIAL_S
-        self._retry_at = 0.0
+        self._backoff.reset()
         self._reader = threading.Thread(
             target=self._read_loop, args=(sock,),
             name=f"rpc-reader-{self.endpoint}", daemon=True)
@@ -303,13 +311,15 @@ class RpcDemux:
                 return chan.call(method, body, attachment, headers, timeout_s)
             except ChannelUnavailable as e:
                 last = e
+                global_registry().counter(
+                    "resilience.retries.rpc.failover").inc()
         raise last if last is not None else ChannelUnavailable("no replicas")
 
     def wait_for_channel(self, timeout_s: float = 60.0) -> RpcChannel:
         """Block until any replica is connectable
         (``ApiDemux.waitForApiChannel`` — backoff handled per-channel)."""
         deadline = time.monotonic() + timeout_s
-        sleep = BACKOFF_INITIAL_S
+        attempt = 0
         while True:
             for chan in self._rotation():
                 try:
@@ -320,8 +330,9 @@ class RpcDemux:
             if time.monotonic() >= deadline:
                 raise ChannelUnavailable(
                     f"no replica reachable within {timeout_s}s")
+            sleep = RECONNECT_POLICY.delay(attempt)
+            attempt += 1
             time.sleep(min(sleep, max(0.0, deadline - time.monotonic())))
-            sleep = min(sleep * 2, BACKOFF_MAX_S)
 
     def close(self) -> None:
         with self._lock:

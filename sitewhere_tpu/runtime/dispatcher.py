@@ -45,7 +45,9 @@ from sitewhere_tpu.ingest.batcher import Batcher, BatchPlan
 from sitewhere_tpu.ingest.decoders import DecodedRequest
 from sitewhere_tpu.ingest.journal import Journal, JournalReader
 from sitewhere_tpu.pipeline.step import pipeline_step
+from sitewhere_tpu.runtime import faults
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.resilience import dead_letter
 from sitewhere_tpu.schema import EventBatch, EventType, as_numpy
 
 logger = logging.getLogger("sitewhere_tpu.dispatcher")
@@ -328,7 +330,7 @@ class PipelineDispatcher(LifecycleComponent):
                 self.on_host_request(req, payload)
             elif self.dead_letters is not None:
                 # they must never silently mint devices via registration
-                self.dead_letters.append_json({
+                dead_letter(self.dead_letters, {
                     "kind": "unsupported-wire-line",
                     "request_kind": req.kind.name,
                     "device_token": req.device_token,
@@ -367,10 +369,9 @@ class PipelineDispatcher(LifecycleComponent):
 
     def ingest_failed_decode(self, payload: bytes, source_id: str, error) -> None:
         if self.dead_letters is not None:
-            self.dead_letters.append_json(
-                {"kind": "failed-decode", "source": source_id,
-                 "error": str(error), "payload": payload.hex()}
-            )
+            dead_letter(self.dead_letters,
+                        {"kind": "failed-decode", "source": source_id,
+                         "error": str(error), "payload": payload.hex()})
 
     # -- the loop -----------------------------------------------------------
 
@@ -549,14 +550,22 @@ class PipelineDispatcher(LifecycleComponent):
             _native_decode_resolved,
             space_of,
         )
+        from sitewhere_tpu.ingest.decoders import DecodeError
 
         space = space_of(self.batcher.resolve_device)
         if space is None:
             return None
-        # the scanner BAILS (None) on anything malformed or non-
-        # measurement rather than raising, so every error case lands on
-        # the scalar path, which owns dead-lettering
-        out = _native_decode_resolved(payload, space)
+        # The scanner BAILS (None) on anything malformed or non-
+        # measurement rather than raising — but its timestamp hardening
+        # (_split_epoch) RAISES DecodeError for finite out-of-int32
+        # eventDates, and a journal written by pre-hardening code may
+        # hold exactly such a record.  Replay must never abort instance
+        # boot over one bad record: fall through to the scalar decoder,
+        # whose DecodeError handler owns dead-lettering.
+        try:
+            out = _native_decode_resolved(payload, space)
+        except DecodeError:
+            return None
         if out is None:
             return None
         columns, _host = out
@@ -604,6 +613,9 @@ class PipelineDispatcher(LifecycleComponent):
         return t
 
     def _run_plan(self, plan: BatchPlan, replay_depth: int = 0) -> None:
+        # chaos hook: a step-dispatch failure (device OOM, donation bug)
+        # — the plan stays outstanding, so the commit gate fails closed
+        faults.fire("dispatcher.step")
         trace = self.tracer.trace("pipeline.plan")
         # the batcher wait of the oldest row = the "batch assemble" stage
         trace.record("batch.assemble", plan.max_wait_s,
@@ -702,6 +714,11 @@ class PipelineDispatcher(LifecycleComponent):
         """
         from sitewhere_tpu.runtime.tracing import _NOOP_TRACE
 
+        # chaos hook: an egress failure mid-window — the plan has already
+        # stepped but never completes, so _plans_outstanding stays
+        # elevated and the journal offset is NEVER committed past it
+        # (at-least-once: a restart replays the record)
+        faults.fire("dispatcher.egress")
         if trace is None:
             trace = _NOOP_TRACE
         host_cols = plan.host_cols
@@ -804,10 +821,9 @@ class PipelineDispatcher(LifecycleComponent):
             unreplayable = [int(r) for r in refs]
         # every unreplayable row dead-letters, even when siblings replay
         if unreplayable and self.dead_letters is not None:
-            self.dead_letters.append_json(
-                {"kind": "unregistered", "count": len(unreplayable),
-                 "refs": unreplayable}
-            )
+            dead_letter(self.dead_letters,
+                        {"kind": "unregistered", "count": len(unreplayable),
+                         "refs": unreplayable})
         if self.registration is None or not requests:
             return
         # A multi-event payload shares one journal ref across rows, so the

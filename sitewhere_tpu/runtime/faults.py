@@ -1,0 +1,174 @@
+"""Deterministic fault injection: named points, seedable, off by default.
+
+Stream-processing evaluations (HarmonicIO/Kafka, arXiv:1807.07724; DSP
+enrichment, arXiv:2307.14287) show tail behavior under component failure
+is what separates benchmark systems from deployable ones — but failure
+paths are untestable unless failures can be produced ON DEMAND and
+DETERMINISTICALLY.  This registry provides that: production code calls
+:func:`fire` at named injection points (``"ingest.decode"``,
+``"dispatcher.egress"``, ``"event_store.flush"``, ``"rpc.connect"``,
+``"outbound.deliver"``, ``"commands.deliver"``, …) and tests arm those
+points with :func:`inject`.
+
+Zero-cost when disabled: with no faults armed, :func:`fire` is a single
+function call guarded by one module-global check — no locks, no dict
+lookups, nothing allocated.  The hot paths that call it do so at payload
+/ plan / flush granularity, never per event row.
+
+Determinism: ``after_n`` skips the first N hits of a point, ``times``
+bounds how many calls raise (``None`` = every call once triggered), and
+``probability`` draws from a PRIVATE ``random.Random(seed)`` so a chaos
+run replays bit-identically from its seed.
+
+Typical test usage::
+
+    from sitewhere_tpu.runtime import faults
+
+    with faults.injected("ingest.decode", after_n=3,
+                         exc=DecodeError("injected")):
+        ...  # 4th decode raises; earlier/later ones pass
+
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+from typing import Dict, Iterator, Optional, Union
+
+__all__ = [
+    "FaultInjected",
+    "inject",
+    "clear",
+    "fire",
+    "active",
+    "hits",
+    "fired",
+    "injected",
+]
+
+
+class FaultInjected(Exception):
+    """Default exception raised at an armed injection point."""
+
+
+ExcSpec = Union[BaseException, type]
+
+
+class _Fault:
+    __slots__ = ("point", "exc", "after_n", "times", "probability",
+                 "rng", "hits", "fired")
+
+    def __init__(self, point: str, exc: ExcSpec, after_n: int,
+                 times: Optional[int], probability: float,
+                 seed: Optional[int]):
+        self.point = point
+        self.exc = exc
+        self.after_n = int(after_n)
+        self.times = times if times is None else int(times)
+        self.probability = float(probability)
+        self.rng = random.Random(seed if seed is not None else 0)
+        self.hits = 0      # every fire() that reached this point
+        self.fired = 0     # fire() calls that actually raised
+
+    def _make_exc(self) -> BaseException:
+        if isinstance(self.exc, type):
+            return self.exc(f"injected fault at {self.point!r}")
+        return self.exc
+
+    def check(self) -> Optional[BaseException]:
+        """Count one hit; return the exception to raise, or None."""
+        self.hits += 1
+        if self.hits <= self.after_n:
+            return None
+        if self.times is not None and self.fired >= self.times:
+            return None
+        if self.probability < 1.0 and self.rng.random() >= self.probability:
+            return None
+        self.fired += 1
+        return self._make_exc()
+
+
+# Module-global fast gate: fire() checks this one name and returns.  It is
+# only ever flipped under _lock, and a stale read merely delays a fault by
+# one call — acceptable for chaos tooling, free for production.
+_armed = False
+_faults: Dict[str, _Fault] = {}
+_lock = threading.Lock()
+
+
+def inject(point: str, exc: ExcSpec = FaultInjected, *, after_n: int = 0,
+           times: Optional[int] = 1, probability: float = 1.0,
+           seed: Optional[int] = None) -> None:
+    """Arm ``point``: the next ``fire(point)`` calls raise ``exc``.
+
+    - ``after_n``: skip the first N hits (fail the N+1-th call).
+    - ``times``: how many calls raise once triggered (``None`` = forever —
+      a permanently dead component).
+    - ``probability``: chance each eligible call raises, drawn from a
+      private ``random.Random(seed)`` — fully reproducible.
+    - ``exc``: exception instance or class to raise.
+    """
+    global _armed
+    with _lock:
+        _faults[point] = _Fault(point, exc, after_n, times, probability, seed)
+        _armed = True
+
+
+def clear(point: Optional[str] = None) -> None:
+    """Disarm one point, or every point when ``point`` is None."""
+    global _armed
+    with _lock:
+        if point is None:
+            _faults.clear()
+        else:
+            _faults.pop(point, None)
+        _armed = bool(_faults)
+
+
+def active() -> bool:
+    return _armed
+
+
+def hits(point: str) -> int:
+    """How many times ``fire(point)`` was reached (armed points only)."""
+    with _lock:
+        f = _faults.get(point)
+        return f.hits if f is not None else 0
+
+
+def fired(point: str) -> int:
+    """How many times ``fire(point)`` actually raised."""
+    with _lock:
+        f = _faults.get(point)
+        return f.fired if f is not None else 0
+
+
+def fire(point: str) -> None:
+    """Injection-point hook: raises when ``point`` is armed and due.
+
+    The disabled path is one global check — call it freely from
+    payload/plan-granularity code.
+    """
+    if not _armed:
+        return
+    with _lock:
+        f = _faults.get(point)
+        exc = f.check() if f is not None else None
+    if exc is not None:
+        raise exc
+
+
+@contextlib.contextmanager
+def injected(point: str, exc: ExcSpec = FaultInjected, *,
+             after_n: int = 0, times: Optional[int] = 1,
+             probability: float = 1.0,
+             seed: Optional[int] = None) -> Iterator[None]:
+    """Scoped :func:`inject` — disarms the point on exit, always."""
+    inject(point, exc, after_n=after_n, times=times,
+           probability=probability, seed=seed)
+    try:
+        yield
+    finally:
+        clear(point)

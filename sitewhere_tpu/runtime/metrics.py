@@ -121,3 +121,14 @@ class MetricsRegistry:
                     for k, t in self._timers.items()
                 },
             }
+
+
+# Process-wide registry for cross-cutting counters (resilience: retries,
+# breaker transitions, supervisor restarts, dead-letter totals).  Components
+# with their own registries keep them; this one aggregates what must be
+# observable without plumbing a registry through every constructor.
+_GLOBAL = MetricsRegistry()
+
+
+def global_registry() -> MetricsRegistry:
+    return _GLOBAL

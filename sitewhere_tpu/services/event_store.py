@@ -46,7 +46,10 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from sitewhere_tpu.ids import NULL_ID
+from sitewhere_tpu.runtime import faults
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.metrics import global_registry
+from sitewhere_tpu.runtime.resilience import dead_letter
 
 from sitewhere_tpu.schema import EventType
 from sitewhere_tpu.services.common import (
@@ -383,6 +386,9 @@ class EventStore(LifecycleComponent):
         flush_interval_s: float = 0.25,
         retention_s: Optional[int] = None,
         resident_bytes: int = 256 << 20,
+        dead_letters=None,
+        max_seal_retries: int = 8,
+        seal_retry_window_s: float = 30.0,
         name: str = "event-store",
     ):
         super().__init__(name)
@@ -426,6 +432,20 @@ class EventStore(LifecycleComponent):
         # Chunks published to _chunks whose npz write failed — columns
         # still attached; retried by the next flush.  Guarded by _lock.
         self._unwritten: List[tuple] = []
+        # Seal failures retry (bounded): once a chunk has failed more
+        # than max_seal_retries times AND its first failure is at least
+        # seal_retry_window_s old, it dead-letters instead of pinning
+        # its columns in memory and blocking the commit gate's sync
+        # flush forever — the dead-letter record is the durable trace of
+        # those rows (see flush()).  The wall-clock window matters: the
+        # flusher ticks every flush_interval_s (plus commit-gate sync
+        # flushes), so an attempt count alone would burn the whole
+        # budget inside ~2 s and drop data over a transient disk blip.
+        self.dead_letters = dead_letters
+        self.max_seal_retries = int(max_seal_retries)
+        self.seal_retry_window_s = float(seal_retry_window_s)
+        self._seal_attempts: Dict[int, Tuple[int, float]] = {}
+        self.sealed_dead_lettered = 0
         self._load_existing()
 
     # -- lifecycle ----------------------------------------------------------
@@ -823,13 +843,40 @@ class EventStore(LifecycleComponent):
             failed = []
             for chunk, part, path in work:
                 try:
+                    faults.fire("event_store.flush")
                     self._write_chunk_file(path, part, chunk, sync=False)
-                except OSError:
+                except OSError as e:
+                    now = time.monotonic()
+                    with self._lock:
+                        attempts, first_t = self._seal_attempts.get(
+                            id(chunk), (0, now))
+                        attempts += 1
+                        self._seal_attempts[id(chunk)] = (attempts, first_t)
+                    global_registry().counter(
+                        "resilience.retries.event_store.seal").inc()
+                    if (attempts > self.max_seal_retries
+                            and now - first_t >= self.seal_retry_window_s):
+                        # Terminal: dead-letter the chunk's rows instead
+                        # of retrying forever — bounded memory, and the
+                        # commit gate's sync flush can succeed again (the
+                        # dead-letter record is the durable trace).
+                        logger.error(
+                            "chunk %d seal failed %d times; dead-lettering"
+                            " %d rows: %s", chunk.seq, attempts, chunk.n, e)
+                        if self._dead_letter_chunk(chunk, part, path, e):
+                            continue
+                        # the durable trace could not be written (often
+                        # the same dead disk): dropping the chunk now
+                        # would be SILENT loss — keep it resident and
+                        # keep the sync flush failing instead
+                        failed.append((chunk, part, path))
+                        continue
                     logger.exception("chunk %d seal failed; will retry",
                                      chunk.seq)
                     failed.append((chunk, part, path))
                     continue
                 with self._lock:
+                    self._seal_attempts.pop(id(chunk), None)
                     if any(c is chunk for c in self._chunks):
                         # release the resident columns: reads reload (and
                         # LRU-cache) from the file from here on
@@ -856,6 +903,31 @@ class EventStore(LifecycleComponent):
                 raise OSError(
                     f"{len(failed)} chunk(s) not durably sealed")
             return flushed
+
+    def _dead_letter_chunk(self, chunk, part, path, exc) -> bool:
+        """Terminal seal failure: record the chunk's rows to the
+        dead-letter sink, then drop it from the store.  The ingest journal
+        may reclaim the raw records once commits resume — the dead-letter
+        record IS the durable trace of these rows from here on, so the
+        chunk is only dropped once that record landed (a configured sink
+        that also fails returns False and the caller keeps retrying the
+        seal — bounded memory loses to silent loss)."""
+        recorded = dead_letter(self.dead_letters, {
+            "kind": "event-flush-failed",
+            "seq": int(chunk.seq),
+            "rows": int(chunk.n),
+            "ts_min": int(part["ts_s"].min()) if len(part["ts_s"]) else 0,
+            "ts_max": int(part["ts_s"].max()) if len(part["ts_s"]) else 0,
+            "error": str(exc),
+        })
+        if self.dead_letters is not None and not recorded:
+            return False
+        with self._lock:
+            self._seal_attempts.pop(id(chunk), None)
+            self._chunks = [c for c in self._chunks if c is not chunk]
+            self._unsynced_paths.discard(path)
+            self.sealed_dead_lettered += int(chunk.n)
+        return True
 
     # -- reads --------------------------------------------------------------
 

@@ -42,3 +42,7 @@ def mesh8(devices):
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running soak / multi-process integration tests")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (runtime.faults) — "
+        "tier-1, NOT slow: failure paths must be proven on every run")

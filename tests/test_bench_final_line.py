@@ -122,21 +122,58 @@ def test_wall_is_below_driver_tail(budget):
     assert budget <= 1500
 
 
+# an allowlisted higher-is-better metric (keep-best applies)
+_HB = "pipeline_events_per_sec_per_chip"
+
+
 def test_store_cache_keeps_best_tpu_capture(tmp_path, monkeypatch):
     """A slow tunnel window must not degrade the recorded evidence: the
-    cache keeps the best supervised TPU doc per metric and records the
-    fresh (worse) run verbatim under "latest"."""
+    cache keeps the best supervised TPU doc per allowlisted metric and
+    records the fresh (worse) run verbatim under "latest"."""
     monkeypatch.setattr(bench, "CACHE_PATH", str(tmp_path / "cache.json"))
-    bench._store_cache("m", {"value": 177011.7, "backend": "tpu"}, [])
-    bench._store_cache("m", {"value": 104104.6, "backend": "tpu"}, [])
+    bench._store_cache(_HB, {"value": 177011.7, "backend": "tpu"}, [])
+    bench._store_cache(_HB, {"value": 104104.6, "backend": "tpu"}, [])
     c = json.load(open(bench.CACHE_PATH))
-    assert c["m"]["doc"]["value"] == 177011.7
-    assert c["m"]["latest"]["doc"]["value"] == 104104.6
+    assert c[_HB]["doc"]["value"] == 177011.7
+    assert c[_HB]["latest"]["doc"]["value"] == 104104.6
     # a better capture replaces the doc outright (and drops "latest")
-    bench._store_cache("m", {"value": 250000.0, "backend": "tpu"}, [])
+    bench._store_cache(_HB, {"value": 250000.0, "backend": "tpu"}, [])
     c = json.load(open(bench.CACHE_PATH))
-    assert c["m"]["doc"]["value"] == 250000.0
-    assert "latest" not in c["m"]
+    assert c[_HB]["doc"]["value"] == 250000.0
+    assert "latest" not in c[_HB]
+
+
+def test_keep_best_gated_to_allowlisted_metrics(tmp_path, monkeypatch):
+    """A metric NOT on the higher-is-better allowlist never keep-bests:
+    the fresh capture always becomes the doc (keeping the max of a
+    latency-style metric would pin an optimistic number forever)."""
+    monkeypatch.setattr(bench, "CACHE_PATH", str(tmp_path / "cache.json"))
+    assert "latency_ms" not in bench._KEEP_BEST_METRICS
+    bench._store_cache("latency_ms", {"value": 9.6, "backend": "tpu"}, [])
+    bench._store_cache("latency_ms", {"value": 11.3, "backend": "tpu"}, [])
+    c = json.load(open(bench.CACHE_PATH))
+    assert c["latency_ms"]["doc"]["value"] == 11.3
+    assert "latest" not in c["latency_ms"]
+
+
+def test_keep_best_emits_regression_marker(tmp_path, monkeypatch, capsys):
+    """A fresh value materially below the retained doc is a suspected
+    code regression, not tunnel noise — keep-best must say so loudly."""
+    monkeypatch.setattr(bench, "CACHE_PATH", str(tmp_path / "cache.json"))
+    bench._store_cache(_HB, {"value": 200000.0, "backend": "tpu"}, [])
+    # well inside noise (~1.7x observed): retained silently
+    bench._store_cache(_HB, {"value": 150000.0, "backend": "tpu"}, [])
+    assert "REGRESSION_SUSPECTED" not in capsys.readouterr().err
+    # materially below (< _REGRESSION_RATIO of retained): loud marker
+    bench._store_cache(_HB, {"value": 50000.0, "backend": "tpu"}, [])
+    err = capsys.readouterr().err
+    assert "REGRESSION_SUSPECTED" in err
+    marker = next(json.loads(line) for line in err.splitlines()
+                  if "REGRESSION_SUSPECTED" in line)
+    assert marker["retained_value"] == 200000.0
+    assert marker["latest_value"] == 50000.0
+    # ...and the cached doc carries the flag for the final line
+    assert bench._cached_doc(_HB)["regression_suspected"] is True
 
 
 def test_cached_doc_surfaces_latest_when_keep_best_retained(tmp_path,
@@ -145,13 +182,17 @@ def test_cached_doc_surfaces_latest_when_keep_best_retained(tmp_path,
     must carry latest_value/latest_git_sha so a cross-SHA regression
     stays visible to the reader."""
     monkeypatch.setattr(bench, "CACHE_PATH", str(tmp_path / "cache.json"))
-    bench._store_cache("m", {"value": 177011.7, "backend": "tpu"}, [])
-    bench._store_cache("m", {"value": 104104.6, "backend": "tpu"}, [])
-    doc = bench._cached_doc("m")
+    bench._store_cache(_HB, {"value": 177011.7, "backend": "tpu"}, [])
+    bench._store_cache(_HB, {"value": 104104.6, "backend": "tpu"}, [])
+    doc = bench._cached_doc(_HB)
     assert doc["value"] == 177011.7
     assert doc["backend"] == "tpu-cached"
     assert doc["latest_value"] == 104104.6
     assert "latest_captured_at" in doc
+    # inside the noise band: surfaced but not flagged
+    assert "regression_suspected" not in doc
     # no retained-best -> no latest_* noise
-    bench._store_cache("m2", {"value": 5.0, "backend": "tpu"}, [])
-    assert "latest_value" not in bench._cached_doc("m2")
+    bench._store_cache("media_label_ops_per_sec",
+                       {"value": 5.0, "backend": "tpu"}, [])
+    assert "latest_value" not in bench._cached_doc(
+        "media_label_ops_per_sec")

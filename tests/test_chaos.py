@@ -1,0 +1,540 @@
+"""Deterministic chaos: failure paths driven through runtime.faults.
+
+Every test arms a named injection point (``runtime/faults.py``) and
+proves the pipeline's contract under that failure:
+
+- a killed receiver restarts under its supervisor with exponential
+  backoff, and a QoS-1 publish whose intake crashed is NOT acked — the
+  device redelivers and zero events are lost;
+- an open circuit breaker SHEDS outbound batches (counted + summarized
+  to dead letters) instead of queueing behind a dead sink;
+- event-store seal failures retry a bounded number of times, then
+  dead-letter the chunk without stalling the flush path;
+- a step/egress fault leaves the journal offset uncommitted (the commit
+  gate fails closed) so a restart replays the rows — at-least-once;
+- a journaled pre-hardening record with an out-of-int32 ``eventDate``
+  dead-letters during replay instead of aborting instance boot.
+
+All faults are seeded/counted — each run is bit-identical.
+"""
+
+import json
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.runtime import faults
+from sitewhere_tpu.runtime.resilience import (
+    CircuitBreaker,
+    CollectingSink,
+    RetryPolicy,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# the injection registry itself
+# ---------------------------------------------------------------------------
+
+class TestFaultRegistry:
+    def test_unarmed_fire_is_noop(self):
+        assert not faults.active()
+        faults.fire("nowhere")  # must not raise, must not allocate state
+        assert faults.hits("nowhere") == 0
+
+    def test_after_n_skips_then_raises(self):
+        faults.inject("p", after_n=2, times=1)
+        faults.fire("p")
+        faults.fire("p")
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("p")
+        faults.fire("p")  # times=1 spent
+        assert faults.hits("p") == 4
+        assert faults.fired("p") == 1
+
+    def test_times_none_is_permanent(self):
+        faults.inject("p", times=None)
+        for _ in range(5):
+            with pytest.raises(faults.FaultInjected):
+                faults.fire("p")
+        assert faults.fired("p") == 5
+
+    def test_custom_exception_instance_and_class(self):
+        faults.inject("p", exc=OSError("disk gone"), times=None)
+        with pytest.raises(OSError, match="disk gone"):
+            faults.fire("p")
+        faults.inject("q", exc=ValueError, times=None)
+        with pytest.raises(ValueError, match="injected fault at 'q'"):
+            faults.fire("q")
+
+    def test_probability_is_seed_deterministic(self):
+        def run(seed):
+            faults.inject("p", probability=0.5, times=None, seed=seed)
+            out = []
+            for _ in range(32):
+                try:
+                    faults.fire("p")
+                    out.append(0)
+                except faults.FaultInjected:
+                    out.append(1)
+            faults.clear("p")
+            return out
+
+        a, b = run(1234), run(1234)
+        assert a == b               # same seed → identical schedule
+        assert 0 < sum(a) < 32      # actually probabilistic
+        assert run(99) != a         # different seed → different draw
+
+    def test_injected_context_disarms_even_on_error(self):
+        with pytest.raises(RuntimeError):
+            with faults.injected("p"):
+                raise RuntimeError("test body blew up")
+        assert not faults.active()
+
+
+# ---------------------------------------------------------------------------
+# killed receiver → supervised restart with backoff
+# ---------------------------------------------------------------------------
+
+class TestReceiverRecovery:
+    def test_udp_receiver_restarts_with_backoff(self):
+        from sitewhere_tpu.ingest.sources import UdpReceiver
+
+        rx = UdpReceiver(port=0)
+        rx.restart_policy = RetryPolicy(initial_s=0.01, max_s=0.1)
+        got = []
+        rx.sink = got.append
+        rx.start()
+        try:
+            addr = ("127.0.0.1", rx.port)
+            tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            # the first datagram's emit crashes the receive loop
+            faults.inject("ingest.emit", times=1)
+            tx.sendto(b"poison", addr)
+            assert _wait(lambda: rx.supervisor.restarts == 1)
+            assert rx.supervisor.restart_delays == pytest.approx([0.01])
+            # restarted loop (same bound socket) keeps receiving
+            assert _wait(lambda: rx.supervisor.alive)
+            assert not rx.supervisor.escalated
+
+            def feed():
+                # UDP is lossy by design: nudge until the restarted loop
+                # picks one up (distinct from the supervised-crash path)
+                tx.sendto(b"after-restart", addr)
+                return got
+
+            assert _wait(feed)
+            assert got[-1] == b"after-restart"
+            tx.close()
+        finally:
+            rx.stop()
+
+    def test_mqtt_qos1_intake_crash_loses_no_events(self):
+        """The acceptance proof: a crashed intake withholds the PUBACK,
+        the device redelivers, and the event lands exactly as published —
+        zero QoS-1 loss across the receiver failure."""
+        from sitewhere_tpu.ingest.mqtt import MqttClient
+        from sitewhere_tpu.ingest.mqtt_broker import MqttBrokerReceiver
+
+        rx = MqttBrokerReceiver(topic_filter="sitewhere/input/#")
+        got = []
+        rx.sink = got.append
+        rx.start()
+        try:
+            dev = MqttClient("127.0.0.1", rx.port, client_id="dev-chaos")
+            dev.connect()
+            # intake crashes on the first emit: broker must NOT ack
+            faults.inject("ingest.emit", times=1)
+            dev.publish("sitewhere/input/dev-chaos", b"ev-1", qos=1)
+            assert not dev.drain_publishes(timeout=5.0)  # no PUBACK came
+            assert _wait(lambda: rx.broker.tap_failures == 1)
+            assert got == []  # the crashed attempt delivered nothing
+            dev.disconnect()
+
+            # device-side at-least-once: reconnect and redeliver
+            dev2 = MqttClient("127.0.0.1", rx.port, client_id="dev-chaos")
+            dev2.connect()
+            dev2.publish("sitewhere/input/dev-chaos", b"ev-1", qos=1)
+            assert dev2.drain_publishes(timeout=10.0)  # PUBACKed now
+            assert got == [b"ev-1"]                    # zero loss
+            dev2.disconnect()
+        finally:
+            rx.stop()
+
+
+# ---------------------------------------------------------------------------
+# open breaker sheds outbound load
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _cols(n=4):
+    # minimal outbound columns: no filters attached, so only the fields
+    # marshal_row would touch matter — and CallbackConnector skips it
+    return {"device_id": np.arange(n, dtype=np.int32)}
+
+
+class TestBreakerSheds:
+    def test_connector_sheds_when_open_and_recovers(self):
+        from sitewhere_tpu.outbound.connectors import CallbackConnector
+
+        clock = FakeClock()
+        sink = CollectingSink()
+        breaker = CircuitBreaker(name="chaos-conn", min_calls=2,
+                                 failure_threshold=1.0, open_for_s=5.0,
+                                 clock=clock)
+        delivered = []
+        conn = CallbackConnector(
+            "chaos-conn", lambda c, m: delivered.append(int(m.sum())),
+            breaker=breaker, dead_letters=sink)
+        mask = np.ones(4, np.bool_)
+
+        faults.inject("outbound.deliver", exc=OSError, times=2)
+        for _ in range(2):
+            with pytest.raises(OSError):
+                conn.process_batch(_cols(), mask)
+        assert breaker.state == CircuitBreaker.OPEN
+
+        # open: batches are SHED (no queueing, no deliver call) and the
+        # shed volume is summarized to the dead-letter sink
+        assert conn.process_batch(_cols(), mask) == 0
+        assert conn.process_batch(_cols(), mask) == 0
+        assert delivered == []
+        assert conn.shed == 8
+        kinds = [r["kind"] for r in sink.records]
+        assert kinds == ["connector-shed", "connector-shed"]
+        assert sum(r["rows"] for r in sink.records) == 8
+
+        # sink recovers: the half-open probe re-admits traffic
+        clock.t = 5.0
+        assert conn.process_batch(_cols(), mask) == 4
+        assert breaker.state == CircuitBreaker.CLOSED
+        assert delivered == [4]
+        assert conn.processed == 4
+
+    def test_http_rejections_trip_the_breaker(self):
+        """A webhook that answers with errors is a FAILING sink: the
+        connector raises DeliveryFailed (counted) and the breaker trips
+        and sheds — it must never record a rejected POST as success."""
+        import http.server
+        import threading
+
+        from sitewhere_tpu.outbound.connectors import (
+            DeliveryFailed,
+            HttpConnector,
+        )
+
+        from test_outbound import make_cols
+
+        class Reject(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                self.send_response(503)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Reject)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            clock = FakeClock()
+            breaker = CircuitBreaker(name="webhook", min_calls=2,
+                                     failure_threshold=1.0, open_for_s=5.0,
+                                     clock=clock)
+            conn = HttpConnector(
+                "webhook", f"http://127.0.0.1:{srv.server_address[1]}/in",
+                breaker=breaker)
+            mask = np.ones(4, np.bool_)
+            for _ in range(2):
+                with pytest.raises(DeliveryFailed):
+                    conn.process_batch(make_cols(4), mask)
+            assert conn.errors == 2
+            assert breaker.state == CircuitBreaker.OPEN
+            # open: batches shed without touching the webhook
+            assert conn.process_batch(make_cols(4), mask) == 0
+            assert conn.shed == 4
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# event-store flush: retry then dead-letter, never stall
+# ---------------------------------------------------------------------------
+
+class TestEventStoreFlushChaos:
+    def test_seal_retries_then_dead_letters_without_stalling(self, tmp_path):
+        from sitewhere_tpu.services.event_store import EventStore
+
+        from test_event_store import make_cols
+
+        sink = CollectingSink()
+        store = EventStore(str(tmp_path), flush_rows=1000,
+                           flush_interval_s=1000, dead_letters=sink,
+                           max_seal_retries=2, seal_retry_window_s=0.0)
+        store.append_columns(make_cols(10))
+        faults.inject("event_store.flush", exc=OSError("disk full"),
+                      times=None)
+        # bounded retries: each sync flush surfaces the failure...
+        for _ in range(store.max_seal_retries):
+            with pytest.raises(OSError):
+                store.flush()
+        assert store.total_events == 10  # columns still resident
+        # ...then the chunk dead-letters and flush succeeds again — the
+        # commit gate's sync flush is unblocked (no stall, bounded memory)
+        store.flush()
+        assert store.sealed_dead_lettered == 10
+        assert store.total_events == 0
+        [rec] = sink.records
+        assert rec["kind"] == "event-flush-failed"
+        assert rec["rows"] == 10
+        assert "disk full" in rec["error"]
+
+        # the store is still live: healthy appends flush durably
+        faults.clear("event_store.flush")
+        store.append_columns(make_cols(5))
+        assert store.flush() == 5
+        assert store.total_events == 5
+
+    def test_seal_retry_budget_is_wall_clock_not_ticks(self, tmp_path):
+        """The flusher ticks every flush_interval_s: an attempt count
+        alone would burn the whole retry budget in seconds and drop data
+        over a transient disk blip.  Until seal_retry_window_s of wall
+        clock has passed, exhausted attempts keep retrying."""
+        from sitewhere_tpu.services.event_store import EventStore
+
+        from test_event_store import make_cols
+
+        sink = CollectingSink()
+        store = EventStore(str(tmp_path), flush_rows=1000,
+                           flush_interval_s=1000, dead_letters=sink,
+                           max_seal_retries=1, seal_retry_window_s=60.0)
+        store.append_columns(make_cols(5))
+        faults.inject("event_store.flush", exc=OSError("blip"), times=None)
+        for _ in range(5):  # attempts well past max_seal_retries
+            with pytest.raises(OSError):
+                store.flush()
+        assert store.sealed_dead_lettered == 0
+        assert store.total_events == 5
+        # the "blip" ends: everything seals, nothing was dropped
+        faults.clear("event_store.flush")
+        store.flush()
+        assert store.total_events == 5
+        assert len(sink.records) == 0
+
+    def test_broken_dead_letter_sink_never_drops_rows(self, tmp_path):
+        """When the dead-letter write itself fails (often the same dead
+        disk), the chunk must stay resident and the sync flush must keep
+        failing — dropping it would be silent data loss."""
+        from sitewhere_tpu.services.event_store import EventStore
+
+        from test_event_store import make_cols
+
+        class BrokenSink:
+            def append_json(self, doc):
+                raise OSError("dead-letter disk gone too")
+
+        store = EventStore(str(tmp_path), flush_rows=1000,
+                           flush_interval_s=1000, dead_letters=BrokenSink(),
+                           max_seal_retries=1, seal_retry_window_s=0.0)
+        store.append_columns(make_cols(10))
+        faults.inject("event_store.flush", exc=OSError("disk full"),
+                      times=None)
+        # well past max_seal_retries: every sync flush still refuses
+        for _ in range(4):
+            with pytest.raises(OSError):
+                store.flush()
+        assert store.total_events == 10
+        assert store.sealed_dead_lettered == 0
+        # the dead-letter sink recovers first: next flush dead-letters
+        # the chunk and unwedges the store
+        store.dead_letters = CollectingSink()
+        store.flush()
+        assert store.sealed_dead_lettered == 10
+        assert len(store.dead_letters.records) == 1
+
+
+# ---------------------------------------------------------------------------
+# dispatcher: fail closed, replay on restart (at-least-once)
+# ---------------------------------------------------------------------------
+
+def _instance_config(tmp_path):
+    from sitewhere_tpu.runtime.config import Config
+
+    return Config({
+        "instance": {"id": "chaos-inst", "data_dir": str(tmp_path / "data")},
+        "pipeline": {"width": 64, "registry_capacity": 128,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+    }, apply_env=False)
+
+
+def _seed_device(inst, token="d-0"):
+    inst.device_management.create_device_type(token="sensor", name="Sensor")
+    inst.device_management.create_device(token=token, device_type="sensor")
+    inst.device_management.create_device_assignment(device=token)
+
+
+def _measurement_line(token, value, event_date):
+    return json.dumps({
+        "deviceToken": token, "type": "Measurement",
+        "request": {"name": "temp", "value": value,
+                    "eventDate": event_date},
+    })
+
+
+class TestDispatcherChaos:
+    def test_step_fault_fails_closed_then_replays(self, tmp_path):
+        from sitewhere_tpu.instance import Instance
+
+        inst = Instance(_instance_config(tmp_path))
+        inst.start()
+        try:
+            _seed_device(inst)
+            payload = _measurement_line("d-0", 7.0, 1_753_800_000).encode()
+            faults.inject("dispatcher.step", times=1)
+            try:
+                inst.dispatcher.ingest_wire_lines(payload)
+            except faults.FaultInjected:
+                pass  # the ingest thread itself took the plan
+            # either the ingest path or the deadline-tick loop thread
+            # takes the plan; whichever runs it dies at the step fault
+            assert _wait(lambda: faults.fired("dispatcher.step") == 1)
+            # journaled, but the dead plan keeps the commit gate closed:
+            # the offset must never move past an unprocessed record
+            assert inst.ingest_journal.end_offset == 1
+            inst.dispatcher.flush(timeout_s=0.05)
+            assert inst.dispatcher.journal_reader.committed == 0
+            assert inst.event_store.total_events == 0
+
+            # "restart": a crash loses the in-memory outstanding-plan
+            # count with the process; replay re-ingests from the
+            # committed offset and the row lands exactly once
+            with inst.dispatcher._lock:
+                inst.dispatcher._plans_outstanding = 0
+            replayed = inst.dispatcher.replay_journal()
+            assert replayed == 1
+            inst.event_store.flush()
+            assert inst.event_store.total_events == 1
+            assert inst.dispatcher.journal_reader.committed == 1
+        finally:
+            inst.stop()
+            inst.terminate()
+
+
+# ---------------------------------------------------------------------------
+# journal replay of a corrupt pre-hardening record (ADVICE high finding)
+# ---------------------------------------------------------------------------
+
+class TestCorruptJournalReplay:
+    def test_out_of_int32_event_date_dead_letters_and_boot_completes(
+            self, tmp_path):
+        """Regression: `_replay_columnar` used to let the native lane's
+        DecodeError (finite out-of-int32 eventDate — a record a
+        pre-hardening build journaled happily) abort replay, and with it
+        instance boot.  It must fall through to the scalar decoder's
+        dead-letter path instead."""
+        from sitewhere_tpu.instance import Instance
+
+        inst = Instance(_instance_config(tmp_path))
+        inst.start()
+        _seed_device(inst)
+        # 1e10 epoch-seconds: finite, below the millis heuristic, out of
+        # int32 — exactly what pre-hardening code journaled unchecked.
+        bad = _measurement_line("d-0", 1.0, 10_000_000_000).encode()
+        good = _measurement_line("d-0", 2.0, 1_753_800_000).encode()
+        inst.ingest_journal.append(bad)
+        inst.ingest_journal.append(good)
+        inst.stop()
+        inst.terminate()
+
+        inst2 = Instance(_instance_config(tmp_path))
+        inst2.start()  # this is the assertion: boot must not raise
+        try:
+            # the bad record dead-lettered; its sibling replayed fine
+            snap = inst2.dispatcher.metrics_snapshot()
+            assert snap["accepted"] == 1
+            kinds = [
+                json.loads(inst2.dead_letters.read_one(i)).get("kind")
+                for i in range(inst2.dead_letters.end_offset)
+            ]
+            assert "failed-decode" in kinds
+        finally:
+            inst2.stop()
+            inst2.terminate()
+
+
+# ---------------------------------------------------------------------------
+# command delivery retry under injected transport failure
+# ---------------------------------------------------------------------------
+
+class TestCommandDeliveryChaos:
+    def _destination(self, sink, retry):
+        from sitewhere_tpu.commands.destinations import (
+            CallbackDeliveryProvider,
+            CommandDestination,
+        )
+
+        return CommandDestination(
+            "chaos-dest", encoder=lambda ex: b"payload",
+            extractor=lambda ex: {}, retry=retry,
+            provider=CallbackDeliveryProvider(
+                lambda ex, payload, params: sink.append(payload)))
+
+    def _execution(self):
+        from sitewhere_tpu.commands.model import (
+            CommandExecution,
+            CommandInvocation,
+        )
+
+        inv = CommandInvocation(command_token="c", target_assignment="a")
+        return CommandExecution(invocation=inv, command_name="c",
+                                namespace="test")
+
+    def test_transient_failures_retried_to_success(self):
+        from sitewhere_tpu.commands.destinations import DeliveryError
+
+        sink = []
+        dest = self._destination(
+            sink, RetryPolicy(initial_s=0.0, max_attempts=3))
+        faults.inject("commands.deliver", exc=DeliveryError, times=2)
+        dest.deliver(self._execution())
+        assert sink == [b"payload"]
+        assert faults.hits("commands.deliver") == 3
+
+    def test_exhausted_retries_surface_as_delivery_error(self):
+        from sitewhere_tpu.commands.destinations import DeliveryError
+
+        sink = []
+        dest = self._destination(
+            sink, RetryPolicy(initial_s=0.0, max_attempts=2))
+        faults.inject("commands.deliver", exc=DeliveryError, times=None)
+        with pytest.raises(DeliveryError):
+            dest.deliver(self._execution())
+        assert sink == []

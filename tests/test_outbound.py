@@ -110,6 +110,15 @@ def test_mqtt_connector_multicast_routes():
     assert len([t for t in topics if t.startswith("sw/alerts/")]) == 2
 
 
+def test_filter_crash_counts_as_connector_error():
+    conn = CallbackConnector(
+        "broken-filter", lambda c, m: None,
+        filters=[CallbackFilter(lambda c: c["no-such-column"] < 1)])
+    with pytest.raises(KeyError):
+        conn.process_batch(make_cols(), np.ones(8, np.bool_))
+    assert conn.errors == 1
+
+
 def test_mqtt_publish_failure_counted_not_raised():
     class BoomClient:
         def publish(self, *a, **k):

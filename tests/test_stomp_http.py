@@ -292,7 +292,12 @@ def test_http_connector_counts_rejections():
     try:
         c = HttpConnector(
             "webhook", f"http://127.0.0.1:{srv.server_address[1]}/hook")
-        c.process_batch(_cols(2), np.array([True, True]))
+        # a rejected POST now RAISES (so an attached breaker sees the
+        # failing sink; the manager isolates it) and is counted once
+        from sitewhere_tpu.outbound.connectors import DeliveryFailed
+
+        with pytest.raises(DeliveryFailed):
+            c.process_batch(_cols(2), np.array([True, True]))
         assert c.errors == 1
     finally:
         srv.shutdown()
