@@ -18,6 +18,7 @@ from typing import Callable, Dict, List, Optional
 from sitewhere_tpu.commands.destinations import CommandDestination, DeliveryError
 from sitewhere_tpu.commands.model import CommandExecution, CommandInvocation
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent, LifecycleState
+from sitewhere_tpu.runtime.tracing import _NOOP_TRACE
 from sitewhere_tpu.services.common import EntityNotFound, ServiceError
 from sitewhere_tpu.services.device_management import DeviceManagement
 
@@ -40,6 +41,7 @@ class CommandProcessor(LifecycleComponent):
         destinations: Optional[List[CommandDestination]] = None,
         router: Optional[Callable[[CommandExecution], str]] = None,
         on_undelivered: Optional[Undelivered] = None,
+        metrics=None,
         name: str = "command-processor",
     ):
         super().__init__(name)
@@ -52,6 +54,11 @@ class CommandProcessor(LifecycleComponent):
         self._lock = threading.Lock()
         self.delivered = 0
         self.undelivered = 0
+        # registry surface (scraped via /api/instance/metrics.prom)
+        self._m_delivered = (metrics.counter("commands.delivered")
+                             if metrics is not None else None)
+        self._m_undelivered = (metrics.counter("commands.undelivered")
+                               if metrics is not None else None)
 
     def add_destination(self, destination: CommandDestination) -> None:
         replaced = self.destinations.get(destination.destination_id)
@@ -151,29 +158,51 @@ class CommandProcessor(LifecycleComponent):
             raise EntityNotFound(f"destination {dest_id}")
         return dest
 
-    def invoke(self, invocation: CommandInvocation) -> bool:
-        """Full delivery path; returns True when the device got the bytes."""
-        try:
-            self.resolve_target(invocation)
-            execution = self.build_execution(invocation)
-            self.route(execution).deliver(execution)
-        except Exception as e:
-            # EVERY failure dead-letters (reference: undelivered topic) —
-            # including coercion/encoding surprises (ValueError/TypeError),
-            # so one bad invocation can never abort a batch.
-            with self._lock:
-                self.undelivered += 1
-            logger.warning("command %s undelivered: %s", invocation.token, e)
-            if self.on_undelivered is not None:
-                self.on_undelivered(invocation, str(e))
-            return False
+    def invoke(self, invocation: CommandInvocation, trace=None) -> bool:
+        """Full delivery path; returns True when the device got the bytes.
+
+        ``trace`` (the originating pipeline plan's trace, when the
+        invocation came through the dispatcher's command egress) wraps
+        the destination delivery in a ``commands.deliver`` span so a
+        retained trace shows the command fan-out leg too."""
+        # the span covers resolve/build/route too: a routing or encoding
+        # failure must error the span just like a destination failure,
+        # or tail sampling would drop the trace of an undelivered command
+        span = (trace or _NOOP_TRACE).span("commands.deliver")
+        span.tag("command", invocation.command_token)
+        with span:
+            try:
+                self.resolve_target(invocation)
+                execution = self.build_execution(invocation)
+                dest = self.route(execution)
+                span.tag("destination", dest.destination_id)
+                dest.deliver(execution)
+            except Exception as e:
+                # EVERY failure dead-letters (reference: undelivered
+                # topic) — including coercion/encoding surprises
+                # (ValueError/TypeError), so one bad invocation can never
+                # abort a batch.  The exception is handled (not re-raised
+                # through __exit__), so flag the span by hand.
+                span.error = f"{type(e).__name__}: {e}"
+                with self._lock:
+                    self.undelivered += 1
+                if self._m_undelivered is not None:
+                    self._m_undelivered.inc()
+                logger.warning("command %s undelivered: %s",
+                               invocation.token, e)
+                if self.on_undelivered is not None:
+                    self.on_undelivered(invocation, str(e))
+                return False
         with self._lock:
             self.delivered += 1
+        if self._m_delivered is not None:
+            self._m_delivered.inc()
         return True
 
-    def invoke_many(self, invocations: List[CommandInvocation]) -> int:
+    def invoke_many(self, invocations: List[CommandInvocation],
+                    trace=None) -> int:
         """Batch path used by the dispatcher; returns delivered count."""
-        return sum(1 for inv in invocations if self.invoke(inv))
+        return sum(1 for inv in invocations if self.invoke(inv, trace=trace))
 
 
 _INT_RANGES = {"int32": (-(1 << 31), (1 << 31) - 1), "int64": (-(1 << 63), (1 << 63) - 1)}

@@ -132,6 +132,7 @@ class Batcher:
         deadline_ms: float = 5.0,
         clock: Callable[[], float] = time.monotonic,
         emit_packed: bool = False,
+        metrics=None,
     ):
         if width % n_shards != 0:
             raise ValueError(f"width={width} not divisible by n_shards={n_shards}")
@@ -158,6 +159,14 @@ class Batcher:
         self._rr = 0  # round-robin shard for unknown devices
         self.emitted_batches = 0
         self.emitted_events = 0
+        # registry fold-in (per EMIT, never per row): batch fill/wait are
+        # the assemble-stage watermark the lag attribution story needs
+        self.metrics = metrics
+        if metrics is not None:
+            self._m_batches = metrics.counter("ingest.batches_emitted")
+            self._m_rows = metrics.counter("ingest.rows_emitted")
+            self._m_fill = metrics.gauge("ingest.batch_fill")
+            self._m_wait = metrics.histogram("ingest.batch_wait_s")
 
     # -- intake: scalar paths ------------------------------------------------
 
@@ -478,6 +487,11 @@ class Batcher:
         self._oldest = min(remaining) if remaining else None
         self.emitted_batches += 1
         self.emitted_events += n
+        if self.metrics is not None:
+            self._m_batches.inc()
+            self._m_rows.inc(n)
+            self._m_fill.set(n / self.width)
+            self._m_wait.observe(wait)
         if self.emit_packed:
             from sitewhere_tpu.pipeline.packed import BATCH_I
 

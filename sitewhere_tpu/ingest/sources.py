@@ -37,14 +37,15 @@ receivers, and the receiver SPI accepts new implementations.
 
 from __future__ import annotations
 
+import errno
 import http.server
 import logging
 import socket
-import socketserver
 import struct
 import threading
+import time
 import urllib.request
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 logger = logging.getLogger("sitewhere_tpu.ingest")
 
@@ -341,43 +342,177 @@ class WebSocketReceiver(Receiver):
                 self._stop_evt.wait(self._backoff.next_delay())
 
 
+class _EmitCrash(Exception):
+    """Marker: the sink/emit path crashed inside a framing loop (as
+    opposed to a framing violation raised by the framing itself)."""
+
+
+# accept() errors that do NOT mean the listener died: ride them out in
+# place (the old ThreadingTCPServer's per-request error handling) —
+# tearing down + restarting on an fd-exhaustion storm would burn the
+# supervisor's restart budget and escalate a transient flood into
+# permanent receiver death
+_TRANSIENT_ACCEPT_ERRNOS = frozenset({
+    errno.ECONNABORTED, errno.EMFILE, errno.ENFILE,
+    errno.ENOBUFS, errno.ENOMEM,
+})
+
+
 class TcpReceiver(Receiver):
-    """Threaded TCP server with pluggable framing."""
+    """Threaded TCP server with pluggable framing.
+
+    The accept loop runs under the shared receiver supervisor (ROADMAP:
+    remaining-receiver chaos coverage): an unexpected accept failure
+    restarts the loop with backoff — re-binding the SAME port, so
+    clients just reconnect — and repeated failures escalate to the
+    terminal lifecycle ERROR state.  A sink/emit crash inside one
+    connection's framing loop closes ONLY that connection (counted in
+    ``connection_errors``): the un-acked stream is the client's cue to
+    reconnect and resend, TCP's redelivery semantics.  The accept loop
+    is never the casualty of one connection's poison payload.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  framing: Callable = length_prefixed_frames):
         super().__init__(name=f"tcp-receiver:{port}")
         self.host, self.port = host, port
         self.framing = framing
-        self._server: Optional[socketserver.ThreadingTCPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._sock: Optional[socket.socket] = None
+        self._alive = False
+        self.connection_errors = 0
+        # live connection handlers: stop() must close + join them so no
+        # emit reaches an already-stopped pipeline after stop() returns
+        # (the contract ThreadingTCPServer.server_close used to provide)
+        self._conn_lock = threading.Lock()
+        self._conns: Dict[socket.socket, threading.Thread] = {}
+
+    def _bind(self) -> None:
+        if self._sock is None:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((self.host, self.port))
+            sock.listen(64)
+            self._sock = sock
+            self.port = sock.getsockname()[1]
+
+    def _close_listener(self) -> None:
+        # shutdown BEFORE close: close() alone does not wake a thread
+        # blocked in accept() on Linux — the loop would hang forever
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def start(self) -> None:
-        receiver = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                try:
-                    receiver.framing(self.request, receiver._emit)
-                except (ValueError, OSError):
-                    pass
-
-        socketserver.ThreadingTCPServer.allow_reuse_address = True
-        self._server = socketserver.ThreadingTCPServer(
-            (self.host, self.port), Handler
-        )
-        self.port = self._server.server_address[1]
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True, name=self.name
-        )
-        self._thread.start()
+        self._bind()
+        self._alive = True
+        self._spawn_supervised(self._run)
         super().start()
 
+    def _handle(self, conn: socket.socket) -> None:
+        # wrap emit so a sink crash is distinguishable from a framing
+        # violation — a sink ValueError must be COUNTED, not mistaken
+        # for a malformed frame
+        def emit(payload: bytes) -> None:
+            try:
+                self._emit(payload)
+            except Exception as e:
+                raise _EmitCrash() from e
+
+        try:
+            with conn:
+                self.framing(conn, emit)
+        except _EmitCrash:
+            # sink crash: this connection dies (its client resends on
+            # reconnect), the accept loop and sibling connections do not
+            self.connection_errors += 1
+            logger.exception("tcp receiver %s: connection crashed",
+                             self.name)
+        except (ValueError, OSError):
+            pass   # framing violation / peer reset — connection-local
+        finally:
+            with self._conn_lock:
+                self._conns.pop(conn, None)
+
+    def _run(self) -> None:
+        self._bind()   # restart after a crash that closed the socket
+        if not self._alive:
+            # stop() raced the supervised restart: its _close_listener
+            # saw _sock=None mid-_bind, so the fresh socket is ours to
+            # release — otherwise the port stays bound forever
+            self._close_listener()
+            return
+        while self._alive:
+            sock = self._sock
+            if sock is None:
+                return   # stop() tore the listener down mid-iteration
+            try:
+                conn, _ = sock.accept()
+            except OSError as e:
+                if not self._alive:
+                    return   # clean shutdown closed the socket
+                if e.errno in _TRANSIENT_ACCEPT_ERRNOS:
+                    # fd exhaustion / aborted handshake: keep listening
+                    logger.warning("tcp receiver %s: transient accept "
+                                   "error, retrying: %s", self.name, e)
+                    time.sleep(0.05)
+                    continue
+                # release the port before the supervised restart rebinds
+                # it (same contract as UdpReceiver._run)
+                self._close_listener()
+                raise        # unexpected socket death → supervisor restarts
+            t = threading.Thread(
+                target=self._handle, args=(conn,),
+                name=f"{self.name}-conn", daemon=True)
+            with self._conn_lock:
+                # registration and stop() flip _alive under the same
+                # lock: a handler either registers before stop()'s
+                # snapshot (so it is closed + joined) or sees the stop
+                # and never starts — nothing can emit into a stopped
+                # pipeline either way
+                if not self._alive:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
+                self._conns[conn] = t
+            t.start()
+
     def stop(self) -> None:
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
+        with self._conn_lock:
+            self._alive = False
+        self._close_listener()
+        # tear down established connections and JOIN their handlers:
+        # nothing may emit into the stopped pipeline after this returns
+        with self._conn_lock:
+            conns = list(self._conns.items())
+        for conn, thread in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+            try:
+                thread.join(timeout=2)
+                if thread.is_alive():
+                    # handler stuck in a slow emit: the no-emit-after-
+                    # stop contract is broken — make it observable
+                    logger.warning(
+                        "tcp receiver %s: connection handler still "
+                        "alive after stop() join timeout", self.name)
+            except RuntimeError:
+                pass   # raced the registration: thread not yet started
+        self._stop_supervisor()
         super().stop()
 
 

@@ -164,12 +164,26 @@ class Instance(LifecycleComponent):
         # blocking the commit gate forever (EventStore.flush contract)
         self.event_store.dead_letters = self.dead_letters
 
-        # span tracing (reference: Jaeger probabilistic 1% sampling,
-        # MicroserviceConfiguration.java:53-57)
+        # span tracing: probabilistic head sampler (reference: Jaeger 1%,
+        # MicroserviceConfiguration.java:53-57) PLUS tail-based retention —
+        # traces with an errored span or end-to-end latency over the
+        # threshold are ALWAYS kept, so the failed and the slow are
+        # inspectable even at a 1% head rate
         from sitewhere_tpu.runtime.tracing import Tracer
 
+        tail_ms = self.config.get("tracing.tail_latency_ms", 100.0)
         self.tracer = Tracer(
-            sample_rate=float(self.config.get("tracing.sample_rate", 0.01)))
+            sample_rate=float(self.config.get("tracing.sample_rate", 0.01)),
+            tail_errors=bool(self.config.get("tracing.tail_errors", True)),
+            tail_latency_s=(float(tail_ms) / 1e3
+                            if tail_ms is not None else None),
+            pending_capacity=int(
+                self.config.get("tracing.pending_capacity", 512)))
+        # instance-scoped metrics registry (the .prom exposition surface;
+        # cross-cutting counters stay in metrics.global_registry())
+        from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
         # runtime-uploadable scripts (ScriptSynchronizer analog)
         from sitewhere_tpu.runtime.scripting import ScriptManager
 
@@ -182,6 +196,7 @@ class Instance(LifecycleComponent):
         self.commands = self.add_child(CommandProcessor(
             self.device_management,
             on_undelivered=self._on_undelivered_command,
+            metrics=self.metrics,
         ))
         self.batch_ops = self.add_child(BatchOperationManager(
             self.device_management, self.commands,
@@ -201,7 +216,8 @@ class Instance(LifecycleComponent):
             engine_factory=self._make_tenant_engine,
             tenant_ids=self.identity,
         ))
-        self.outbound = self.add_child(OutboundConnectorsManager())
+        self.outbound = self.add_child(
+            OutboundConnectorsManager(metrics=self.metrics))
         self.registration = self.add_child(RegistrationManager(
             self.device_management,
             default_device_type=self.config.get("registration.default_device_type"),
@@ -226,6 +242,7 @@ class Instance(LifecycleComponent):
             # dispatcher's many-output egress favors packed even on CPU;
             # on a mesh, per-call placement scales with buffer count).
             emit_packed=self._packed_step_enabled(),
+            metrics=self.metrics,
         )
         self.dispatcher = self.add_child(PipelineDispatcher(
             batcher=self.batcher,
@@ -246,6 +263,7 @@ class Instance(LifecycleComponent):
             journal_reader=JournalReader(self.ingest_journal, "pipeline"),
             recovery_decoder=recovery_decoder,
             tracer=self.tracer,
+            metrics=self.metrics,
         ))
         self.presence = self.add_child(PresenceManager(
             self.device_state,
@@ -302,7 +320,8 @@ class Instance(LifecycleComponent):
                 dead_letters=self.dead_letters,
                 deadline_ms=float(self.config.get(
                     "rpc.forward_deadline_ms", 25.0)),
-                data_dir=self.data_dir))
+                data_dir=self.data_dir,
+                tracer=self.tracer))
         else:
             self._peer_demuxes = {}
         self._rpc_peers = list(peers)
@@ -551,7 +570,7 @@ class Instance(LifecycleComponent):
 
         self.dispatcher.inject_batch(batch, np.asarray(batch.valid))
 
-    def _on_command_rows(self, cols, mask) -> None:
+    def _on_command_rows(self, cols, mask, trace=None) -> None:
         """Deliver pipeline COMMAND_INVOCATION events (reference:
         enriched-command-invocations → command-delivery, SURVEY.md §3.4).
 
@@ -594,7 +613,7 @@ class Instance(LifecycleComponent):
             except (ValueError, KeyError, CorruptJournal) as e:
                 logger.debug("unresolvable command payload ref %s: %s", ref, e)
             if invocation is not None:
-                self.commands.invoke(invocation)
+                self.commands.invoke(invocation, trace=trace)
             else:
                 self.dead_letters.append_json({
                     "kind": "undeliverable-invocation",

@@ -7,6 +7,16 @@ processes each batch on its own worker thread with error isolation; a
 connector exception is counted and logged, never propagated to the
 dispatcher (the pipeline equivalent of a consumer group falling behind is
 the connector's queue depth).
+
+Observability: ``submit`` carries the originating plan's trace (an
+``outbound.deliver`` span per connector lands in the SAME trace, even
+though delivery is asynchronous) and its ingest timestamp, so the
+manager can fold per-stage lag into the metrics registry —
+``outbound.queue_depth.<id>`` gauges, the ``outbound.ack_latency_s``
+histogram (submit→successful process, with trace-id exemplars), and the
+per-connector ``pipeline.ingest_to_outbound_ack_latency_s.<id>`` gauges
+the watermark story needs (per-stage attribution localizes regressions;
+arxiv 1807.07724 / 2307.14287).  Failed deliveries never record an ack.
 """
 
 from __future__ import annotations
@@ -14,12 +24,14 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from sitewhere_tpu.outbound.connectors import OutboundConnector
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.tracing import _NOOP_TRACE
 
 logger = logging.getLogger("sitewhere_tpu.outbound")
 
@@ -28,16 +40,17 @@ class OutboundConnectorsManager(LifecycleComponent):
     """Owns the connector set; dispatches batches to per-connector queues."""
 
     def __init__(self, connectors: Optional[List[OutboundConnector]] = None,
-                 queue_depth: int = 64):
+                 queue_depth: int = 64, metrics=None):
         super().__init__("outbound-connectors")
         self.queue_depth = queue_depth
+        self.metrics = metrics
         self._workers: Dict[str, "_Worker"] = {}
         for c in connectors or []:
             self.add_connector(c)
 
     def add_connector(self, connector: OutboundConnector) -> None:
         self.add_child(connector)
-        worker = _Worker(connector, self.queue_depth)
+        worker = _Worker(connector, self.queue_depth, self.metrics)
         self._workers[connector.connector_id] = worker
         if self.state.name == "STARTED":
             worker.start()
@@ -52,12 +65,19 @@ class OutboundConnectorsManager(LifecycleComponent):
             worker.shutdown()
         super().stop()
 
-    def submit(self, cols: Dict[str, np.ndarray], mask: np.ndarray) -> None:
+    def submit(self, cols: Dict[str, np.ndarray], mask: np.ndarray,
+               trace=None, ingest_t0: Optional[float] = None) -> None:
         """Offer one enriched batch to every connector (non-blocking; a
         full queue drops the batch for that connector and counts it —
-        backpressure stays local, like an overwhelmed consumer group)."""
+        backpressure stays local, like an overwhelmed consumer group).
+
+        ``trace`` is the originating plan's trace (delivery spans join
+        it); ``ingest_t0`` is the monotonic receive time of the plan's
+        oldest row, for the ingest→outbound-ack watermark gauge."""
+        item = (cols, mask, trace or _NOOP_TRACE, ingest_t0,
+                time.monotonic())
         for worker in self._workers.values():
-            worker.offer(cols, mask)
+            worker.offer(item)
 
     def drain(self, timeout: float = 10.0) -> None:
         """Block until all queued batches are processed (tests/shutdown)."""
@@ -77,12 +97,25 @@ class OutboundConnectorsManager(LifecycleComponent):
 
 
 class _Worker:
-    def __init__(self, connector: OutboundConnector, depth: int):
+    def __init__(self, connector: OutboundConnector, depth: int,
+                 metrics=None):
         self.connector = connector
         self.q: "queue.Queue" = queue.Queue(maxsize=depth)
         self.dropped = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        if metrics is not None:
+            cid = connector.connector_id
+            self._m_depth = metrics.gauge(f"outbound.queue_depth.{cid}")
+            self._m_ack = metrics.histogram("outbound.ack_latency_s")
+            # per connector: one shared gauge would be last-write-wins,
+            # letting a fast connector mask a lagging one's watermark
+            self._m_e2e = metrics.gauge(
+                f"pipeline.ingest_to_outbound_ack_latency_s.{cid}")
+            self._m_dropped = metrics.counter("outbound.batches_dropped")
+        else:
+            self._m_depth = self._m_ack = self._m_e2e = None
+            self._m_dropped = None
 
     def start(self) -> None:
         if self._thread is not None:
@@ -103,11 +136,15 @@ class _Worker:
             self._thread.join(timeout=5)
             self._thread = None
 
-    def offer(self, cols, mask) -> None:
+    def offer(self, item) -> None:
         try:
-            self.q.put_nowait((cols, mask))
+            self.q.put_nowait(item)
         except queue.Full:
             self.dropped += 1
+            if self._m_dropped is not None:
+                self._m_dropped.inc()
+        if self._m_depth is not None:
+            self._m_depth.set(self.q.qsize())
 
     def drain(self, timeout: float) -> None:
         import time
@@ -124,13 +161,36 @@ class _Worker:
             try:
                 if item is None:
                     continue
-                cols, mask = item
+                cols, mask, trace, ingest_t0, t_submit = item
+                delivered = False
                 try:
-                    self.connector.process_batch(cols, mask)
+                    with trace.span("outbound.deliver") as span:
+                        span.tag("connector", self.connector.connector_id)
+                        self.connector.process_batch(cols, mask)
+                    delivered = True
                 except Exception:
                     # isolation only: process_batch already counted the
                     # error and informed the connector's breaker
                     logger.exception("connector %s failed on batch",
                                      self.connector.connector_id)
+                now = time.monotonic()
+                if self._m_ack is not None:
+                    if delivered:
+                        # a failed batch is NOT an ack — recording it
+                        # would make an outage read as healthy delivery.
+                        # Exemplar is best-effort: a tail-candidate trace
+                        # flips sampled at the dispatcher's end(), which
+                        # an idle worker's fast ack can precede — such an
+                        # ack carries no exemplar even when the trace is
+                        # later retained (the e2e histogram's exemplar,
+                        # recorded post-decision, is the authoritative
+                        # bucket→trace link).
+                        self._m_ack.observe(
+                            now - t_submit,
+                            trace_id=(trace.trace_id if trace.sampled
+                                      else None))
+                        if ingest_t0 is not None:
+                            self._m_e2e.set(now - ingest_t0)
+                    self._m_depth.set(self.q.qsize())
             finally:
                 self.q.task_done()

@@ -28,6 +28,7 @@ from sitewhere_tpu.rpc import wire
 from sitewhere_tpu.runtime import faults
 from sitewhere_tpu.runtime.metrics import global_registry
 from sitewhere_tpu.runtime.resilience import Backoff, RetryPolicy
+from sitewhere_tpu.runtime.tracing import _NOOP_TRACE
 
 logger = logging.getLogger("sitewhere_tpu.rpc")
 
@@ -173,14 +174,26 @@ class RpcChannel:
     def call(self, method: str, body: object = None,
              attachment: bytes = b"",
              headers: Optional[Dict[str, str]] = None,
-             timeout_s: float = 30.0) -> Tuple[object, bytes]:
+             timeout_s: float = 30.0, trace=None) -> Tuple[object, bytes]:
         """One request/reply round trip.  Returns ``(body, attachment)``.
+
+        ``trace`` (a :class:`~sitewhere_tpu.runtime.tracing.Trace`) wraps
+        the round trip in an ``rpc.client.<method>`` span and stamps the
+        trace context into the frame headers so the server continues the
+        SAME trace — the client tracing interceptor analog.
 
         Raises :class:`RpcError` for server-reported failures,
         :class:`ChannelUnavailable` for transport failures (the demux
         catches the latter and fails over).
         """
-        hdrs = dict(headers or {})
+        trace = trace or _NOOP_TRACE
+        with trace.span(f"rpc.client.{method}") as span:
+            span.tag("endpoint", self.endpoint)
+            hdrs = trace.propagate(dict(headers or {}), parent=span)
+            return self._call(method, body, attachment, hdrs, timeout_s)
+
+    def _call(self, method: str, body: object, attachment: bytes,
+              hdrs: Dict[str, str], timeout_s: float) -> Tuple[object, bytes]:
         if self._token_provider is not None and "authorization" not in hdrs:
             hdrs["authorization"] = self._token_provider()
         if self._tenant is not None and "tenant" not in hdrs:
@@ -293,11 +306,12 @@ class RpcDemux:
     def call(self, method: str, body: object = None,
              attachment: bytes = b"",
              headers: Optional[Dict[str, str]] = None,
-             timeout_s: float = 30.0) -> Tuple[object, bytes]:
+             timeout_s: float = 30.0, trace=None) -> Tuple[object, bytes]:
         """Round-robin call with failover: transport failures rotate to
         the next replica; server-reported errors (RpcError) do NOT fail
         over — the reference likewise retries only channel faults, not
-        application faults."""
+        application faults.  ``trace`` propagates per attempt, so a
+        failed-over call shows one client span per replica tried."""
         rotation = self._rotation()
         if not rotation:
             raise ChannelUnavailable("no endpoints configured")
@@ -308,7 +322,8 @@ class RpcDemux:
                     f"{chan.endpoint} in backoff")
                 continue
             try:
-                return chan.call(method, body, attachment, headers, timeout_s)
+                return chan.call(method, body, attachment, headers, timeout_s,
+                                 trace=trace)
             except ChannelUnavailable as e:
                 last = e
                 global_registry().counter(

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional
 from sitewhere_tpu.ingest.journal import Journal, JournalReader
 from sitewhere_tpu.rpc.channel import ChannelUnavailable, RpcDemux, RpcError
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.tracing import _NOOP_TRACE
 
 logger = logging.getLogger("sitewhere_tpu.rpc")
 
@@ -163,9 +164,13 @@ class HostForwarder(LifecycleComponent):
                  max_buffer_bytes: int = 1 << 20,
                  max_retries: int = 3,
                  data_dir: Optional[str] = None,
+                 tracer=None,
                  name: str = "host-forwarder"):
         super().__init__(name)
         self.dispatcher = dispatcher
+        # span tracing of the DCN hop: each forwarded batch is one trace
+        # whose client/server spans share a trace_id across hosts
+        self.tracer = tracer
         # local handler for host-plane requests owned by this host
         # (set by the instance; see ingest_host_request)
         self.on_host_request = None
@@ -478,12 +483,30 @@ class HostForwarder(LifecycleComponent):
             self._dead_letter(owner, payload, "no demux for peer")
             return True
         rows = payload.count(b"\n") + 1
+        trace = (self.tracer.trace("forward.batch")
+                 if self.tracer is not None else _NOOP_TRACE)
+        try:
+            # root span names the DCN hop; the per-attempt
+            # rpc.client.events.ingest spans share its trace_id
+            with trace.span("forward.batch") as span:
+                span.tag("peer", owner).tag("rows", rows)
+                ok = self._deliver_traced(owner, payload, demux, rows, trace)
+                if not ok:
+                    # exhausted retries: flag the hop so tail sampling
+                    # retains the trace of an unreachable peer
+                    span.error = "peer unreachable: retries exhausted"
+                return ok
+        finally:
+            trace.end()
+
+    def _deliver_traced(self, owner: int, payload: bytes, demux,
+                        rows: int, trace) -> bool:
         for attempt in range(self.max_retries):
             try:
                 body, _ = demux.call(
                     "events.ingest",
                     {"sourceId": f"fwd:{self.process_id}"},
-                    attachment=payload)
+                    attachment=payload, trace=trace)
                 with self._lock:
                     self.forwarded_rows += int(body.get("accepted", rows))
                 return True

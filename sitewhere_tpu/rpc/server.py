@@ -238,10 +238,22 @@ class RpcServer(LifecycleComponent):
                               authorities, frame.headers.get("tenant"),
                               frame.attachment, peer)
             if self._tracer is not None:
-                trace = self._tracer.trace(f"rpc.{frame.method}")
-                with trace.span(frame.method) as span:
-                    span.tag("peer", peer)
-                    result = handler.fn(ctx, frame.body)
+                # Continue the CALLER's trace when the headers carry one
+                # (the reference's server tracing interceptor reads the
+                # propagated gRPC metadata) — same trace_id on both sides
+                # of the boundary; start a fresh trace otherwise.
+                trace = self._tracer.join(frame.headers)
+                if trace is None:
+                    trace = self._tracer.trace(f"rpc.{frame.method}")
+                try:
+                    with trace.span(f"rpc.server.{frame.method}") as span:
+                        span.tag("peer", peer)
+                        result = handler.fn(ctx, frame.body)
+                finally:
+                    # the server owns its side's retention decision: an
+                    # error HERE retains these spans even when the caller
+                    # drops its own (tail sampling is per-side)
+                    trace.end()
             else:
                 result = handler.fn(ctx, frame.body)
             attachment = b""

@@ -35,6 +35,12 @@ import socket
 import struct
 from typing import Dict, Optional, Tuple
 
+# Trace context rides the JSON headers lane next to authorization/tenant
+# (the reference's tracing interceptors stamp gRPC metadata the same
+# way): ``trace-id`` + ``parent-id`` + ``trace-sampled`` headers —
+# written by Trace.propagate, read by Tracer.join (runtime/tracing.py);
+# the wire layer itself treats them as opaque headers.
+
 MAGIC = b"SWR1"
 FLAG_RESPONSE = 0x01
 FLAG_ERROR = 0x02

@@ -64,7 +64,8 @@ class PipelineDispatcher(LifecycleComponent):
     - ``state_manager`` → DeviceStateManager (commit + sweeps)
     - ``event_store`` → accepted-row persistence (append_columns)
     - ``outbound`` → OutboundConnectorsManager (submit cols+mask)
-    - ``on_command_rows(cols, idx)`` → command-delivery hook
+    - ``on_command_rows(cols, idx, trace=None)`` → command-delivery hook
+      (``trace`` is the plan's trace so the delivery span joins it)
     - ``registration`` → RegistrationManager (process_unregistered)
     """
 
@@ -78,7 +79,7 @@ class PipelineDispatcher(LifecycleComponent):
         event_store=None,
         outbound=None,
         registration=None,
-        on_command_rows: Optional[Callable[[Dict[str, np.ndarray], np.ndarray], None]] = None,
+        on_command_rows: Optional[Callable[..., None]] = None,
         journal: Optional[Journal] = None,
         dead_letters: Optional[Journal] = None,
         resolve_tenant: Optional[Callable[[str], int]] = None,
@@ -89,6 +90,7 @@ class PipelineDispatcher(LifecycleComponent):
         journal_reader: Optional[JournalReader] = None,
         recovery_decoder: Optional[Callable[[bytes], List[DecodedRequest]]] = None,
         tracer=None,
+        metrics=None,
         name: str = "pipeline-dispatcher",
     ):
         super().__init__(name)
@@ -197,6 +199,27 @@ class PipelineDispatcher(LifecycleComponent):
 
             tracer = Tracer(sample_rate=0.0)  # disabled unless configured
         self.tracer = tracer
+        # Registry surface (the .prom exposition): instruments are bound
+        # ONCE here so the per-plan path pays attribute loads, not dict
+        # lookups.  Histogram observations carry the plan's trace id as
+        # an exemplar when that trace was retained — the exposition links
+        # a latency bucket to a concrete trace an operator can open.
+        if metrics is None:
+            from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self._m_e2e = metrics.histogram("pipeline.e2e_latency_s")
+        self._m_assemble = metrics.histogram("pipeline.batch_assemble_s")
+        self._m_steps = metrics.counter("pipeline.steps")
+        self._m_queue = metrics.gauge("ingest.queue_depth")
+        self._m_inflight = metrics.gauge("pipeline.inflight_steps")
+        self._m_seal = metrics.gauge("pipeline.ingest_to_seal_latency_s")
+        self._m_totals = {
+            key: metrics.counter(f"pipeline.events_{key}")
+            for key in ("processed", "accepted", "unregistered",
+                        "unassigned", "threshold_alerts", "zone_alerts")
+        }
         # host-aggregated counters (metrics endpoint surface)
         self.steps = 0
         self.totals: Dict[str, int] = {
@@ -620,6 +643,7 @@ class PipelineDispatcher(LifecycleComponent):
         # the batcher wait of the oldest row = the "batch assemble" stage
         trace.record("batch.assemble", plan.max_wait_s,
                      rows=plan.n_events, fill=round(plan.fill, 3))
+        self._m_assemble.observe(plan.max_wait_s)
         with self._step_lock:
             if plan.packed_i is not None:
                 from sitewhere_tpu.pipeline.packed import PackedView
@@ -690,6 +714,7 @@ class PipelineDispatcher(LifecycleComponent):
         egress the oldest plans beyond the window while the device
         computes.  Called under _step_lock."""
         self.steps += 1
+        self._m_steps.inc()
         self._inflight.append((plan, out, replay_depth, trace))
         while len(self._inflight) > self.inflight_depth:
             self._egress(*self._inflight.popleft())
@@ -728,7 +753,13 @@ class PipelineDispatcher(LifecycleComponent):
             cols = self._columns(host_cols, out)
         for key in ("processed", "accepted", "unregistered", "unassigned",
                     "threshold_alerts", "zone_alerts"):
-            self.totals[key] += int(getattr(m, key))
+            count = int(getattr(m, key))
+            self.totals[key] += count
+            if count:
+                self._m_totals[key].inc(count)
+        # monotonic receive time of the plan's oldest row — the watermark
+        # the per-stage ingest→seal / ingest→ack gauges measure from
+        ingest_t0 = plan.created_at - plan.max_wait_s
 
         refs = host_cols["payload_ref"]
         journaled = refs != NULL_ID
@@ -741,18 +772,21 @@ class PipelineDispatcher(LifecycleComponent):
             with trace.span("egress.persist").tag(
                     "rows", int(getattr(m, "accepted"))):
                 self.event_store.append_columns(cols, mask=accepted)
+            self._m_seal.set(time.monotonic() - ingest_t0)
 
         # 2. enriched fan-out (outbound connectors + rule processor hosts)
+        #    — the trace rides along so the async delivery span joins it
         if self.outbound is not None and accepted.any():
             with trace.span("egress.outbound"):
-                self.outbound.submit(cols, accepted)
+                self.outbound.submit(cols, accepted, trace=trace,
+                                     ingest_t0=ingest_t0)
 
         # 3. command invocations (command-delivery analog)
         cmd_mask = accepted & (cols["event_type"] == EventType.COMMAND_INVOCATION)
         if self.on_command_rows is not None and cmd_mask.any():
             self.totals["commands"] += int(cmd_mask.sum())
             with trace.span("egress.commands"):
-                self.on_command_rows(cols, cmd_mask)
+                self.on_command_rows(cols, cmd_mask, trace=trace)
 
         # 4. auto-registration + replay (device-registration analog)
         if int(m.unregistered) > 0:
@@ -776,6 +810,16 @@ class PipelineDispatcher(LifecycleComponent):
         with self._lock:
             self.latencies_s.append(lat)
             self._plans_outstanding -= 1
+        # Close the trace: for tail candidates this IS the retention
+        # decision (errored/slow traces flip to sampled, so the async
+        # outbound/command spans still land in the ring).  The e2e
+        # histogram exemplar uses the post-decision sampled flag — only
+        # traces an operator can actually open are linked.
+        trace.end()
+        self._m_e2e.observe(
+            lat, trace_id=(trace.trace_id if trace.sampled else None))
+        self._m_queue.set(self.batcher.pending)
+        self._m_inflight.set(len(self._inflight))
 
     def _columns(self, host_cols: Dict[str, np.ndarray], out) -> Dict[str, np.ndarray]:
         cols = {

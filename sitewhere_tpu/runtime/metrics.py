@@ -1,4 +1,4 @@
-"""In-process metrics: counters, gauges, timers with percentiles.
+"""In-process metrics: counters, gauges, timers, histograms + exposition.
 
 Reference: Dropwizard ``MetricRegistry`` per microservice with meters and
 timers on the hot path (``Microservice.java:147``,
@@ -6,14 +6,55 @@ timers on the hot path (``Microservice.java:147``,
 (``Microservice.java:264-272``).  Here a lock-light registry the REST
 surface and log reporter read; pipeline-step counters (device-side psums)
 are folded in by the dispatcher.
+
+Naming convention: lowercase dotted ``subsystem.noun[_verb][_unit]``
+segments (``pipeline.e2e_latency_s``, ``resilience.retries.rpc.connect``)
+— :data:`METRIC_NAME_RE` is the linted contract; registry accessors
+sanitize dynamic segments (connector ids, receiver names) into it.
+
+Exposition: :func:`render_openmetrics` serializes one or more registries
+as OpenMetrics/Prometheus text (counters, gauges, timers-as-summaries,
+histograms with bucket counts and ``trace_id`` exemplars linking a
+latency bucket to a retained trace); :func:`parse_exposition` is the
+matching minimal scrape-side parser the smoke tooling and tests use.
 """
 
 from __future__ import annotations
 
 import bisect
+import collections
+import logging
+import math
+import re
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("sitewhere_tpu.metrics")
+
+# The linted naming contract: ≥2 lowercase dotted segments, each
+# [a-z0-9_-] starting alphanumeric.  Dynamic segments are sanitized into
+# this space by the registry accessors.
+METRIC_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9_-]*(\.[a-z0-9][a-z0-9_-]*)+$")
+
+_SANITIZE_RE = re.compile(r"[^a-z0-9_.-]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Map an arbitrary name into the dotted convention (lowercase;
+    invalid chars → ``_``; empty or badly-led segments get an ``x``
+    prefix) so dynamic segments — connector ids, receiver names like
+    ``tcp-receiver:9090`` — can never mint an unlintable or
+    un-exposable metric.  Idempotent.  Segment COUNT is the caller's
+    concern: metric names are code-authored dotted paths; only the
+    segments themselves may be dynamic."""
+    segs = []
+    for seg in name.lower().split("."):
+        seg = _SANITIZE_RE.sub("_", seg)
+        if not seg or not seg[0].isalnum():
+            seg = "x" + seg   # segments must start [a-z0-9]
+        segs.append(seg)
+    return ".".join(segs)
 
 
 class Counter:
@@ -39,11 +80,20 @@ class Gauge:
 
 
 class Timer:
-    """Reservoir timer with p50/p95/p99 (bounded sorted reservoir)."""
+    """Reservoir timer with p50/p95/p99 over a bounded sample ring.
+
+    ``observe`` is O(1) — append to a ``deque(maxlen=reservoir)`` under
+    the lock — and the sort is deferred to the READ side (percentile /
+    snapshot), cached until the next observation.  The previous
+    ``bisect.insort`` kept the reservoir sorted on every observation:
+    O(n) memmove per sample *while holding the lock*, i.e. ~4096 element
+    moves on the hot path per event at steady state.
+    """
 
     def __init__(self, reservoir: int = 4096):
         self.reservoir = reservoir
-        self._samples: List[float] = []
+        self._samples: collections.deque = collections.deque(maxlen=reservoir)
+        self._sorted: Optional[List[float]] = None
         self._lock = threading.Lock()
         self.count = 0
         self.total = 0.0
@@ -52,10 +102,8 @@ class Timer:
         with self._lock:
             self.count += 1
             self.total += seconds
-            bisect.insort(self._samples, seconds)
-            if len(self._samples) > self.reservoir:
-                # drop alternating extremes to keep the distribution shape
-                del self._samples[0 if self.count % 2 else -1]
+            self._samples.append(seconds)
+            self._sorted = None
 
     def time(self):
         timer = self
@@ -75,52 +123,296 @@ class Timer:
         with self._lock:
             if not self._samples:
                 return 0.0
-            idx = min(len(self._samples) - 1, int(q * len(self._samples)))
-            return self._samples[idx]
+            if self._sorted is None:
+                self._sorted = sorted(self._samples)
+            idx = min(len(self._sorted) - 1, int(q * len(self._sorted)))
+            return self._sorted[idx]
 
     @property
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
 
 
+# Fixed latency buckets (seconds): 1ms…10s around the <10ms p99 target,
+# with sub-target resolution where the SLO lives.
+DEFAULT_LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket histogram with optional trace-id exemplars.
+
+    Buckets are cumulative ``le`` (≤ upper bound) counts, Prometheus
+    histogram semantics, so scrape deltas aggregate across hosts without
+    a reservoir merge.  ``observe(v, trace_id=...)`` additionally pins
+    the LAST exemplar per bucket — the exposition links a latency bucket
+    to a concrete retained trace an operator can open.
+    """
+
+    __slots__ = ("buckets", "_counts", "count", "total", "_exemplars",
+                 "_lock")
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS_S):
+        self.buckets: Tuple[float, ...] = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.buckets) + 1)   # +1: the +Inf bucket
+        self.count = 0
+        self.total = 0.0
+        # bucket index → (trace_id, observed value, unix ts)
+        self._exemplars: Dict[int, Tuple[str, float, float]] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
+        value = float(value)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self.count += 1
+            self.total += value
+            if trace_id:
+                self._exemplars[idx] = (str(trace_id), value, time.time())
+
+    def snapshot(self) -> dict:
+        """Cumulative bucket counts keyed by the ``le`` bound."""
+        with self._lock:
+            counts = list(self._counts)
+            count, total = self.count, self.total
+        cum, out = 0, {}
+        for bound, n in zip(self.buckets, counts):
+            cum += n
+            out[bound] = cum
+        return {"count": count, "sum": total, "buckets": out}
+
+    def _render_state(self):
+        with self._lock:
+            return (list(self._counts), self.count, self.total,
+                    dict(self._exemplars))
+
+
 class MetricsRegistry:
-    """Named metrics, hierarchical dotted keys."""
+    """Named metrics, hierarchical dotted keys (sanitized on access)."""
 
     def __init__(self):
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._timers: Dict[str, Timer] = {}
+        self._histograms: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str) -> Counter:
         with self._lock:
-            return self._counters.setdefault(name, Counter())
+            return self._counters.setdefault(sanitize_metric_name(name),
+                                             Counter())
 
     def gauge(self, name: str) -> Gauge:
         with self._lock:
-            return self._gauges.setdefault(name, Gauge())
+            return self._gauges.setdefault(sanitize_metric_name(name),
+                                           Gauge())
 
     def timer(self, name: str) -> Timer:
         with self._lock:
-            return self._timers.setdefault(name, Timer())
+            return self._timers.setdefault(sanitize_metric_name(name),
+                                           Timer())
+
+    def histogram(self, name: str,
+                  buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
+        name = sanitize_metric_name(name)
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(
+                    buckets or DEFAULT_LATENCY_BUCKETS_S)
+            elif (buckets is not None
+                  and tuple(sorted(float(b) for b in buckets)) != h.buckets):
+                # silently bucketing B's observations under A's bounds
+                # would corrupt the scrape surface — keep A's, but say so
+                logger.warning(
+                    "histogram %r already registered with different "
+                    "buckets; keeping the existing bounds", name)
+            return h
+
+    def names(self) -> List[str]:
+        """Every registered metric name (the lint surface)."""
+        with self._lock:
+            return sorted({*self._counters, *self._gauges, *self._timers,
+                           *self._histograms})
 
     def snapshot(self) -> dict:
         """Serializable view for the REST/admin surface."""
         with self._lock:
-            return {
-                "counters": {k: c.value for k, c in self._counters.items()},
-                "gauges": {k: g.value for k, g in self._gauges.items()},
-                "timers": {
-                    k: {
-                        "count": t.count,
-                        "mean_ms": t.mean * 1e3,
-                        "p50_ms": t.percentile(0.50) * 1e3,
-                        "p95_ms": t.percentile(0.95) * 1e3,
-                        "p99_ms": t.percentile(0.99) * 1e3,
-                    }
-                    for k, t in self._timers.items()
-                },
-            }
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            timers = dict(self._timers)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {k: c.value for k, c in counters.items()},
+            "gauges": {k: g.value for k, g in gauges.items()},
+            "timers": {
+                k: {
+                    "count": t.count,
+                    "mean_ms": t.mean * 1e3,
+                    "p50_ms": t.percentile(0.50) * 1e3,
+                    "p95_ms": t.percentile(0.95) * 1e3,
+                    "p99_ms": t.percentile(0.99) * 1e3,
+                }
+                for k, t in timers.items()
+            },
+            "histograms": {k: h.snapshot() for k, h in histograms.items()},
+        }
+
+
+# -- OpenMetrics exposition ---------------------------------------------------
+
+_PROM_INVALID = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return _PROM_INVALID.sub("_", name)
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    # non-finite first: int(nan) raises, int(inf) overflows — and one
+    # bad sample must never take down the whole scrape surface
+    if f != f:
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _exemplar(ex: Optional[Tuple[str, float, float]]) -> str:
+    if not ex:
+        return ""
+    trace_id, value, ts = ex
+    return f' # {{trace_id="{trace_id}"}} {_fmt(value)} {ts:.3f}'
+
+
+def _claim(seen: Dict[str, Tuple[str, str]], prom_name: str, dotted: str,
+           kind: str) -> bool:
+    """Reserve a flattened family name; False = already emitted.  A
+    DIFFERENT dotted name (e.g. ``x.a-1`` vs ``x.a.1``) or a different
+    instrument kind (``counter('a.b')`` + ``gauge('a.b')``) collapsing
+    onto one already-emitted family would silently hide the loser —
+    warn.  Same dotted name + kind stays silent: that's the documented
+    first-registry-wins shadowing."""
+    prior = seen.get(prom_name)
+    if prior is None:
+        seen[prom_name] = (dotted, kind)
+        return True
+    if prior != (dotted, kind):
+        logger.warning(
+            "metric %r (%s) hidden from exposition: flattens to %r, "
+            "already emitted as %s for %r",
+            dotted, kind, prom_name, prior[1], prior[0])
+    return False
+
+
+def render_openmetrics(*registries: MetricsRegistry) -> str:
+    """Serialize registries as OpenMetrics text (the ``.prom`` surface).
+
+    Families merge first-registry-wins on name collisions (the instance
+    registry shadows the process-global one).  Histogram buckets carry
+    ``trace_id`` exemplars when the hot path supplied them; timers render
+    as summaries (quantiles are host-local, not aggregatable — the
+    histograms exist for cross-host aggregation).
+    """
+    lines: List[str] = []
+    seen: Dict[str, Tuple[str, str]] = {}
+    for reg in registries:
+        with reg._lock:
+            counters = dict(reg._counters)
+            gauges = dict(reg._gauges)
+            timers = dict(reg._timers)
+            histograms = dict(reg._histograms)
+        for name, c in sorted(counters.items()):
+            n = _prom_name(name)
+            if not _claim(seen, n, name, "counter"):
+                continue
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n}_total {_fmt(c.value)}")
+        for name, g in sorted(gauges.items()):
+            n = _prom_name(name)
+            if not _claim(seen, n, name, "gauge"):
+                continue
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {_fmt(g.value)}")
+        for name, t in sorted(timers.items()):
+            n = _prom_name(name)
+            if not _claim(seen, n, name, "summary"):
+                continue
+            lines.append(f"# TYPE {n} summary")
+            for q in (0.5, 0.95, 0.99):
+                lines.append(f'{n}{{quantile="{q}"}} {_fmt(t.percentile(q))}')
+            lines.append(f"{n}_sum {_fmt(t.total)}")
+            lines.append(f"{n}_count {_fmt(t.count)}")
+        for name, h in sorted(histograms.items()):
+            n = _prom_name(name)
+            if not _claim(seen, n, name, "histogram"):
+                continue
+            counts, count, total, exemplars = h._render_state()
+            lines.append(f"# TYPE {n} histogram")
+            cum = 0
+            for i, bound in enumerate(h.buckets):
+                cum += counts[i]
+                lines.append(f'{n}_bucket{{le="{_fmt(bound)}"}} {cum}'
+                             + _exemplar(exemplars.get(i)))
+            lines.append(f'{n}_bucket{{le="+Inf"}} {count}'
+                         + _exemplar(exemplars.get(len(h.buckets))))
+            lines.append(f"{n}_sum {_fmt(total)}")
+            lines.append(f"{n}_count {_fmt(count)}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^}]*\})?"
+    r" (?P<value>[^ #]+)"
+    r"(?P<exemplar> # \{[^}]*\} [^ ]+( [^ ]+)?)?$"
+)
+
+
+def parse_exposition(text: str) -> Dict[str, dict]:
+    """Minimal OpenMetrics scrape-side parser (smoke tooling + tests).
+
+    Returns ``{family: {"type": ..., "samples": {sample_key: value}}}``
+    where ``sample_key`` is the sample name plus its label string.
+    Raises ``ValueError`` on malformed lines, samples without a
+    preceding TYPE declaration, or a missing ``# EOF`` terminator —
+    i.e. it VALIDATES, it doesn't best-effort skip.
+    """
+    families: Dict[str, dict] = {}
+    stripped = text.rstrip("\n").split("\n")
+    if not stripped or stripped[-1] != "# EOF":
+        raise ValueError("exposition not terminated with # EOF")
+    for line in stripped[:-1]:
+        if not line:
+            raise ValueError("blank line in exposition")
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) < 3 or parts[1] not in ("TYPE", "HELP", "UNIT"):
+                raise ValueError(f"malformed comment line: {line!r}")
+            if parts[1] == "TYPE":
+                if len(parts) < 4:
+                    raise ValueError(f"TYPE line missing type: {line!r}")
+                families[parts[2]] = {"type": parts[3], "samples": {}}
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"malformed sample line: {line!r}")
+        name = m.group("name")
+        family = next(
+            (f for f in (name, name.rsplit("_", 1)[0]) if f in families),
+            None)
+        if family is None:
+            raise ValueError(f"sample {name!r} without a TYPE declaration")
+        value = float(m.group("value"))
+        families[family]["samples"][name + (m.group("labels") or "")] = value
+    return families
 
 
 # Process-wide registry for cross-cutting counters (resilience: retries,

@@ -1,15 +1,31 @@
-"""Minimal span tracing with probabilistic sampling.
+"""Span tracing: head + tail sampling, cross-boundary context propagation.
 
 Reference: OpenTracing + Jaeger with a 1% probabilistic sampler
 (``microservice/MicroserviceConfiguration.java:53-57``), spans around
 lifecycle ops and gRPC client/server interceptors
 (``grpc/client/common/tracing/ClientTracingInterceptor.java``).  The
-pipeline here is one process, so "distributed" tracing collapses to
-per-plan traces whose spans are the host stages wrapped around the one
-device program: batch assemble (batcher wait), step dispatch, and each
-egress leg.  Finished spans land in a bounded ring the REST surface
-exposes; the sampling decision is made ONCE per trace so a sampled trace
-is always complete.
+pipeline here is one process per host, so spans are the host stages
+wrapped around the one device program: batch assemble (batcher wait),
+step dispatch, and each egress leg — plus the RPC legs when a trace
+crosses hosts.
+
+Two samplers compose:
+
+- **Head sampling** (the Jaeger 1% analog): the decision is made ONCE at
+  the trace root, so a sampled trace carries every stage span.  Sampled
+  spans land in the finished ring as they close.
+- **Tail sampling**: every *unsampled* trace still records its spans into
+  a bounded pending buffer; when the trace ends (or is evicted), it is
+  RETAINED if any span errored or the trace exceeded the latency
+  threshold, and dropped otherwise.  The traces an operator actually
+  needs — the failed and the slow — are therefore always kept, at a
+  per-plan (never per-event) bookkeeping cost.
+
+Cross-boundary propagation: :meth:`Trace.propagate` stamps the trace
+context into a header dict (the RPC fabric's JSON headers lane,
+``rpc/wire.py``) and :meth:`Tracer.join` continues it on the far side,
+so one trace spans ingest → dispatch → seal → fan-out → remote delivery
+with the same ``trace_id`` on every host.
 """
 
 from __future__ import annotations
@@ -19,6 +35,12 @@ import random
 import threading
 import time
 from typing import Dict, List, Optional
+
+# Trace-context header keys (carried in the RPC frame's JSON headers
+# lane next to authorization/tenant — see rpc/wire.py).
+TRACE_ID_HEADER = "trace-id"
+PARENT_ID_HEADER = "parent-id"
+TRACE_SAMPLED_HEADER = "trace-sampled"
 
 _ids = random.Random()
 _ids_lock = threading.Lock()
@@ -43,16 +65,25 @@ class _NoopSpan:
     def tag(self, key: str, value) -> "_NoopSpan":
         return self
 
+    @property
+    def error(self):
+        return None
+
+    @error.setter
+    def error(self, value) -> None:
+        pass   # unsampled: discard (callers may flag failures uniformly)
+
 
 _NOOP = _NoopSpan()
 
 
 class Span:
     __slots__ = ("tracer", "trace_id", "span_id", "parent_id", "name",
-                 "start_s", "duration_s", "tags", "error", "_t0")
+                 "start_s", "duration_s", "tags", "error", "trace", "_t0")
 
     def __init__(self, tracer: "Tracer", trace_id: str, name: str,
-                 parent_id: Optional[str] = None):
+                 parent_id: Optional[str] = None,
+                 trace: Optional["Trace"] = None):
         self.tracer = tracer
         self.trace_id = trace_id
         self.span_id = _new_id()
@@ -62,6 +93,7 @@ class Span:
         self.duration_s: Optional[float] = None
         self.tags: Dict[str, object] = {}
         self.error: Optional[str] = None
+        self.trace = trace
 
     def tag(self, key: str, value) -> "Span":
         self.tags[key] = value
@@ -93,32 +125,74 @@ class Span:
 
 
 class Trace:
-    """A sampled trace handle: spawn child spans under one trace id."""
+    """A live trace handle: spawn child spans under one trace id.
 
-    __slots__ = ("tracer", "trace_id", "root_id")
+    ``sampled=True`` means retention is already decided (head-sampled
+    here, or upstream on the propagating side): spans flush straight to
+    the finished ring.  ``sampled=False`` means the trace is a
+    tail-sampling candidate: spans buffer until :meth:`end` decides.
+    """
+
+    __slots__ = ("tracer", "trace_id", "root_id", "sampled", "decided")
 
     def __init__(self, tracer: "Tracer", trace_id: str,
-                 root_id: Optional[str]):
+                 root_id: Optional[str], sampled: bool = True):
         self.tracer = tracer
         self.trace_id = trace_id
         self.root_id = root_id
+        self.sampled = sampled
+        # retention state: head-sampled traces are born decided; tail
+        # candidates flip decided (and maybe sampled) at end()/eviction
+        # — guarded by the tracer's lock, so a late async span can
+        # never re-open a decided trace's pending entry
+        self.decided = sampled
 
     def span(self, name: str, parent: Optional[Span] = None):
         return Span(self.tracer, self.trace_id, name,
                     parent_id=(parent.span_id if isinstance(parent, Span)
-                               else self.root_id))
+                               else self.root_id),
+                    trace=self)
 
     def record(self, name: str, duration_s: float, **tags) -> None:
         """Record an already-measured stage (e.g. batcher wait) as a span."""
-        span = Span(self.tracer, self.trace_id, name, parent_id=self.root_id)
+        span = Span(self.tracer, self.trace_id, name, parent_id=self.root_id,
+                    trace=self)
         span.start_s = time.time() - duration_s
         span.duration_s = duration_s
         span.tags.update(tags)
         self.tracer._finish(span)
 
+    def propagate(self, headers: Dict[str, str],
+                  parent: Optional[Span] = None) -> Dict[str, str]:
+        """Stamp the trace context into ``headers`` (in place) so the
+        receiving side can :meth:`Tracer.join` it.  ``parent`` names the
+        client-side span the remote spans should hang off."""
+        headers[TRACE_ID_HEADER] = self.trace_id
+        parent_id = (parent.span_id if isinstance(parent, Span)
+                     else self.root_id)
+        if parent_id:
+            headers[PARENT_ID_HEADER] = parent_id
+        headers[TRACE_SAMPLED_HEADER] = "1" if self.sampled else "0"
+        return headers
+
+    def end(self) -> None:
+        """Close the trace: applies the tail-sampling retention decision
+        for pending traces (no-op for head-sampled ones).  Safe to call
+        once per trace from the side that created it; spans finished
+        AFTER end() (async egress legs) go straight to the ring when the
+        trace was retained and are discarded when it was dropped — they
+        never re-open the pending entry.  Exception: a late ERRORED span
+        (with ``tail_errors`` on) re-opens retention, so an async
+        delivery failure is never invisible."""
+        self.tracer._end_trace(self)
+
 
 class _NoopTrace:
     __slots__ = ()
+
+    trace_id = None
+    sampled = False
+    decided = True
 
     def span(self, name: str, parent=None):
         return _NOOP
@@ -126,37 +200,169 @@ class _NoopTrace:
     def record(self, name: str, duration_s: float, **tags) -> None:
         pass
 
+    def propagate(self, headers: Dict[str, str], parent=None) -> Dict[str, str]:
+        return headers
+
+    def end(self) -> None:
+        pass
+
 
 _NOOP_TRACE = _NoopTrace()
 
 
-class Tracer:
-    """Probabilistic head-sampling tracer with a bounded finished-span ring."""
+class _PendingTrace:
+    __slots__ = ("spans", "started")
 
-    def __init__(self, sample_rate: float = 0.01, capacity: int = 2048):
+    def __init__(self):
+        self.spans: List[Span] = []
+        self.started = time.monotonic()
+
+
+class Tracer:
+    """Head + tail sampling tracer with a bounded finished-span ring.
+
+    - ``sample_rate``: probabilistic head sampler (decision per trace).
+    - ``tail_errors``: retain any unsampled trace with an errored span.
+    - ``tail_latency_s``: retain any unsampled trace whose span extent
+      meets/exceeds this many seconds (``None`` disables the check).
+    - ``pending_capacity``: bound on concurrently-pending (undecided)
+      traces; the oldest is evicted-and-decided when exceeded, so an
+      abandoned trace can never leak.
+
+    With both tail knobs off (the default), unsampled traces cost one
+    branch — exactly the old head-only behavior.
+    """
+
+    def __init__(self, sample_rate: float = 0.01, capacity: int = 2048,
+                 tail_latency_s: Optional[float] = None,
+                 tail_errors: bool = False,
+                 pending_capacity: int = 512,
+                 seed: int = 0xC0FFEE):
         self.sample_rate = float(sample_rate)
+        self.tail_latency_s = tail_latency_s
+        self.tail_errors = bool(tail_errors)
+        self.pending_capacity = int(pending_capacity)
         self._spans: collections.deque = collections.deque(maxlen=capacity)
+        self._pending: "collections.OrderedDict[str, _PendingTrace]" = \
+            collections.OrderedDict()
         self._lock = threading.Lock()
-        self._rng = random.Random(0xC0FFEE)
+        self._rng = random.Random(seed)
         self.started = 0
         self.sampled = 0
+        self.joined = 0
+        self.retained_tail = 0
+        self.dropped_tail = 0
+
+    @property
+    def _tail_enabled(self) -> bool:
+        return self.tail_errors or self.tail_latency_s is not None
 
     def trace(self, name: str):
-        """Head-sampled trace root: returns a live or no-op trace handle.
+        """Trace root: head-sample, else tail-candidate, else no-op.
 
-        The decision is per-trace (reference: Jaeger probabilistic 1%,
-        ``MicroserviceConfiguration.java:55``) so sampled traces carry
-        every stage span.
+        The head decision is per-trace (reference: Jaeger probabilistic
+        1%, ``MicroserviceConfiguration.java:55``) so sampled traces
+        carry every stage span; with tail sampling on, unsampled traces
+        still buffer pending the end-of-trace retention decision.
         """
         self.started += 1
-        if self._rng.random() >= self.sample_rate:
+        if self._rng.random() < self.sample_rate:
+            self.sampled += 1
+            return Trace(self, _new_id(), None, sampled=True)
+        if not self._tail_enabled:
             return _NOOP_TRACE
-        self.sampled += 1
-        return Trace(self, _new_id(), None)
+        return Trace(self, _new_id(), None, sampled=False)
+
+    def join(self, headers: Optional[Dict[str, str]]):
+        """Continue a propagated trace from ``headers``; None when no
+        trace context rides them.  The upstream head decision carries
+        over; tail candidates are decided locally too, so an error on
+        EITHER side of the boundary retains that side's spans."""
+        if not headers:
+            return None
+        trace_id = headers.get(TRACE_ID_HEADER)
+        if not trace_id:
+            return None
+        self.joined += 1
+        sampled = headers.get(TRACE_SAMPLED_HEADER) == "1"
+        if not sampled and not self._tail_enabled:
+            return _NOOP_TRACE
+        return Trace(self, str(trace_id),
+                     headers.get(PARENT_ID_HEADER) or None, sampled=sampled)
+
+    # -- span / trace completion --------------------------------------------
 
     def _finish(self, span: Span) -> None:
+        trace = span.trace
+        if trace is None or trace.sampled:
+            with self._lock:
+                self._spans.append(span)
+            return
         with self._lock:
-            self._spans.append(span)
+            if trace.sampled:
+                # retention decided between the check above and the lock
+                self._spans.append(span)
+                return
+            if trace.decided:
+                # decided-and-dropped: late async spans drop too — EXCEPT
+                # an errored one (an outbound worker failing after the
+                # plan's drop decision): the error guarantee must hold
+                # for async legs, so retention re-opens from this span
+                # on (the pre-decision clean spans are already gone)
+                if self.tail_errors and span.error:
+                    trace.sampled = True
+                    self._spans.append(span)
+                    self.retained_tail += 1
+                    self.dropped_tail -= 1
+                return
+            entry = self._pending.get(trace.trace_id)
+            if entry is None:
+                entry = self._pending[trace.trace_id] = _PendingTrace()
+                if len(self._pending) > self.pending_capacity:
+                    # abandoned trace (owner crashed before end()):
+                    # decide now so its error spans still survive
+                    _, evicted = self._pending.popitem(last=False)
+                    self._decide_locked(evicted)
+            entry.spans.append(span)
+
+    def _end_trace(self, trace: Trace) -> None:
+        with self._lock:
+            if trace.decided:
+                return
+            entry = self._pending.pop(trace.trace_id, None)
+            if entry is None:
+                # nothing buffered — nothing kept; still COUNTED as a
+                # drop so a late errored span's re-open (retained += 1,
+                # dropped -= 1) can never push dropped_tail negative
+                trace.decided = True
+                self.dropped_tail += 1
+                return
+            # late spans (async egress legs, outbound workers) of a
+            # retained trace go straight to the ring; of a dropped one
+            # they are discarded — either way, never re-pended
+            trace.sampled = self._decide_locked(entry)
+            trace.decided = True
+
+    def _decide_locked(self, entry: _PendingTrace) -> bool:
+        """Apply the tail retention rule to one pending trace and mark
+        its handle decided.  Caller holds ``_lock``."""
+        spans = entry.spans
+        keep = self.tail_errors and any(s.error for s in spans)
+        if not keep and self.tail_latency_s is not None and spans:
+            starts = [s.start_s for s in spans]
+            ends = [s.start_s + (s.duration_s or 0.0) for s in spans]
+            keep = (max(ends) - min(starts)) >= self.tail_latency_s
+        if keep:
+            self._spans.extend(spans)
+            self.retained_tail += 1
+        else:
+            self.dropped_tail += 1
+        if spans and spans[0].trace is not None:
+            spans[0].trace.sampled = keep
+            spans[0].trace.decided = True
+        return keep
+
+    # -- read side ------------------------------------------------------------
 
     def recent(self, limit: int = 100) -> List[dict]:
         with self._lock:
@@ -166,9 +372,14 @@ class Tracer:
     def stats(self) -> dict:
         with self._lock:
             buffered = len(self._spans)
+            pending = len(self._pending)
         return {
             "sample_rate": self.sample_rate,
             "traces_started": self.started,
             "traces_sampled": self.sampled,
+            "traces_joined": self.joined,
+            "traces_retained_tail": self.retained_tail,
+            "traces_dropped_tail": self.dropped_tail,
+            "traces_pending": pending,
             "spans_buffered": buffered,
         }

@@ -597,6 +597,27 @@ def register_routes(gw: RestGateway, inst) -> None:
     r("GET", "/api/instance/metrics",
       lambda q: inst.dispatcher.metrics_snapshot())
 
+    def metrics_prom(q):
+        """OpenMetrics exposition of the instance + process registries."""
+        from sitewhere_tpu.runtime.metrics import (
+            global_registry,
+            render_openmetrics,
+        )
+
+        text = render_openmetrics(inst.metrics, global_registry())
+        return RawResponse(
+            text.encode("utf-8"),
+            content_type=("application/openmetrics-text; "
+                          "version=1.0.0; charset=utf-8"))
+    # unauthenticated like /api/openapi.json: scrapers (Prometheus, the
+    # smoke tooling) don't carry JWTs.  Deliberate exposure tradeoff:
+    # the surface is metric names/values, connector ids embedded in
+    # per-connector gauge names, and opaque trace-id exemplars — the
+    # trace ids are random handles only dereferenceable through the
+    # JWT-protected topology/trace surface
+    r("GET", "/api/instance/metrics.prom", metrics_prom,
+      auth_required=False)
+
     # ---- dead letters: inspect + requeue (reprocess-topic analog) ---------
     def _int_arg(raw, field: str) -> int:
         try:

@@ -148,6 +148,95 @@ class TestReceiverRecovery:
         finally:
             rx.stop()
 
+    def test_tcp_emit_fault_is_connection_local(self):
+        """An ``ingest.emit`` crash inside one connection's framing loop
+        kills ONLY that connection (the un-acked stream is the client's
+        cue to resend — TCP redelivery); the supervised accept loop
+        never restarts for it."""
+        from sitewhere_tpu.ingest.sources import TcpReceiver, newline_frames
+
+        rx = TcpReceiver(port=0, framing=newline_frames)
+        got = []
+        rx.sink = got.append
+        rx.start()
+        try:
+            faults.inject("ingest.emit", times=1)
+            tx = socket.create_connection(("127.0.0.1", rx.port), timeout=5)
+            tx.sendall(b"poison\n")
+            # the poisoned connection is closed by the receiver
+            tx.settimeout(5)
+            assert tx.recv(1) == b""
+            tx.close()
+            assert _wait(lambda: rx.connection_errors == 1)
+            assert rx.supervisor.restarts == 0
+            assert not got
+            # the client's redelivery path: reconnect and resend
+            tx = socket.create_connection(("127.0.0.1", rx.port), timeout=5)
+            tx.sendall(b"after-reconnect\n")
+            assert _wait(lambda: got)
+            assert got[-1] == b"after-reconnect"
+            tx.close()
+        finally:
+            rx.stop()
+
+    def test_tcp_sink_value_error_is_counted_not_swallowed(self):
+        """A sink raising ValueError is a sink crash, not a framing
+        violation: it must tick ``connection_errors`` (monitoring) and
+        stay connection-local."""
+        from sitewhere_tpu.ingest.sources import TcpReceiver, newline_frames
+
+        rx = TcpReceiver(port=0, framing=newline_frames)
+
+        def bad_sink(payload):
+            raise ValueError("decode exploded")
+
+        rx.sink = bad_sink
+        rx.start()
+        try:
+            tx = socket.create_connection(("127.0.0.1", rx.port), timeout=5)
+            tx.sendall(b"anything\n")
+            assert _wait(lambda: rx.connection_errors == 1)
+            assert rx.supervisor.restarts == 0
+            tx.close()
+        finally:
+            rx.stop()
+
+    def test_tcp_accept_loop_restarts_and_rebinds_same_port(self):
+        """Accept-loop death (socket dies under it) restarts under the
+        supervisor with backoff and re-binds the SAME port, so clients
+        just reconnect."""
+        from sitewhere_tpu.ingest.sources import TcpReceiver, newline_frames
+
+        rx = TcpReceiver(port=0, framing=newline_frames)
+        rx.restart_policy = RetryPolicy(initial_s=0.01, max_s=0.1)
+        got = []
+        rx.sink = got.append
+        rx.start()
+        try:
+            port = rx.port
+            # the accept loop's socket dies under it (shutdown wakes a
+            # BLOCKED accept — close alone would not, on Linux)
+            rx._sock.shutdown(socket.SHUT_RDWR)
+            assert _wait(lambda: rx.supervisor.restarts >= 1)
+            assert not rx.supervisor.escalated
+
+            def feed():
+                # reconnect until the restarted loop has re-bound
+                try:
+                    tx = socket.create_connection(("127.0.0.1", port),
+                                                  timeout=1)
+                except OSError:
+                    return False
+                tx.sendall(b"after-restart\n")
+                tx.close()
+                return _wait(lambda: got, timeout=1.0)
+
+            assert _wait(feed)
+            assert got[-1] == b"after-restart"
+            assert rx.port == port
+        finally:
+            rx.stop()
+
     def test_mqtt_qos1_intake_crash_loses_no_events(self):
         """The acceptance proof: a crashed intake withholds the PUBACK,
         the device redelivers, and the event lands exactly as published —

@@ -164,6 +164,90 @@ class TestServerChannel:
         chan.close()
 
 
+# ---------------------------------------------------------------------------
+# trace-context propagation across the RPC boundary
+# ---------------------------------------------------------------------------
+
+class TestTracePropagation:
+    """The caller's trace context rides the frame headers; the server
+    continues the SAME trace instead of starting a fresh one per call —
+    the client/server tracing-interceptor pair."""
+
+    def _server(self, tracer, fn=None):
+        srv = RpcServer(port=0, tracer=tracer)
+        srv.register("echo", fn or (lambda ctx, body: body),
+                     auth_required=False)
+        srv.start()
+        return srv
+
+    def test_same_trace_id_on_both_sides(self):
+        from sitewhere_tpu.runtime.tracing import Tracer
+
+        server_tracer = Tracer(sample_rate=1.0)
+        srv = self._server(server_tracer)
+        client_tracer = Tracer(sample_rate=1.0)
+        try:
+            chan = RpcChannel(srv.endpoint)
+            trace = client_tracer.trace("forward.batch")
+            body, _ = chan.call("echo", {"x": 1}, trace=trace)
+            trace.end()
+            chan.close()
+            assert body == {"x": 1}
+            client_spans = client_tracer.recent(10)
+            server_spans = server_tracer.recent(10)
+            assert [s["name"] for s in client_spans] == ["rpc.client.echo"]
+            assert [s["name"] for s in server_spans] == ["rpc.server.echo"]
+            # the acceptance criterion: one trace id across the boundary
+            assert client_spans[0]["trace_id"] == server_spans[0]["trace_id"]
+            # and the server span hangs off the client span
+            assert server_spans[0]["parent_id"] == client_spans[0]["span_id"]
+            assert server_tracer.joined == 1
+        finally:
+            srv.stop()
+
+    def test_forced_error_retained_by_tail_sampler_on_both_sides(self):
+        """A forced-error call with a 0% head rate: BOTH sides'
+        tail samplers keep their half of the trace, same trace_id."""
+        from sitewhere_tpu.runtime.tracing import Tracer
+
+        def boom(ctx, body):
+            raise ValueError("forced")
+
+        server_tracer = Tracer(sample_rate=0.0, tail_errors=True)
+        srv = self._server(server_tracer, fn=boom)
+        client_tracer = Tracer(sample_rate=0.0, tail_errors=True)
+        try:
+            chan = RpcChannel(srv.endpoint)
+            trace = client_tracer.trace("forward.batch")
+            with pytest.raises(RpcError):
+                chan.call("echo", {"x": 1}, trace=trace)
+            trace.end()
+            chan.close()
+            assert server_tracer.retained_tail == 1
+            assert client_tracer.retained_tail == 1
+            client_spans = client_tracer.recent(10)
+            server_spans = server_tracer.recent(10)
+            assert client_spans[0]["trace_id"] == server_spans[0]["trace_id"]
+            assert server_spans[0]["error"]
+        finally:
+            srv.stop()
+
+    def test_no_trace_context_starts_fresh_server_trace(self):
+        from sitewhere_tpu.runtime.tracing import Tracer
+
+        server_tracer = Tracer(sample_rate=1.0)
+        srv = self._server(server_tracer)
+        try:
+            chan = RpcChannel(srv.endpoint)
+            chan.call("echo", {})
+            chan.close()
+            assert server_tracer.joined == 0
+            assert server_tracer.sampled == 1
+        finally:
+            srv.stop()
+
+
+
 class TestInterceptors:
     @pytest.fixture()
     def secured(self):
@@ -500,6 +584,56 @@ class TestForwarding:
         assert len(insts[1].event_store.query(device_id=int(d1))) == 2
         # nothing dead-lettered, nothing misplaced
         assert fwd.dead_lettered == 0
+
+    def test_forwarded_batch_trace_spans_both_hosts(self, two_hosts):
+        """The DCN hop is traced end to end: a forwarded batch's
+        client span (sender host) and server span (owning host) share
+        one trace id — the cross-host half of the acceptance proof."""
+        from sitewhere_tpu.runtime.tracing import Tracer
+
+        insts, servers = two_hosts
+        tok1 = next(f"dev-{i}" for i in range(100)
+                    if owning_process(f"dev-{i}", 2) == 1)
+        insts[1].device_management.create_device(token=tok1,
+                                                 device_type="sensor")
+        insts[1].device_management.create_device_assignment(device=tok1)
+
+        jwt = insts[1].tokens.mint("admin", ["ROLE_ADMIN"])
+        demux_to_1 = RpcDemux([servers[1].endpoint],
+                              token_provider=lambda: jwt)
+        fwd_tracer = Tracer(sample_rate=1.0)
+        fwd = HostForwarder(
+            insts[0].dispatcher, process_id=0,
+            peer_demuxes={0: None, 1: demux_to_1},
+            dead_letters=insts[0].dead_letters,
+            deadline_ms=10.0, tracer=fwd_tracer)
+        fwd.start()
+        try:
+            fwd.ingest_payload(
+                b'{"deviceToken": "%s", "type": "Measurement",'
+                b' "request": {"name": "t", "value": 1,'
+                b' "eventDate": 1000}}' % tok1.encode())
+            fwd.flush()
+            deadline = time.time() + 10
+            while time.time() < deadline and fwd.forwarded_rows < 1:
+                time.sleep(0.05)
+            assert fwd.forwarded_rows == 1
+        finally:
+            fwd.stop()
+            demux_to_1.close()
+
+        sent = [s for s in fwd_tracer.recent(200)
+                if s["name"] == "rpc.client.events.ingest"]
+        recv = [s for s in insts[1].tracer.recent(200)
+                if s["name"] == "rpc.server.events.ingest"]
+        assert sent and recv
+        shared = {s["trace_id"] for s in sent} & {s["trace_id"] for s in recv}
+        assert shared, "no trace id crossed the host boundary"
+        # the DCN hop itself is a span (README: "forward.batch"), in the
+        # same trace as the client/server legs
+        hops = [s for s in fwd_tracer.recent(200)
+                if s["name"] == "forward.batch"]
+        assert hops and {s["trace_id"] for s in hops} & shared
 
     def test_config_driven_multihost_instances(self, tmp_path):
         """Two Instances from config alone (rpc.peers + shared jwt

@@ -143,6 +143,19 @@ class TestCrudSurface:
         status, metrics = client.request("GET", "/api/instance/metrics")
         assert status == 200 and "accepted" in metrics
 
+    def test_openmetrics_scrape_is_unauthenticated_and_parses(self, server):
+        """Prometheus-style scrapers carry no JWT: the ``.prom``
+        exposition is open, well-typed, and parseable."""
+        from sitewhere_tpu.runtime.metrics import parse_exposition
+
+        status, data, ctype = Client(server.port).request(
+            "GET", "/api/instance/metrics.prom", raw=True)
+        assert status == 200
+        assert ctype.startswith("application/openmetrics-text")
+        families = parse_exposition(data.decode())
+        assert "pipeline_e2e_latency_s" in families
+        assert families["pipeline_e2e_latency_s"]["type"] == "histogram"
+
     def test_rule_doc_round_trip_and_validation(self, client):
         """GET serves snake_case keys; PUTting that doc back with an edit
         must apply it, typos must 400, non-integral enums must 400."""

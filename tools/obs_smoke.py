@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Observability smoke: boot an instance, push events, scrape the
+OpenMetrics exposition, and assert the whole surface holds together.
+
+Proof obligations (the PR-2 acceptance criteria, end to end over HTTP):
+
+- ``GET /api/instance/metrics.prom`` serves parseable OpenMetrics text
+  (``parse_exposition`` VALIDATES — it does not best-effort skip);
+- at least one latency histogram has non-zero bucket counts;
+- the ingest→seal watermark gauge is populated after traffic;
+- a forced-error RPC call leaves a retained trace on BOTH sides of the
+  boundary (tail sampling at a 0% head rate) with the same trace_id.
+
+Usage::
+
+    python tools/obs_smoke.py
+
+Exit status 0 = all assertions hold.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Deterministic CPU: the JAX_PLATFORMS env var is overridden by platform
+# sitecustomize hooks — force it via the config API before any backend
+# initializes (same approach as tests/conftest.py).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+N_EVENTS = 256
+
+
+def _make_instance(data_dir):
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+
+    cfg = Config({
+        "instance": {"id": "obs-smoke", "data_dir": data_dir},
+        "pipeline": {"width": 64, "registry_capacity": 256,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+        # head sampler off: every retained trace below is the tail
+        # sampler's doing
+        "tracing": {"sample_rate": 0.0, "tail_latency_ms": 50.0},
+    }, apply_env=False)
+    return Instance(cfg)
+
+
+def main() -> int:
+    from sitewhere_tpu.runtime.metrics import parse_exposition
+    from sitewhere_tpu.web import WebServer
+
+    root = tempfile.mkdtemp(prefix="obs-smoke-")
+    failures = []
+    try:
+        inst = _make_instance(os.path.join(root, "data"))
+        inst.start()
+        dm = inst.device_management
+        dm.create_device_type(token="sensor", name="Sensor")
+        for i in range(4):
+            dm.create_device(token=f"d-{i}", device_type="sensor")
+            dm.create_device_assignment(device=f"d-{i}")
+        web = WebServer(inst)
+        web.start()
+
+        # -- traffic ------------------------------------------------------
+        lines = [json.dumps({
+            "deviceToken": f"d-{r % 4}", "type": "Measurement",
+            "request": {"name": "temp", "value": float(r),
+                        "eventDate": 1_753_800_000 + r}})
+            for r in range(N_EVENTS)]
+        inst.dispatcher.ingest_wire_lines("\n".join(lines).encode())
+        inst.dispatcher.flush()
+        inst.event_store.flush()
+
+        # -- a forced-error RPC call: the acceptance proof.  The server
+        #    runs on the INSTANCE tracer; the handler raises inside the
+        #    rpc.server span, so the instance's tail sampler must retain
+        #    it — and the caller's side retains its half with the SAME
+        #    trace id (both at a 0% head rate).
+        from sitewhere_tpu.rpc import RpcChannel, RpcError, RpcServer
+        from sitewhere_tpu.runtime.tracing import Tracer
+
+        def boom(ctx, body):
+            raise ValueError("forced observability error")
+
+        srv = RpcServer(port=0, tracer=inst.tracer)
+        srv.register("boom", boom, auth_required=False)
+        srv.start()
+        client_tracer = Tracer(sample_rate=0.0, tail_errors=True)
+        chan = RpcChannel(srv.endpoint)
+        client_trace = client_tracer.trace("forward.batch")
+        try:
+            chan.call("boom", {}, trace=client_trace)
+            failures.append("forced-error RPC unexpectedly succeeded")
+        except RpcError:
+            pass
+        client_trace.end()
+        chan.close()
+        srv.stop()
+
+        server_spans = [s for s in inst.tracer.recent(200)
+                        if s["name"] == "rpc.server.boom"]
+        client_spans = [s for s in client_tracer.recent(10)
+                        if s["name"] == "rpc.client.boom"]
+        if not (server_spans and server_spans[0]["error"]):
+            failures.append("server side did not retain the error trace")
+        if not client_spans or client_tracer.retained_tail != 1:
+            failures.append("client side did not retain the error trace")
+        if server_spans and client_spans and \
+                client_spans[0]["trace_id"] != server_spans[0]["trace_id"]:
+            failures.append("trace id did not cross the RPC boundary")
+
+        # -- scrape -------------------------------------------------------
+        url = f"http://127.0.0.1:{web.port}/api/instance/metrics.prom"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            ctype = resp.headers.get("Content-Type", "")
+            text = resp.read().decode("utf-8")
+        if not ctype.startswith("application/openmetrics-text"):
+            failures.append(f"unexpected content type: {ctype}")
+        families = parse_exposition(text)  # raises on malformed exposition
+
+        histograms = {f: v for f, v in families.items()
+                      if v["type"] == "histogram"}
+        if not histograms:
+            failures.append("no histogram families in the exposition")
+        populated = [
+            f for f, v in histograms.items()
+            if v["samples"].get(f + "_count", 0) > 0
+            and any("_bucket{" in k for k in v["samples"])
+        ]
+        if not populated:
+            failures.append("no histogram with non-zero bucket counts")
+
+        seal = families.get("pipeline_ingest_to_seal_latency_s", {})
+        seal_v = seal.get("samples", {}).get(
+            "pipeline_ingest_to_seal_latency_s", 0.0)
+        if seal_v <= 0.0:
+            failures.append("ingest->seal watermark gauge not populated")
+
+        stats = inst.tracer.stats()
+        if stats["traces_retained_tail"] < 1:
+            failures.append(
+                f"forced-error trace was not retained: {stats}")
+
+        web.stop()
+        inst.stop()
+        inst.terminate()
+
+        print(json.dumps({
+            "families": len(families),
+            "histograms_populated": populated,
+            "ingest_to_seal_latency_s": seal_v,
+            "tracer": stats,
+            "ok": not failures,
+        }, indent=2))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("obs_smoke: exposition parses, histograms populated, "
+          "error trace retained")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
