@@ -155,6 +155,10 @@ class AmqpReceiver(Receiver):
                  reconnect_delay_s: float = 0.5,
                  max_reconnect_delay_s: float = 30.0):
         super().__init__(name=f"amqp-receiver:{host}:{port}/{queue}")
+        # basic.ack is sent only AFTER the sink accepts the delivery:
+        # the ingest decode pool must keep this source synchronous or
+        # the ack would precede the journal append (at-least-once)
+        self.acks_on_emit = True
         self.host, self.port = host, port
         self.vhost = vhost
         self.queue = queue

@@ -353,6 +353,9 @@ class EventHubReceiver(Receiver):
                  reconnect_delay_s: float = 0.5,
                  max_reconnect_delay_s: float = 30.0):
         super().__init__(name=f"eventhub-receiver:{host}:{port}/{event_hub}")
+        # disposition(accepted) settles only AFTER the sink accepts:
+        # ack-gated, so the ingest decode pool keeps this source sync
+        self.acks_on_emit = True
         self.host, self.port = host, port
         self.event_hub = event_hub
         self.consumer_group = consumer_group

@@ -61,6 +61,12 @@ _FIELDS = (
 _COL_FIELDS = tuple(name for name, _, _ in _FIELDS[1:])
 _DTYPE = {name: dt for name, dt, _ in _FIELDS}
 _FILL = {name: fill for name, _, fill in _FIELDS}
+# 0-d fill templates: `np.broadcast_to(_FILL_0D[f], n)` is a zero-copy
+# 0-stride view of any length — intake never allocates a full column for
+# an omitted field again (emission copies by slice regardless).  The
+# views are read-only; nothing on the intake/emit path writes into a
+# queued chunk's columns, only the freshly-allocated batch buffers.
+_FILL_0D = {name: np.full((), fill, dt) for name, dt, fill in _FIELDS}
 
 
 @dataclasses.dataclass
@@ -104,10 +110,101 @@ class BatchPlan:
     # instead of 16).
     packed_i: Optional[np.ndarray] = None
     packed_f: Optional[np.ndarray] = None
+    # Device-resident (bi, bf) pair staged ahead of the step by the
+    # dispatcher (pipeline/packed.py stage_packed_batch): the H2D copy of
+    # plan N+1 overlaps plan N's device step.  None = unstaged (sync
+    # transfer at step-call time, the CPU-backend fallback).
+    staged: Optional[tuple] = None
 
     @property
     def fill(self) -> float:
         return self.n_events / self.width
+
+
+class AdaptiveBatchController:
+    """Load-adaptive emission window (the deadline the batcher emits on).
+
+    The batch WIDTH is compiled into the jitted step and cannot change
+    per plan — the adaptive knob is the *time window* a partial batch may
+    coalesce before the deadline forces it out.  The stream-processing
+    literature identifies exactly this trade (arxiv 1807.07724 §5,
+    2307.14287 §4): small windows chase the latency SLO, large windows
+    chase throughput, and a static setting is wrong at one end or the
+    other.  Decisions are made per EMIT (never per row) from signals the
+    batcher already has:
+
+    - a deadline emit at low fill with nothing left pending → the stream
+      is idle; SHRINK the window toward ``min_s`` (less added latency);
+    - a segment-fill emit, or a full batch still pending after an emit →
+      the stream is backlogged; GROW the window toward ``max_s`` (fuller
+      batches, fewer partial-width dispatches).
+
+    Deterministic: no internal clock — driven entirely by the batcher's
+    emits, so a fake-clock test replays decisions exactly.  Decisions are
+    exported through the metrics registry (``ingest.adaptive_window_s``
+    gauge, ``ingest.adaptive_grow`` / ``ingest.adaptive_shrink``
+    counters).
+    """
+
+    def __init__(
+        self,
+        deadline_ms: float = 5.0,
+        min_ms: Optional[float] = None,
+        max_ms: Optional[float] = None,
+        low_fill: float = 0.25,
+        grow: float = 1.5,
+        shrink: float = 0.75,
+        metrics=None,
+    ):
+        if grow <= 1.0 or not 0.0 < shrink < 1.0:
+            raise ValueError("need grow > 1 and 0 < shrink < 1")
+        self.window_s = deadline_ms / 1e3
+        self.min_s = (min_ms if min_ms is not None else deadline_ms / 4) / 1e3
+        self.max_s = (max_ms if max_ms is not None else deadline_ms * 8) / 1e3
+        if not self.min_s <= self.window_s <= self.max_s:
+            raise ValueError(
+                f"deadline {self.window_s}s outside [{self.min_s}, {self.max_s}]")
+        self.low_fill = low_fill
+        self.grow = grow
+        self.shrink = shrink
+        self.grows = 0
+        self.shrinks = 0
+        if metrics is not None:
+            self._m_window = metrics.gauge("ingest.adaptive_window_s")
+            self._m_window.set(self.window_s)
+            self._m_grow = metrics.counter("ingest.adaptive_grow")
+            self._m_shrink = metrics.counter("ingest.adaptive_shrink")
+        else:
+            self._m_window = self._m_grow = self._m_shrink = None
+
+    @property
+    def deadline_s(self) -> float:
+        return self.window_s
+
+    def on_emit(self, n_events: int, width: int, pending: int,
+                reason: str) -> None:
+        """Observe one emission (``reason``: "fill" | "deadline" |
+        "flush") and adjust the window.  Flush emits are shutdown/drain
+        artifacts and never adapt."""
+        if reason == "flush":
+            return
+        if reason == "fill" or pending >= width:
+            new = min(self.window_s * self.grow, self.max_s)
+            if new != self.window_s:
+                self.window_s = new
+                self.grows += 1
+                if self._m_grow is not None:
+                    self._m_grow.inc()
+                    self._m_window.set(new)
+        elif reason == "deadline" and pending == 0 \
+                and n_events <= self.low_fill * width:
+            new = max(self.window_s * self.shrink, self.min_s)
+            if new != self.window_s:
+                self.window_s = new
+                self.shrinks += 1
+                if self._m_shrink is not None:
+                    self._m_shrink.inc()
+                    self._m_window.set(new)
 
 
 class Batcher:
@@ -133,6 +230,7 @@ class Batcher:
         clock: Callable[[], float] = time.monotonic,
         emit_packed: bool = False,
         metrics=None,
+        controller: Optional[AdaptiveBatchController] = None,
     ):
         if width % n_shards != 0:
             raise ValueError(f"width={width} not divisible by n_shards={n_shards}")
@@ -148,7 +246,11 @@ class Batcher:
         self.resolve_mtype = resolve_mtype
         self.resolve_alert = resolve_alert
         self.invocations = invocations
-        self.deadline_s = deadline_ms / 1e3
+        self._deadline_s = deadline_ms / 1e3
+        # Optional adaptive window: when set, the controller owns the
+        # deadline (shrinks under idle, grows under backlog) and the
+        # static value above is only the fallback after detach.
+        self.controller = controller
         self.clock = clock
         self.emit_packed = emit_packed
         self._pending: List[Deque[_Chunk]] = [
@@ -167,6 +269,25 @@ class Batcher:
             self._m_rows = metrics.counter("ingest.rows_emitted")
             self._m_fill = metrics.gauge("ingest.batch_fill")
             self._m_wait = metrics.histogram("ingest.batch_wait_s")
+
+    @property
+    def deadline_s(self) -> float:
+        if self.controller is not None:
+            return self.controller.deadline_s
+        return self._deadline_s
+
+    @deadline_s.setter
+    def deadline_s(self, value: float) -> None:
+        self._deadline_s = float(value)
+        if self.controller is not None:
+            # write-through: the attribute was a plain knob before the
+            # controller existed, so an explicit set re-anchors the
+            # adaptive window (still clamped to its [min_s, max_s])
+            # instead of being silently shadowed by it
+            c = self.controller
+            c.window_s = min(max(float(value), c.min_s), c.max_s)
+            if c._m_window is not None:
+                c._m_window.set(c.window_s)
 
     # -- intake: scalar paths ------------------------------------------------
 
@@ -291,17 +412,27 @@ class Batcher:
         if n == 0:
             return []
         cols: Dict[str, np.ndarray] = {}
+        filled: set = set()
         for f in _COL_FIELDS:
             v = columns.get(f)
             if f == "device_id":
                 cols[f] = device_id
             elif v is None:
-                cols[f] = np.full(n, _FILL[f], _DTYPE[f])
+                # Zero-alloc fill: a 0-stride read-only broadcast of the
+                # per-field template, never a fresh np.full per call —
+                # emission copies by slice regardless, and nothing writes
+                # into queued chunk columns.
+                cols[f] = np.broadcast_to(_FILL_0D[f], n)
+                filled.add(f)
             else:
-                cols[f] = np.asarray(v, _DTYPE[f])
-                if len(cols[f]) != n:
+                if not (type(v) is np.ndarray and v.dtype == _DTYPE[f]
+                        and v.ndim == 1):
+                    # already-typed 1-D inputs skip the asarray sweep
+                    v = np.asarray(v, _DTYPE[f])
+                cols[f] = v
+                if len(v) != n:
                     raise ValueError(
-                        f"column {f!r} length {len(cols[f])} != {n}")
+                        f"column {f!r} length {len(v)} != {n}")
         unknown_keys = set(columns) - set(_COL_FIELDS)
         if unknown_keys:
             raise ValueError(f"unknown columns {sorted(unknown_keys)}")
@@ -328,9 +459,13 @@ class Batcher:
             # corrupt queued events.  (The multi-shard path copies via its
             # boolean-mask gather already.)
             if _copy:
+                # Fill broadcasts are immutable templates — copying them
+                # would just re-materialize the np.full this path dropped.
                 cols = {
                     f: (np.array(c, copy=True)
-                        if c is columns.get(f) or c.base is not None else c)
+                        if f not in filled
+                        and (c is columns.get(f) or c.base is not None)
+                        else c)
                     for f, c in cols.items()
                 }
             self._pending[0].append(_Chunk(cols=cols, length=n, arrival=now))
@@ -413,14 +548,14 @@ class Batcher:
         if self._oldest is None:
             return None
         if self.clock() - self._oldest >= self.deadline_s:
-            return self._emit()
+            return self._emit(reason="deadline")
         return None
 
     def flush(self) -> Optional[BatchPlan]:
         """Emit whatever is pending (shutdown/drain)."""
         if self._oldest is None:
             return None
-        return self._emit()
+        return self._emit(reason="flush")
 
     @property
     def pending(self) -> int:
@@ -428,7 +563,7 @@ class Batcher:
 
     # -- emission -----------------------------------------------------------
 
-    def _emit(self) -> BatchPlan:
+    def _emit(self, reason: str = "fill") -> BatchPlan:
         import jax.numpy as jnp
 
         ibuf = fbuf = None
@@ -492,6 +627,8 @@ class Batcher:
             self._m_rows.inc(n)
             self._m_fill.set(n / self.width)
             self._m_wait.observe(wait)
+        if self.controller is not None:
+            self.controller.on_emit(n, self.width, self.pending, reason)
         if self.emit_packed:
             from sitewhere_tpu.pipeline.packed import BATCH_I
 

@@ -174,6 +174,10 @@ class CoapServerReceiver(Receiver):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         super().__init__(name=f"coap-receiver:{port}")
+        # the piggybacked ACK 2.04 is sent only after _emit returns: the
+        # client's CON retransmission is the redelivery cue, so the
+        # ingest decode pool must keep this source synchronous
+        self.acks_on_emit = True
         self.host, self.port = host, port
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None

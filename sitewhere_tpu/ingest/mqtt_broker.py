@@ -368,6 +368,10 @@ class MqttBrokerReceiver(Receiver):
         self.topic_filter = topic_filter
         self.broker = MqttBroker(host=host, port=port)
         self.broker.on_publish.append(self._tap)
+        # QoS-1 PUBACK is withheld when the intake tap crashes — the ack
+        # is gated on emit returning, so the ingest decode pool must keep
+        # this source synchronous (see InboundEventSource.decode_pool)
+        self.acks_on_emit = True
 
     @property
     def port(self) -> int:

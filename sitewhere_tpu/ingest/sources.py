@@ -37,6 +37,7 @@ receivers, and the receiver SPI accepts new implementations.
 
 from __future__ import annotations
 
+import collections
 import errno
 import http.server
 import logging
@@ -57,6 +58,167 @@ from sitewhere_tpu.runtime.resilience import Backoff, RetryPolicy, Supervisor
 Decoder = Callable[[bytes], List[DecodedRequest]]
 Forward = Callable[[DecodedRequest, bytes], None]
 FailedDecode = Callable[[bytes, str, Exception], None]
+
+
+class _DecodeJob:
+    __slots__ = ("work", "deliver", "result", "error", "done", "delivering")
+
+    def __init__(self, work, deliver):
+        self.work = work
+        self.deliver = deliver
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.done = False
+        self.delivering = False
+
+
+class DecodePool:
+    """Ordered parallel decode: the host-pipeline stage that lets window
+    N+1's payload decode while window N is on device.
+
+    Payloads submitted under the same ``key`` (the source id — the
+    sharded sequence key) DECODE on any worker concurrently but DELIVER
+    strictly in submission order, so per-device event order and the
+    journal's offset↔row correspondence survive the parallelism.  The
+    per-key lane is a FIFO of jobs; whichever worker completes the lane's
+    head drains every completed head job in order (the ``delivering``
+    flag makes that drain single-threaded per lane without a dedicated
+    delivery thread).
+
+    ``max_pending`` bounds buffered payloads across all lanes —
+    ``submit`` blocks the receiver thread when saturated, which is the
+    backpressure that keeps a fast socket from outrunning the pipeline.
+    """
+
+    def __init__(self, workers: int = 2, max_pending: int = 128,
+                 name: str = "ingest-decode", metrics=None):
+        import queue as _queue
+
+        self.name = name
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._sem = threading.BoundedSemaphore(max_pending)
+        self._lanes: Dict[object, "collections.deque"] = {}
+        self._lock = threading.Lock()
+        self._alive = True
+        self.submitted = 0
+        self.delivered = 0
+        self.delivery_errors = 0
+        if metrics is not None:
+            self._m_depth = metrics.gauge("ingest.decode_pool_depth")
+            self._m_jobs = metrics.counter("ingest.decode_pool_jobs")
+        else:
+            self._m_depth = self._m_jobs = None
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"{name}-{i}")
+            for i in range(max(1, int(workers)))
+        ]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, key, work: Callable[[], object],
+               deliver: Callable[[object, Optional[BaseException]], None],
+               ) -> None:
+        """Queue ``work`` (CPU-only, side-effect free) for parallel
+        execution; ``deliver(result, error)`` runs later, in per-``key``
+        submission order, on a pool thread.  Blocks when the pool's
+        pending budget is exhausted (backpressure)."""
+        if self._alive:
+            self._sem.acquire()
+            # Re-check under the lock: a stop() between the check above
+            # and the enqueue would strand the job behind the worker
+            # sentinels (never executed, permit leaked) — the atomic
+            # check-and-enqueue makes every job land either ahead of the
+            # sentinels or on the synchronous fallback below.
+            with self._lock:
+                queued = self._alive
+                if queued:
+                    job = _DecodeJob(work, deliver)
+                    self._lanes.setdefault(key, collections.deque()).append(job)
+                    self.submitted += 1
+                    self._q.put((key, job))
+            if queued:
+                if self._m_jobs is not None:
+                    self._m_jobs.inc()
+                    self._m_depth.set(self.pending)
+                return
+            self._sem.release()
+        # stopped pool: degrade to synchronous (never drop a payload)
+        try:
+            result = work()
+        except Exception as e:  # noqa: BLE001 — mirrors worker path
+            deliver(None, e)
+            return
+        deliver(result, None)
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return sum(len(lane) for lane in self._lanes.values())
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            key, job = item
+            try:
+                job.result = job.work()
+            except BaseException as e:  # noqa: BLE001 — routed to deliver
+                job.error = e
+            job.done = True
+            self._drain(key)
+
+    def _drain(self, key) -> None:
+        """Deliver completed head jobs of one lane in order; only one
+        thread delivers per lane at a time (the head job it popped is
+        gone before any sibling can see the next head)."""
+        while True:
+            with self._lock:
+                lane = self._lanes.get(key)
+                if not lane or not lane[0].done or lane[0].delivering:
+                    return
+                job = lane[0]
+                job.delivering = True
+            try:
+                job.deliver(job.result, job.error)
+            except BaseException:  # noqa: BLE001 — a deliver that re-raises
+                # a non-Exception (sys.exit in a decoder, a C-extension
+                # signal) must not kill the unsupervised worker thread:
+                # with every worker dead the queue backs up until the
+                # pending semaphore wedges all receiver threads
+                self.delivery_errors += 1
+                logger.exception("decode pool %s: delivery failed",
+                                 self.name)
+            finally:
+                with self._lock:
+                    lane.popleft()
+                    if not lane:
+                        self._lanes.pop(key, None)
+                    self.delivered += 1
+                self._sem.release()
+                if self._m_depth is not None:
+                    self._m_depth.set(self.pending)
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Block until every submitted payload has DELIVERED (tests and
+        shutdown: nothing may reach the pipeline after stop returns)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.pending == 0:
+                return True
+            time.sleep(0.001)
+        return self.pending == 0
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self.flush(timeout_s)
+        with self._lock:  # pairs with submit's check-and-enqueue
+            self._alive = False
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=timeout_s)
+        self._threads = []
 
 
 class InboundEventSource(LifecycleComponent):
@@ -101,6 +263,21 @@ class InboundEventSource(LifecycleComponent):
         # is not applied (wire rows land in the default tenant).
         self.raw_wire = raw_wire
         self.on_wire_payload: Optional[Callable[[bytes, str], int]] = None
+        # Overlapped decode (host-pipeline stage 1): with a DecodePool
+        # attached, the CPU-heavy decode of each payload runs on a pool
+        # worker while earlier windows are on device; the ordered
+        # delivery stage (journal + forward) keeps per-source submission
+        # order.  ``on_wire_decode``/``on_wire_decoded`` are the split
+        # halves of the wire lane (PipelineDispatcher.decode_wire_lines /
+        # ingest_wire_decoded).  The pool is ONLY used when no receiver
+        # gates a broker ack on the emit call returning
+        # (``acks_on_emit``): for those (MQTT broker intake, STOMP
+        # client-individual) an async decode would acknowledge a payload
+        # the journal has not seen yet — a durability regression — so
+        # they keep the synchronous path and their redelivery semantics.
+        self.decode_pool: Optional[DecodePool] = None
+        self.on_wire_decode: Optional[Callable[[bytes], object]] = None
+        self.on_wire_decoded: Optional[Callable[..., int]] = None
         self.decoded_count = 0
         self.failed_count = 0
         self.duplicate_count = 0
@@ -109,18 +286,85 @@ class InboundEventSource(LifecycleComponent):
             r.sink = self.on_encoded_payload
             self.add_child(r)
 
+    def _pool_usable(self) -> bool:
+        """May this source decode asynchronously?  Requires an attached
+        pool, the split wire callables (for the wire lane), and NO
+        ack-gated receiver (see ``decode_pool`` comment above)."""
+        if self.decode_pool is None:
+            return False
+        if any(getattr(r, "acks_on_emit", False) for r in self.receivers):
+            return False
+        if self.raw_wire:
+            return self.on_wire_decode is not None \
+                and self.on_wire_decoded is not None
+        return True
+
     def on_encoded_payload(self, payload: bytes) -> None:
         """Receiver callback (reference ``onEncodedEventReceived:189-199``).
 
         Never lets an exception escape into the transport thread: decode
         failures dead-letter; forward-target failures are logged and
         counted (a broken sink must not kill the receiver).
+
+        With a decode pool attached (and no ack-gated receiver) the
+        CPU-heavy decode stage runs on a pool worker — window N+1
+        decodes while window N is on device — and the forward stage
+        (journal + batch) runs later in per-source submission order.
         """
+        if self._pool_usable():
+            self.decode_pool.submit(
+                self.source_id,
+                lambda: self._decode_stage(payload),
+                lambda result, exc: self._pool_deliver(payload, result, exc),
+            )
+            return
+        try:
+            result = self._decode_stage(payload)
+        except Exception as e:  # noqa: BLE001 — _forward_stage routes it
+            self._forward_stage(payload, None, e)
+            return
+        self._forward_stage(payload, result, None)
+
+    def _decode_stage(self, payload: bytes):
+        """CPU-only decode (pool-worker safe: no shared mutation)."""
+        faults.fire("ingest.decode")
+        if self.raw_wire and self.on_wire_payload is not None:
+            if self.on_wire_decode is not None:
+                return self.on_wire_decode(payload)
+            return None  # unsplit wire sink decodes inside forward
+        return self.decoder(payload)
+
+    def _pool_deliver(self, payload: bytes, decoded,
+                      exc: Optional[BaseException]) -> None:
+        """Pooled delivery: ``_forward_stage``'s re-raise of non-decode
+        failures has no receiver thread to land on here — the pool would
+        log-and-drop it — so the payload dead-letters instead."""
+        try:
+            self._forward_stage(payload, decoded, exc)
+        except BaseException as e:  # noqa: BLE001 — last stop before the
+            # pool; BaseException because _forward_stage re-raises
+            # whatever the decode stage threw
+            self.failed_count += 1
+            if self.on_failed_decode is not None:
+                self.on_failed_decode(payload, self.source_id, e)
+            else:
+                logger.exception(
+                    "pooled forward failed for source %s", self.source_id)
+
+    def _forward_stage(self, payload: bytes, decoded,
+                       exc: Optional[BaseException]) -> None:
+        """Ordered delivery: counters, dead-letters, journal + forward."""
         if self.raw_wire and self.on_wire_payload is not None:
             try:
-                faults.fire("ingest.decode")
-                self.decoded_count += self.on_wire_payload(
-                    payload, self.source_id)
+                if exc is not None:
+                    raise exc
+                if decoded is None:
+                    self.decoded_count += self.on_wire_payload(
+                        payload, self.source_id)
+                else:
+                    columns, host_reqs = decoded
+                    self.decoded_count += self.on_wire_decoded(
+                        payload, columns, host_reqs)
             except DecodeError as e:
                 # same observable failure path as the scalar decoder:
                 # the source's counter ticks and its on_failed_decode
@@ -133,14 +377,19 @@ class InboundEventSource(LifecycleComponent):
                 logger.exception(
                     "raw wire forward failed for source %s", self.source_id)
             return
-        try:
-            faults.fire("ingest.decode")
-            requests = self.decoder(payload)
-        except DecodeError as e:
-            self.failed_count += 1
-            if self.on_failed_decode is not None:
-                self.on_failed_decode(payload, self.source_id, e)
-            return
+        if exc is not None:
+            if isinstance(exc, DecodeError):
+                self.failed_count += 1
+                if self.on_failed_decode is not None:
+                    self.on_failed_decode(payload, self.source_id, exc)
+                return
+            # non-decode crash (an injected fault, a decoder bug):
+            # synchronous callers see it on the receiver thread exactly
+            # as before the split — the receiver's supervisor/broker
+            # redelivery owns it; pooled delivery catches it in
+            # _pool_deliver and dead-letters the payload
+            raise exc
+        requests = decoded
         events: List[DecodedRequest] = []
         for req in requests:
             if self.deduplicator is not None and self.deduplicator.is_duplicate(req):
@@ -577,6 +826,10 @@ class HttpReceiver(Receiver):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  path: str = "/events"):
         super().__init__(name=f"http-receiver:{port}")
+        # the 202 response is an ack gated on _emit returning: the
+        # decode pool must keep this source synchronous or the 202
+        # would precede the journal append (at-least-once)
+        self.acks_on_emit = True
         self.host, self.port, self.path = host, port, path
         self._server: Optional[http.server.ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None

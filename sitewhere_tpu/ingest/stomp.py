@@ -27,6 +27,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from sitewhere_tpu.ingest.sources import Receiver, logger
+from sitewhere_tpu.runtime.resilience import Backoff, RetryPolicy
 
 _ESCAPES = {"\\": "\\\\", "\r": "\\r", "\n": "\\n", ":": "\\c"}
 _UNESCAPES = {"\\\\": "\\", "\\r": "\r", "\\n": "\n", "\\c": ":"}
@@ -170,18 +171,32 @@ class StompReceiver(Receiver):
         self.max_reconnect_delay_s = max_reconnect_delay_s
         self._alive = False
         self._stop_evt = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         self._sock: Optional[socket.socket] = None
         self.connects = 0
         self.acked = 0
         self.emit_errors = 0
+        # Broker-ack semantics: with per-message acks, the ACK is gated
+        # on the sink accepting the payload — the ingest decode pool must
+        # not run this source's decode asynchronously (an async ack would
+        # acknowledge a payload the journal has not seen).
+        self.acks_on_emit = ack != "auto"
+        # reconnect schedule on the shared primitive (was ad-hoc
+        # delay-doubling state)
+        self._backoff = Backoff(
+            RetryPolicy(initial_s=reconnect_delay_s,
+                        max_s=max_reconnect_delay_s),
+            name="ingest.stomp-reconnect")
 
     def start(self) -> None:
         self._alive = True
         self._stop_evt.clear()
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name=self.name)
-        self._thread.start()
+        # Supervised (ROADMAP: remaining-receiver chaos coverage):
+        # transport errors are handled by the reconnect loop itself; the
+        # supervisor catches anything unexpected — a frame-codec bug, an
+        # injected fault escaping the per-message emit guard — and
+        # restarts the whole loop with backoff instead of silently
+        # killing the thread, escalating terminally after max_restarts.
+        self._spawn_supervised(self._loop)
         super().start()
 
     def stop(self) -> None:
@@ -193,9 +208,7 @@ class StompReceiver(Receiver):
                 sock.close()
             except OSError:
                 pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        self._stop_supervisor()
         super().stop()
 
     # -- session ------------------------------------------------------------
@@ -259,12 +272,11 @@ class StompReceiver(Receiver):
         return sock, send_every, expect_every
 
     def _loop(self) -> None:
-        delay = self.reconnect_delay_s
         while self._alive:
             try:
                 self._sock, send_every, expect_every = self._connect()
                 self.connects += 1
-                delay = self.reconnect_delay_s
+                self._backoff.reset()  # connected: fresh schedule
                 self._session(self._sock, send_every, expect_every)
             except (OSError, StompError) as e:
                 if self._alive:
@@ -277,8 +289,7 @@ class StompReceiver(Receiver):
                     except OSError:
                         pass
             if self._alive:
-                self._stop_evt.wait(delay)
-                delay = min(delay * 2, self.max_reconnect_delay_s)
+                self._stop_evt.wait(self._backoff.next_delay())
 
     def _session(self, sock: socket.socket, send_every: float,
                  expect_every: float) -> None:

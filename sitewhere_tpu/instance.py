@@ -227,6 +227,21 @@ class Instance(LifecycleComponent):
         ))
 
         # dispatch
+        # Adaptive emission window (overlapped host pipeline): the
+        # configured deadline is the ANCHOR; the controller shrinks the
+        # window under idle traffic (chasing the <10ms p99 SLO) and grows
+        # it under backlog (chasing full-width batches).  Disable with
+        # pipeline.adaptive_deadline=false for a fixed window.
+        controller = None
+        if bool(self.config.get("pipeline.adaptive_deadline", True)):
+            from sitewhere_tpu.ingest.batcher import AdaptiveBatchController
+
+            controller = AdaptiveBatchController(
+                deadline_ms=float(self.config["pipeline.deadline_ms"]),
+                min_ms=self.config.get("pipeline.deadline_min_ms"),
+                max_ms=self.config.get("pipeline.deadline_max_ms"),
+                metrics=self.metrics,
+            )
         self.batcher = Batcher(
             width=width,
             n_shards=n_shards,
@@ -243,7 +258,21 @@ class Instance(LifecycleComponent):
             # on a mesh, per-call placement scales with buffer count).
             emit_packed=self._packed_step_enabled(),
             metrics=self.metrics,
+            controller=controller,
         )
+        # Decode worker pool (overlapped host pipeline, stage 1): wire
+        # payloads decode on these workers while earlier windows are on
+        # device; per-source lanes keep delivery in submission order.
+        # ingest.decode_workers=0 disables (synchronous decode).
+        from sitewhere_tpu.ingest.sources import DecodePool
+
+        decode_workers = int(self.config.get("ingest.decode_workers", 2))
+        self.decode_pool = (
+            DecodePool(workers=decode_workers,
+                       max_pending=int(self.config.get(
+                           "ingest.decode_max_pending", 128)),
+                       metrics=self.metrics)
+            if decode_workers > 0 else None)
         self.dispatcher = self.add_child(PipelineDispatcher(
             batcher=self.batcher,
             registry_provider=self.mirror.publish_registry,
@@ -259,6 +288,7 @@ class Instance(LifecycleComponent):
             resolve_tenant=self._tenant_dense_id,
             on_host_request=self._on_host_request,
             inflight_depth=int(self.config.get("pipeline.inflight_depth", 0)),
+            egress_offload=self.config.get("pipeline.egress_offload"),
             mesh=self.mesh,
             journal_reader=JournalReader(self.ingest_journal, "pipeline"),
             recovery_decoder=recovery_decoder,
@@ -691,7 +721,15 @@ class Instance(LifecycleComponent):
                 source.on_wire_payload = (
                     lambda p, sid: self.dispatcher.ingest_wire_lines(
                         p, sid, raise_on_decode_error=True))
+                # split halves for the decode pool: decode on a worker,
+                # journal+batch in per-source order
+                source.on_wire_decode = self.dispatcher.decode_wire_lines
+                source.on_wire_decoded = self.dispatcher.ingest_wire_decoded
             source.on_registration = self.dispatcher.ingest_registration
+        if self.decode_pool is not None and hasattr(source, "decode_pool"):
+            # overlapped decode; the source itself keeps ack-gated
+            # receivers (broker redelivery semantics) synchronous
+            source.decode_pool = self.decode_pool
         source.on_failed_decode = self.dispatcher.ingest_failed_decode
         if getattr(source, "on_host_request", None) is None \
                 and self.forwarder is None:
@@ -823,6 +861,20 @@ class Instance(LifecycleComponent):
             logger.info("recovered %d journaled events on start", replayed)
 
     def stop(self) -> None:
+        # Stop the receivers, THEN drain the decode pool: a payload a
+        # still-running receiver accepts after the flush would otherwise
+        # deliver concurrently with (or after) the dispatcher's shutdown
+        # flush below.  super().stop() skips the already-stopped sources.
+        if self.decode_pool is not None:
+            from sitewhere_tpu.runtime.lifecycle import LifecycleState
+
+            for src in self.sources:
+                if src.state == LifecycleState.STARTED:
+                    try:
+                        src.stop()
+                    except Exception:  # keep stopping, like super().stop()
+                        logger.exception("error stopping %s", src.name)
+            self.decode_pool.flush()
         super().stop()  # dispatcher stop flushes + commits the offset
         # Final snapshot AFTER the flush so the checkpoint captures the
         # last committed state (components are stopped but data is live).
@@ -830,6 +882,11 @@ class Instance(LifecycleComponent):
 
     def terminate(self) -> None:
         super().terminate()
+        if self.decode_pool is not None:
+            # release the pool's worker threads (tests build many
+            # instances; daemons would pile up)
+            self.decode_pool.stop(timeout_s=2.0)
+            self.decode_pool = None
         if self._peer_demuxes:
             # the Config can outlive this Instance: a stale listener
             # would hold the whole graph and resurrect closed channels

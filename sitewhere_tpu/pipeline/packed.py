@@ -248,6 +248,69 @@ def packed_presence_sweep(ps: PackedState, now_s, missing_after_s):
 
 # -- host side --------------------------------------------------------------
 
+# Capability probes (cached tristate): older jax.Array builds lack
+# copy_to_host_async, and on the CPU backend device_put staging is a
+# plain memcpy with no transfer to overlap — both degrade to synchronous
+# behavior instead of failing (satellite: CPU backend and older JAX keep
+# working).
+_ASYNC_HOST_COPY: Optional[bool] = None
+_BATCH_STAGING: Optional[bool] = None
+
+
+def supports_async_host_copy() -> bool:
+    """Once-probed: do device arrays expose ``copy_to_host_async``?"""
+    global _ASYNC_HOST_COPY
+    if _ASYNC_HOST_COPY is None:
+        try:
+            probe = jnp.zeros(1, jnp.int32)
+            _ASYNC_HOST_COPY = hasattr(probe, "copy_to_host_async")
+        except Exception:  # no backend at all — stay synchronous
+            _ASYNC_HOST_COPY = False
+    return _ASYNC_HOST_COPY
+
+
+def start_host_copy(*arrays) -> None:
+    """Kick off async device→host copies (no-op without the capability):
+    by the time egress blocks on ``np.asarray`` the bytes are host-side."""
+    if not supports_async_host_copy():
+        return
+    for dev in arrays:
+        try:
+            dev.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            return  # deleted/donated buffer or committed host array
+
+
+def supports_batch_staging() -> bool:
+    """Once-probed: is ahead-of-step ``device_put`` staging a win?  Only
+    off the CPU backend — there device_put is a synchronous memcpy, so
+    staging would add a copy without overlapping anything."""
+    global _BATCH_STAGING
+    if _BATCH_STAGING is None:
+        try:
+            _BATCH_STAGING = jax.default_backend() != "cpu" \
+                and supports_async_host_copy()
+        except Exception:
+            _BATCH_STAGING = False
+    return _BATCH_STAGING
+
+
+def stage_packed_batch(bi: np.ndarray, bf: np.ndarray,
+                       force: bool = False):
+    """Start the H2D transfer of one packed batch ahead of its step (the
+    double-buffer front half): ``device_put`` returns immediately with
+    arrays whose transfer proceeds asynchronously, so staging plan N+1
+    while plan N computes overlaps the copy with the step.  Returns None
+    when staging is unsupported (sync fallback: the jitted call moves the
+    numpy buffers itself, exactly the pre-staging behavior)."""
+    if not (force or supports_batch_staging()):
+        return None
+    try:
+        return jax.device_put(bi), jax.device_put(bf)
+    except Exception:  # backend refused — fall back to sync transfer
+        return None
+
+
 def pack_batch_host(cols: Dict[str, np.ndarray],
                     width: int) -> Tuple[np.ndarray, np.ndarray]:
     """Numpy columns → ([12, B] int32, [4, B] float32), one memcpy each."""
@@ -353,7 +416,8 @@ __all__ = [
     "PackedTables", "PackedState", "PackedView",
     "pack_tables", "unpack_tables", "pack_state", "unpack_state",
     "unpack_batch", "pack_outputs", "packed_pipeline_step",
-    "pack_batch_host",
+    "pack_batch_host", "stage_packed_batch", "start_host_copy",
+    "supports_async_host_copy", "supports_batch_staging",
     "F_ACCEPTED", "F_UNREGISTERED", "F_UNASSIGNED", "F_DERIVED",
     "BATCH_I", "BATCH_F", "OUT_I", "PRESENCE_ROW",
 ]

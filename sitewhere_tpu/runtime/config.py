@@ -54,7 +54,18 @@ DEFAULTS: Dict[str, Any] = {
         "mtype_slots": 8,
         "deadline_ms": 5.0,
         "n_shards": 1,
+        # overlapped host pipeline (README "Performance"): adaptive
+        # emission window around deadline_ms, and egress fan-out on a
+        # supervised offload worker instead of the dispatch thread.
+        # egress_offload null = backend-adaptive: on for accelerator
+        # backends (egress fetches release the GIL, overlap is real),
+        # off on CPU (the GIL serializes the stages anyway)
+        "adaptive_deadline": True,
+        "egress_offload": None,
     },
+    # decode worker pool: wire payloads decode off the receiver/dispatch
+    # threads (per-source lanes keep delivery ordered); 0 = synchronous
+    "ingest": {"decode_workers": 2, "decode_max_pending": 128},
     # prune_after_checkpoint reclaims journal segments below the
     # pipeline's committed offset after each snapshot (everything under
     # it is re-derivable from checkpoint + event store)

@@ -1,6 +1,7 @@
 """Batcher: routing, deadline, carry-over, and end-to-end-with-pipeline tests."""
 
 import numpy as np
+import pytest
 
 from sitewhere_tpu.ids import NULL_ID
 from sitewhere_tpu.ingest.batcher import Batcher
@@ -255,6 +256,128 @@ def test_staging_chunk_carryover_does_not_resurrect_rows():
     assert rest.n_events == 1
     assert np.asarray(rest.batch.value)[0] == float(seg)
     assert b.pending == 0 and b.flush() is None
+
+
+def test_add_arrays_reuses_fill_templates_without_allocation():
+    """Satellite fix: omitted columns must not allocate a full column per
+    call — they are 0-stride broadcast views of the shared templates."""
+    b = Batcher(
+        width=8, n_shards=1, registry_capacity=CAP,
+        resolve_device=lambda t: NULL_ID, resolve_mtype=lambda n: 0,
+        resolve_alert=lambda n: 0, deadline_ms=5.0, clock=FakeClock())
+    b.add_arrays(_copy=False, device_id=np.array([0, 1, 2], np.int32))
+    chunk = b._pending[0][0]
+    fill = chunk.cols["value"]
+    assert fill.strides == (0,)          # broadcast view, not np.full
+    assert not fill.flags.writeable
+    # emission still materializes correct fill values into the batch
+    plan = b.flush()
+    assert plan.host_cols["value"][:3].tolist() == [0.0, 0.0, 0.0]
+    assert plan.host_cols["payload_ref"][:3].tolist() == [NULL_ID] * 3
+
+
+def test_add_arrays_no_copy_fast_path_for_typed_inputs():
+    """_copy=False + already-typed arrays: queued columns ARE the caller
+    arrays (zero copies on the internal hot path)."""
+    b = Batcher(
+        width=8, n_shards=1, registry_capacity=CAP,
+        resolve_device=lambda t: NULL_ID, resolve_mtype=lambda n: 0,
+        resolve_alert=lambda n: 0, deadline_ms=5.0, clock=FakeClock())
+    val = np.array([1.0, 2.0, 3.0], np.float32)
+    b.add_arrays(_copy=False, device_id=np.array([0, 1, 2], np.int32),
+                 value=val)
+    assert b._pending[0][0].cols["value"] is val
+
+
+# -- adaptive batch-width controller ----------------------------------------
+
+def make_adaptive(deadline_ms=5.0, **kw):
+    from sitewhere_tpu.ingest.batcher import AdaptiveBatchController
+
+    return AdaptiveBatchController(deadline_ms=deadline_ms, **kw)
+
+
+def test_adaptive_shrinks_under_idle_and_grows_under_backlog():
+    """Acceptance: deterministic (fake-clock) shrink-under-idle and
+    grow-under-backlog, driven through the batcher itself."""
+    clock = FakeClock()
+    ctl = make_adaptive(deadline_ms=5.0, min_ms=1.25, max_ms=40.0)
+    devices = {f"d{i}": i for i in range(CAP)}
+    b = make_batcher(devices=devices, clock=clock)
+    b.controller = ctl
+    base = 0.005
+    assert b.deadline_s == base
+
+    # idle: single low-fill rows emitted on deadline → window shrinks
+    clock.t = 0.0
+    b.add(meas("d0"), tenant_id=0, payload_ref=0)
+    clock.t = base + 0.001
+    assert b.poll() is not None
+    assert ctl.shrinks == 1
+    assert b.deadline_s == pytest.approx(base * 0.75)
+
+    # keep idling: monotonically down to the floor, never below
+    for i in range(20):
+        b.add(meas("d0"), tenant_id=0, payload_ref=0)
+        clock.t += 1.0
+        assert b.poll() is not None
+    assert b.deadline_s == pytest.approx(0.00125)
+
+    # backlog: segment-fill emissions → window grows toward the cap
+    seg = WIDTH // N_SHARDS
+    grows_before = ctl.grows
+    for _ in range(40):
+        plans = b.add_arrays(device_id=np.zeros(seg, np.int32))
+        assert plans  # shard 0's segment filled → pressure signal
+    assert ctl.grows > grows_before
+    assert b.deadline_s == pytest.approx(0.040)
+
+    # decision counts: 5 shrinks reach the floor (0.75^5), 9 grows reach
+    # the cap (1.5^9) — saturated emits are not counted as decisions
+    assert ctl.shrinks == 5 and ctl.grows == 9
+
+
+def test_deadline_setter_writes_through_to_controller():
+    ctl = make_adaptive(deadline_ms=5.0, min_ms=1.25, max_ms=40.0)
+    b = make_batcher()
+    b.controller = ctl
+    # an explicit set re-anchors the adaptive window (clamped)
+    b.deadline_s = 0.010
+    assert b.deadline_s == pytest.approx(0.010)
+    b.deadline_s = 0.0001  # below the floor: clamps, never silently lost
+    assert b.deadline_s == pytest.approx(0.00125)
+
+
+def test_adaptive_flush_and_moderate_fill_do_not_adapt():
+    clock = FakeClock()
+    ctl = make_adaptive(deadline_ms=5.0)
+    devices = {f"d{i}": i for i in range(CAP)}
+    b = make_batcher(devices=devices, clock=clock)
+    b.controller = ctl
+    # flush emits never adapt (shutdown artifacts)
+    b.add(meas("d0"), tenant_id=0, payload_ref=0)
+    assert b.flush() is not None
+    assert ctl.grows == ctl.shrinks == 0
+    # deadline emit at moderate fill (above low_fill): window holds
+    for i in range(8):  # 8 of 16 = 50% fill, spread across shards
+        b.add(meas(f"d{i * 8 % CAP}"), tenant_id=0, payload_ref=0)
+    clock.t += 1.0
+    assert b.poll() is not None
+    assert ctl.shrinks == 0 and ctl.grows == 0
+    assert b.deadline_s == 0.005
+
+
+def test_adaptive_exports_decisions_to_metrics():
+    from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+    m = MetricsRegistry()
+    ctl = make_adaptive(deadline_ms=5.0, metrics=m)
+    ctl.on_emit(1, 16, 0, "deadline")     # idle → shrink
+    ctl.on_emit(16, 16, 32, "fill")       # backlog → grow
+    snap = m.snapshot()
+    assert snap["counters"]["ingest.adaptive_shrink"] == 1
+    assert snap["counters"]["ingest.adaptive_grow"] == 1
+    assert snap["gauges"]["ingest.adaptive_window_s"] == ctl.window_s
 
 
 def test_add_arrays_single_shard_copies_caller_arrays():

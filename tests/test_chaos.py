@@ -237,6 +237,42 @@ class TestReceiverRecovery:
         finally:
             rx.stop()
 
+    def test_stomp_emit_crash_leaves_message_unacked_for_redelivery(self):
+        """STOMP slice of the remaining-receiver chaos coverage: the
+        receiver loop now runs supervised, and an ``ingest.emit`` crash
+        stays message-local — the MESSAGE is left UNACKED (the broker's
+        redelivery cue, at-least-once) without restarting the session
+        loop, and the redelivered copy lands and acks."""
+        from sitewhere_tpu.ingest.stomp import StompReceiver
+
+        from test_stomp_http import MiniBroker
+
+        broker = MiniBroker()
+        got = []
+        rx = StompReceiver("127.0.0.1", broker.port,
+                           destination="/queue/q", heartbeat_ms=0,
+                           reconnect_delay_s=0.05)
+        rx.sink = got.append
+        rx.start()
+        try:
+            assert _wait(lambda: broker.subscribes)
+            # supervised loop (ROADMAP open item, STOMP slice)
+            assert rx.supervisor is not None and rx.supervisor.alive
+            assert rx.acks_on_emit  # client-individual gates ACK on emit
+            faults.inject("ingest.emit", times=1)
+            broker.push("m-1", b"ev-1")
+            assert _wait(lambda: rx.emit_errors == 1)
+            assert broker.acks == []           # crashed intake: no ACK
+            assert got == []
+            assert rx.supervisor.restarts == 0  # crash was message-local
+            # broker-side at-least-once: redelivery lands and acks
+            broker.push("m-1", b"ev-1")
+            assert _wait(lambda: got == [b"ev-1"])
+            assert _wait(lambda: broker.acks == ["m-1"])
+        finally:
+            rx.stop()
+            broker.close()
+
     def test_mqtt_qos1_intake_crash_loses_no_events(self):
         """The acceptance proof: a crashed intake withholds the PUBACK,
         the device redelivers, and the event lands exactly as published —
@@ -473,13 +509,14 @@ class TestEventStoreFlushChaos:
 # dispatcher: fail closed, replay on restart (at-least-once)
 # ---------------------------------------------------------------------------
 
-def _instance_config(tmp_path):
+def _instance_config(tmp_path, **pipeline):
     from sitewhere_tpu.runtime.config import Config
 
     return Config({
         "instance": {"id": "chaos-inst", "data_dir": str(tmp_path / "data")},
         "pipeline": {"width": 64, "registry_capacity": 128,
-                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1,
+                     **pipeline},
         "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
     }, apply_env=False)
 
@@ -499,6 +536,60 @@ def _measurement_line(token, value, event_date):
 
 
 class TestDispatcherChaos:
+    def test_egress_worker_killed_mid_window_then_replays(self, tmp_path):
+        """Acceptance (overlapped host pipeline): the egress fault kills
+        the OFFLOAD WORKER mid-window; its supervisor restarts the loop,
+        but the dead plan never completes — the journal offset is never
+        committed past it, and replay after 'restart' recovers the rows
+        exactly once (at-least-once under offloaded egress)."""
+        from sitewhere_tpu.instance import Instance
+
+        # offload is backend-adaptive (off on CPU) — force it on so the
+        # fault lands on the supervised worker, not the inline fallback
+        inst = Instance(_instance_config(tmp_path, egress_offload=True))
+        inst.start()
+        try:
+            _seed_device(inst)
+            payload = _measurement_line("d-0", 7.0, 1_753_800_000).encode()
+            faults.inject("dispatcher.egress", times=1)
+            inst.dispatcher.ingest_wire_lines(payload)
+            # the offloaded egress took the plan and died on it
+            assert _wait(lambda: faults.fired("dispatcher.egress") == 1)
+            assert _wait(lambda: inst.dispatcher._egress_super.restarts >= 1)
+            assert not inst.dispatcher._egress_super.escalated
+            # journaled, but the dead plan keeps the commit gate closed
+            assert inst.ingest_journal.end_offset == 1
+            inst.dispatcher.flush(timeout_s=0.05)
+            assert inst.dispatcher.journal_reader.committed == 0
+            inst.event_store.flush()
+            assert inst.event_store.total_events == 0
+
+            # the restarted worker still serves its siblings (the dead
+            # plan keeps the outstanding gate >0, so bound the flush)
+            payload2 = _measurement_line("d-0", 8.0, 1_753_800_001).encode()
+            inst.dispatcher.ingest_wire_lines(payload2)
+            assert _wait(lambda: inst.dispatcher.totals["accepted"] == 1)
+            inst.dispatcher.flush(timeout_s=0.5)
+            inst.event_store.flush()
+            assert inst.event_store.total_events == 1
+            # ...but the offset STILL must not move past the dead plan
+            assert inst.dispatcher.journal_reader.committed == 0
+
+            # "restart": the crash loses the in-memory outstanding count;
+            # replay re-ingests from the committed offset.  Both records
+            # replay (at-least-once re-delivers the sibling too: same
+            # semantics as a Kafka consumer rewound to its offset).
+            with inst.dispatcher._lock:
+                inst.dispatcher._plans_outstanding = 0
+            replayed = inst.dispatcher.replay_journal()
+            assert replayed == 2
+            inst.event_store.flush()
+            assert inst.event_store.total_events == 3
+            assert inst.dispatcher.journal_reader.committed == 2
+        finally:
+            inst.stop()
+            inst.terminate()
+
     def test_step_fault_fails_closed_then_replays(self, tmp_path):
         from sitewhere_tpu.instance import Instance
 

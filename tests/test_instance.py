@@ -312,6 +312,10 @@ class TestBatchIngest:
         }).encode()
         before = instance.ingest_journal.end_offset
         src.on_encoded_payload(payload)
+        # intake is asynchronous with the decode pool attached: drain it
+        # before flushing so the forward (journal + batch) has happened
+        if instance.decode_pool is not None:
+            assert instance.decode_pool.flush()
         instance.dispatcher.flush()
         assert instance.ingest_journal.end_offset == before + 1
         snap = instance.dispatcher.metrics_snapshot()
