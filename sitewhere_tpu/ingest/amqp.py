@@ -33,6 +33,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
 from sitewhere_tpu.ingest.sources import Receiver, logger
+from sitewhere_tpu.runtime.overload import OverloadShed
 
 PROTOCOL_HEADER = b"AMQP\x00\x00\x09\x01"
 
@@ -171,7 +172,6 @@ class AmqpReceiver(Receiver):
         self.max_reconnect_delay_s = max_reconnect_delay_s
         self._alive = False
         self._stop_evt = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         self._sock: Optional[socket.socket] = None
         self.connects = 0
         self.acked = 0
@@ -182,6 +182,9 @@ class AmqpReceiver(Receiver):
         # head → instant redelivery to this sole consumer) degrades to a
         # slow retry loop, not a CPU-burning redeliver/nack spin
         self._nack_streak = 0
+        # same pacing for overload sheds (tracked separately: a shed is
+        # backpressure, not a fault — no error counters, no logs)
+        self._shed_streak = 0
         # Frames parsed past the one a handshake step awaited (the broker
         # may coalesce e.g. consume-ok + the first deliver into one TCP
         # segment); _consume drains these before its first recv.
@@ -192,9 +195,14 @@ class AmqpReceiver(Receiver):
     def start(self) -> None:
         self._alive = True
         self._stop_evt.clear()
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name=self.name)
-        self._thread.start()
+        # Supervised (ROADMAP: remaining-receiver chaos coverage):
+        # transport errors are handled by the reconnect loop itself;
+        # the supervisor catches anything unexpected — a frame-codec
+        # bug (struct.error/IndexError from a malformed frame), an
+        # injected fault escaping the per-delivery guard — and restarts
+        # the whole loop with backoff instead of silently killing the
+        # consumer thread, escalating terminally after max_restarts.
+        self._spawn_supervised(self._loop)
         super().start()
 
     def stop(self) -> None:
@@ -206,9 +214,7 @@ class AmqpReceiver(Receiver):
                 sock.close()
             except OSError:
                 pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        self._stop_supervisor()
         super().stop()
 
     # -- session -------------------------------------------------------------
@@ -405,9 +411,29 @@ class AmqpReceiver(Receiver):
         ``prefetch`` such failures, stall the consumer forever on an
         otherwise-healthy session.  Consecutive failures back off
         (50 ms doubling to 1 s) before the nack, because the broker
-        redelivers a requeued message to this sole consumer immediately."""
+        redelivers a requeued message to this sole consumer immediately.
+
+        An admission SHED is different from a failure but takes the
+        same wire action, separately paced and counted: an escalating
+        pause, then ``basic.nack`` with requeue.  Leaving the delivery
+        unacked instead would eat the prefetch window on a
+        heartbeat-healthy session that never recycles — after
+        ``prefetch`` sheds the broker stops delivering and the consumer
+        is wedged FOREVER, even after overload clears (the exact stall
+        documented above).  The pre-nack pause is the backpressure; the
+        requeued message redelivers (at-least-once) and lands once
+        admission reopens."""
         try:
             self._emit(payload)
+        except OverloadShed as e:
+            self._shed_streak += 1
+            delay = min(max(0.05, e.retry_after_s / 16)
+                        * (2 ** min(self._shed_streak - 1, 6)), 1.0)
+            self._stop_evt.wait(delay)
+            sock.sendall(method_frame(
+                self.CHANNEL, BASIC_NACK,
+                struct.pack(">QB", delivery_tag, 0x02)))
+            return time.monotonic()
         except Exception:
             self.emit_errors += 1
             self.nacked += 1
@@ -422,6 +448,7 @@ class AmqpReceiver(Receiver):
                 struct.pack(">QB", delivery_tag, 0x02)))
             return time.monotonic()
         self._nack_streak = 0
+        self._shed_streak = 0
         sock.sendall(method_frame(
             self.CHANNEL, BASIC_ACK,
             struct.pack(">QB", delivery_tag, 0)))

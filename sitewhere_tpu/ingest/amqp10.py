@@ -43,6 +43,7 @@ import uuid as _uuid
 from typing import Dict, List, Optional, Tuple
 
 from sitewhere_tpu.ingest.sources import Receiver, logger
+from sitewhere_tpu.runtime.overload import OverloadShed
 
 AMQP_HEADER = b"AMQP\x00\x01\x00\x00"
 SASL_HEADER = b"AMQP\x03\x01\x00\x00"
@@ -371,7 +372,6 @@ class EventHubReceiver(Receiver):
         self.max_reconnect_delay_s = max_reconnect_delay_s
         self._alive = False
         self._stop_evt = threading.Event()
-        self._threads: List[threading.Thread] = []
         self._socks: Dict[int, socket.socket] = {}
         self.connects = 0
         self.accepted = 0
@@ -416,11 +416,16 @@ class EventHubReceiver(Receiver):
     def start(self) -> None:
         self._alive = True
         self._stop_evt.clear()
+        # One supervisor per partition (ROADMAP: remaining-receiver
+        # chaos coverage): the reconnect loop handles transport errors
+        # itself; the supervisor catches anything unexpected — a codec
+        # bug, an injected fault escaping the per-delivery guard — and
+        # restarts THAT partition's loop with backoff, escalating
+        # terminally after max_restarts.  Partitions fail independently.
         for p in range(self.partitions):
-            t = threading.Thread(target=self._partition_loop, args=(p,),
-                                 daemon=True, name=f"{self.name}[{p}]")
-            self._threads.append(t)
-            t.start()
+            self._spawn_supervised(
+                lambda p=p: self._partition_loop(p),
+                name=f"{self.name}[{p}]")
         super().start()
 
     def stop(self) -> None:
@@ -431,9 +436,7 @@ class EventHubReceiver(Receiver):
                 sock.close()
             except OSError:
                 pass
-        for t in self._threads:
-            t.join(timeout=5)
-        self._threads = []
+        self._stop_supervisor()
         if self._ckpt_dirty:
             try:
                 self._save_offsets()
@@ -715,6 +718,13 @@ class EventHubReceiver(Receiver):
         body, annotations = parse_message(message)
         try:
             self._emit(body)
+        except OverloadShed:
+            # admission shed: leave the delivery UNSETTLED, do NOT
+            # checkpoint, and recycle the link — the broker redelivers
+            # every unsettled message on detach (at-least-once), and
+            # the partition loop's reconnect backoff IS the pause
+            # overload wants from this source
+            raise Amqp10Error("intake shed; recycling link for redelivery")
         except Exception:
             # The sink journals before returning; a failure here is a
             # local fault — leave the delivery unsettled so the broker

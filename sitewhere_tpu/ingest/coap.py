@@ -21,11 +21,11 @@ import dataclasses
 import logging
 import socket
 import struct
-import threading
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
 from sitewhere_tpu.ingest.sources import Receiver
+from sitewhere_tpu.runtime.overload import OverloadShed
 
 logger = logging.getLogger("sitewhere_tpu.ingest.coap")
 
@@ -38,9 +38,21 @@ CHANGED_204 = (2 << 5) | 4       # 2.04 Changed
 CREATED_201 = (2 << 5) | 1       # 2.01 Created
 BAD_REQUEST_400 = (4 << 5) | 0   # 4.00
 NOT_ALLOWED_405 = (4 << 5) | 5   # 4.05
+UNAVAILABLE_503 = (5 << 5) | 3   # 5.03 Service Unavailable
 
 OPT_URI_PATH = 11
 OPT_CONTENT_FORMAT = 12
+OPT_MAX_AGE = 14
+
+
+def _uint_option(value: int) -> bytes:
+    """Encode a CoAP uint option value (§3.2: minimal big-endian)."""
+    value = max(0, int(value))
+    out = b""
+    while value:
+        out = bytes([value & 0xFF]) + out
+        value >>= 8
+    return out
 
 
 class CoapError(Exception):
@@ -180,23 +192,28 @@ class CoapServerReceiver(Receiver):
         self.acks_on_emit = True
         self.host, self.port = host, port
         self._sock: Optional[socket.socket] = None
-        self._thread: Optional[threading.Thread] = None
         self._alive = False
         self.bad_messages = 0
         self.duplicates = 0
+        self.emit_errors = 0
         # (addr, message_id) → cached reply bytes (None for NON, §4.5:
         # the dup is silently ignored when there is nothing to retransmit)
         self._seen: "OrderedDict[tuple, Optional[bytes]]" = OrderedDict()
 
+    def _bind(self) -> None:
+        if self._sock is None:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._sock.bind((self.host, self.port))
+            self.port = self._sock.getsockname()[1]
+
     def start(self) -> None:
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self._sock.bind((self.host, self.port))
-        self.port = self._sock.getsockname()[1]
+        self._bind()
         self._alive = True
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name=self.name
-        )
-        self._thread.start()
+        # Supervised (ROADMAP: remaining-receiver chaos coverage): an
+        # unexpected socket death restarts the loop with backoff and
+        # rebinds the SAME port (datagrams sent during the backoff sit
+        # in the kernel buffer); repeated failures escalate terminally.
+        self._spawn_supervised(self._run)
         super().start()
 
     def stop(self) -> None:
@@ -204,15 +221,29 @@ class CoapServerReceiver(Receiver):
         if self._sock is not None:
             self._sock.close()
             self._sock = None
+        self._stop_supervisor()
         super().stop()
 
-    def _loop(self) -> None:
-        sock = self._sock  # stop() clears the attribute; loop owns a ref
+    def _run(self) -> None:
+        self._bind()   # restart after a crash that closed the socket
         while self._alive:
+            sock = self._sock
+            if sock is None:
+                return   # stop() tore the socket down mid-iteration
             try:
                 data, addr = sock.recvfrom(65536)
             except OSError:
-                return
+                if not self._alive:
+                    return   # clean shutdown closed the socket
+                # release the port before the supervised restart rebinds
+                # it (same contract as UdpReceiver._run)
+                sock, self._sock = self._sock, None
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                raise        # unexpected socket death → supervisor restarts
             if not data:
                 continue
             try:
@@ -222,13 +253,21 @@ class CoapServerReceiver(Receiver):
                 reply = self._rst_for(data)
                 logger.debug("bad CoAP datagram from %s: %s", addr, e)
             except Exception:
+                # sink/emit crash: datagram-local, like a TCP
+                # connection-local crash — NO reply goes out and the
+                # (addr, mid) is NOT cached, so the client's CON
+                # retransmission re-emits the payload (CoAP's
+                # redelivery semantics); the server loop keeps serving
+                self.emit_errors += 1
                 logger.exception("CoAP handler failed")
                 continue
             if reply is not None:
                 try:
                     sock.sendto(reply, addr)
                 except OSError:
-                    return
+                    if not self._alive:
+                        return
+                    raise
 
     def _handle(self, data: bytes, addr) -> Optional[bytes]:
         msg = parse_message(data)
@@ -241,10 +280,19 @@ class CoapServerReceiver(Receiver):
             self.duplicates += 1
             self._seen.move_to_end(key)
             return self._seen[key]
+        options: List[Tuple[int, bytes]] = []
         if msg.code in (POST, PUT):
             if msg.payload:
-                self._emit(msg.payload)
-                code = CHANGED_204
+                try:
+                    self._emit(msg.payload)
+                    code = CHANGED_204
+                except OverloadShed as e:
+                    # CoAP-native backpressure (§5.9.3.4): 5.03 with
+                    # Max-Age as the retry hint — the constrained
+                    # client backs off instead of retransmitting hot
+                    code = UNAVAILABLE_503
+                    options.append((OPT_MAX_AGE, _uint_option(
+                        max(1, int(round(e.retry_after_s))))))
             else:
                 code = BAD_REQUEST_400
         elif msg.code in (GET, DELETE):
@@ -256,7 +304,7 @@ class CoapServerReceiver(Receiver):
         if msg.mtype == CON:
             reply = encode_message(CoapMessage(
                 mtype=ACK, code=code, message_id=msg.message_id,
-                token=msg.token,
+                token=msg.token, options=options,
             ))
         self._seen[key] = reply
         while len(self._seen) > self.DEDUP_CAPACITY:

@@ -24,6 +24,7 @@ import json
 import os
 import struct
 import threading
+import time
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
@@ -61,6 +62,9 @@ class Journal:
         self.index_every = max(1, index_every)
         self._lock = threading.Lock()
         self._unsynced = 0
+        # duration of the most recent fsync — an overload pressure
+        # signal (a saturated disk shows up here before queues fill)
+        self.last_fsync_s = 0.0
         # Offset index: (offset, segment path, byte pos) every
         # index_every records, so scans seek instead of replaying segments.
         self._index: List[Tuple[int, str, int]] = []
@@ -184,7 +188,9 @@ class Journal:
             self._unsynced += 1
             if self.fsync_every == 0 or self._unsynced >= self.fsync_every:
                 self._file.flush()
+                t0 = time.perf_counter()
                 os.fsync(self._file.fileno())
+                self.last_fsync_s = time.perf_counter() - t0
                 self._unsynced = 0
             if self._file.tell() >= self.segment_bytes:
                 self._rotate()
@@ -208,7 +214,9 @@ class Journal:
     def flush(self) -> None:
         with self._lock:
             self._file.flush()
+            t0 = time.perf_counter()
             os.fsync(self._file.fileno())
+            self.last_fsync_s = time.perf_counter() - t0
             self._unsynced = 0
 
     def close(self) -> None:

@@ -31,8 +31,11 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
+
+from sitewhere_tpu.runtime.overload import OverloadShed
 
 from sitewhere_tpu.ingest.mqtt import (
     CONNACK,
@@ -121,6 +124,10 @@ class MqttBroker:
         self.published = 0
         self.delivered = 0
         self.tap_failures = 0
+        self.sheds = 0
+        # cap on the per-shed read pause so a long Retry-After hint can
+        # never freeze a session past its keepalive grace
+        self.max_shed_pause_s = 0.25
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -274,6 +281,16 @@ class MqttBroker:
         for tap in self.on_publish:
             try:
                 tap(topic, payload)
+            except OverloadShed as e:
+                # MQTT-native backpressure: withhold the PUBACK (the
+                # device's unacked QoS-1 publish is its redelivery cue)
+                # and PAUSE reading this session briefly — the TCP
+                # receive window fills behind the paused read, slowing
+                # the publisher at the socket layer.  The session stays
+                # up: shedding is flow control, not a fault.
+                self.sheds += 1
+                time.sleep(min(e.retry_after_s, self.max_shed_pause_s))
+                return
             except Exception as e:
                 # At-least-once REQUIRES withholding the PUBACK when the
                 # tap (the platform's intake) failed: dropping the

@@ -53,6 +53,7 @@ logger = logging.getLogger("sitewhere_tpu.ingest")
 from sitewhere_tpu.ingest.decoders import DecodedRequest, DecodeError, RequestKind
 from sitewhere_tpu.runtime import faults
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.overload import OverloadShed
 from sitewhere_tpu.runtime.resilience import Backoff, RetryPolicy, Supervisor
 
 Decoder = Callable[[bytes], List[DecodedRequest]]
@@ -95,6 +96,7 @@ class DecodePool:
         import queue as _queue
 
         self.name = name
+        self.max_pending = int(max_pending)   # overload signal denominator
         self._q: "_queue.Queue" = _queue.Queue()
         self._sem = threading.BoundedSemaphore(max_pending)
         self._lanes: Dict[object, "collections.deque"] = {}
@@ -281,6 +283,7 @@ class InboundEventSource(LifecycleComponent):
         self.decoded_count = 0
         self.failed_count = 0
         self.duplicate_count = 0
+        self.shed_count = 0
         self.dropped_host_requests = 0
         for r in receivers:
             r.sink = self.on_encoded_payload
@@ -341,6 +344,11 @@ class InboundEventSource(LifecycleComponent):
         log-and-drop it — so the payload dead-letters instead."""
         try:
             self._forward_stage(payload, decoded, exc)
+        except OverloadShed:
+            # already counted + dead-lettered at the admission edge; the
+            # pooled sources (UDP/TCP/WS) have no ack channel to signal
+            # backpressure on, so the shed ends here
+            return
         except BaseException as e:  # noqa: BLE001 — last stop before the
             # pool; BaseException because _forward_stage re-raises
             # whatever the decode stage threw
@@ -363,8 +371,18 @@ class InboundEventSource(LifecycleComponent):
                         payload, self.source_id)
                 else:
                     columns, host_reqs = decoded
+                    # source_id rides along so overload admission
+                    # buckets + intake-shed audit records attribute to
+                    # THIS source, not a shared "wire" bucket
                     self.decoded_count += self.on_wire_decoded(
-                        payload, columns, host_reqs)
+                        payload, columns, host_reqs,
+                        source_id=self.source_id)
+            except OverloadShed:
+                # admission refused the payload: counted here, then
+                # re-raised so the RECEIVER signals protocol-native
+                # backpressure (429 / 5.03 / withheld PUBACK / unacked)
+                self.shed_count += 1
+                raise
             except DecodeError as e:
                 # same observable failure path as the scalar decoder:
                 # the source's counter ticks and its on_failed_decode
@@ -391,6 +409,8 @@ class InboundEventSource(LifecycleComponent):
             raise exc
         requests = decoded
         events: List[DecodedRequest] = []
+        forwarded = 0
+        last_shed: Optional[OverloadShed] = None
         for req in requests:
             if self.deduplicator is not None and self.deduplicator.is_duplicate(req):
                 self.duplicate_count += 1
@@ -400,17 +420,25 @@ class InboundEventSource(LifecycleComponent):
                 if req.kind == RequestKind.REGISTRATION:
                     if self.on_registration is not None:
                         self.on_registration(req, payload)
+                    forwarded += 1
                 elif req.event_type is None:
                     # Host-plane requests (stream data, mappings): never
                     # into the tensor batcher.
                     if self.on_host_request is not None:
                         self.on_host_request(req, payload)
+                        forwarded += 1
                     else:
                         self.dropped_host_requests += 1
                 elif self.on_events is not None:
                     events.append(req)  # forwarded in one batch below
                 elif self.on_event is not None:
                     self.on_event(req, payload)
+                    forwarded += 1
+            except OverloadShed as e:
+                # this request was refused by admission (counted + dead-
+                # lettered there); siblings keep forwarding
+                self.shed_count += 1
+                last_shed = e
             except Exception:
                 self.failed_count += 1
                 logger.exception(
@@ -420,11 +448,21 @@ class InboundEventSource(LifecycleComponent):
         if events:
             try:
                 self.on_events(events, payload)
+                forwarded += len(events)
+            except OverloadShed as e:
+                # ingest_many raises only when EVERY row was shed —
+                # partial sheds are absorbed inside it
+                self.shed_count += 1
+                last_shed = e
             except Exception:
                 self.failed_count += 1
                 logger.exception(
                     "batch forward failed for source %s", self.source_id,
                 )
+        if last_shed is not None and forwarded == 0:
+            # the whole payload was shed: the receiver owns the
+            # protocol-native backpressure signal
+            raise last_shed
 
 
 class Receiver(LifecycleComponent):
@@ -445,23 +483,42 @@ class Receiver(LifecycleComponent):
         super().__init__(name=name)
         self.sink: Optional[Callable[[bytes], None]] = None
         self.received_count = 0
+        self.sheds = 0
         self.restart_policy = RetryPolicy(initial_s=0.05, max_s=5.0)
         self.max_restarts = 8
         self.supervisor: Optional[Supervisor] = None
+        # multi-loop receivers (EventHub partitions) supervise several
+        # threads; `supervisor` stays the LAST spawned for back-compat
+        self.supervisors: List[Supervisor] = []
 
     def _emit(self, payload: bytes) -> None:
         faults.fire("ingest.emit")
         self.received_count += 1
-        if self.sink is not None:
+        if self.sink is None:
+            return
+        try:
             self.sink(payload)
+        except OverloadShed:
+            # admission refused the payload.  Ack-gated transports
+            # (HTTP 202, CoAP ACK, QoS-1 PUBACK, STOMP/AMQP acks) see
+            # the raise and answer with their native backpressure
+            # signal; ack-less transports (UDP, TCP framing, WS, REST
+            # poll) have nothing to signal on — the shed was counted +
+            # dead-lettered at the admission edge, so it must NOT fall
+            # into their supervisors as a crash.
+            self.sheds += 1
+            if getattr(self, "acks_on_emit", False):
+                raise
 
-    def _spawn_supervised(self, run: Callable[[], None]) -> Supervisor:
+    def _spawn_supervised(self, run: Callable[[], None],
+                          name: Optional[str] = None) -> Supervisor:
         """Run ``run`` on a supervised thread; escalation marks this
         component failed (the operator-visible terminal state)."""
         self.supervisor = Supervisor(
-            self.name, run, policy=self.restart_policy,
+            name or self.name, run, policy=self.restart_policy,
             max_restarts=self.max_restarts, min_uptime_s=5.0,
             on_escalate=self._on_escalate)
+        self.supervisors.append(self.supervisor)
         self.supervisor.start()
         return self.supervisor
 
@@ -470,9 +527,10 @@ class Receiver(LifecycleComponent):
         self._fail(exc)
 
     def _stop_supervisor(self) -> None:
-        if self.supervisor is not None:
-            self.supervisor.stop()
-            self.supervisor = None
+        for sup in self.supervisors:
+            sup.stop()
+        self.supervisors = []
+        self.supervisor = None
 
 
 def length_prefixed_frames(conn: socket.socket, emit: Callable[[bytes], None]) -> None:
@@ -844,7 +902,18 @@ class HttpReceiver(Receiver):
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
-                receiver._emit(body)
+                try:
+                    receiver._emit(body)
+                except OverloadShed as e:
+                    # HTTP-native backpressure: the client owns the
+                    # retry (shed ≠ silent drop — the payload was also
+                    # dead-lettered at the admission edge)
+                    self.send_response(429)
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, int(round(e.retry_after_s)))))
+                    self.end_headers()
+                    return
                 self.send_response(202)
                 self.end_headers()
 

@@ -27,6 +27,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from sitewhere_tpu.ingest.sources import Receiver, logger
+from sitewhere_tpu.runtime.overload import OverloadShed
 from sitewhere_tpu.runtime.resilience import Backoff, RetryPolicy
 
 _ESCAPES = {"\\": "\\\\", "\r": "\\r", "\n": "\\n", ":": "\\c"}
@@ -315,6 +316,13 @@ class StompReceiver(Receiver):
                     if body:
                         try:
                             self._emit(body)
+                        except OverloadShed:
+                            # STOMP-native backpressure: leave the
+                            # MESSAGE unacked — the broker redelivers
+                            # once the subscription recovers (shed ≠
+                            # loss; the payload is also dead-lettered
+                            # at the admission edge for audit/replay)
+                            delivered = False
                         except Exception:
                             # a poison message must not kill the receiver
                             # thread; leaving it unacked makes the broker

@@ -189,6 +189,40 @@ class Instance(LifecycleComponent):
 
         self.scripts = ScriptManager(self.data_dir)
 
+        # Overload control (runtime/overload.py): a watermark-driven
+        # state machine over signals the pipeline already exports.  The
+        # dispatcher ticks it every loop cycle; admission at ingest and
+        # the degradation ladder (labels, analytics/search endpoints,
+        # non-priority outbound fan-out) hang off its state.  Journal
+        # append + seal + checkpoint are NEVER gated by it.
+        self.overload = None
+        if bool(self.config.get("overload.enabled", True)):
+            from sitewhere_tpu.runtime.overload import (
+                OverloadController,
+                Watermarks,
+            )
+
+            self.overload = OverloadController(
+                watermarks=Watermarks().replace(
+                    self.config.get("overload.watermarks") or {}),
+                cooldown_s=float(self.config.get("overload.cooldown_s", 2.0)),
+                hysteresis=float(self.config.get("overload.hysteresis", 0.7)),
+                confirm_samples=int(self.config.get(
+                    "overload.confirm_samples", 2)),
+                sample_interval_s=float(self.config.get(
+                    "overload.sample_interval_s", 0.1)),
+                retry_after_s=float(self.config.get(
+                    "overload.retry_after_s", 1.0)),
+                degraded_telemetry_rate_per_s=float(self.config.get(
+                    "overload.degraded_telemetry_rate_per_s", 10_000.0)),
+                degraded_telemetry_burst=float(self.config.get(
+                    "overload.degraded_telemetry_burst", 20_000.0)),
+                signals_fn=self._overload_signals,
+                metrics=self.metrics,
+                tracer=self.tracer,
+            )
+            self.labels.load_gate = self.overload.allow_optional
+
         # domain services the dispatcher egresses into — registered as
         # children BEFORE it so the reverse-order stop keeps them alive
         # through the dispatcher's shutdown flush
@@ -217,7 +251,8 @@ class Instance(LifecycleComponent):
             tenant_ids=self.identity,
         ))
         self.outbound = self.add_child(
-            OutboundConnectorsManager(metrics=self.metrics))
+            OutboundConnectorsManager(metrics=self.metrics,
+                                      overload=self.overload))
         self.registration = self.add_child(RegistrationManager(
             self.device_management,
             default_device_type=self.config.get("registration.default_device_type"),
@@ -294,6 +329,7 @@ class Instance(LifecycleComponent):
             recovery_decoder=recovery_decoder,
             tracer=self.tracer,
             metrics=self.metrics,
+            overload=self.overload,
         ))
         self.presence = self.add_child(PresenceManager(
             self.device_state,
@@ -551,6 +587,31 @@ class Instance(LifecycleComponent):
 
         env = packed_env_override()
         return True if env is None else env
+
+    def _overload_signals(self):
+        """One sample of the pressure signals the overload controller
+        watches — all of them gauges/counters the system already
+        exports, read lock-free (a slightly stale read only delays a
+        transition by one sample)."""
+        from sitewhere_tpu.runtime.overload import OverloadSignals
+
+        d = self.dispatcher
+        pool = self.decode_pool
+        decode_backlog = (pool.pending / pool.max_pending
+                          if pool is not None and pool.max_pending else 0.0)
+        # ingest→seal lag comes from the LIVE watermark (age of the
+        # oldest unsealed event), not the last-value seal gauge — the
+        # gauge pins historical spikes (a jit compile's 3s seal) for as
+        # long as anything is busy, which would read as sustained
+        # overload; the live measure self-decays as work seals.
+        return OverloadSignals(
+            seal_lag_s=d.oldest_unsealed_wait_s(),
+            decode_backlog=decode_backlog,
+            egress_inflight=(len(d._inflight)
+                             / max(1, d.egress_queue_depth)),
+            batcher_backlog=self.batcher.pending / max(1, self.batcher.width),
+            fsync_latency_s=float(self.ingest_journal.last_fsync_s),
+        )
 
     def _tenant_dense_id(self, token: str) -> int:
         return self.identity.tenant.mint(token)
@@ -920,6 +981,8 @@ class Instance(LifecycleComponent):
                 if k.startswith("resilience.")
             },
         }
+        if self.overload is not None:
+            topo["overload"] = self.overload.snapshot()
         if self.forwarder is not None:
             topo["forwarding"] = self.forwarder.metrics()
         return topo
@@ -1002,6 +1065,10 @@ class Instance(LifecycleComponent):
         - ``unregistered``: re-read each referenced ingest-journal
           payload and re-ingest — after the operator registered the
           device manually, the rows now validate.
+        - ``intake-shed``: re-ingest a payload that overload admission
+          refused (the audit/replay half of the shedding contract) —
+          admission applies again, so a requeue during a STILL-overloaded
+          window is refused, not silently re-shed.
         - ``undelivered-command``: re-invoke the command against its
           target assignment.
         Requeue granularity is the PAYLOAD (at-least-once): a multi-device
@@ -1028,8 +1095,8 @@ class Instance(LifecycleComponent):
                     "reason": "record was already requeued"}
         # same default the dispatcher's crash recovery uses
         decoder = self.dispatcher.recovery_decoder or JsonLinesDecoder()
-        if kind in ("failed-decode", "failed-stream-request") \
-                and "payload" in doc:
+        if kind in ("failed-decode", "failed-stream-request",
+                    "intake-shed") and "payload" in doc:
             payload = bytes.fromhex(doc["payload"])
             try:
                 reqs = decoder(payload)
@@ -1043,9 +1110,18 @@ class Instance(LifecycleComponent):
                         "reason": "decode failed again: no rows decoded"}
             from sitewhere_tpu.ingest.decoders import RequestKind
 
+            from sitewhere_tpu.runtime.overload import OverloadShed
+
             events = [r for r in reqs if r.event_type is not None]
             if events:
-                self.dispatcher.ingest_many(events, payload)
+                try:
+                    self.dispatcher.ingest_many(events, payload,
+                                                source_id="requeue")
+                except OverloadShed as e:
+                    # still overloaded: the record stays un-requeued so
+                    # the operator can retry after recovery
+                    return {"requeued": False, "kind": kind,
+                            "reason": f"refused by admission: {e}"}
             rows = len(events)
             for r in reqs:
                 if r.event_type is not None:

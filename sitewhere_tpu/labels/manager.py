@@ -73,12 +73,26 @@ class LabelGeneratorManager(LifecycleComponent):
     def __init__(self, generators: Optional[List[LabelGenerator]] = None):
         super().__init__("label-generation")
         self._generators: Dict[str, LabelGenerator] = {}
+        # Degradation ladder (runtime/overload.py): when wired, label
+        # rendering — optional, CPU-bound work — refuses with 503 from
+        # DEGRADED up so its cycles go to the event path instead.
+        self.load_gate = None   # Callable[[str], bool] | None
+        self.refused_under_load = 0
         for gen in generators or [LabelGenerator("default", "Default QR")]:
             self.register(gen)
 
     def register(self, generator: LabelGenerator) -> LabelGenerator:
         self._generators[generator.generator_id] = generator
         return generator
+
+    def _check_capacity(self) -> None:
+        if self.load_gate is not None and not self.load_gate("labels"):
+            from sitewhere_tpu.services.common import ServiceUnavailable
+
+            self.refused_under_load += 1
+            raise ServiceUnavailable(
+                "label generation is switched off while the instance "
+                "is overloaded; retry after it recovers")
 
     def get_generator(self, generator_id: str) -> LabelGenerator:
         gen = self._generators.get(generator_id)
@@ -89,12 +103,14 @@ class LabelGeneratorManager(LifecycleComponent):
         return list(self._generators.values())
 
     def generate_matrix(self, generator_id: str, kind: str, token: str) -> np.ndarray:
+        self._check_capacity()
         gen = self.get_generator(generator_id)
         return qr.encode(gen.url_for(kind, token), level=gen.ec_level)
 
     def generate_png(self, generator_id: str, kind: str, token: str) -> bytes:
         """Entity label as PNG bytes — the REST/gRPC payload of the reference
         (``service-label-generation/.../grpc/LabelGenerationImpl.java``)."""
+        self._check_capacity()
         gen = self.get_generator(generator_id)
         matrix = self.generate_matrix(generator_id, kind, token)
         return png.write_png(render_modules(matrix, gen.scale, gen.border))
@@ -102,6 +118,7 @@ class LabelGeneratorManager(LifecycleComponent):
     def generate_png_batch(self, generator_id: str, kind: str,
                            tokens: Sequence[str]) -> List[bytes]:
         """Batch label run: encode each token, render all in one upscale."""
+        self._check_capacity()
         gen = self.get_generator(generator_id)
         payloads = [gen.url_for(kind, t) for t in tokens]
         version = max(

@@ -118,12 +118,17 @@ class OutboundConnector(LifecycleComponent):
 
     def __init__(self, connector_id: str, filters=None,
                  breaker: Optional[CircuitBreaker] = None,
-                 dead_letters=None):
+                 dead_letters=None, priority: bool = False):
         super().__init__(f"connector-{connector_id}")
         self.connector_id = connector_id
         self.filters = list(filters or [])
         self.breaker = breaker
         self.dead_letters = dead_letters
+        # Overload ladder contract: priority connectors (alert
+        # notifiers, command bridges) keep receiving batches in
+        # SHEDDING/EMERGENCY; non-priority fan-out (search indexers,
+        # bulk exporters, analytics taps) sheds first.
+        self.priority = bool(priority)
         self._lock = threading.Lock()
         self.processed = 0
         self.errors = 0

@@ -40,10 +40,15 @@ class OutboundConnectorsManager(LifecycleComponent):
     """Owns the connector set; dispatches batches to per-connector queues."""
 
     def __init__(self, connectors: Optional[List[OutboundConnector]] = None,
-                 queue_depth: int = 64, metrics=None):
+                 queue_depth: int = 64, metrics=None, overload=None):
         super().__init__("outbound-connectors")
         self.queue_depth = queue_depth
         self.metrics = metrics
+        # degradation ladder (runtime/overload.py): from SHEDDING up,
+        # batches are offered only to PRIORITY connectors (alert
+        # notifiers, command bridges); bulk fan-out (search indexers,
+        # file sinks, analytics taps) sheds and is counted per worker
+        self.overload = overload
         self._workers: Dict[str, "_Worker"] = {}
         for c in connectors or []:
             self.add_connector(c)
@@ -77,6 +82,13 @@ class OutboundConnectorsManager(LifecycleComponent):
         item = (cols, mask, trace or _NOOP_TRACE, ingest_t0,
                 time.monotonic())
         for worker in self._workers.values():
+            if (self.overload is not None
+                    and not self.overload.allow_fanout(
+                        getattr(worker.connector, "priority", False))):
+                worker.overload_shed += 1
+                if worker._m_shed is not None:
+                    worker._m_shed.inc()
+                continue
             worker.offer(item)
 
     def drain(self, timeout: float = 10.0) -> None:
@@ -102,6 +114,7 @@ class _Worker:
         self.connector = connector
         self.q: "queue.Queue" = queue.Queue(maxsize=depth)
         self.dropped = 0
+        self.overload_shed = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         if metrics is not None:
@@ -113,9 +126,11 @@ class _Worker:
             self._m_e2e = metrics.gauge(
                 f"pipeline.ingest_to_outbound_ack_latency_s.{cid}")
             self._m_dropped = metrics.counter("outbound.batches_dropped")
+            self._m_shed = metrics.counter(
+                f"outbound.overload_shed.{cid}")
         else:
             self._m_depth = self._m_ack = self._m_e2e = None
-            self._m_dropped = None
+            self._m_dropped = self._m_shed = None
 
     def start(self) -> None:
         if self._thread is not None:
@@ -147,13 +162,19 @@ class _Worker:
             self._m_depth.set(self.q.qsize())
 
     def drain(self, timeout: float) -> None:
-        import time
-
         # unfinished_tasks only reaches 0 after task_done() — i.e. after the
         # in-flight batch has fully processed, not merely been dequeued.
+        # Wait on the queue's all_tasks_done condition (what Queue.join
+        # waits on) instead of polling: task_done() notifies it, so the
+        # drain wakes exactly when work completes and the deadline is
+        # honored precisely, with zero CPU burned in between.
         deadline = time.monotonic() + timeout
-        while self.q.unfinished_tasks and time.monotonic() < deadline:
-            time.sleep(0.005)
+        with self.q.all_tasks_done:
+            while self.q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self.q.all_tasks_done.wait(remaining)
 
     def _loop(self) -> None:
         while not self._stop.is_set():

@@ -515,6 +515,16 @@ class HostForwarder(LifecycleComponent):
                             attempt + 1, self.max_retries, e)
                 time.sleep(min(0.1 * (2 ** attempt), 2.0))
             except RpcError as e:
+                if getattr(e, "error", "") == "overloaded":
+                    # the owner SHED the rows (admission backpressure):
+                    # retryable exactly like an unreachable peer — the
+                    # spool rewinds and redelivers once it recovers,
+                    # never a dead-letter for rows the owner will take
+                    logger.info("forward to %d shed by overload "
+                                "(%d/%d)", owner, attempt + 1,
+                                self.max_retries)
+                    time.sleep(min(0.1 * (2 ** attempt), 2.0))
+                    continue
                 self._dead_letter(owner, payload, f"peer rejected: {e}")
                 return True
         return False

@@ -24,6 +24,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from sitewhere_tpu.rpc import wire
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.overload import OverloadShed
 from sitewhere_tpu.services.common import (
     AuthError,
     DuplicateToken,
@@ -37,6 +38,9 @@ from sitewhere_tpu.services.common import (
 logger = logging.getLogger("sitewhere_tpu.rpc")
 
 _ERROR_CODES = (
+    # an overloaded host's admission refusal is RETRYABLE backpressure
+    # for the forwarding peer (its spool redelivers), never "internal"
+    (OverloadShed, "overloaded"),
     (EntityNotFound, "not_found"),
     (DuplicateToken, "duplicate"),
     (InvalidReference, "invalid_reference"),

@@ -74,6 +74,27 @@ DEFAULTS: Dict[str, Any] = {
     # events.retention_s: event-time retention window for the columnar
     # store, enforced chunk-at-a-time (0 = keep forever)
     "events": {"retention_s": 0, "resident_bytes": 256 << 20},
+    # overload control (runtime/overload.py): watermark-driven state
+    # machine (NORMAL→DEGRADED→SHEDDING→EMERGENCY) over the exported
+    # pressure signals, with priority-class admission at ingest and a
+    # degradation ladder downstream.  "watermarks" overrides per-signal
+    # [degraded, shedding, emergency] enter thresholds, e.g.
+    # {"batcher_backlog": [1.0, 4.0, 16.0]}.  retry_after_s seeds the
+    # 429 Retry-After / CoAP Max-Age hint (scaled by severity).
+    "overload": {
+        "enabled": True,
+        "cooldown_s": 2.0,
+        "hysteresis": 0.7,
+        # a watermark must hold for confirm_samples consecutive samples
+        # before escalation — one slow plan pinning a last-value gauge
+        # is a spike, not sustained overload
+        "confirm_samples": 2,
+        "sample_interval_s": 0.1,
+        "retry_after_s": 1.0,
+        "degraded_telemetry_rate_per_s": 10_000.0,
+        "degraded_telemetry_burst": 20_000.0,
+        "watermarks": {},
+    },
     "presence": {"scan_interval_s": 600.0, "missing_after_s": 8 * 3600.0},
     "api": {"host": "127.0.0.1", "port": 8080, "jwt_ttl_s": 3600},
     "metrics": {"report_interval_s": 20.0},

@@ -113,6 +113,7 @@ class PipelineDispatcher(LifecycleComponent):
         tracer=None,
         metrics=None,
         egress_offload: Optional[bool] = None,
+        overload=None,
         name: str = "pipeline-dispatcher",
     ):
         super().__init__(name)
@@ -130,6 +131,14 @@ class PipelineDispatcher(LifecycleComponent):
         self.resolve_tenant = resolve_tenant or (lambda token: 0)
         # host-plane requests (device streams) decoded off the wire path
         self.on_host_request = on_host_request
+        # Overload admission gate (runtime/overload.py): the LIVE intake
+        # edges (ingest / ingest_many / ingest_wire_decoded) consult it
+        # BEFORE journaling; shed rows dead-letter (kind "intake-shed")
+        # and a fully-shed payload raises OverloadShed so the receiving
+        # transport signals protocol-native backpressure.  Recovery
+        # paths (journal replay, derived re-injection, ingest_arrays)
+        # deliberately bypass it — already-journaled work is never shed.
+        self.overload = overload
         self.max_replay_depth = max_replay_depth
         # No donation of `state`: DeviceStateManager.commit's sweep-merge
         # and concurrent readers still reference the previous epoch.
@@ -341,8 +350,56 @@ class PipelineDispatcher(LifecycleComponent):
 
             plan.staged = stage_packed_batch(plan.packed_i, plan.packed_f)
 
-    def ingest(self, req: DecodedRequest, payload: bytes = b"") -> None:
+    def _shed_intake(self, payload: bytes, shed: Dict[object, int],
+                     source_id: str, tenant: str) -> None:
+        """Audit one intake shed: dead-letter the payload with reason +
+        per-class counts (kind ``intake-shed``) so shedding is
+        inspectable AND replayable (``requeue_dead_letter`` re-drives it
+        like a failed decode once the overload clears)."""
+        dead_letter(self.dead_letters, {
+            "kind": "intake-shed",
+            "state": self.overload.state.name,
+            "reason": self.overload.last_driver or "admission",
+            "classes": {cls.name.lower(): int(n)
+                        for cls, n in shed.items()},
+            "source": source_id,
+            "tenant": tenant,
+            "payload": payload.hex(),
+        })
+
+    def _admit_requests(self, reqs: List[DecodedRequest], payload: bytes,
+                        source_id: str) -> List[DecodedRequest]:
+        """Admission-filter a decoded request list.  Returns the admitted
+        subset; sheds are dead-lettered once per payload.  Raises
+        :class:`OverloadShed` when NOTHING was admitted — the caller's
+        transport turns that into native backpressure."""
+        from sitewhere_tpu.runtime.overload import classify_event_type
+
+        admitted: List[DecodedRequest] = []
+        shed: Dict[object, int] = {}
+        worst = None
+        for req in reqs:
+            cls = classify_event_type(int(req.event_type))
+            tenant = (req.metadata.get("tenant", "default")
+                      if req.metadata else "default")
+            if self.overload.admit(cls, tenant=tenant, source=source_id):
+                admitted.append(req)
+            else:
+                shed[cls] = shed.get(cls, 0) + 1
+                worst = cls
+        if shed:
+            tenant = (reqs[0].metadata.get("tenant", "default")
+                      if reqs[0].metadata else "default")
+            self._shed_intake(payload, shed, source_id, tenant)
+        if not admitted and shed:
+            raise self.overload.shed_exception(worst)
+        return admitted
+
+    def ingest(self, req: DecodedRequest, payload: bytes = b"",
+               source_id: str = "ingest") -> None:
         """Queue one decoded request (journal it first: at-least-once)."""
+        if self.overload is not None and req.event_type is not None:
+            req = self._admit_requests([req], payload, source_id)[0]
         ref = NULL_ID
         if self.journal is not None and payload:
             ref = self.journal.append(payload)
@@ -353,7 +410,8 @@ class PipelineDispatcher(LifecycleComponent):
                                      payload_ref=ref)))
 
     def ingest_many(self, reqs: List[DecodedRequest],
-                    payload: bytes = b"") -> None:
+                    payload: bytes = b"",
+                    source_id: str = "ingest") -> None:
         """Columnar intake of one wire payload's decoded events (the
         batch-decoder fast path): one resolution pass, no per-row
         dataclass churn, and the payload journals ONCE — every row shares
@@ -368,6 +426,13 @@ class PipelineDispatcher(LifecycleComponent):
                 raise ValueError(
                     f"{r.kind.name} is a host-plane request, not a pipeline event"
                 )
+        if self.overload is not None:
+            # admission before the journal append: shed rows are dead-
+            # lettered (replayable), never journaled — a fully shed
+            # payload raises so the transport signals backpressure
+            reqs = self._admit_requests(reqs, payload, source_id)
+            if not reqs:
+                return
         ref = NULL_ID
         if self.journal is not None and payload:
             ref = self.journal.append(payload)
@@ -417,7 +482,8 @@ class PipelineDispatcher(LifecycleComponent):
                 raise
             self.ingest_failed_decode(payload, source_id, e)
             return 0
-        return self.ingest_wire_decoded(payload, columns, host_reqs)
+        return self.ingest_wire_decoded(payload, columns, host_reqs,
+                                        source_id=source_id)
 
     def decode_wire_lines(self, payload: bytes):
         """The pure DECODE stage of :meth:`ingest_wire_lines` — no
@@ -433,13 +499,82 @@ class PipelineDispatcher(LifecycleComponent):
             return decode_json_lines(
                 payload, device_space=space_of(self.batcher.resolve_device))
 
+    def _admit_columns(self, columns, payload: bytes, source_id: str):
+        """Admission-filter one decoded wire-column dict (vectorized:
+        one fancy-index classifies every row, one bucket take per class
+        per payload).  Returns ``(admitted_columns, shed_classes)`` —
+        columns may be the input unchanged, or None for zero admitted
+        rows; dead-letters sheds; raising is the CALLER's decision
+        (host-plane lines may still make the payload partially
+        useful)."""
+        from sitewhere_tpu.ingest.columnar import n_rows
+        from sitewhere_tpu.runtime.overload import (
+            CLASS_OF_EVENT_TYPE,
+            PriorityClass,
+        )
+
+        n = n_rows(columns)
+        if n == 0:
+            return columns, {}
+        et = np.asarray(columns["event_type"])
+        class_of = np.fromiter(
+            (int(c) for c in CLASS_OF_EVENT_TYPE), np.int32,
+            len(CLASS_OF_EVENT_TYPE))
+        # out-of-range types (STATE_CHANGE, future kinds) classify as
+        # COMMAND — same default as classify_event_type; a bare clip
+        # would alias them onto the last slot (COMMAND_RESPONSE →
+        # CRITICAL) and exempt them from shedding entirely
+        in_range = (et >= 0) & (et < len(class_of))
+        classes = np.where(
+            in_range, class_of[np.clip(et, 0, len(class_of) - 1)],
+            np.int32(int(PriorityClass.COMMAND)))
+        keep = np.ones(n, bool)
+        shed: Dict[object, int] = {}
+        for cls in (PriorityClass.TELEMETRY, PriorityClass.COMMAND):
+            m = classes == int(cls)
+            count = int(m.sum())
+            if count and not self.overload.admit(
+                    cls, source=source_id, n=count):
+                keep &= ~m
+                shed[cls] = count
+        if not shed:
+            return columns, shed
+        self._shed_intake(payload, shed, source_id, "default")
+        if not keep.any():
+            return None, shed
+        # decoded columns mix ndarrays (event_type, ts, values) and
+        # python lists (device_token, mtype, alert_type) — filter every
+        # length-n sequence, pass scalars/None through untouched
+        rows = np.nonzero(keep)[0]
+
+        def _filter(value):
+            if isinstance(value, np.ndarray) and value.ndim >= 1 \
+                    and len(value) == n:
+                return value[keep]
+            if isinstance(value, (list, tuple)) and len(value) == n:
+                return [value[i] for i in rows]
+            return value
+
+        return ({key: _filter(value) for key, value in columns.items()},
+                shed)
+
     def ingest_wire_decoded(self, payload: bytes, columns,
-                            host_reqs) -> int:
+                            host_reqs, source_id: str = "wire") -> int:
         """The ordered INGEST tail of :meth:`ingest_wire_lines`: journal
         once, route host-plane lines, resolve + batch the event rows.
         Must run in per-source submission order (the decode pool's
         delivery contract) so per-device event order and the journal's
         offset↔row correspondence are preserved."""
+        if self.overload is not None:
+            columns, shed = self._admit_columns(columns, payload, source_id)
+            if columns is None:
+                if host_reqs:
+                    columns = {}   # host-plane lines still route below
+                else:
+                    # the WHOLE payload was shed: native backpressure,
+                    # attributed to the most-privileged class refused
+                    raise self.overload.shed_exception(
+                        min(shed, key=int))
         # Decode validated the payload — journal once (at-least-once).
         ref = NULL_ID
         if self.journal is not None and payload:
@@ -462,6 +597,8 @@ class PipelineDispatcher(LifecycleComponent):
                     "device_token": req.device_token,
                     "payload_ref": int(ref),
                 })
+        if not columns:
+            return 0   # every event row was shed; host-plane lines routed
         return self._ingest_resolved_columns(columns, ref)
 
     def _ingest_resolved_columns(self, columns, ref: int) -> int:
@@ -543,6 +680,10 @@ class PipelineDispatcher(LifecycleComponent):
         # the loop thread at sub-millisecond cadence
         while not self._stop.wait(max(self.batcher.deadline_s / 2, 0.002)):
             try:
+                if self.overload is not None:
+                    # sample the pressure signals + run the overload
+                    # state machine (rate-limited inside tick)
+                    self.overload.tick()
                 # Backpressure: with the in-flight window full, a deadline
                 # tick would emit a PARTIAL plan behind `depth` queued
                 # steps — it gains no latency and fragments the width.
@@ -1175,6 +1316,34 @@ class PipelineDispatcher(LifecycleComponent):
         self._run_plans(self._take(
             lambda: self.batcher.add_arrays(_copy=False, **cols)),
             replay_depth)
+
+    def oldest_unsealed_wait_s(self) -> float:
+        """LIVE ingest→seal watermark: age of the oldest event admitted
+        but not yet through egress — the overload controller's lag
+        signal.  The last-value seal gauge can't serve here: one slow
+        plan (a jit compile) pins it at a historical spike for as long
+        as anything is busy, reading as sustained overload when the
+        system is actually healthy.  This measure self-decays: work
+        seals, the wait disappears.  Lock-free reads (a torn read only
+        skews one sample)."""
+        if self.steps == 0:
+            # warm-up gate: before the FIRST step completes, rows wait
+            # on the jit compile (seconds), which is boot cost — not
+            # overload.  Compiles are shape-cached after this; the
+            # other signals (backlog fractions) still guard a wedged
+            # boot.
+            return 0.0
+        now = time.monotonic()
+        wait = 0.0
+        oldest = self.batcher._oldest
+        if oldest is not None and self.batcher.pending > 0:
+            wait = now - oldest
+        try:
+            plan = self._inflight[0][0]
+            wait = max(wait, now - plan.created_at + plan.max_wait_s)
+        except IndexError:
+            pass
+        return max(0.0, wait)
 
     def metrics_snapshot(self) -> Dict[str, object]:
         with self._lock:

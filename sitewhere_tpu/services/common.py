@@ -50,6 +50,13 @@ class ForbiddenError(ServiceError):
     http_status = 403
 
 
+class ServiceUnavailable(ServiceError):
+    """Optional work refused under overload (degradation ladder) —
+    clients should back off and retry once the instance recovers."""
+
+    http_status = 503
+
+
 @dataclasses.dataclass(frozen=True)
 class SearchCriteria:
     """Page + optional time-range criteria.

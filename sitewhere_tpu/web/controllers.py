@@ -73,6 +73,19 @@ def register_routes(gw: RestGateway, inst) -> None:
     r = gw.router.add
     dm = inst.device_management
 
+    def _optional_capacity(feature: str) -> None:
+        """Degradation-ladder gate (runtime/overload.py): optional
+        read-side work — analytics, external search — answers 503 from
+        DEGRADED up so its cycles go to the event path.  The durable
+        core (ingest, event queries, management) is never gated."""
+        from sitewhere_tpu.services.common import ServiceUnavailable
+
+        ov = getattr(inst, "overload", None)
+        require(ov is None or ov.allow_optional(feature),
+                ServiceUnavailable(
+                    f"{feature} is switched off while the instance is "
+                    "overloaded; retry after it recovers"))
+
     # ---- auth (reference JwtService; unauthenticated route) ---------------
     def issue_jwt(req: Request):
         body = req.json()
@@ -332,6 +345,7 @@ def register_routes(gw: RestGateway, inst) -> None:
     def chart_series(q: Request):
         from sitewhere_tpu.analytics.charts import build_chart_series
 
+        _optional_capacity("analytics")
         a = dm.get_device_assignment(q.params["token"])
         aid = dm.handle_for("assignment", a.token)
         # repeated params AND comma-separated lists accepted
@@ -639,6 +653,7 @@ def register_routes(gw: RestGateway, inst) -> None:
 
     # ---- external search providers (service-event-search analog) ----------
     def external_search(q: Request):
+        _optional_capacity("search")
         mgr = getattr(inst, "search_providers", None)
         require(mgr is not None, EntityNotFound("no search providers configured"))
         provider = mgr.get_provider(q.params["provider"])

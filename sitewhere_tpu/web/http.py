@@ -323,6 +323,17 @@ class RestGateway:
         except MethodNotAllowed:
             self._send(h, 405, {"error": "method not allowed"})
             return
+        except Exception as e:
+            # an admission refusal escaping a write-side handler (e.g.
+            # a command invocation during EMERGENCY) is backpressure,
+            # not a server bug: 503, never an opaque 500
+            from sitewhere_tpu.runtime.overload import OverloadShed
+
+            if isinstance(e, OverloadShed):
+                self._send(h, 503, {"error": str(e),
+                                    "retryAfterSeconds": e.retry_after_s})
+                return
+            raise
 
         if isinstance(result, RawResponse):
             self._send_raw(h, result)

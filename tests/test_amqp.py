@@ -106,9 +106,15 @@ class MiniAmqpBroker:
                 except OSError:
                     pass
 
-    def _recv_method(self, conn, reader, want):
+    def _recv_method(self, conn, reader, want, pending):
+        # frames coalesced into one recv AFTER the awaited method stay
+        # on ``pending`` for the next call — returning mid-batch used to
+        # DROP them (e.g. connection.open right behind tune-ok when the
+        # client's sends coalesce in the kernel), wedging the handshake
+        # until both sides timed out: the historical flake in this file
         while True:
-            for ftype, channel, payload in reader.feed(conn.recv(65536)):
+            while pending:
+                ftype, channel, payload = pending.pop(0)
                 if ftype != FRAME_METHOD:
                     continue
                 cm = struct.unpack_from(">HH", payload, 0)
@@ -119,10 +125,12 @@ class MiniAmqpBroker:
                     self.acks.append(tag)
                     continue
                 raise AmqpError(f"mini-broker: unexpected {cm}")
+            pending.extend(reader.feed(conn.recv(65536)))
 
     def _session(self, conn):
         conn.settimeout(10)
         reader = FrameReader()
+        pending = []
         hdr = b""
         while len(hdr) < 8:
             hdr += conn.recv(8 - len(hdr))
@@ -130,7 +138,8 @@ class MiniAmqpBroker:
         conn.sendall(method_frame(0, CONNECTION_START, struct.pack(
             ">BB", 0, 9) + field_table({}) + longstr(b"PLAIN")
             + longstr(b"en_US")))
-        _, args = self._recv_method(conn, reader, CONNECTION_START_OK)
+        _, args = self._recv_method(conn, reader, CONNECTION_START_OK,
+                                    pending)
         # client-properties table, then mechanism + response
         tbl_len = struct.unpack_from(">I", args, 0)[0]
         off = 4 + tbl_len
@@ -139,19 +148,19 @@ class MiniAmqpBroker:
         self.auth = (mech, args[off + 4: off + 4 + resp_len])
         conn.sendall(method_frame(0, CONNECTION_TUNE, struct.pack(
             ">HIH", 2047, 131072, self.heartbeat)))
-        self._recv_method(conn, reader, CONNECTION_TUNE_OK)
-        self._recv_method(conn, reader, CONNECTION_OPEN)
+        self._recv_method(conn, reader, CONNECTION_TUNE_OK, pending)
+        self._recv_method(conn, reader, CONNECTION_OPEN, pending)
         conn.sendall(method_frame(0, CONNECTION_OPEN_OK, shortstr("")))
-        ch, _ = self._recv_method(conn, reader, CHANNEL_OPEN)
+        ch, _ = self._recv_method(conn, reader, CHANNEL_OPEN, pending)
         conn.sendall(method_frame(ch, CHANNEL_OPEN_OK, struct.pack(">I", 0)))
-        self._recv_method(conn, reader, BASIC_QOS)
+        self._recv_method(conn, reader, BASIC_QOS, pending)
         conn.sendall(method_frame(ch, BASIC_QOS_OK))
-        _, args = self._recv_method(conn, reader, QUEUE_DECLARE)
+        _, args = self._recv_method(conn, reader, QUEUE_DECLARE, pending)
         qname, _ = parse_shortstr(args, 2)
         self.declares.append(qname)
         conn.sendall(method_frame(ch, QUEUE_DECLARE_OK, shortstr(qname)
                                   + struct.pack(">II", 0, 0)))
-        self._recv_method(conn, reader, BASIC_CONSUME)
+        self._recv_method(conn, reader, BASIC_CONSUME, pending)
         tag = 0
         ok = method_frame(ch, BASIC_CONSUME_OK, shortstr("ctag-1"))
         if self.coalesce_first_delivery:
@@ -178,13 +187,17 @@ class MiniAmqpBroker:
                 tag += 1
                 unacked[tag] = payload
                 conn.sendall(self._delivery_frames(ch, tag, payload))
-            try:
-                data = conn.recv(65536)
-            except socket.timeout:
-                continue
-            if not data:
-                return
-            for ftype, _, payload in reader.feed(data):
+            frames = pending[:]
+            pending.clear()
+            if not frames:
+                try:
+                    data = conn.recv(65536)
+                except socket.timeout:
+                    continue
+                if not data:
+                    return
+                frames = reader.feed(data)
+            for ftype, _, payload in frames:
                 if ftype == FRAME_METHOD:
                     cm = struct.unpack_from(">HH", payload, 0)
                     if cm == BASIC_ACK:

@@ -123,16 +123,62 @@ def main() -> int:
         stored = inst.event_store.total_events
         dead = inst.dead_letters.end_offset
         resilience = inst.topology().get("resilience", {})
-        if stored < ingested:
+        # the overload controller may legitimately shed telemetry DURING
+        # the fault storm (seal lag spikes are exactly its signal); shed
+        # rows are dead-lettered at intake, not journaled — they are
+        # audited, not lost
+        storm_sheds = (inst.overload.shed_total
+                       if inst.overload is not None else 0)
+        if stored + storm_sheds < ingested:
             # at-least-once: replay may duplicate, must never lose
             failures.append(
-                f"event loss: ingested {ingested}, stored {stored}")
+                f"event loss: ingested {ingested}, stored {stored}, "
+                f"shed (audited) {storm_sheds}")
         if fault_hits.get("event_store.flush") and not resilience.get(
                 "resilience.retries.event_store.seal"):
             # seal failures route through the shared retry primitive —
             # its counter must reach the topology surface
             failures.append("seal faults fired but the retry counter "
                             "never reached the topology surface")
+        # -- overload: the ladder sheds telemetry, never alerts -----------
+        from sitewhere_tpu.runtime.overload import (
+            OverloadShed,
+            OverloadState,
+        )
+
+        overload_report = {}
+        if inst.overload is not None:
+            inst.overload.force(OverloadState.SHEDDING, reason="chaos")
+            telemetry = _line("d-0", 1.0, 1_753_900_000).encode()
+            alert = json.dumps({
+                "deviceToken": "d-0", "type": "Alert",
+                "request": {"type": "overheat", "level": "warning",
+                            "eventDate": 1_753_900_001}}).encode()
+            shed_signalled = False
+            try:
+                inst.dispatcher.ingest_wire_lines(telemetry, "chaos-smoke")
+            except OverloadShed:
+                shed_signalled = True
+            if not shed_signalled:
+                failures.append("SHEDDING did not shed telemetry intake")
+            alert_rows = inst.dispatcher.ingest_wire_lines(
+                alert, "chaos-smoke")
+            if alert_rows != 1:
+                failures.append("alert-class intake was shed (never "
+                                "allowed, in any overload state)")
+            shed_letters = [
+                d for d in inst.list_dead_letters(limit=50)
+                if d.get("kind") == "intake-shed"
+            ]
+            if not shed_letters:
+                failures.append("shed intake was not dead-lettered")
+            inst.overload.force(OverloadState.NORMAL, reason="chaos-done")
+            inst.dispatcher.flush()
+            inst.event_store.flush()
+            stored = inst.event_store.total_events  # alert row sealed too
+            ingested += 1
+            overload_report = inst.overload.snapshot()
+
         inst.stop()
         inst.terminate()
 
@@ -154,6 +200,7 @@ def main() -> int:
             "dead_letters": dead,
             "fault_hits": fault_hits,
             "resilience": resilience,
+            "overload": overload_report,
             "ok": not failures,
         }, indent=2))
     finally:
