@@ -9,8 +9,23 @@ from sitewhere_tpu.analytics.runner import (  # noqa: F401
     AnalyticsJob,
     Anomaly,
     EventTap,
+    QueryRunner,
     WindowGrid,
     build_window_grid,
     detect_anomalies,
     detect_anomalies_window_sharded,
+)
+from sitewhere_tpu.analytics.query import (  # noqa: F401
+    PatternQuery,
+    QueryMatch,
+    SessionQuery,
+    WindowQuery,
+    compile_query,
+    parse_query,
+)
+from sitewhere_tpu.analytics.windows import (  # noqa: F401
+    WindowAggregates,
+    aggregate_windows,
+    sessionize,
+    sliding_aggregates,
 )

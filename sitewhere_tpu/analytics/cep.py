@@ -1,0 +1,408 @@
+"""CEP layer: per-device pattern state machines as batched JAX kernels.
+
+Reference: the platform ran Siddhi for complex event processing —
+per-event callbacks walking host-side state machines.  Here a pattern is
+a small table of states x event-predicate transitions evaluated with
+vectorized gather/select over a whole batch, carrying per-device state
+vectors between steps exactly like ``state/manager.py`` carries
+presence: no per-event host loop, and the SAME compiled function runs
+on the live in-flight batch and on replayed history (H-STREAM,
+arXiv:2108.03485).
+
+Pattern semantics (documented contract, shared by both modes):
+
+- Events are processed in (device, ts) order; ties keep arrival order.
+- A machine at stage ``s`` advances on the EARLIEST not-yet-consumed
+  event matching step ``s``'s predicate, provided it arrives within
+  ``within_s[s]`` of the previous step's event (steps after the first;
+  ``within_s <= 0`` = no deadline).
+- An event past the deadline resets the machine; if that same event
+  matches step 0 it restarts the pattern (stage 1) at its timestamp.
+- Reaching the final stage emits a match (device, first-step ts, final
+  ts, final value) and resets to stage 0 — patterns re-arm.
+
+A step predicate matches on event type, measurement type, a value
+comparison, and/or the derived ``window-cross`` feature: the running
+mean of the query's tumbling window (count/sum carried per device, the
+same accumulate-in-order arithmetic in live and retrospective mode)
+crossing the configured threshold on THIS event.  That makes "devices
+whose 5-min mean crossed X within Y of an alert" a two-step pattern.
+
+Evaluation is a fixed ``K``-pass kernel (K = pattern length): each pass
+gathers every device's stage, evaluates the stage's predicate row-wise,
+elects the earliest candidate per device with one scatter-min, and
+applies the transition with masked scatters.  One call yields at most
+one match per device; the runner re-invokes while ``progress`` is
+nonzero (the per-batch frontier makes every re-invocation strictly
+consume rows, so the loop is bounded) — which is also what makes a
+giant retrospective chunk produce the SAME matches as the equivalent
+sequence of small live batches.
+
+Float contract of the window-cross feature: running window sums
+accumulate in float32 (live: incrementally across batches; replay: by
+prefix-sum differences inside each chunk), so the two modes agree
+exactly only while the sums stay well-conditioned — thresholds sitting
+within float32 rounding of the running mean (large-magnitude values in
+very large chunks) may resolve a cross differently across batchings.
+Thresholds should sit outside measurement noise, which real rules do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.ids import NULL_ID
+from sitewhere_tpu.schema import ComparisonOp, EventType
+from sitewhere_tpu.analytics.windows import (
+    compare,
+    compare_traced,
+    sort_by_device_time,
+)
+
+_BIG_I32 = jnp.int32(2**31 - 1)
+
+
+@dataclasses.dataclass
+class PatternStep:
+    """One state-transition predicate of a pattern.
+
+    ``event_type``/``mtype_id`` of -1 are wildcards; ``op``/``threshold``
+    apply to the event value only when ``has_value``; ``window_cross``
+    requires the window-cross feature to fire on the event;
+    ``within_s`` bounds the gap from the previous step (ignored on step
+    0; <= 0 means unbounded — no deadline).
+    """
+
+    event_type: int = -1
+    mtype_id: int = -1
+    has_value: bool = False
+    op: int = int(ComparisonOp.GT)
+    threshold: float = 0.0
+    window_cross: bool = False
+    within_s: int = 0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CepState:
+    """Per-device pattern + window-accumulator state, carried between
+    batches (the presence-carry analog)."""
+
+    stage: jax.Array      # int32[D] — current pattern stage
+    stage_ts: jax.Array   # int32[D] — ts of the last advancing event
+    first_ts: jax.Array   # int32[D] — ts of the step-0 event
+    frontier: jax.Array   # int32[D] — last consumed row idx (per batch)
+    win: jax.Array        # int32[D] — open tumbling window (-1 none)
+    win_cnt: jax.Array    # float32[D]
+    win_sum: jax.Array    # float32[D]
+
+    @classmethod
+    def empty(cls, capacity: int) -> "CepState":
+        return cls(
+            stage=jnp.zeros(capacity, jnp.int32),
+            stage_ts=jnp.zeros(capacity, jnp.int32),
+            first_ts=jnp.zeros(capacity, jnp.int32),
+            frontier=jnp.full(capacity, -1, jnp.int32),
+            win=jnp.full(capacity, -1, jnp.int32),
+            win_cnt=jnp.zeros(capacity, jnp.float32),
+            win_sum=jnp.zeros(capacity, jnp.float32),
+        )
+
+
+@dataclasses.dataclass
+class CepProgram:
+    """A compiled pattern: step tables as device arrays + window-cross
+    feature config.  ``n_steps`` is static (pass count); thresholds are
+    traced so editing a rule never retraces."""
+
+    n_steps: int
+    step_event_type: jax.Array  # int32[K]
+    step_mtype: jax.Array       # int32[K]
+    step_has_value: jax.Array   # bool[K]
+    step_op: jax.Array          # int32[K]
+    step_threshold: jax.Array   # float32[K]
+    step_cross: jax.Array       # bool[K]
+    step_within: jax.Array      # int32[K]
+    # window-cross feature (static structure, traced threshold)
+    cross_enabled: bool = False
+    window_s: int = 300
+    cross_op: int = int(ComparisonOp.GT)
+    cross_threshold: float = 0.0
+    cross_mtype: int = -1
+
+    @classmethod
+    def compile(cls, steps: List[PatternStep], *, window_s: int = 300,
+                cross_op: int = int(ComparisonOp.GT),
+                cross_threshold: float = 0.0,
+                cross_mtype: int = -1) -> "CepProgram":
+        if not steps:
+            raise ValueError("a pattern needs at least one step")
+        return cls(
+            n_steps=len(steps),
+            step_event_type=jnp.asarray(
+                [s.event_type for s in steps], jnp.int32),
+            step_mtype=jnp.asarray([s.mtype_id for s in steps], jnp.int32),
+            step_has_value=jnp.asarray(
+                [s.has_value for s in steps], jnp.bool_),
+            step_op=jnp.asarray([s.op for s in steps], jnp.int32),
+            step_threshold=jnp.asarray(
+                [s.threshold for s in steps], jnp.float32),
+            step_cross=jnp.asarray(
+                [s.window_cross for s in steps], jnp.bool_),
+            step_within=jnp.asarray(
+                [s.within_s for s in steps], jnp.int32),
+            cross_enabled=any(s.window_cross for s in steps),
+            window_s=int(window_s),
+            cross_op=int(cross_op),
+            cross_threshold=float(cross_threshold),
+            cross_mtype=int(cross_mtype),
+        )
+
+
+@partial(jax.jit, static_argnames=("window_s", "cross_op",
+                                   "cross_enabled"))
+def cep_features(
+    state: CepState,
+    device_id, ts_s, event_type, mtype_id, value, valid,
+    *,
+    window_s: int,
+    cross_op: int,
+    cross_threshold,
+    cross_mtype,
+    cross_enabled: bool,
+):
+    """Sort the batch and derive the window-cross feature.
+
+    Returns ``(new_state, order, cross)`` — ``order`` is the (device,
+    ts) sort the pattern passes consume; ``cross[i]`` (sorted order)
+    fires when event i pushes its device's running tumbling-window mean
+    across the threshold (edge-triggered: the mean did not satisfy the
+    comparison before this event, or the window just opened).  The
+    per-device (window, count, sum) carry makes the feature identical
+    under any batch split — the live/retrospective equivalence hinge.
+    """
+    n = device_id.shape[0]
+    order = sort_by_device_time(device_id, ts_s, valid)
+    dev = device_id[order]
+    ts = ts_s[order]
+    ok = valid[order]
+    if not cross_enabled:
+        return state, order, jnp.zeros(n, bool)
+    et = event_type[order]
+    mt = mtype_id[order]
+    val = value[order]
+
+    capacity = state.win.shape[0]
+    mrow = ok & (dev >= 0) & (dev < capacity) & (
+        et == int(EventType.MEASUREMENT)) & (
+        (cross_mtype < 0) | (mt == cross_mtype))
+    win = jnp.where(mrow, ts // jnp.int32(window_s), -2)
+    idx = jnp.arange(n)
+    # previous measurement row (any device): device rows are contiguous
+    # after the sort, so "previous mrow of the same device+window" is
+    # just the running max of mrow indices checked for dev/win equality
+    lastm_incl = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(mrow, idx, -1))
+    prev_m = jnp.where(idx > 0, lastm_incl[jnp.maximum(idx - 1, 0)], -1)
+    prev_dev = jnp.where(prev_m >= 0, dev[jnp.maximum(prev_m, 0)], -1)
+    prev_win = jnp.where(prev_m >= 0, win[jnp.maximum(prev_m, 0)], -2)
+    boundary = mrow & ((prev_m < 0) | (prev_dev != dev)
+                       | (prev_win != win))
+    seg = jnp.where(mrow, jnp.cumsum(boundary) - 1, n)
+    # running in-segment prefix stats (sorted segment-boundary cumsum)
+    prefix_cnt = jnp.cumsum(mrow.astype(jnp.float32))
+    prefix_sum = jnp.cumsum(jnp.where(mrow, val, 0.0))
+    seg_start = jax.ops.segment_min(
+        jnp.where(mrow, idx, _BIG_I32), seg, num_segments=n + 1)
+    start_i = jnp.clip(seg_start[jnp.minimum(seg, n)], 0, n - 1)
+    # inclusive prefix minus the prefix just BEFORE the segment start
+    # (the start row is itself a measurement row, so add its own terms)
+    rcnt = (prefix_cnt - prefix_cnt[start_i]
+            + mrow[start_i].astype(jnp.float32))
+    rsum = (prefix_sum - prefix_sum[start_i]
+            + jnp.where(mrow[start_i], val[start_i], 0.0))
+    # carry merge: the device's first in-batch window continues the
+    # carried open window when the indices agree
+    dev_safe = jnp.clip(dev, 0, capacity - 1)
+    dev_first_seg = boundary & ((prev_m < 0) | (prev_dev != dev))
+    first_seg_of_dev = jax.ops.segment_max(
+        jnp.where(dev_first_seg, 1, 0), seg, num_segments=n + 1)[
+            jnp.minimum(seg, n)] > 0
+    same_win = first_seg_of_dev & (state.win[dev_safe] == win) & mrow
+    c_cnt = jnp.where(same_win, state.win_cnt[dev_safe], 0.0)
+    c_sum = jnp.where(same_win, state.win_sum[dev_safe], 0.0)
+    tot_cnt = rcnt + c_cnt
+    tot_sum = rsum + c_sum
+    mean_after = tot_sum / jnp.maximum(tot_cnt, 1.0)
+    before_cnt = tot_cnt - 1.0
+    mean_before = (tot_sum - val) / jnp.maximum(before_cnt, 1.0)
+    sat_after = compare(cross_op, mean_after, cross_threshold)
+    sat_before = compare(cross_op, mean_before, cross_threshold)
+    cross = mrow & sat_after & ((before_cnt < 0.5) | ~sat_before)
+
+    # new carry: each device's LAST measurement row closes the batch
+    last_incl = jax.ops.segment_max(
+        jnp.where(mrow, idx, -1),
+        jnp.where(mrow, dev_safe, capacity),
+        num_segments=capacity + 1)[:capacity]
+    has_m = last_incl >= 0
+    li = jnp.clip(last_incl, 0, n - 1)
+    new_win = jnp.where(has_m, win[li], state.win)
+    new_cnt = jnp.where(has_m, tot_cnt[li], state.win_cnt)
+    new_sum = jnp.where(has_m, tot_sum[li], state.win_sum)
+    state = dataclasses.replace(
+        state, win=new_win.astype(jnp.int32), win_cnt=new_cnt,
+        win_sum=new_sum)
+    return state, order, cross
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def cep_pass(
+    state: CepState,
+    program_arrays,   # tuple of the step tables (pytree leaf order fixed)
+    dev, ts, et, mt, val, ok, cross,
+    *,
+    n_steps: int,
+):
+    """K vectorized transition passes over one sorted batch.
+
+    Returns ``(state, matched[D], match_first_ts[D], match_ts[D],
+    match_val[D], progress)``; at most one match per device per call —
+    the caller loops while ``progress`` is nonzero.
+    """
+    (s_et, s_mt, s_hasv, s_op, s_thr, s_cross, s_within) = program_arrays
+    n = dev.shape[0]
+    capacity = state.stage.shape[0]
+    idx = jnp.arange(n)
+    dev_safe = jnp.clip(dev, 0, capacity - 1)
+    in_cap = ok & (dev >= 0) & (dev < capacity)
+
+    matched = jnp.zeros(capacity, bool)
+    match_first = jnp.zeros(capacity, jnp.int32)
+    match_ts = jnp.zeros(capacity, jnp.int32)
+    match_val = jnp.zeros(capacity, jnp.float32)
+    progress = jnp.int32(0)
+    stage, stage_ts, first_ts, frontier = (
+        state.stage, state.stage_ts, state.first_ts, state.frontier)
+
+    def row_pred(step_idx):
+        """Row-wise predicate of each row's device's step ``step_idx``
+        (a [B] array of per-row step indices)."""
+        k = jnp.clip(step_idx, 0, n_steps - 1)
+        p = (s_et[k] < 0) | (s_et[k] == et)
+        p &= (s_mt[k] < 0) | (s_mt[k] == mt)
+        p &= ~s_hasv[k] | compare_traced(s_op[k], val, s_thr[k])
+        p &= ~s_cross[k] | cross
+        return p
+
+    for _ in range(n_steps):
+        s = stage[dev_safe]
+        fresh = idx > frontier[dev_safe]
+        # within_s <= 0 means NO deadline for that step (the parse
+        # default) — otherwise a default-registered two-step pattern
+        # could only advance on identically-timestamped events
+        within = s_within[jnp.clip(s, 0, n_steps - 1)]
+        in_time = (s == 0) | (within <= 0) | (
+            ts <= stage_ts[dev_safe] + within)
+        cand_adv = in_cap & fresh & in_time & row_pred(s)
+        cand_restart = (in_cap & fresh & (s > 0) & ~in_time
+                        & row_pred(jnp.zeros_like(s)))
+        cand = cand_adv | cand_restart
+        winner = jnp.full(capacity, n, jnp.int32).at[
+            jnp.where(cand, dev_safe, capacity)].min(
+                jnp.where(cand, idx, n).astype(jnp.int32), mode="drop")
+        is_win = cand & (idx == winner[dev_safe])
+        progress = progress + jnp.sum(is_win).astype(jnp.int32)
+        # transition, row-wise then scattered (one winner per device)
+        restart = cand_restart & is_win
+        new_stage_row = jnp.where(restart, 1, s + 1)
+        new_first_row = jnp.where(restart | (s == 0), ts,
+                                  first_ts[dev_safe])
+        hit = is_win & (new_stage_row >= n_steps)
+        tgt = jnp.where(is_win, dev_safe, capacity)
+        stage = stage.at[tgt].set(
+            jnp.where(hit, 0, new_stage_row), mode="drop")
+        stage_ts = stage_ts.at[tgt].set(ts, mode="drop")
+        first_ts = first_ts.at[tgt].set(new_first_row, mode="drop")
+        frontier = frontier.at[tgt].set(idx.astype(jnp.int32),
+                                        mode="drop")
+        hit_tgt = jnp.where(hit, dev_safe, capacity)
+        matched = matched.at[hit_tgt].set(True, mode="drop")
+        match_first = match_first.at[hit_tgt].set(new_first_row,
+                                                  mode="drop")
+        match_ts = match_ts.at[hit_tgt].set(ts, mode="drop")
+        match_val = match_val.at[hit_tgt].set(val, mode="drop")
+
+    state = dataclasses.replace(
+        state, stage=stage, stage_ts=stage_ts, first_ts=first_ts,
+        frontier=frontier)
+    return state, matched, match_first, match_ts, match_val, progress
+
+
+class PatternEvaluator:
+    """Host driver of one compiled pattern: carries :class:`CepState`
+    across batches and loops the pass kernel until quiescent."""
+
+    def __init__(self, program: CepProgram, capacity: int):
+        self.program = program
+        self.capacity = int(capacity)
+        self.state = CepState.empty(self.capacity)
+
+    def reset(self) -> None:
+        self.state = CepState.empty(self.capacity)
+
+    def _tables(self):
+        p = self.program
+        return (p.step_event_type, p.step_mtype, p.step_has_value,
+                p.step_op, p.step_threshold, p.step_cross, p.step_within)
+
+    def eval_batch(self, device_id, ts_s, event_type, mtype_id, value,
+                   valid) -> List[Dict[str, object]]:
+        """Evaluate one batch; returns match dicts (device_id,
+        first_ts_s, ts_s, value) in detection order."""
+        p = self.program
+        # fresh per-batch frontier: rows of THIS batch are all unseen
+        self.state = dataclasses.replace(
+            self.state,
+            frontier=jnp.full(self.capacity, -1, jnp.int32))
+        self.state, order, cross = cep_features(
+            self.state, device_id, ts_s, event_type, mtype_id, value,
+            valid,
+            window_s=p.window_s, cross_op=p.cross_op,
+            cross_threshold=jnp.float32(p.cross_threshold),
+            cross_mtype=jnp.int32(p.cross_mtype),
+            cross_enabled=p.cross_enabled)
+        dev = device_id[order]
+        ts = ts_s[order]
+        et = event_type[order]
+        mt = mtype_id[order]
+        val = value[order]
+        ok = valid[order]
+        matches: List[Dict[str, object]] = []
+        while True:
+            (self.state, matched, m_first, m_ts, m_val,
+             progress) = cep_pass(
+                self.state, self._tables(), dev, ts, et, mt, val, ok,
+                cross, n_steps=p.n_steps)
+            hits = np.nonzero(np.asarray(matched))[0]
+            if hits.size:
+                first = np.asarray(m_first)
+                tss = np.asarray(m_ts)
+                vals = np.asarray(m_val)
+                for d in hits:
+                    matches.append({
+                        "device_id": int(d),
+                        "first_ts_s": int(first[d]),
+                        "ts_s": int(tss[d]),
+                        "value": float(vals[d]),
+                    })
+            if int(progress) == 0:
+                break
+        matches.sort(key=lambda m: (m["ts_s"], m["device_id"]))
+        return matches

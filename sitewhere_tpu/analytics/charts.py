@@ -6,6 +6,13 @@ series sorted by date (the admin UI's chart feed,
 ``Assignments.java`` chart endpoints).  Here the grouping/sorting is
 vectorized over the columnar event store: one mask per filter, one
 argsort per request — no per-event objects until the response rows.
+
+Bucketed series (``bucket_s``) reuse the analytics window kernels
+(:func:`sitewhere_tpu.analytics.windows.aggregate_windows` over a
+[series, bucket] grid) instead of a private aggregation path — a chart
+bucket and a :class:`~sitewhere_tpu.analytics.query.WindowQuery` window
+over the same data are computed by the same scatter kernel, so charts
+and queries cannot disagree.
 """
 
 from __future__ import annotations
@@ -25,6 +32,8 @@ def build_chart_series(
     end_s: Optional[int] = None,
     mtype_name_of=None,
     max_points_per_series: int = 10_000,
+    bucket_s: Optional[int] = None,
+    agg: str = "mean",
 ) -> List[Dict[str, object]]:
     """Per-measurement-type chart series, entries sorted by time.
 
@@ -33,6 +42,10 @@ def build_chart_series(
     maps dense handles back to names for the response.  Series longer
     than ``max_points_per_series`` keep the NEWEST points (the chart
     window), mirroring paged list semantics.
+
+    With ``bucket_s`` each series is downsampled to one entry per
+    epoch-aligned bucket via the shared window kernels (``agg`` picks
+    count/sum/mean/min/max/std/rate); entries then carry ``count`` too.
     """
     from sitewhere_tpu.schema import EventType
 
@@ -59,6 +72,10 @@ def build_chart_series(
     ts_all = np.concatenate(ts)
     vals_all = np.concatenate(vals)
     mts_all = np.concatenate(mts)
+    if bucket_s is not None:
+        return _bucketed_series(
+            ts_all, vals_all, mts_all, int(bucket_s), agg,
+            mtype_name_of, max_points_per_series)
 
     series: List[Dict[str, object]] = []
     for mtype in np.unique(mts_all):
@@ -73,6 +90,62 @@ def build_chart_series(
             "measurement_name": name,
             "entries": [
                 {"ts_s": int(a), "value": float(b)} for a, b in zip(t, v)
+            ],
+        })
+    return series
+
+
+def _bucketed_series(ts_all, vals_all, mts_all, bucket_s: int, agg: str,
+                     mtype_name_of, max_points: int):
+    """Downsample through the analytics window kernels: the series axis
+    plays the grid's device axis, buckets are epoch-aligned windows."""
+    import jax.numpy as jnp
+
+    from sitewhere_tpu.schema import pow2_at_least
+    from sitewhere_tpu.services.common import ValidationError
+    from sitewhere_tpu.analytics.windows import aggregate_windows
+
+    if bucket_s <= 0:
+        raise ValidationError("bucketS must be > 0")
+    if len(ts_all) == 0:
+        return []
+    uniq = np.unique(mts_all)
+    sidx = np.searchsorted(uniq, mts_all).astype(np.int32)
+    w0 = int(ts_all.min()) // bucket_s
+    win = (ts_all.astype(np.int64) // bucket_s - w0).astype(np.int32)
+    # the grid is dense over the bucketed span: bound it so a
+    # fine-grained bucket over a long history cannot allocate an
+    # unbounded [series, buckets] grid per request — narrow the time
+    # range or coarsen the bucket instead
+    if int(win.max()) >= (1 << 16):
+        raise ValidationError(
+            f"bucketS={bucket_s} over this time span needs "
+            f"{int(win.max()) + 1} buckets (max {1 << 16}); use a "
+            "coarser bucket or a startDate/endDate range")
+    n_series = pow2_at_least(len(uniq), floor=1)
+    n_windows = pow2_at_least(int(win.max()) + 1, floor=64)
+    grid = aggregate_windows(
+        jnp.asarray(sidx), jnp.asarray(win),
+        jnp.asarray(vals_all.astype(np.float32)),
+        jnp.ones(len(ts_all), bool),
+        n_devices=n_series, n_windows=n_windows)
+    values = np.asarray(grid.aggregate(agg, window_s=bucket_s))
+    counts = np.asarray(grid.counts)
+    series: List[Dict[str, object]] = []
+    for i, mtype in enumerate(uniq):
+        occupied = np.nonzero(counts[i] > 0)[0][-max_points:]
+        name = (mtype_name_of(int(mtype)) if mtype_name_of is not None
+                else None)
+        series.append({
+            "measurement_id": int(mtype),
+            "measurement_name": name,
+            "bucket_s": bucket_s,
+            "agg": agg,
+            "entries": [
+                {"ts_s": int((w0 + w) * bucket_s),
+                 "value": float(values[i, w]),
+                 "count": int(counts[i, w])}
+                for w in occupied
             ],
         })
     return series

@@ -1,0 +1,885 @@
+"""Declarative streaming queries compiled to one windowed operator.
+
+The H-STREAM shape (arXiv:2108.03485): a query is declared once
+(:class:`WindowQuery` / :class:`SessionQuery` / :class:`PatternQuery`),
+compiles to ONE jitted operator, and the same operator runs in **live
+mode** (the dispatcher's enriched in-flight batches) and **retrospective
+mode** (sealed event-store chunks streamed through it) — golden
+equivalence between the modes is by construction: the operator carries
+per-device state (open windows, open sessions, pattern stages) between
+calls, so any split of the same event sequence into batches yields the
+same matches.
+
+Window semantics: tumbling windows are epoch-aligned (window index =
+``ts // window_s``); a window FINALIZES when a later window arrives for
+the device (or on flush), and a match is the finalized window whose
+aggregate satisfies the predicate.  Sliding windows (``length`` > 1)
+evaluate the trailing-``length``-hop combined aggregate at every hop
+finalization — per-device rings of recent hop aggregates make the
+trailing combination exact across batch splits.  Sessions close when an
+inter-event gap exceeds ``gap_s`` (or on flush) and match on count or
+duration.  Patterns are :mod:`sitewhere_tpu.analytics.cep` programs.
+
+Everything below the spec layer is fixed-shape struct-of-array code:
+batches sort once (two stable argsorts), per-(device, window) segments
+reduce via the segment-boundary-cumsum kernels of
+:mod:`sitewhere_tpu.analytics.windows`, and carries merge with masked
+scatters — no per-event host loop anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.schema import ComparisonOp, EventType, pow2_at_least
+from sitewhere_tpu.analytics.cep import (
+    CepProgram,
+    PatternEvaluator,
+    PatternStep,
+)
+from sitewhere_tpu.analytics.windows import (
+    AGGREGATES,
+    compare,
+    sort_by_device_time,
+)
+
+_BIG_I32 = jnp.int32(2**31 - 1)
+_F32_MAX = jnp.float32(3.0e38)
+
+SESSION_AGGREGATES = ("count", "duration_s")
+
+
+# ---------------------------------------------------------------------------
+# query specs (the REST-facing declarative layer)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class WindowQuery:
+    """Tumbling/sliding windowed aggregate predicate over measurements."""
+
+    name: str
+    threshold: float
+    agg: str = "mean"
+    op: int = int(ComparisonOp.GT)
+    window_s: int = 300
+    length: int = 1          # trailing hops; 1 = tumbling
+    mtype: Optional[str] = None
+    min_count: int = 1
+    kind: str = "window"
+
+    def __post_init__(self):
+        if self.agg not in AGGREGATES:
+            raise ValueError(f"unknown aggregate {self.agg!r}")
+        if self.window_s <= 0 or self.length < 1:
+            raise ValueError("window_s must be > 0 and length >= 1")
+
+
+@dataclasses.dataclass
+class SessionQuery:
+    """Gap-based session predicate (count or duration)."""
+
+    name: str
+    threshold: float
+    gap_s: int = 300
+    agg: str = "count"
+    op: int = int(ComparisonOp.GT)
+    mtype: Optional[str] = None
+    kind: str = "session"
+
+    def __post_init__(self):
+        if self.agg not in SESSION_AGGREGATES:
+            raise ValueError(f"unknown session aggregate {self.agg!r}")
+        if self.gap_s <= 0:
+            raise ValueError("gap_s must be > 0")
+
+
+@dataclasses.dataclass
+class PatternQuery:
+    """CEP pattern: ordered steps, optionally over a window-cross
+    feature ("5-min mean crossed X within Y of an alert")."""
+
+    name: str
+    steps: List[PatternStep]
+    window_s: int = 300
+    cross_op: int = int(ComparisonOp.GT)
+    cross_threshold: float = 0.0
+    cross_mtype: Optional[str] = None
+    kind: str = "pattern"
+
+    def __post_init__(self):
+        if not self.steps:
+            raise ValueError("a pattern needs at least one step")
+
+
+@dataclasses.dataclass
+class QueryMatch:
+    """One match, host-facing (REST marshals this directly)."""
+
+    query: str
+    kind: str
+    device_id: int
+    ts_s: int                # window/session/pattern END time
+    start_ts_s: int          # window/session start, pattern first step
+    value: float             # the aggregate (or final event value)
+    count: int = 0
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+_EVENT_TYPE_BY_NAME = {et.name.lower(): int(et) for et in EventType}
+
+
+def parse_query(doc: Dict[str, object],
+                resolve_mtype=None) -> object:
+    """One REST body → query spec (400-style ValueError on junk).
+
+    ``kind`` selects the family; enum fields accept names or values;
+    ``resolve_mtype`` maps measurement names to dense handles at
+    pattern-compile time (specs keep the name).
+    """
+    doc = dict(doc)
+    kind = str(doc.get("kind", "window")).lower()
+    name = doc.get("name")
+    if not name or not isinstance(name, str):
+        raise ValueError("query needs a string 'name'")
+
+    def _op(raw, field="op"):
+        if isinstance(raw, str):
+            try:
+                return int(ComparisonOp[raw.upper()])
+            except KeyError:
+                raise ValueError(f"bad {field}: {raw!r}") from None
+        try:
+            return int(ComparisonOp(int(raw)))
+        except (TypeError, ValueError):
+            raise ValueError(f"bad {field}: {raw!r}") from None
+
+    if kind == "window":
+        return WindowQuery(
+            name=name,
+            threshold=float(doc.get("threshold", 0.0)),
+            agg=str(doc.get("agg", "mean")).lower(),
+            op=_op(doc.get("op", "gt")),
+            window_s=int(doc.get("windowS", doc.get("window_s", 300))),
+            length=int(doc.get("length", 1)),
+            mtype=doc.get("mtype"),
+            min_count=int(doc.get("minCount", doc.get("min_count", 1))),
+        )
+    if kind == "session":
+        return SessionQuery(
+            name=name,
+            threshold=float(doc.get("threshold", 0.0)),
+            gap_s=int(doc.get("gapS", doc.get("gap_s", 300))),
+            agg=str(doc.get("agg", "count")).lower(),
+            op=_op(doc.get("op", "gt")),
+            mtype=doc.get("mtype"),
+        )
+    if kind == "pattern":
+        raw_steps = doc.get("steps")
+        if not isinstance(raw_steps, list) or not raw_steps:
+            raise ValueError("pattern needs a non-empty 'steps' list")
+        steps = []
+        for s in raw_steps:
+            s = dict(s)
+            et = s.get("eventType", s.get("event_type", -1))
+            if isinstance(et, str):
+                et_i = _EVENT_TYPE_BY_NAME.get(et.lower())
+                if et_i is None:
+                    raise ValueError(f"bad eventType {et!r}")
+            else:
+                et_i = int(et)
+            mtype_id = -1
+            mtype = s.get("mtype")
+            if mtype is not None and resolve_mtype is not None:
+                mtype_id = int(resolve_mtype(str(mtype)))
+            steps.append(PatternStep(
+                event_type=et_i,
+                mtype_id=mtype_id,
+                has_value="threshold" in s,
+                op=_op(s.get("op", "gt")),
+                threshold=float(s.get("threshold", 0.0)),
+                window_cross=bool(s.get("windowCross",
+                                        s.get("window_cross", False))),
+                within_s=int(s.get("withinS", s.get("within_s", 0))),
+            ))
+        return PatternQuery(
+            name=name, steps=steps,
+            window_s=int(doc.get("windowS", doc.get("window_s", 300))),
+            cross_op=_op(doc.get("crossOp", doc.get("cross_op", "gt")),
+                         "crossOp"),
+            cross_threshold=float(doc.get(
+                "crossThreshold", doc.get("cross_threshold", 0.0))),
+            cross_mtype=doc.get("crossMtype", doc.get("cross_mtype")),
+        )
+    raise ValueError(f"unknown query kind {kind!r}")
+
+
+def describe_query(spec) -> Dict[str, object]:
+    """Spec → jsonable doc (the GET shape; re-POSTable)."""
+    return dataclasses.asdict(spec)   # recurses into PatternStep lists
+
+
+# ---------------------------------------------------------------------------
+# windowed operator (tumbling + sliding)
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class WindowOpState:
+    """Per-device open window + ring of the last L finalized hops."""
+
+    win: jax.Array       # int32[D] (-1 = none open)
+    cnt: jax.Array       # float32[D]
+    sm: jax.Array        # float32[D]
+    ssq: jax.Array       # float32[D]
+    mn: jax.Array        # float32[D]
+    mx: jax.Array        # float32[D]
+    ring_win: jax.Array  # int32[D, L] (-1 empty slot)
+    ring_cnt: jax.Array  # float32[D, L]
+    ring_sum: jax.Array  # float32[D, L]
+    ring_ssq: jax.Array  # float32[D, L]
+    ring_min: jax.Array  # float32[D, L]
+    ring_max: jax.Array  # float32[D, L]
+
+    @classmethod
+    def empty(cls, capacity: int, length: int) -> "WindowOpState":
+        d, l = capacity, max(1, length)
+        return cls(
+            win=jnp.full(d, -1, jnp.int32),
+            cnt=jnp.zeros(d, jnp.float32),
+            sm=jnp.zeros(d, jnp.float32),
+            ssq=jnp.zeros(d, jnp.float32),
+            mn=jnp.full(d, _F32_MAX, jnp.float32),
+            mx=jnp.full(d, -_F32_MAX, jnp.float32),
+            ring_win=jnp.full((d, l), -1, jnp.int32),
+            ring_cnt=jnp.zeros((d, l), jnp.float32),
+            ring_sum=jnp.zeros((d, l), jnp.float32),
+            ring_ssq=jnp.zeros((d, l), jnp.float32),
+            ring_min=jnp.full((d, l), _F32_MAX, jnp.float32),
+            ring_max=jnp.full((d, l), -_F32_MAX, jnp.float32),
+        )
+
+
+def _agg_value(agg: str, cnt, sm, ssq, mn, mx, span_s: float):
+    n = jnp.maximum(cnt, 1.0)
+    if agg == "count":
+        return cnt
+    if agg == "sum":
+        return sm
+    if agg == "mean":
+        return sm / n
+    if agg == "min":
+        return mn
+    if agg == "max":
+        return mx
+    if agg == "std":
+        m = sm / n
+        return jnp.sqrt(jnp.maximum(ssq / n - m * m, 0.0))
+    if agg == "rate":
+        return cnt / jnp.float32(span_s)
+    raise ValueError(f"unknown aggregate {agg!r}")
+
+
+@partial(jax.jit, static_argnames=("window_s", "length", "agg", "op",
+                                   "min_count"))
+def window_eval(
+    state: WindowOpState,
+    device_id, ts_s, value, ok,
+    threshold,
+    *,
+    window_s: int,
+    length: int,
+    agg: str,
+    op: int,
+    min_count: int,
+):
+    """One batch through the windowed operator.
+
+    Returns ``(new_state, out)`` where ``out`` is a dict of per-segment
+    arrays (size B): in-batch finalized-window matches plus the carried
+    open windows that this batch's arrivals finalized.  ``ok`` is the
+    caller's row filter (measurement + mtype).
+    """
+    n = device_id.shape[0]
+    capacity = state.win.shape[0]
+    L = max(1, length)
+    order = sort_by_device_time(device_id, ts_s, ok)
+    dev = device_id[order]
+    ts = ts_s[order]
+    val = value[order]
+    okr = ok[order] & (dev >= 0) & (dev < capacity)
+    win = jnp.where(okr, ts // jnp.int32(window_s), -2)
+    idx = jnp.arange(n)
+    prev = jnp.maximum(idx - 1, 0)
+    prev_ok = jnp.where(idx > 0, okr[prev], False)
+    prev_dev = jnp.where(prev_ok, dev[prev], -1)
+    prev_win = jnp.where(prev_ok, win[prev], -2)
+    boundary = okr & (~prev_ok | (prev_dev != dev) | (prev_win != win))
+    dev_first_row = okr & (~prev_ok | (prev_dev != dev))
+    seg = jnp.where(okr, jnp.cumsum(boundary) - 1, n)
+
+    ones = jnp.where(okr, 1.0, 0.0)
+    nseg = n + 1
+    seg_cnt = jax.ops.segment_sum(ones, seg, num_segments=nseg)
+    seg_sum = jax.ops.segment_sum(jnp.where(okr, val, 0.0), seg,
+                                  num_segments=nseg)
+    seg_ssq = jax.ops.segment_sum(jnp.where(okr, val * val, 0.0), seg,
+                                  num_segments=nseg)
+    seg_min = jax.ops.segment_min(jnp.where(okr, val, _F32_MAX), seg,
+                                  num_segments=nseg)
+    seg_max = jax.ops.segment_max(jnp.where(okr, val, -_F32_MAX), seg,
+                                  num_segments=nseg)
+    seg_dev = jax.ops.segment_max(jnp.where(okr, dev, -1), seg,
+                                  num_segments=nseg)
+    seg_win = jax.ops.segment_max(jnp.where(okr, win, -2), seg,
+                                  num_segments=nseg)
+    seg_first = jax.ops.segment_max(
+        jnp.where(dev_first_row, 1, 0), seg, num_segments=nseg) > 0
+    live = seg_dev >= 0
+    next_dev = jnp.concatenate([seg_dev[1:], jnp.full(1, -1, jnp.int32)])
+    seg_last = live & (next_dev != seg_dev)
+
+    sd = jnp.clip(seg_dev, 0, capacity - 1)
+    c_win = state.win[sd]
+    c_active = live & seg_first & (c_win >= 0)
+    same = c_active & (c_win == seg_win)
+    m_cnt = seg_cnt + jnp.where(same, state.cnt[sd], 0.0)
+    m_sum = seg_sum + jnp.where(same, state.sm[sd], 0.0)
+    m_ssq = seg_ssq + jnp.where(same, state.ssq[sd], 0.0)
+    m_min = jnp.minimum(seg_min, jnp.where(same, state.mn[sd], _F32_MAX))
+    m_max = jnp.maximum(seg_max, jnp.where(same, state.mx[sd], -_F32_MAX))
+    carry_final = c_active & (c_win != seg_win)
+    final = live & ~seg_last
+
+    span_s = float(window_s) * L
+
+    def trailing(sidx_cnt, sidx_sum, sidx_ssq, sidx_min, sidx_max,
+                 t_win, include_batch: bool):
+        """Trailing-L combination ending at hop ``t_win`` per segment."""
+        T = [sidx_cnt, sidx_sum, sidx_ssq, sidx_min, sidx_max]
+        if L == 1:
+            return T
+        if include_batch:
+            # a device's in-batch windows occupy consecutive segments
+            # with strictly increasing window index, so every in-range
+            # prior hop lives within the previous L-1 segments
+            for j in range(1, L):
+                pidx = jnp.maximum(jnp.arange(nseg) - j, 0)
+                use = (jnp.arange(nseg) >= j) & live[pidx] \
+                    & (seg_dev[pidx] == seg_dev) \
+                    & (seg_win[pidx] > t_win - L) & (seg_win[pidx] < t_win)
+                T[0] = T[0] + jnp.where(use, m_cnt[pidx], 0.0)
+                T[1] = T[1] + jnp.where(use, m_sum[pidx], 0.0)
+                T[2] = T[2] + jnp.where(use, m_ssq[pidx], 0.0)
+                T[3] = jnp.minimum(
+                    T[3], jnp.where(use, m_min[pidx], _F32_MAX))
+                T[4] = jnp.maximum(
+                    T[4], jnp.where(use, m_max[pidx], -_F32_MAX))
+            # the carried window the batch just closed also counts
+            use_c = carry_final_dev & (c_win_dev > t_win - L) \
+                & (c_win_dev < t_win)
+            T[0] = T[0] + jnp.where(use_c, state.cnt[sd], 0.0)
+            T[1] = T[1] + jnp.where(use_c, state.sm[sd], 0.0)
+            T[2] = T[2] + jnp.where(use_c, state.ssq[sd], 0.0)
+            T[3] = jnp.minimum(
+                T[3], jnp.where(use_c, state.mn[sd], _F32_MAX))
+            T[4] = jnp.maximum(
+                T[4], jnp.where(use_c, state.mx[sd], -_F32_MAX))
+        # pre-batch ring snapshot: slots strictly inside (t_win-L, t_win)
+        # — slot t_win % L can only hold t_win ± kL, never in range
+        r_win = state.ring_win[sd]                 # [nseg, L]
+        slot = jnp.arange(L)[None, :]
+        use_r = (r_win > (t_win - L)[:, None]) \
+            & (r_win < t_win[:, None]) & (slot != (t_win % L)[:, None])
+        T[0] = T[0] + jnp.sum(
+            jnp.where(use_r, state.ring_cnt[sd], 0.0), axis=1)
+        T[1] = T[1] + jnp.sum(
+            jnp.where(use_r, state.ring_sum[sd], 0.0), axis=1)
+        T[2] = T[2] + jnp.sum(
+            jnp.where(use_r, state.ring_ssq[sd], 0.0), axis=1)
+        T[3] = jnp.minimum(T[3], jnp.min(
+            jnp.where(use_r, state.ring_min[sd], _F32_MAX), axis=1))
+        T[4] = jnp.maximum(T[4], jnp.max(
+            jnp.where(use_r, state.ring_max[sd], -_F32_MAX), axis=1))
+        return T
+
+    # per-device carry info gathered per segment (trailing needs it on
+    # every segment of the device, not only the first)
+    first_win_dev = jnp.full(capacity, -2, jnp.int32).at[
+        jnp.where(live & seg_first, sd, capacity)].set(
+            seg_win, mode="drop")
+    c_win_dev = state.win[sd]
+    carry_final_dev = (c_win_dev >= 0) & (first_win_dev[sd] >= 0) \
+        & (c_win_dev != first_win_dev[sd])
+
+    t_cnt, t_sum, t_ssq, t_min, t_max = trailing(
+        m_cnt, m_sum, m_ssq, m_min, m_max, seg_win, include_batch=True)
+    seg_value = _agg_value(agg, t_cnt, t_sum, t_ssq, t_min, t_max, span_s)
+    match = final & (t_cnt >= min_count) & compare(op, seg_value,
+                                                   threshold)
+
+    cf_cnt, cf_sum, cf_ssq, cf_min, cf_max = trailing(
+        state.cnt[sd], state.sm[sd], state.ssq[sd], state.mn[sd],
+        state.mx[sd], c_win, include_batch=False)
+    carry_value = _agg_value(agg, cf_cnt, cf_sum, cf_ssq, cf_min, cf_max,
+                             span_s)
+    carry_match = carry_final & (cf_cnt >= min_count) & compare(
+        op, carry_value, threshold)
+
+    # ring update: push every window finalized this batch; on slot
+    # collision (a device spanning >= L hops in one batch) the LATEST
+    # window wins, decided by a win-max pre-pass so the scatter is
+    # conflict-free
+    if L > 1:
+        fin_seg = final
+        key_seg = jnp.where(fin_seg, sd * L + seg_win % L, capacity * L)
+        fin_carry = live & seg_first & carry_final
+        key_carry = jnp.where(fin_carry, sd * L + c_win % L,
+                              capacity * L)
+        slot_win = jnp.full(capacity * L + 1, -1, jnp.int32)
+        slot_win = slot_win.at[key_seg].max(
+            jnp.where(fin_seg, seg_win, -1), mode="drop")
+        slot_win = slot_win.at[key_carry].max(
+            jnp.where(fin_carry, c_win, -1), mode="drop")
+        win_seg = fin_seg & (slot_win[jnp.minimum(key_seg,
+                                                  capacity * L)] == seg_win)
+        win_car = fin_carry & (slot_win[jnp.minimum(key_carry,
+                                                    capacity * L)] == c_win)
+
+        def push(flat, key, mask, values, fill=None):
+            tgt = jnp.where(mask, key, capacity * L)
+            out = flat.reshape(-1)
+            pad = jnp.zeros(1, out.dtype)
+            out = jnp.concatenate([out, pad]).at[tgt].set(
+                values, mode="drop")[:-1]
+            return out.reshape(capacity, L)
+
+        rw, rc, rs, rq, rmn, rmx = (state.ring_win, state.ring_cnt,
+                                    state.ring_sum, state.ring_ssq,
+                                    state.ring_min, state.ring_max)
+        for mask, key, w, c, s_, q, lo, hi in (
+            (win_seg, key_seg, seg_win, m_cnt, m_sum, m_ssq, m_min,
+             m_max),
+            (win_car, key_carry, c_win, state.cnt[sd], state.sm[sd],
+             state.ssq[sd], state.mn[sd], state.mx[sd]),
+        ):
+            rw = push(rw, key, mask, w)
+            rc = push(rc, key, mask, c)
+            rs = push(rs, key, mask, s_)
+            rq = push(rq, key, mask, q)
+            rmn = push(rmn, key, mask, lo)
+            rmx = push(rmx, key, mask, hi)
+        state = dataclasses.replace(
+            state, ring_win=rw, ring_cnt=rc, ring_sum=rs, ring_ssq=rq,
+            ring_min=rmn, ring_max=rmx)
+
+    # new open-window carry: each device's last segment
+    tgt = jnp.where(seg_last, sd, capacity)
+    state = dataclasses.replace(
+        state,
+        win=state.win.at[tgt].set(seg_win, mode="drop"),
+        cnt=state.cnt.at[tgt].set(m_cnt, mode="drop"),
+        sm=state.sm.at[tgt].set(m_sum, mode="drop"),
+        ssq=state.ssq.at[tgt].set(m_ssq, mode="drop"),
+        mn=state.mn.at[tgt].set(m_min, mode="drop"),
+        mx=state.mx.at[tgt].set(m_max, mode="drop"),
+    )
+    out = {
+        "match": match[:n], "device": seg_dev[:n],
+        "win_start": ((seg_win - (L - 1)) * window_s)[:n],
+        "win_end": ((seg_win + 1) * window_s)[:n],
+        "value": seg_value[:n], "count": t_cnt[:n],
+        "carry_match": carry_match[:n],
+        "carry_win_start": ((c_win - (L - 1)) * window_s)[:n],
+        "carry_win_end": ((c_win + 1) * window_s)[:n],
+        "carry_value": carry_value[:n], "carry_count": cf_cnt[:n],
+        "occupied": jnp.sum(jnp.where(live, 1, 0)),
+    }
+    return state, out
+
+
+@partial(jax.jit, static_argnames=("window_s", "length", "agg", "op",
+                                   "min_count"))
+def window_flush(state: WindowOpState, threshold, *, window_s: int,
+                 length: int, agg: str, op: int, min_count: int):
+    """Finalize every open window (shutdown / end-of-history)."""
+    L = max(1, length)
+    span_s = float(window_s) * L
+    cnt, sm, ssq, mn, mx = (state.cnt, state.sm, state.ssq, state.mn,
+                            state.mx)
+    if L > 1:
+        t_win = state.win
+        slot = jnp.arange(L)[None, :]
+        use = (state.ring_win > (t_win - L)[:, None]) \
+            & (state.ring_win < t_win[:, None]) \
+            & (slot != (t_win % L)[:, None])
+        cnt = cnt + jnp.sum(jnp.where(use, state.ring_cnt, 0.0), axis=1)
+        sm = sm + jnp.sum(jnp.where(use, state.ring_sum, 0.0), axis=1)
+        ssq = ssq + jnp.sum(jnp.where(use, state.ring_ssq, 0.0), axis=1)
+        mn = jnp.minimum(mn, jnp.min(
+            jnp.where(use, state.ring_min, _F32_MAX), axis=1))
+        mx = jnp.maximum(mx, jnp.max(
+            jnp.where(use, state.ring_max, -_F32_MAX), axis=1))
+    value = _agg_value(agg, cnt, sm, ssq, mn, mx, span_s)
+    match = (state.win >= 0) & (cnt >= min_count) & compare(op, value,
+                                                            threshold)
+    return {
+        "match": match,
+        "win_start": (state.win - (L - 1)) * window_s,
+        "win_end": (state.win + 1) * window_s,
+        "value": value, "count": cnt,
+    }
+
+
+# ---------------------------------------------------------------------------
+# session operator
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SessionOpState:
+    """Per-device open session (start/last/count; start=-1 none)."""
+
+    start: jax.Array  # int32[D]
+    last: jax.Array   # int32[D]
+    cnt: jax.Array    # int32[D]
+
+    @classmethod
+    def empty(cls, capacity: int) -> "SessionOpState":
+        return cls(
+            start=jnp.full(capacity, -1, jnp.int32),
+            last=jnp.zeros(capacity, jnp.int32),
+            cnt=jnp.zeros(capacity, jnp.int32),
+        )
+
+
+@partial(jax.jit, static_argnames=("agg", "op"))
+def session_eval(state: SessionOpState, device_id, ts_s, ok,
+                 gap_s, threshold, *, agg: str, op: int):
+    """One batch through the session operator (gap-closed sessions)."""
+    n = device_id.shape[0]
+    capacity = state.start.shape[0]
+    order = sort_by_device_time(device_id, ts_s, ok)
+    dev = device_id[order]
+    ts = ts_s[order]
+    okr = ok[order] & (dev >= 0) & (dev < capacity)
+    idx = jnp.arange(n)
+    prev = jnp.maximum(idx - 1, 0)
+    prev_ok = jnp.where(idx > 0, okr[prev], False)
+    prev_dev = jnp.where(prev_ok, dev[prev], -1)
+    prev_ts = jnp.where(prev_ok, ts[prev], 0)
+    gap = jnp.asarray(gap_s, ts.dtype)
+    boundary = okr & (~prev_ok | (prev_dev != dev)
+                      | (ts - prev_ts > gap))
+    dev_first_row = okr & (~prev_ok | (prev_dev != dev))
+    seg = jnp.where(okr, jnp.cumsum(boundary) - 1, n)
+    nseg = n + 1
+    seg_cnt = jax.ops.segment_sum(
+        jnp.where(okr, 1, 0), seg, num_segments=nseg)
+    seg_start = jax.ops.segment_min(
+        jnp.where(okr, ts, _BIG_I32), seg, num_segments=nseg)
+    seg_end = jax.ops.segment_max(
+        jnp.where(okr, ts, -_BIG_I32), seg, num_segments=nseg)
+    seg_dev = jax.ops.segment_max(
+        jnp.where(okr, dev, -1), seg, num_segments=nseg)
+    seg_first = jax.ops.segment_max(
+        jnp.where(dev_first_row, 1, 0), seg, num_segments=nseg) > 0
+    live = seg_dev >= 0
+    next_dev = jnp.concatenate([seg_dev[1:], jnp.full(1, -1, jnp.int32)])
+    seg_last = live & (next_dev != seg_dev)
+
+    sd = jnp.clip(seg_dev, 0, capacity - 1)
+    c_active = live & seg_first & (state.start[sd] >= 0)
+    extends = c_active & (seg_start - state.last[sd] <= gap)
+    m_start = jnp.where(extends, state.start[sd], seg_start)
+    m_cnt = seg_cnt + jnp.where(extends, state.cnt[sd], 0)
+    carry_final = c_active & ~extends
+    final = live & ~seg_last
+
+    def _value(cnt, start, end):
+        if agg == "count":
+            return cnt.astype(jnp.float32)
+        if agg == "duration_s":
+            return (end - start).astype(jnp.float32)
+        raise ValueError(f"unknown session aggregate {agg!r}")
+
+    seg_value = _value(m_cnt, m_start, seg_end)
+    match = final & compare(op, seg_value, threshold)
+    # carry outputs read the PRE-update state (the session the batch
+    # just closed), captured before the scatter below replaces it
+    carry_start = state.start[sd]
+    carry_end = state.last[sd]
+    carry_cnt = state.cnt[sd]
+    carry_value = _value(carry_cnt, carry_start, carry_end)
+    carry_match = carry_final & compare(op, carry_value, threshold)
+
+    tgt = jnp.where(seg_last, sd, capacity)
+    state = dataclasses.replace(
+        state,
+        start=state.start.at[tgt].set(m_start, mode="drop"),
+        last=state.last.at[tgt].set(seg_end, mode="drop"),
+        cnt=state.cnt.at[tgt].set(m_cnt, mode="drop"),
+    )
+    return state, {
+        "match": match[:n], "device": seg_dev[:n],
+        "start": m_start[:n], "end": seg_end[:n],
+        "value": seg_value[:n], "count": m_cnt[:n],
+        "carry_match": carry_match[:n],
+        "carry_start": carry_start[:n], "carry_end": carry_end[:n],
+        "carry_count": carry_cnt[:n], "carry_value": carry_value[:n],
+    }
+
+
+@partial(jax.jit, static_argnames=("agg", "op"))
+def session_flush(state: SessionOpState, threshold, *, agg: str,
+                  op: int):
+    if agg == "count":
+        value = state.cnt.astype(jnp.float32)
+    else:
+        value = (state.last - state.start).astype(jnp.float32)
+    match = (state.start >= 0) & compare(op, value, threshold)
+    return {"match": match, "start": state.start, "end": state.last,
+            "value": value, "count": state.cnt}
+
+
+# ---------------------------------------------------------------------------
+# compiled queries (spec + state + host extraction)
+# ---------------------------------------------------------------------------
+
+
+def _pad(arr: np.ndarray, n: int, fill=0):
+    if len(arr) == n:
+        return arr
+    out = np.full(n, fill, arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+class CompiledQuery:
+    """Base driver: pads batches to pow2 buckets (bounded recompiles),
+    runs the jitted operator, extracts matches host-side."""
+
+    def __init__(self, spec, capacity: int, mtype_id: int = -1):
+        self.spec = spec
+        self.capacity = int(capacity)
+        self.mtype_id = int(mtype_id)
+        self.matches_emitted = 0
+        # window operators update this per eval: fraction of devices
+        # holding an open window (the occupancy gauge's source)
+        self.last_occupancy: Optional[float] = None
+
+    # subclasses: eval_cols(cols) -> List[QueryMatch]; flush() -> [...]
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def _prep(self, cols: Dict[str, np.ndarray]):
+        """Pad the needed columns to a pow2 bucket; returns jnp arrays
+        (device_id, ts_s, event_type, mtype_id, value, valid)."""
+        dev = np.asarray(cols["device_id"], np.int32)
+        n = len(dev)
+        b = pow2_at_least(max(n, 1), floor=64)
+        valid = np.zeros(b, bool)
+        valid[:n] = True
+        if "valid" in cols:
+            valid[:n] &= np.asarray(cols["valid"], bool)[:n]
+        return (
+            jnp.asarray(_pad(dev, b, -1)),
+            jnp.asarray(_pad(np.asarray(cols["ts_s"], np.int32), b)),
+            jnp.asarray(_pad(np.asarray(cols["event_type"], np.int32),
+                             b, -1)),
+            jnp.asarray(_pad(np.asarray(cols["mtype_id"], np.int32),
+                             b, -1)),
+            jnp.asarray(_pad(np.asarray(cols["value"], np.float32), b)),
+            jnp.asarray(valid),
+        )
+
+
+class CompiledWindowQuery(CompiledQuery):
+    def __init__(self, spec: WindowQuery, capacity: int,
+                 mtype_id: int = -1):
+        super().__init__(spec, capacity, mtype_id)
+        self.state = WindowOpState.empty(capacity, spec.length)
+
+    def reset(self) -> None:
+        self.state = WindowOpState.empty(self.capacity, self.spec.length)
+
+    def _row_filter(self, et, mt, valid):
+        ok = valid & (et == int(EventType.MEASUREMENT))
+        if self.mtype_id >= 0:
+            ok = ok & (mt == self.mtype_id)
+        return ok
+
+    def eval_cols(self, cols: Dict[str, np.ndarray]) -> List[QueryMatch]:
+        s = self.spec
+        dev, ts, et, mt, val, valid = self._prep(cols)
+        ok = self._row_filter(et, mt, valid)
+        self.state, out = window_eval(
+            self.state, dev, ts, val, ok, jnp.float32(s.threshold),
+            window_s=s.window_s, length=s.length, agg=s.agg, op=s.op,
+            min_count=s.min_count)
+        self.last_occupancy = float(
+            np.asarray((self.state.win >= 0)).mean())
+        return self._extract(out)
+
+    def _extract(self, out) -> List[QueryMatch]:
+        matches: List[QueryMatch] = []
+        host = {k: np.asarray(v) for k, v in out.items()
+                if k != "occupied"}
+        for i in np.nonzero(host["carry_match"])[0]:
+            matches.append(QueryMatch(
+                query=self.spec.name, kind="window",
+                device_id=int(host["device"][i]),
+                ts_s=int(host["carry_win_end"][i]),
+                start_ts_s=int(host["carry_win_start"][i]),
+                value=float(host["carry_value"][i]),
+                count=int(host["carry_count"][i])))
+        for i in np.nonzero(host["match"])[0]:
+            matches.append(QueryMatch(
+                query=self.spec.name, kind="window",
+                device_id=int(host["device"][i]),
+                ts_s=int(host["win_end"][i]),
+                start_ts_s=int(host["win_start"][i]),
+                value=float(host["value"][i]),
+                count=int(host["count"][i])))
+        matches.sort(key=lambda m: (m.ts_s, m.device_id))
+        self.matches_emitted += len(matches)
+        return matches
+
+    def flush(self) -> List[QueryMatch]:
+        s = self.spec
+        out = window_flush(
+            self.state, jnp.float32(s.threshold), window_s=s.window_s,
+            length=s.length, agg=s.agg, op=s.op, min_count=s.min_count)
+        host = {k: np.asarray(v) for k, v in out.items()}
+        matches = [
+            QueryMatch(
+                query=s.name, kind="window", device_id=int(d),
+                ts_s=int(host["win_end"][d]),
+                start_ts_s=int(host["win_start"][d]),
+                value=float(host["value"][d]),
+                count=int(host["count"][d]))
+            for d in np.nonzero(host["match"])[0]
+        ]
+        matches.sort(key=lambda m: (m.ts_s, m.device_id))
+        self.matches_emitted += len(matches)
+        self.reset()
+        return matches
+
+
+class CompiledSessionQuery(CompiledQuery):
+    def __init__(self, spec: SessionQuery, capacity: int,
+                 mtype_id: int = -1):
+        super().__init__(spec, capacity, mtype_id)
+        self.state = SessionOpState.empty(capacity)
+
+    def reset(self) -> None:
+        self.state = SessionOpState.empty(self.capacity)
+
+    def eval_cols(self, cols: Dict[str, np.ndarray]) -> List[QueryMatch]:
+        s = self.spec
+        dev, ts, et, mt, val, valid = self._prep(cols)
+        ok = valid
+        if self.mtype_id >= 0:
+            ok = ok & (et == int(EventType.MEASUREMENT)) \
+                & (mt == self.mtype_id)
+        self.state, out = session_eval(
+            self.state, dev, ts, ok, jnp.int32(s.gap_s),
+            jnp.float32(s.threshold), agg=s.agg, op=s.op)
+        host = {k: np.asarray(v) for k, v in out.items()}
+        matches: List[QueryMatch] = []
+        for i in np.nonzero(host["carry_match"])[0]:
+            matches.append(QueryMatch(
+                query=s.name, kind="session",
+                device_id=int(host["device"][i]),
+                ts_s=int(host["carry_end"][i]),
+                start_ts_s=int(host["carry_start"][i]),
+                value=float(host["carry_value"][i]),
+                count=int(host["carry_count"][i])))
+        for i in np.nonzero(host["match"])[0]:
+            matches.append(QueryMatch(
+                query=s.name, kind="session",
+                device_id=int(host["device"][i]),
+                ts_s=int(host["end"][i]),
+                start_ts_s=int(host["start"][i]),
+                value=float(host["value"][i]),
+                count=int(host["count"][i])))
+        matches.sort(key=lambda m: (m.ts_s, m.device_id))
+        self.matches_emitted += len(matches)
+        return matches
+
+    def flush(self) -> List[QueryMatch]:
+        s = self.spec
+        out = session_flush(self.state, jnp.float32(s.threshold),
+                            agg=s.agg, op=s.op)
+        host = {k: np.asarray(v) for k, v in out.items()}
+        matches = [
+            QueryMatch(
+                query=s.name, kind="session", device_id=int(d),
+                ts_s=int(host["end"][d]),
+                start_ts_s=int(host["start"][d]),
+                value=float(host["value"][d]), count=int(host["count"][d]))
+            for d in np.nonzero(host["match"])[0]
+        ]
+        matches.sort(key=lambda m: (m.ts_s, m.device_id))
+        self.matches_emitted += len(matches)
+        self.reset()
+        return matches
+
+
+class CompiledPatternQuery(CompiledQuery):
+    def __init__(self, spec: PatternQuery, capacity: int,
+                 cross_mtype_id: int = -1):
+        super().__init__(spec, capacity, cross_mtype_id)
+        self.program = CepProgram.compile(
+            spec.steps, window_s=spec.window_s, cross_op=spec.cross_op,
+            cross_threshold=spec.cross_threshold,
+            cross_mtype=cross_mtype_id)
+        self.evaluator = PatternEvaluator(self.program, capacity)
+
+    def reset(self) -> None:
+        self.evaluator.reset()
+
+    def eval_cols(self, cols: Dict[str, np.ndarray]) -> List[QueryMatch]:
+        dev, ts, et, mt, val, valid = self._prep(cols)
+        raw = self.evaluator.eval_batch(dev, ts, et, mt, val, valid)
+        matches = [
+            QueryMatch(
+                query=self.spec.name, kind="pattern",
+                device_id=m["device_id"], ts_s=m["ts_s"],
+                start_ts_s=m["first_ts_s"], value=m["value"], count=1)
+            for m in raw
+        ]
+        self.matches_emitted += len(matches)
+        return matches
+
+    def flush(self) -> List[QueryMatch]:
+        self.reset()   # patterns have no deferred windows to finalize
+        return []
+
+
+def compile_query(spec, capacity: int, resolve_mtype=None):
+    """Spec → compiled query (the one-compile entry point)."""
+    def handle(name):
+        if name is None or resolve_mtype is None:
+            return -1
+        return int(resolve_mtype(str(name)))
+
+    if isinstance(spec, WindowQuery):
+        return CompiledWindowQuery(spec, capacity, handle(spec.mtype))
+    if isinstance(spec, SessionQuery):
+        return CompiledSessionQuery(spec, capacity, handle(spec.mtype))
+    if isinstance(spec, PatternQuery):
+        return CompiledPatternQuery(spec, capacity,
+                                    handle(spec.cross_mtype))
+    raise ValueError(f"not a query spec: {spec!r}")

@@ -14,12 +14,20 @@ count/sum/sumsq; trailing-baseline mean/std come from shifted cumulative
 sums along the window axis; an anomaly is a window whose mean deviates
 more than ``z_threshold`` standard deviations from its trailing baseline
 (minimum sample counts guard cold starts).
+
+This module also hosts :class:`QueryRunner`, the registry + execution
+surface of the streaming query layer (:mod:`sitewhere_tpu.analytics.
+query`): registered Window/Session/Pattern queries evaluate live on the
+dispatcher's enriched batches and retrospectively over the sealed event
+store — the Siddhi-CEP + Spark-job capability tier as one subsystem.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
+import time
 import functools
 from functools import partial
 from typing import Dict, List, Optional
@@ -28,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.schema import EventType
 
 
@@ -493,6 +502,420 @@ class AnalyticsJob:
             cols["device_id"], cols["ts_s"], cols["value"],
             n_devices=n_devices, token_of=token_of, mesh=mesh,
         )
+
+
+class _LiveQuery:
+    """One registered query: spec + compiled live operator + stats."""
+
+    __slots__ = ("spec", "compiled", "matches", "live_matches",
+                 "retro_runs", "created_s", "timer", "retro_timer",
+                 "counter")
+
+    def __init__(self, spec, compiled, max_matches: int, timer,
+                 retro_timer, counter):
+        import collections
+        import time as _time
+
+        self.spec = spec
+        self.compiled = compiled
+        self.matches: "collections.deque" = collections.deque(
+            maxlen=max_matches)
+        self.live_matches = 0
+        self.retro_runs = 0
+        self.created_s = int(_time.time())
+        self.timer = timer              # live per-batch eval
+        self.retro_timer = retro_timer  # whole-scan retrospective runs
+        self.counter = counter
+
+
+class QueryRunner(LifecycleComponent):
+    """Registered streaming queries: live evaluation + retrospective runs.
+
+    The query surface of the streaming analytics subsystem (H-STREAM,
+    arXiv:2108.03485): a registered :class:`~sitewhere_tpu.analytics.
+    query.WindowQuery` / ``SessionQuery`` / ``PatternQuery`` compiles
+    ONCE; the dispatcher's egress hands every accepted enriched batch to
+    :meth:`submit_live` (a non-blocking bounded offer onto the runner's
+    own worker thread, so a slow query can never stall egress), and
+    :meth:`run_retrospective` streams the SAME compiled operator over the
+    sealed event store (zone-map/bloom-pruned chunks) with fresh state —
+    identical matches on identical data, by construction.
+
+    Overload contract: live evaluation is a NON-priority consumer — it
+    sheds from SHEDDING via the same ladder gate as bulk outbound
+    fan-out; retrospective scans are gated at the REST edge (refused
+    from DEGRADED like the other analytics endpoints).  Matches fan out
+    through the outbound connector path as synthetic STATE_CHANGE rows,
+    so priority connectors (alert notifiers) still see them under load.
+    """
+
+    _LIVE_COLS = ("device_id", "ts_s", "event_type", "mtype_id", "value")
+
+    def __init__(self, capacity: int, resolve_mtype=None, event_store=None,
+                 outbound=None, overload=None, metrics=None, tracer=None,
+                 max_queries: int = 32, max_matches: int = 1024,
+                 queue_depth: int = 64, fanout_matches: bool = True,
+                 name: str = "analytics-queries"):
+        import queue as _queue
+
+        super().__init__(name)
+        self.capacity = int(capacity)
+        self.resolve_mtype = resolve_mtype
+        self.event_store = event_store
+        self.outbound = outbound
+        self.overload = overload
+        self.tracer = tracer
+        self.max_queries = int(max_queries)
+        self.max_matches = int(max_matches)
+        self.fanout_matches = bool(fanout_matches)
+        if metrics is None:
+            from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self._m_queries = metrics.gauge("analytics.queries")
+        self._m_batches = metrics.counter("analytics.live_batches")
+        self._m_dropped = metrics.counter("analytics.live_dropped")
+        self._m_shed = metrics.counter("analytics.live_shed")
+        self._m_retro_rows = metrics.counter("analytics.retro_rows")
+        self._m_retro_runs = metrics.counter("analytics.retro_runs")
+        self._m_occupancy = metrics.gauge("analytics.window_occupancy")
+        self._lock = threading.RLock()
+        # serializes mutation of compiled live state: the worker's
+        # eval_cols vs flush_live's flush()/reset() (REST thread) —
+        # interleaving them would re-open flushed windows and emit
+        # duplicate matches
+        self._eval_mutex = threading.Lock()
+        self._queries: Dict[str, _LiveQuery] = {}
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=queue_depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        super().start()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._worker, name=f"{self.name}-eval", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        # Drain BEFORE signalling: the dispatcher (stopped first in the
+        # instance's reverse-order teardown) has just offered its final
+        # accepted batches — abandoning them would silently lose their
+        # matches, the analytics analog of skipping the final offset
+        # commit.
+        if self._thread is not None:
+            self.drain(timeout_s=5.0)
+        self._stop.set()
+        if self._thread is not None:
+            try:
+                self._q.put_nowait(None)
+            except Exception:
+                pass
+            self._thread.join(timeout=5)
+            self._thread = None
+        super().stop()
+
+    # -- registry -----------------------------------------------------------
+
+    def register(self, doc: Dict[str, object]) -> Dict[str, object]:
+        """Register (or replace) a query from its REST doc; compiles the
+        operator immediately so a bad spec fails the POST, not the
+        first batch."""
+        from sitewhere_tpu.analytics.query import compile_query, parse_query
+        from sitewhere_tpu.runtime.metrics import sanitize_metric_name
+        from sitewhere_tpu.services.common import ValidationError
+
+        try:
+            spec = parse_query(doc, resolve_mtype=self.resolve_mtype)
+            compiled = compile_query(spec, self.capacity,
+                                     resolve_mtype=self.resolve_mtype)
+        except ValueError as e:
+            raise ValidationError(str(e)) from e
+        tag = sanitize_metric_name(f"analytics.q.{spec.name}").split(
+            ".", 2)[-1]
+        entry = _LiveQuery(
+            spec, compiled, self.max_matches,
+            timer=self.metrics.timer(f"analytics.eval_s.{tag}"),
+            retro_timer=self.metrics.timer(f"analytics.retro_s.{tag}"),
+            counter=self.metrics.counter(f"analytics.matches.{tag}"))
+        with self._lock:
+            # distinct names must not silently share metric instruments
+            # through name sanitization ("temp high" vs "temp-high")
+            for other in self._queries.values():
+                if other.spec.name != spec.name \
+                        and other.counter is entry.counter:
+                    raise ValidationError(
+                        f"query name {spec.name!r} collides with "
+                        f"{other.spec.name!r} after metric-name "
+                        "sanitization; pick a distinct name")
+            if (spec.name not in self._queries
+                    and len(self._queries) >= self.max_queries):
+                raise ValidationError(
+                    f"query limit {self.max_queries} reached")
+            self._queries[spec.name] = entry
+            self._m_queries.set(len(self._queries))
+        return self.describe(spec.name)
+
+    def describe(self, name: str) -> Dict[str, object]:
+        from sitewhere_tpu.analytics.query import describe_query
+        from sitewhere_tpu.services.common import EntityNotFound
+
+        with self._lock:
+            entry = self._queries.get(name)
+        if entry is None:
+            raise EntityNotFound(f"no query {name!r}")
+        return {
+            "query": describe_query(entry.spec),
+            "liveMatches": entry.live_matches,
+            "retrospectiveRuns": entry.retro_runs,
+            "created_s": entry.created_s,
+        }
+
+    def list_queries(self) -> List[Dict[str, object]]:
+        from sitewhere_tpu.analytics.query import describe_query
+
+        # one lock pass: a concurrent DELETE must not 404 the listing
+        with self._lock:
+            entries = [self._queries[n] for n in sorted(self._queries)]
+            return [{
+                "query": describe_query(e.spec),
+                "liveMatches": e.live_matches,
+                "retrospectiveRuns": e.retro_runs,
+                "created_s": e.created_s,
+            } for e in entries]
+
+    def remove(self, name: str) -> Dict[str, object]:
+        """Deregister a query.  Its metric instruments stay in the
+        registry (MetricsRegistry has no deletion; re-registering the
+        name reuses them) — exposition growth is bounded by distinct
+        names ever registered, not by churn of the same names."""
+        from sitewhere_tpu.services.common import EntityNotFound
+
+        with self._lock:
+            entry = self._queries.pop(name, None)
+            self._m_queries.set(len(self._queries))
+        if entry is None:
+            raise EntityNotFound(f"no query {name!r}")
+        return {"removed": name}
+
+    def recent_matches(self, name: str,
+                       limit: int = 100) -> List[Dict[str, object]]:
+        from sitewhere_tpu.services.common import EntityNotFound
+
+        with self._lock:
+            entry = self._queries.get(name)
+            if entry is None:
+                raise EntityNotFound(f"no query {name!r}")
+            out = list(entry.matches)[-max(1, int(limit)):]
+        return [m.to_dict() for m in out]
+
+    # -- live mode ----------------------------------------------------------
+
+    def submit_live(self, cols: Dict[str, np.ndarray], mask: np.ndarray,
+                    trace=None) -> None:
+        """Offer one accepted enriched batch (non-blocking; called from
+        dispatcher egress).  Sheds as a non-priority consumer from
+        SHEDDING up; drops (counted) when the eval queue is full."""
+        with self._lock:
+            if not self._queries:
+                return
+        if self.overload is not None \
+                and not self.overload.allow_fanout(priority=False):
+            self._m_shed.inc()
+            return
+        mask = np.asarray(mask)
+        # boolean fancy-indexing already yields fresh arrays — no
+        # second copy on the egress path
+        batch = {k: np.asarray(cols[k])[mask] for k in self._LIVE_COLS}
+        try:
+            self._q.put_nowait(batch)
+        except Exception:
+            self._m_dropped.inc()
+
+    def drain(self, timeout_s: float = 10.0) -> None:
+        """Block until every offered batch has been evaluated."""
+        deadline = time.monotonic() + timeout_s
+        with self._q.all_tasks_done:
+            while self._q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._q.all_tasks_done.wait(remaining)
+
+    def flush_live(self, name: Optional[str] = None) -> int:
+        """Finalize open windows/sessions of live state (drains first).
+        Returns the number of matches emitted."""
+        from sitewhere_tpu.services.common import EntityNotFound
+
+        self.drain()
+        with self._lock:
+            entries = [e for n, e in sorted(self._queries.items())
+                       if name is None or n == name]
+        if name is not None and not entries:
+            raise EntityNotFound(f"no query {name!r}")
+        emitted = 0
+        for entry in entries:
+            with self._eval_mutex:
+                matches = entry.compiled.flush()
+            self._record(entry, matches, live=True)
+            emitted += len(matches)
+        return emitted
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                batch = self._q.get(timeout=0.1)
+            except Exception:
+                continue
+            try:
+                if batch is None:
+                    continue
+                self._m_batches.inc()
+                self._eval_batch(batch)
+            except Exception:
+                logging.getLogger("sitewhere_tpu.analytics").exception(
+                    "live analytics eval failed")
+            finally:
+                self._q.task_done()
+
+    def _eval_batch(self, batch: Dict[str, np.ndarray]) -> None:
+        from sitewhere_tpu.runtime.tracing import _NOOP_TRACE
+
+        with self._lock:
+            entries = list(self._queries.values())
+        trace = (self.tracer.trace("analytics.eval")
+                 if self.tracer is not None else _NOOP_TRACE)
+        for entry in entries:
+            with trace.span("analytics.query") as sp:
+                sp.tag("query", entry.spec.name)
+                sp.tag("rows", int(len(batch["device_id"])))
+                with entry.timer.time(), self._eval_mutex:
+                    matches = entry.compiled.eval_cols(batch)
+            occ = getattr(entry.compiled, "last_occupancy", None)
+            if occ is not None:
+                self._m_occupancy.set(occ)
+            self._record(entry, matches, live=True)
+        trace.end()
+
+    def _record(self, entry: _LiveQuery, matches, live: bool) -> None:
+        if not matches:
+            return
+        entry.counter.inc(len(matches))
+        with self._lock:
+            if live:
+                entry.live_matches += len(matches)
+                entry.matches.extend(matches)
+        if live and self.fanout_matches and self.outbound is not None:
+            cols, mask = self._match_columns(matches)
+            try:
+                self.outbound.submit(cols, mask)
+            except Exception:
+                logging.getLogger("sitewhere_tpu.analytics").exception(
+                    "match fan-out failed")
+
+    def _match_columns(self, matches):
+        """Matches as a synthetic enriched column batch (STATE_CHANGE
+        rows) so they ride the existing outbound/connector path."""
+        from sitewhere_tpu.ids import NULL_ID
+        from sitewhere_tpu.schema import EventType
+
+        n = len(matches)
+        cols = {
+            "device_id": np.asarray([m.device_id for m in matches],
+                                    np.int32),
+            "tenant_id": np.zeros(n, np.int32),
+            "event_type": np.full(n, int(EventType.STATE_CHANGE),
+                                  np.int32),
+            "ts_s": np.asarray([m.ts_s for m in matches], np.int32),
+            "ts_ns": np.zeros(n, np.int32),
+            "mtype_id": np.full(n, NULL_ID, np.int32),
+            "value": np.asarray([m.value for m in matches], np.float32),
+            "lat": np.zeros(n, np.float32),
+            "lon": np.zeros(n, np.float32),
+            "elevation": np.zeros(n, np.float32),
+            "alert_code": np.full(n, NULL_ID, np.int32),
+            "alert_level": np.zeros(n, np.int32),
+            "command_id": np.full(n, NULL_ID, np.int32),
+            "payload_ref": np.full(n, NULL_ID, np.int32),
+            "device_type_id": np.full(n, NULL_ID, np.int32),
+            "assignment_id": np.full(n, NULL_ID, np.int32),
+            "area_id": np.full(n, NULL_ID, np.int32),
+            "customer_id": np.full(n, NULL_ID, np.int32),
+            "asset_id": np.full(n, NULL_ID, np.int32),
+        }
+        return cols, np.ones(n, bool)
+
+    # -- retrospective mode -------------------------------------------------
+
+    def run_retrospective(self, name: str, start_s: Optional[int] = None,
+                          end_s: Optional[int] = None,
+                          store=None) -> Dict[str, object]:
+        """Stream the query's compiled operator over the sealed event
+        store with FRESH state: same kernels, same carry logic, same
+        matches as live mode would have produced over those events.
+        Chunk pruning (zone maps + blooms + time bounds) runs in the
+        store's scan API, and the bounded column cache keeps the
+        resident set flat regardless of history size."""
+        from sitewhere_tpu.analytics.query import (
+            WindowQuery,
+            compile_query,
+        )
+        from sitewhere_tpu.runtime.tracing import _NOOP_TRACE
+        from sitewhere_tpu.schema import EventType
+        from sitewhere_tpu.services.common import EntityNotFound
+
+        store = store or self.event_store
+        if store is None:
+            raise EntityNotFound("no event store configured")
+        with self._lock:
+            entry = self._queries.get(name)
+        if entry is None:
+            raise EntityNotFound(f"no query {name!r}")
+        compiled = compile_query(entry.spec, self.capacity,
+                                 resolve_mtype=self.resolve_mtype)
+        filters: Dict[str, object] = {"start_s": start_s, "end_s": end_s}
+        if isinstance(entry.spec, WindowQuery):
+            # window queries only consume measurements — let the store
+            # prune non-measurement chunks via its zone maps
+            filters["event_type"] = int(EventType.MEASUREMENT)
+            if compiled.mtype_id >= 0:
+                filters["mtype_id"] = compiled.mtype_id
+        trace = (self.tracer.trace("analytics.retrospective")
+                 if self.tracer is not None else _NOOP_TRACE)
+        rows = 0
+        chunks = 0
+        matches = []
+        with trace.span("analytics.scan") as sp:
+            sp.tag("query", name)
+            # the retro timer, not the live one: a multi-second whole
+            # -history scan must not blow out the per-batch live p99
+            with entry.retro_timer.time():
+                for cols in store.iter_chunks(**filters):
+                    n = len(cols["ts_s"])
+                    if n == 0:
+                        continue
+                    rows += n
+                    chunks += 1
+                    matches.extend(compiled.eval_cols(cols))
+                matches.extend(compiled.flush())
+            sp.tag("rows", rows)
+            sp.tag("chunks", chunks)
+            sp.tag("matches", len(matches))
+        trace.end()
+        entry.counter.inc(len(matches))
+        self._m_retro_rows.inc(rows)
+        self._m_retro_runs.inc()
+        with self._lock:
+            entry.retro_runs += 1
+        return {
+            "query": name,
+            "rows": rows,
+            "chunks": chunks,
+            "matches": [m.to_dict() for m in matches],
+        }
 
 
 class EventTap:

@@ -1,0 +1,300 @@
+"""Window kernel library: tumbling/sliding aggregation + sessionization.
+
+The substrate of the streaming analytics subsystem (H-STREAM,
+arXiv:2108.03485 — one windowed operator over both live streams and
+their histories).  Two kernel families, both jitted struct-of-array
+programs with static shapes:
+
+- **Grid kernels** over the ``[D, W]`` (device x window) layout the
+  anomaly runner introduced: :func:`aggregate_windows` scatters N events
+  into dense per-(device, window) count/sum/sumsq/min/max statistics in
+  one pass, and :func:`sliding_aggregates` turns the tumbling grid into
+  trailing-L sliding statistics with one ``lax.reduce_window`` per
+  field.  Chart bucketing (:mod:`sitewhere_tpu.analytics.charts`), the
+  retrospective estimators, and the bench all run on these — one
+  aggregation path, so charts and queries cannot disagree.
+- **Segment kernels** over sorted event rows: :func:`sort_by_device_time`
+  (two stable argsorts — no int64 keys on device) and
+  :func:`sessionize`, the gap-based session assignment via sorted
+  segment-boundary cumsum: a session boundary is a device change or an
+  inter-event gap strictly greater than ``gap_s``; session ids are the
+  running cumsum of boundaries, and per-session stats are one
+  ``segment_sum``/``min``/``max`` each.  The compiled query operator
+  (:mod:`sitewhere_tpu.analytics.query`) builds on the same
+  boundary-cumsum machinery.
+
+Numerical note: variance here is the sumsq form (``ssq/n - mean^2``,
+clamped at 0) because sumsq — unlike residual m2 — combines linearly
+across windows, which is what sliding combination and cross-batch
+carry need.  That form cancels catastrophically in float32 once values
+reach ~1e4 with small spread; ``AnalyticsJob`` centers in host float64
+before scattering for exactly that reason (``runner.run_columns``),
+but the STREAMING operators cannot (centering needs the global mean,
+which live mode doesn't have yet).  Contract: ``std``-aggregate
+queries and chart buckets are well-conditioned for values up to ~1e3;
+large-magnitude series should be offset at the decoder or queried via
+mean/min/max, which don't difference large squares.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sitewhere_tpu.schema import ComparisonOp
+
+_BIG_I32 = jnp.int32(2**31 - 1)
+_F32_MAX = jnp.float32(3.0e38)
+
+
+def compare(op: int, value, threshold):
+    """Static-op comparison (python dispatch; ``op`` is a config int)."""
+    op = int(op)
+    if op == int(ComparisonOp.GT):
+        return value > threshold
+    if op == int(ComparisonOp.LT):
+        return value < threshold
+    if op == int(ComparisonOp.GTE):
+        return value >= threshold
+    if op == int(ComparisonOp.LTE):
+        return value <= threshold
+    if op == int(ComparisonOp.EQ):
+        return value == threshold
+    if op == int(ComparisonOp.NEQ):
+        return value != threshold
+    raise ValueError(f"unknown comparison op {op}")
+
+
+def compare_traced(op, value, threshold):
+    """Traced-op comparison (``op`` is a device array — CEP step tables
+    evaluate every row against its step's op in one vectorized select)."""
+    outs = jnp.stack([
+        value > threshold, value < threshold,
+        value >= threshold, value <= threshold,
+        value == threshold, value != threshold,
+    ])
+    return jnp.take_along_axis(
+        outs, jnp.clip(op, 0, 5)[None, ...], axis=0)[0]
+
+
+# ---------------------------------------------------------------------------
+# grid kernels ([D, W] layout)
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class WindowAggregates:
+    """Dense per-(device, window) aggregates: the [D, W] stats grid."""
+
+    counts: jax.Array   # int32[D, W]
+    sums: jax.Array     # float32[D, W]
+    sumsqs: jax.Array   # float32[D, W]
+    mins: jax.Array     # float32[D, W] (+FLT_MAX where empty)
+    maxs: jax.Array     # float32[D, W] (-FLT_MAX where empty)
+
+    @property
+    def n_devices(self) -> int:
+        return self.counts.shape[0]
+
+    @property
+    def n_windows(self) -> int:
+        return self.counts.shape[1]
+
+    def means(self) -> jax.Array:
+        return self.sums / jnp.maximum(self.counts, 1).astype(jnp.float32)
+
+    def variances(self) -> jax.Array:
+        n = jnp.maximum(self.counts, 1).astype(jnp.float32)
+        m = self.sums / n
+        return jnp.maximum(self.sumsqs / n - m * m, 0.0)
+
+    def stds(self) -> jax.Array:
+        return jnp.sqrt(self.variances())
+
+    def rates(self, window_s: float) -> jax.Array:
+        return self.counts.astype(jnp.float32) / jnp.float32(window_s)
+
+    def aggregate(self, agg: str, window_s: float = 1.0) -> jax.Array:
+        """One named aggregate surface over the grid (count/sum/mean/
+        min/max/std/rate) — the single place queries, charts, and the
+        bench resolve an aggregate name to numbers."""
+        if agg == "count":
+            return self.counts.astype(jnp.float32)
+        if agg == "sum":
+            return self.sums
+        if agg == "mean":
+            return self.means()
+        if agg == "min":
+            return jnp.where(self.counts > 0, self.mins, 0.0)
+        if agg == "max":
+            return jnp.where(self.counts > 0, self.maxs, 0.0)
+        if agg == "std":
+            return self.stds()
+        if agg == "rate":
+            return self.rates(window_s)
+        raise ValueError(f"unknown aggregate {agg!r}")
+
+    def occupancy(self) -> jax.Array:
+        """Fraction of grid cells holding at least one event (the
+        window-grid occupancy gauge)."""
+        return (self.counts > 0).mean()
+
+
+AGGREGATES = ("count", "sum", "mean", "min", "max", "std", "rate")
+
+
+@partial(jax.jit, static_argnames=("n_devices", "n_windows"))
+def aggregate_windows(
+    device_id: jax.Array,   # int32[N]
+    window_idx: jax.Array,  # int32[N]
+    value: jax.Array,       # float32[N]
+    valid: jax.Array,       # bool[N]
+    n_devices: int,
+    n_windows: int,
+) -> WindowAggregates:
+    """Scatter N events into the [D, W] aggregate grid (one pass)."""
+    cells = n_devices * n_windows
+    ok = (
+        valid
+        & (device_id >= 0) & (device_id < n_devices)
+        & (window_idx >= 0) & (window_idx < n_windows)
+    )
+    flat = jnp.where(ok, device_id * n_windows + window_idx, cells)
+    v = jnp.where(ok, value, 0.0)
+    counts = jnp.zeros(cells + 1, jnp.int32).at[flat].add(1, mode="drop")
+    sums = jnp.zeros(cells + 1, jnp.float32).at[flat].add(v, mode="drop")
+    sumsqs = jnp.zeros(cells + 1, jnp.float32).at[flat].add(
+        v * v, mode="drop")
+    mins = jnp.full(cells + 1, _F32_MAX, jnp.float32).at[flat].min(
+        jnp.where(ok, value, _F32_MAX), mode="drop")
+    maxs = jnp.full(cells + 1, -_F32_MAX, jnp.float32).at[flat].max(
+        jnp.where(ok, value, -_F32_MAX), mode="drop")
+    shape = (n_devices, n_windows)
+    return WindowAggregates(
+        counts=counts[:cells].reshape(shape),
+        sums=sums[:cells].reshape(shape),
+        sumsqs=sumsqs[:cells].reshape(shape),
+        mins=mins[:cells].reshape(shape),
+        maxs=maxs[:cells].reshape(shape),
+    )
+
+
+@partial(jax.jit, static_argnames=("length",))
+def sliding_aggregates(agg: WindowAggregates,
+                       length: int) -> WindowAggregates:
+    """Trailing-``length``-hop sliding aggregates at every hop.
+
+    Window w of the result covers tumbling hops (w-length, w] — the
+    sliding window ENDING at hop w.  Sum-like fields combine by
+    addition, min/max by min/max; each is one ``lax.reduce_window``
+    over the left-padded window axis, so sliding stats cost O(D*W*L)
+    with no per-window loop.
+    """
+    if length < 1:
+        raise ValueError("sliding length must be >= 1")
+    pad = ((0, 0), (length - 1, 0))
+
+    def roll(x, init, op):
+        # init must be a static python scalar for reduce_window
+        padded = jnp.pad(x, pad, constant_values=x.dtype.type(init))
+        return lax.reduce_window(padded, x.dtype.type(init), op,
+                                 (1, length), (1, 1), "VALID")
+
+    return WindowAggregates(
+        counts=roll(agg.counts, 0, lax.add),
+        sums=roll(agg.sums, 0.0, lax.add),
+        sumsqs=roll(agg.sumsqs, 0.0, lax.add),
+        mins=roll(agg.mins, 3.0e38, lax.min),
+        maxs=roll(agg.maxs, -3.0e38, lax.max),
+    )
+
+
+# ---------------------------------------------------------------------------
+# segment kernels (sorted event rows)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def sort_by_device_time(device_id: jax.Array, ts_s: jax.Array,
+                        valid: jax.Array) -> jax.Array:
+    """Stable (device, ts) sort order with invalid rows LAST.
+
+    Two stable argsorts compose into a lexicographic sort without int64
+    keys; ties (equal device+ts) keep arrival order — the property the
+    live/retrospective equivalence argument leans on.
+    """
+    dev = jnp.where(valid, device_id, _BIG_I32)
+    order = jnp.argsort(ts_s, stable=True)
+    return order[jnp.argsort(dev[order], stable=True)]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SessionAssignment:
+    """Sessionization output: per-event ids + per-session stats.
+
+    ``session_id`` aligns with the INPUT row order (-1 for invalid
+    rows); the per-session arrays are sized N (a batch of N events can
+    hold at most N sessions) with ``n_sessions`` giving the live count.
+    Sessions are numbered in (device, start-time) order.
+    """
+
+    session_id: jax.Array    # int32[N], -1 for invalid rows
+    n_sessions: jax.Array    # int32[]
+    device_id: jax.Array     # int32[N] per session (NULL rows: -1)
+    start_ts_s: jax.Array    # int32[N]
+    end_ts_s: jax.Array      # int32[N]
+    counts: jax.Array        # int32[N]
+
+
+@jax.jit
+def sessionize(device_id: jax.Array, ts_s: jax.Array, valid: jax.Array,
+               gap_s) -> SessionAssignment:
+    """Gap-based session assignment via sorted segment-boundary cumsum.
+
+    Two events of one device share a session iff their gap is at most
+    ``gap_s`` (a gap EXACTLY equal to ``gap_s`` keeps the session; only
+    a strictly greater gap closes it).  Sessions never span devices.
+    """
+    n = device_id.shape[0]
+    order = sort_by_device_time(device_id, ts_s, valid)
+    dev_s = device_id[order]
+    ts_sorted = ts_s[order]
+    ok = valid[order]
+    idx = jnp.arange(n)
+    prev_dev = jnp.where(idx > 0, dev_s[jnp.maximum(idx - 1, 0)], -1)
+    prev_ts = jnp.where(idx > 0, ts_sorted[jnp.maximum(idx - 1, 0)], 0)
+    prev_ok = jnp.where(idx > 0, ok[jnp.maximum(idx - 1, 0)], False)
+    boundary = ok & (
+        ~prev_ok
+        | (dev_s != prev_dev)
+        | (ts_sorted - prev_ts > jnp.asarray(gap_s, ts_sorted.dtype))
+    )
+    sid_sorted = jnp.where(ok, jnp.cumsum(boundary) - 1, -1)
+    n_sessions = jnp.max(sid_sorted, initial=-1) + 1
+    # per-session stats: one segment reduction each (drop bucket n)
+    seg = jnp.where(ok, sid_sorted, n)
+    counts = jax.ops.segment_sum(
+        jnp.ones(n, jnp.int32), seg, num_segments=n + 1)
+    start = jax.ops.segment_min(
+        jnp.where(ok, ts_sorted, _BIG_I32), seg, num_segments=n + 1)
+    end = jax.ops.segment_max(
+        jnp.where(ok, ts_sorted, -_BIG_I32), seg, num_segments=n + 1)
+    dev = jax.ops.segment_max(
+        jnp.where(ok, dev_s, -1), seg, num_segments=n + 1)
+    live = jnp.arange(n) < n_sessions
+    # session ids back in input-row order
+    session_id = jnp.zeros(n, jnp.int32).at[order].set(sid_sorted)
+    return SessionAssignment(
+        session_id=session_id,
+        n_sessions=n_sessions.astype(jnp.int32),
+        device_id=jnp.where(live, dev[:n], -1).astype(jnp.int32),
+        start_ts_s=jnp.where(live, start[:n], 0).astype(jnp.int32),
+        end_ts_s=jnp.where(live, end[:n], 0).astype(jnp.int32),
+        counts=jnp.where(live, counts[:n], 0).astype(jnp.int32),
+    )

@@ -86,9 +86,11 @@ class _Session:
     """One connected client: socket + subscriptions + a write lock
     (fan-out writes come from OTHER clients' reader threads)."""
 
-    def __init__(self, client_id: str, sock: socket.socket):
+    def __init__(self, client_id: str, sock: socket.socket,
+                 keepalive: int = 0):
         self.client_id = client_id
         self.sock = sock
+        self.keepalive = int(keepalive)  # negotiated seconds; 0 = none
         self.subs: Dict[str, int] = {}  # filter -> granted qos
         self.lock = threading.Lock()
         self.packet_id = 0
@@ -125,8 +127,10 @@ class MqttBroker:
         self.delivered = 0
         self.tap_failures = 0
         self.sheds = 0
-        # cap on the per-shed read pause so a long Retry-After hint can
-        # never freeze a session past its keepalive grace
+        # floor cap on the per-shed read pause; sessions that negotiated
+        # a keepalive get a LONGER per-session deadline derived from it
+        # (see shed_pause_s) — chatty devices pause longer without
+        # tripping the 1.5x keepalive reaper
         self.max_shed_pause_s = 0.25
 
     # -- lifecycle -----------------------------------------------------------
@@ -207,7 +211,7 @@ class MqttBroker:
                 conn.sendall(bytes([CONNACK << 4, 2, 0, 0x02]))
                 return None
             client_id = f"auto-{uuid.uuid4().hex[:12]}"
-        session = _Session(client_id, conn)
+        session = _Session(client_id, conn, keepalive=keepalive)
         with self._lock:
             old = self._sessions.pop(client_id, None)
             self._sessions[client_id] = session
@@ -266,6 +270,27 @@ class MqttBroker:
             except OSError:
                 pass
 
+    def shed_pause_s(self, session: _Session, hint_s: float) -> float:
+        """Per-session shed-pause deadline, tied to the NEGOTIATED
+        keepalive.
+
+        The pause blocks the session's reader thread, so its bound is
+        what keeps backpressure from looking like death: a keepalive-0
+        session has no liveness contract, so only the conservative
+        broker-wide floor applies; a session with keepalive K may pause
+        up to the reaper's slack — ``(grace - 1) * K`` (grace is the
+        MQTT-3.1.2-24 1.5x multiplier, so half a keepalive) — because
+        the device's next scheduled packet still lands inside the
+        ``K * grace`` silence window the reaper enforces.  Chatty
+        high-keepalive devices therefore absorb a long Retry-After as
+        one pause instead of a redelivery storm, while short-keepalive
+        devices keep their snappy reap behavior."""
+        cap = self.max_shed_pause_s
+        if session.keepalive > 0:
+            cap = max(cap, (self.max_keepalive_grace - 1.0)
+                      * session.keepalive)
+        return min(float(hint_s), cap)
+
     # -- packet handlers -----------------------------------------------------
 
     def _handle_publish(self, session: _Session, flags: int,
@@ -289,7 +314,7 @@ class MqttBroker:
                 # the publisher at the socket layer.  The session stays
                 # up: shedding is flow control, not a fault.
                 self.sheds += 1
-                time.sleep(min(e.retry_after_s, self.max_shed_pause_s))
+                time.sleep(self.shed_pause_s(session, e.retry_after_s))
                 return
             except Exception as e:
                 # At-least-once REQUIRES withholding the PUBACK when the

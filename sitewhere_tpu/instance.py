@@ -253,6 +253,35 @@ class Instance(LifecycleComponent):
         self.outbound = self.add_child(
             OutboundConnectorsManager(metrics=self.metrics,
                                       overload=self.overload))
+        # Streaming analytics & CEP (analytics/ subsystem): registered
+        # Window/Session/Pattern queries compile once and run BOTH on
+        # the live enriched batches (dispatcher egress offers them to
+        # the runner's worker; sheds from SHEDDING as a non-priority
+        # consumer) and retrospectively over the sealed event store
+        # (REST-gated from DEGRADED like the other analytics surfaces).
+        # Added before the dispatcher so the reverse-order stop keeps it
+        # alive through the dispatcher's shutdown flush.
+        self.analytics = None
+        if bool(self.config.get("analytics.enabled", True)):
+            from sitewhere_tpu.analytics.runner import QueryRunner
+
+            self.analytics = self.add_child(QueryRunner(
+                capacity=cap,
+                resolve_mtype=self.identity.mtype.mint,
+                event_store=self.event_store,
+                outbound=self.outbound,
+                overload=self.overload,
+                metrics=self.metrics,
+                tracer=self.tracer,
+                max_queries=int(self.config.get(
+                    "analytics.max_queries", 32)),
+                max_matches=int(self.config.get(
+                    "analytics.max_matches", 1024)),
+                queue_depth=int(self.config.get(
+                    "analytics.queue_depth", 64)),
+                fanout_matches=bool(self.config.get(
+                    "analytics.fanout_matches", True)),
+            ))
         self.registration = self.add_child(RegistrationManager(
             self.device_management,
             default_device_type=self.config.get("registration.default_device_type"),
@@ -318,6 +347,7 @@ class Instance(LifecycleComponent):
             outbound=self.outbound,
             registration=self.registration,
             on_command_rows=self._on_command_rows,
+            analytics=self.analytics,
             journal=self.ingest_journal,
             dead_letters=self.dead_letters,
             resolve_tenant=self._tenant_dense_id,

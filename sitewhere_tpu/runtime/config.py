@@ -95,6 +95,18 @@ DEFAULTS: Dict[str, Any] = {
         "degraded_telemetry_burst": 20_000.0,
         "watermarks": {},
     },
+    # streaming analytics & CEP (analytics/): registered queries compile
+    # once and run live (dispatcher egress) + retrospectively (event
+    # store).  queue_depth bounds the live eval queue; max_matches the
+    # per-query match ring; fanout_matches re-publishes matches through
+    # the outbound connector path as STATE_CHANGE rows.
+    "analytics": {
+        "enabled": True,
+        "max_queries": 32,
+        "max_matches": 1024,
+        "queue_depth": 64,
+        "fanout_matches": True,
+    },
     "presence": {"scan_interval_s": 600.0, "missing_after_s": 8 * 3600.0},
     "api": {"host": "127.0.0.1", "port": 8080, "jwt_ttl_s": 3600},
     "metrics": {"report_interval_s": 20.0},

@@ -101,6 +101,7 @@ class PipelineDispatcher(LifecycleComponent):
         outbound=None,
         registration=None,
         on_command_rows: Optional[Callable[..., None]] = None,
+        analytics=None,
         journal: Optional[Journal] = None,
         dead_letters: Optional[Journal] = None,
         resolve_tenant: Optional[Callable[[str], int]] = None,
@@ -126,6 +127,11 @@ class PipelineDispatcher(LifecycleComponent):
         self.outbound = outbound
         self.registration = registration
         self.on_command_rows = on_command_rows
+        # Streaming analytics (analytics/runner.QueryRunner): egress
+        # offers every accepted enriched batch via a NON-blocking
+        # bounded queue — live CEP/window queries evaluate on the
+        # runner's own worker, never on the egress path's budget.
+        self.analytics = analytics
         self.journal = journal
         self.dead_letters = dead_letters
         self.resolve_tenant = resolve_tenant or (lambda token: 0)
@@ -1141,6 +1147,13 @@ class PipelineDispatcher(LifecycleComponent):
             with trace.span("egress.outbound"):
                 self.outbound.submit(cols, accepted, trace=trace,
                                      ingest_t0=ingest_t0)
+
+        # 2b. streaming analytics: live window/CEP query evaluation
+        #     (non-blocking offer; sheds itself from SHEDDING up as a
+        #     non-priority consumer — see QueryRunner.submit_live)
+        if self.analytics is not None and accepted.any():
+            with trace.span("egress.analytics"):
+                self.analytics.submit_live(cols, accepted, trace=trace)
 
         # 3. command invocations (command-delivery analog)
         cmd_mask = accepted & (cols["event_type"] == EventType.COMMAND_INVOCATION)

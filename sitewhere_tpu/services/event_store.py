@@ -372,6 +372,29 @@ class _Chunk:
                     and bloom[h2 >> 3] >> (7 - (h2 & 7)) & 1)
 
 
+def _chunk_pruned(c: _Chunk, active, probes, t0, t1) -> bool:
+    """Zone-map + Bloom skip (the hour-bucket/denormalized-table
+    analog) — ONE predicate shared by the indexed ``query`` path and
+    the ``iter_chunks`` scan API, so the two can never disagree about
+    what a chunk's metadata excludes."""
+    if c.n == 0:
+        return True
+    if t0 is not None and c.max_ts < t0:
+        return True
+    if t1 is not None and c.min_ts > t1:
+        return True
+    if c.bounds is None:
+        return False  # light chunk (unsealed buffer): never pruned
+    for name, want in active:
+        lo, hi = c.bounds[name]
+        if want < lo or want > hi:
+            return True
+        probe = probes.get(name)
+        if probe is not None and not c.may_contain(name, *probe):
+            return True
+    return False
+
+
 class EventStore(LifecycleComponent):
     """Buffered columnar event persistence with indexed queries.
 
@@ -1065,24 +1088,7 @@ class EventStore(LifecycleComponent):
         }
 
         def pruned(c: _Chunk) -> bool:
-            """Zone-map + Bloom skip (the hour-bucket/denormalized-table
-            analog)."""
-            if c.n == 0:
-                return True
-            if t0 is not None and c.max_ts < t0:
-                return True
-            if t1 is not None and c.min_ts > t1:
-                return True
-            if c.bounds is None:
-                return False  # light chunk (unsealed buffer): never pruned
-            for name, want in active:
-                lo, hi = c.bounds[name]
-                if want < lo or want > hi:
-                    return True
-                probe = probes.get(name)
-                if probe is not None and not c.may_contain(name, *probe):
-                    return True
-            return False
+            return _chunk_pruned(c, active, probes, t0, t1)
 
         def match_mask(c: _Chunk) -> Optional[np.ndarray]:
             """Row mask, or None meaning every row matches (a filterless
@@ -1181,20 +1187,66 @@ class EventStore(LifecycleComponent):
                    for name in _COLUMN_NAMES}))
         return SearchResults(results=results, total=total)
 
-    def iter_chunks(self) -> Iterator[Dict[str, np.ndarray]]:
-        """Sealed chunks oldest-first — the analytics runner's scan API.
+    def iter_chunks(
+        self,
+        *,
+        event_type: Optional[int] = None,
+        mtype_id: Optional[int] = None,
+        device_id: Optional[int] = None,
+        tenant_id: Optional[int] = None,
+        start_s: Optional[int] = None,
+        end_s: Optional[int] = None,
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """Sealed chunks oldest-first — the analytics scan API.
 
         Lazy chunks materialize through the column cache, so a scan over
         a store far larger than ``resident_bytes`` streams (the LRU
-        evicts behind the scan) instead of accumulating."""
+        evicts behind the scan) instead of accumulating.
+
+        Optional exact-match/time filters make this the retrospective
+        query path: a chunk whose zone-map bounds (or Bloom, for
+        device_id) exclude the wanted key is skipped without touching
+        its columns — the same pruning the indexed ``query`` API uses —
+        and surviving chunks yield row-filtered column dicts with
+        relative order preserved (append order, i.e. the order live
+        evaluation saw the events)."""
         self.flush()
         with self._lock:
             chunks = list(self._chunks)
+        active = [
+            (name, int(want))
+            for name, want in (
+                ("event_type", event_type), ("mtype_id", mtype_id),
+                ("device_id", device_id), ("tenant_id", tenant_id))
+            if want is not None
+        ]
+        probes = {
+            name: _bloom_probe(want) for name, want in active
+            if name in _BLOOM_COLUMNS
+        }
         for chunk in chunks:
+            if _chunk_pruned(chunk, active, probes, start_s, end_s):
+                continue
             try:
-                yield chunk.materialize()
+                cols = chunk.materialize()
             except _ChunkPruned:
                 continue  # expired mid-scan: same as scanning after it
+            mask = None
+            for name, want in active:
+                m = cols[name] == want
+                mask = m if mask is None else (mask & m)
+            # time masks only when the chunk STRADDLES the bound (the
+            # query path's rule — a fully-covered chunk's rows all pass)
+            if start_s is not None and chunk.min_ts < start_s:
+                m = cols["ts_s"] >= start_s
+                mask = m if mask is None else (mask & m)
+            if end_s is not None and chunk.max_ts > end_s:
+                m = cols["ts_s"] <= end_s
+                mask = m if mask is None else (mask & m)
+            if mask is None or mask.all():
+                yield cols
+            elif mask.any():
+                yield {k: v[mask] for k, v in cols.items()}
 
     def cache_stats(self) -> Dict[str, int]:
         """Resident-set accounting (observability + tests)."""

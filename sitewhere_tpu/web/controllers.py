@@ -369,6 +369,10 @@ def register_routes(gw: RestGateway, inst) -> None:
             except ValueError:
                 return None
 
+        from sitewhere_tpu.analytics.windows import AGGREGATES
+
+        agg = (q.q1("agg") or "mean").lower()
+        require(agg in AGGREGATES, ValidationError(f"bad agg: {agg!r}"))
         return build_chart_series(
             inst.event_store,
             assignment_id=aid,
@@ -376,6 +380,10 @@ def register_routes(gw: RestGateway, inst) -> None:
             start_s=_int_q("startDate"),
             end_s=_int_q("endDate"),
             mtype_name_of=inst.identity.mtype.token_of,
+            # bucketS downsamples through the shared window kernels —
+            # the same aggregation path the streaming queries compile
+            bucket_s=_int_q("bucketS"),
+            agg=agg,
         )
     r("GET", "/api/assignments/{token}/measurements/series", chart_series)
 
@@ -571,6 +579,63 @@ def register_routes(gw: RestGateway, inst) -> None:
     r("PUT", "/api/rules/{token}", update_rule)
     r("DELETE", "/api/rules/{token}",
       lambda q: inst.rules.delete_rule(q.params["token"]))
+
+    # ---- streaming analytics & CEP (sitewhere-spark/Siddhi analog) --------
+    # Window/Session/Pattern queries compile once; live matches stream
+    # from the dispatcher, retrospective runs replay the event store
+    # through the SAME operator.  Retrospective scans are optional
+    # capacity — refused from DEGRADED like the chart/search endpoints;
+    # registration and match fetches stay cheap and ungated.
+    def _analytics():
+        mgr = getattr(inst, "analytics", None)
+        require(mgr is not None,
+                EntityNotFound("analytics is disabled on this instance"))
+        return mgr
+
+    r("GET", "/api/analytics/queries",
+      lambda q: {"queries": _analytics().list_queries()})
+    r("POST", "/api/analytics/queries",
+      lambda q: _analytics().register(q.json()))
+    r("GET", "/api/analytics/queries/{name}",
+      lambda q: _analytics().describe(q.params["name"]))
+    r("DELETE", "/api/analytics/queries/{name}",
+      lambda q: _analytics().remove(q.params["name"]))
+
+    def run_query_retrospective(q: Request):
+        _optional_capacity("analytics")
+        body = q.json()
+
+        def _opt_int(key):
+            raw = body.get(key, q.q1(key))
+            if raw is None:
+                return None
+            try:
+                return int(raw)
+            except (TypeError, ValueError):
+                raise ValidationError(f"{key} must be an integer: {raw!r}")
+
+        mgr = _analytics()
+        inst.event_store.flush()
+        return mgr.run_retrospective(
+            q.params["name"],
+            start_s=_opt_int("startDate"),
+            end_s=_opt_int("endDate"))
+
+    r("POST", "/api/analytics/queries/{name}/run", run_query_retrospective)
+
+    def query_matches(q: Request):
+        try:
+            limit = int(q.q1("limit", "100"))
+        except ValueError:
+            limit = 100
+        return {"matches": _analytics().recent_matches(
+            q.params["name"], limit)}
+
+    r("GET", "/api/analytics/queries/{name}/matches", query_matches)
+    # finalize open windows/sessions of the live state (ops/test hook —
+    # live matches otherwise wait for the next window to arrive)
+    r("POST", "/api/analytics/queries/{name}/flush",
+      lambda q: {"emitted": _analytics().flush_live(q.params["name"])})
 
     # ---- device state (reference service-device-state RPCs) ---------------
     r("GET", "/api/devicestates/{token}",

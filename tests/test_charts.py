@@ -247,3 +247,79 @@ def test_rule_rest_roundtrip_and_bad_enums(inst):
             assert r.status == 400, bad
     finally:
         web.stop()
+
+
+def test_chart_series_bucketed_reuses_window_kernels(inst):
+    """bucket_s downsamples via the shared analytics window kernels —
+    the same scatter a WindowQuery compiles, so they cannot disagree."""
+    from sitewhere_tpu.analytics.charts import build_chart_series
+
+    a = _feed(inst)
+    aid = inst.device_management.handle_for("assignment", a.token)
+    inst.event_store.flush()
+    series = build_chart_series(
+        inst.event_store, assignment_id=aid,
+        mtype_name_of=inst.identity.mtype.token_of,
+        bucket_s=10, agg="mean")
+    assert {s["measurement_name"] for s in series} == {"temp", "rpm"}
+    for s in series:
+        assert s["bucket_s"] == 10 and s["agg"] == "mean"
+        t = [e["ts_s"] for e in s["entries"]]
+        assert t == sorted(t)
+        assert all(ts % 10 == 0 for ts in t)     # epoch-aligned buckets
+        assert sum(e["count"] for e in s["entries"]) == 15
+    # the bucket mean equals the plain series' masked mean (one path)
+    raw = build_chart_series(
+        inst.event_store, assignment_id=aid,
+        mtype_name_of=inst.identity.mtype.token_of)
+    for s in series:
+        rs = next(r for r in raw
+                  if r["measurement_id"] == s["measurement_id"])
+        for e in s["entries"]:
+            vals = [p["value"] for p in rs["entries"]
+                    if e["ts_s"] <= p["ts_s"] < e["ts_s"] + 10]
+            assert e["value"] == pytest.approx(float(np.mean(vals)))
+
+
+def test_chart_series_bucketed_rest_param(inst):
+    import http.client
+
+    from sitewhere_tpu.web import WebServer
+
+    a = _feed(inst)
+    web = WebServer(inst, port=0)
+    web.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", web.port,
+                                          timeout=10)
+        conn.request("POST", "/api/jwt", body=json.dumps(
+            {"username": "admin", "password": "password"}).encode())
+        token = json.loads(conn.getresponse().read())["token"]
+        hdrs = {"Authorization": f"Bearer {token}"}
+        conn.request(
+            "GET",
+            f"/api/assignments/{a.token}/measurements/series"
+            "?bucketS=10&agg=max&measurementIds=temp", headers=hdrs)
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+        assert resp.status == 200
+        assert len(doc) == 1 and doc[0]["agg"] == "max"
+        assert all("count" in e for e in doc[0]["entries"])
+        # junk agg 400s instead of silently defaulting
+        conn.request(
+            "GET",
+            f"/api/assignments/{a.token}/measurements/series?agg=junk",
+            headers=hdrs)
+        resp = conn.getresponse()
+        resp.read()   # drain: http.client requires it before reuse
+        assert resp.status == 400
+        # non-positive bucket is client error, not a 500
+        conn.request(
+            "GET",
+            f"/api/assignments/{a.token}/measurements/series?bucketS=0",
+            headers=hdrs)
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 400
+    finally:
+        web.stop()

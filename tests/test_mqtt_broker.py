@@ -369,3 +369,75 @@ def test_command_delivery_through_hosted_broker(tmp_path):
         source.stop()
         inst.stop()
         inst.terminate()
+
+
+def test_shed_pause_tied_to_negotiated_keepalive():
+    """The per-session shed-pause deadline follows the keepalive: a
+    session with keepalive K may pause up to the reaper's slack
+    ((grace-1) * K); keepalive-0 sessions keep the broker-wide floor."""
+    from sitewhere_tpu.ingest.mqtt_broker import MqttBroker, _Session
+
+    broker = MqttBroker()
+    chatty = _Session("chatty", socket.socket(), keepalive=60)
+    quiet = _Session("quiet", socket.socket(), keepalive=0)
+    short = _Session("short", socket.socket(), keepalive=1)
+    try:
+        # hint below every cap passes through unchanged
+        assert broker.shed_pause_s(chatty, 0.1) == pytest.approx(0.1)
+        # keepalive 60 @ grace 1.5 → 30s slack absorbs a long hint
+        assert broker.shed_pause_s(chatty, 120.0) == pytest.approx(30.0)
+        # no keepalive → conservative broker-wide floor
+        assert broker.shed_pause_s(quiet, 120.0) == pytest.approx(
+            broker.max_shed_pause_s)
+        # short keepalives get their own (smaller) slack — still at
+        # least the floor
+        assert broker.shed_pause_s(short, 120.0) == pytest.approx(0.5)
+        assert broker.shed_pause_s(short, 0.05) == pytest.approx(0.05)
+    finally:
+        for s in (chatty, quiet, short):
+            s.close()
+
+
+def test_shed_pause_applied_on_overload(monkeypatch):
+    """An OverloadShed from the tap withholds the PUBACK and pauses for
+    the keepalive-derived deadline, not the raw Retry-After hint."""
+    import sitewhere_tpu.ingest.mqtt_broker as mb
+    from sitewhere_tpu.runtime.overload import (
+        OverloadShed,
+        OverloadState,
+        PriorityClass,
+    )
+
+    broker = MqttBroker()
+    broker.start()
+    try:
+        def shed(topic, payload):
+            raise OverloadShed(PriorityClass.TELEMETRY,
+                               OverloadState.SHEDDING,
+                               retry_after_s=120.0)
+
+        broker.on_publish.append(shed)
+        pauses = []
+        real_sleep = time.sleep
+
+        def fake_sleep(s):
+            # record (and skip) the broker's long shed pause; small
+            # sleeps — this test's own polling — run for real
+            if s > 1.0:
+                pauses.append(s)
+                return
+            real_sleep(s)
+
+        monkeypatch.setattr(mb.time, "sleep", fake_sleep)
+        client = MqttClient("127.0.0.1", broker.port,
+                            client_id="dev-shed", keepalive=60)
+        client.connect()
+        client.publish("sitewhere/input/x", b"{}", qos=0)
+        deadline = time.monotonic() + 5
+        while not pauses and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pauses and pauses[0] == pytest.approx(30.0)
+        assert broker.sheds == 1
+        client.disconnect()
+    finally:
+        broker.stop()

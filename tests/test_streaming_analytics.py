@@ -1,0 +1,610 @@
+"""Streaming analytics & CEP subsystem: kernels, compiled queries, and
+the live-vs-retrospective golden equivalence.
+
+Covers the unified windowed operator (H-STREAM shape): window kernel
+library (tumbling/sliding grids, sessionization), the compiled
+Window/Session/Pattern queries with per-device state carried across
+batch boundaries, the Instance wiring (dispatcher egress → live eval;
+event store → retrospective eval), the overload-ladder interaction
+(retrospective refused from DEGRADED, live shed from SHEDDING), the
+REST surface, and the analytics bench smoke.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sitewhere_tpu.schema import ComparisonOp, EventType
+from sitewhere_tpu.analytics.cep import PatternStep
+from sitewhere_tpu.analytics.query import (
+    PatternQuery,
+    SessionQuery,
+    WindowQuery,
+    compile_query,
+    parse_query,
+)
+from sitewhere_tpu.analytics.windows import (
+    aggregate_windows,
+    sessionize,
+    sliding_aggregates,
+)
+
+M = int(EventType.MEASUREMENT)
+A = int(EventType.ALERT)
+T0 = 1_753_800_000
+
+
+def _cols(rows):
+    """rows of (device, ts, event_type, mtype, value) → column dict."""
+    dev, ts, et, mt, val = map(np.asarray, zip(*rows))
+    return {
+        "device_id": dev.astype(np.int32),
+        "ts_s": ts.astype(np.int32),
+        "event_type": et.astype(np.int32),
+        "mtype_id": mt.astype(np.int32),
+        "value": val.astype(np.float32),
+    }
+
+
+def _matches(compiled, rows, split=None):
+    """Run rows through a fresh-state eval (optionally split into
+    batches of ``split``) and return (device, start, end, value) keys."""
+    compiled.reset()
+    split = split or len(rows)
+    out = []
+    for lo in range(0, len(rows), split):
+        out += compiled.eval_cols(_cols(rows[lo:lo + split]))
+    out += compiled.flush()
+    return [(m.device_id, m.start_ts_s, m.ts_s, round(m.value, 4))
+            for m in out]
+
+
+# ---------------------------------------------------------------------------
+# window kernel library
+# ---------------------------------------------------------------------------
+
+
+class TestWindowKernels:
+    def test_aggregate_windows_stats(self):
+        grid = aggregate_windows(
+            jnp.asarray([0, 0, 1, 0], jnp.int32),
+            jnp.asarray([0, 0, 2, 1], jnp.int32),
+            jnp.asarray([1.0, 3.0, 5.0, 7.0], jnp.float32),
+            jnp.ones(4, bool), n_devices=2, n_windows=3)
+        assert int(grid.counts[0, 0]) == 2
+        assert float(grid.sums[0, 0]) == 4.0
+        assert float(grid.means()[0, 0]) == 2.0
+        assert float(grid.mins[0, 0]) == 1.0
+        assert float(grid.maxs[0, 0]) == 3.0
+        assert float(grid.variances()[0, 0]) == pytest.approx(1.0)
+        assert float(grid.aggregate("rate", window_s=2.0)[0, 0]) == 1.0
+        assert float(grid.occupancy()) == pytest.approx(3 / 6)
+
+    def test_sliding_aggregates_trailing(self):
+        grid = aggregate_windows(
+            jnp.zeros(3, jnp.int32), jnp.asarray([0, 1, 3], jnp.int32),
+            jnp.asarray([10.0, 20.0, 40.0], jnp.float32),
+            jnp.ones(3, bool), n_devices=1, n_windows=4)
+        s = sliding_aggregates(grid, length=2)
+        # window w covers hops (w-2, w]
+        assert list(np.asarray(s.counts[0])) == [1, 2, 1, 1]
+        assert float(s.sums[0, 1]) == 30.0
+        assert float(s.mins[0, 1]) == 10.0
+        assert float(s.maxs[0, 3]) == 40.0
+        # empty trailing window stays empty-identity
+        assert float(s.means()[0, 2]) == 20.0
+
+    def test_sessionize_gap_edges(self):
+        # gap EXACTLY equal to gap_s keeps the session; +1 closes it;
+        # sessions never span devices; invalid rows get -1
+        dev = jnp.asarray([0, 0, 0, 1, 0, 1], jnp.int32)
+        ts = jnp.asarray([0, 100, 201, 100, 500, 90], jnp.int32)
+        valid = jnp.asarray([True, True, True, True, True, False])
+        out = sessionize(dev, ts, valid, jnp.int32(100))
+        sid = np.asarray(out.session_id)
+        # dev0: [0,100] (gap == 100 keeps), 201 (gap 101 closes), 500;
+        # dev1: one valid event; the invalid row joins nothing
+        assert int(out.n_sessions) == 4
+        assert sid[0] == sid[1]
+        assert sid[2] != sid[0]
+        assert sid[4] not in (sid[0], sid[2])
+        assert sid[3] >= 0 and sid[5] == -1
+        counts = np.asarray(out.counts)[: int(out.n_sessions)]
+        starts = np.asarray(out.start_ts_s)[: int(out.n_sessions)]
+        ends = np.asarray(out.end_ts_s)[: int(out.n_sessions)]
+        assert sorted(counts.tolist()) == [1, 1, 1, 2]
+        s0 = int(sid[0])
+        assert counts[s0] == 2 and starts[s0] == 0 and ends[s0] == 100
+
+    def test_sessionize_interleaved_devices(self):
+        # arrival interleaves devices; sessionization sorts per device
+        dev = jnp.asarray([0, 1, 0, 1], jnp.int32)
+        ts = jnp.asarray([0, 5, 50, 400], jnp.int32)
+        out = sessionize(dev, ts, jnp.ones(4, bool), jnp.int32(100))
+        sid = np.asarray(out.session_id)
+        assert sid[0] == sid[2]          # dev0 one session
+        assert sid[1] != sid[3]          # dev1 split by the 395 gap
+        assert int(out.n_sessions) == 3
+
+
+# ---------------------------------------------------------------------------
+# compiled operators: batch-split invariance (the carry contract)
+# ---------------------------------------------------------------------------
+
+
+class TestCompiledOperators:
+    def test_tumbling_window_split_invariant(self):
+        q = WindowQuery(name="w", threshold=25.0, agg="mean",
+                        window_s=300)
+        c = compile_query(q, capacity=8)
+        rows = [
+            (0, 0, M, 1, 20.0), (0, 10, M, 1, 40.0),
+            (0, 300, M, 1, 10.0), (0, 600, M, 1, 50.0),
+            (1, 0, M, 1, 10.0), (1, 310, M, 1, 20.0),
+        ]
+        full = _matches(c, rows)
+        assert (0, 0, 300, 30.0) in full
+        assert (0, 600, 900, 50.0) in full       # finalized by flush
+        assert not any(d == 1 for d, *_ in full)
+        for split in (1, 2, 3):
+            assert _matches(c, rows, split) == full
+
+    def test_sliding_window_split_invariant(self):
+        q = WindowQuery(name="s", threshold=25.0, agg="mean",
+                        window_s=300, length=2)
+        c = compile_query(q, capacity=8)
+        rows = [
+            (0, 0, M, 1, 40.0), (0, 300, M, 1, 20.0),
+            (0, 600, M, 1, 10.0), (0, 900, M, 1, 80.0),
+            (0, 1800, M, 1, 5.0),    # a 2-hop gap empties the trailing set
+        ]
+        full = _matches(c, rows)
+        for split in (1, 2, 3):
+            assert _matches(c, rows, split) == full
+        # trailing(win0, win1) mean = 30 reported over [0, 600)
+        assert (0, 0, 600, 30.0) in full
+
+    def test_sliding_min_max_aggregates(self):
+        q = WindowQuery(name="mx", threshold=39.0, agg="max",
+                        window_s=100, length=3)
+        c = compile_query(q, capacity=4)
+        rows = [(0, 0, M, 1, 40.0), (0, 100, M, 1, 1.0),
+                (0, 200, M, 1, 2.0), (0, 300, M, 1, 3.0)]
+        full = _matches(c, rows)
+        for split in (1, 2):
+            assert _matches(c, rows, split) == full
+        # the 40 stays in the trailing max for exactly 3 hops
+        assert [(m[1], m[2]) for m in full] == [
+            (-200, 100), (-100, 200), (0, 300), (100, 400)][:len(full)] \
+            or len(full) == 3
+
+    def test_session_query_split_invariant(self):
+        q = SessionQuery(name="sess", threshold=2.0, gap_s=100,
+                         agg="count")
+        c = compile_query(q, capacity=8)
+        rows = [
+            (0, 0, M, 1, 1.0), (0, 50, M, 1, 1.0), (0, 150, M, 1, 1.0),
+            (0, 400, M, 1, 1.0),
+            (1, 0, M, 1, 1.0), (1, 100, M, 1, 1.0),
+        ]
+        full = _matches(c, rows)
+        assert full == [(0, 0, 150, 3.0)]
+        for split in (1, 2, 3):
+            assert _matches(c, rows, split) == full
+
+    def test_session_duration_predicate(self):
+        q = SessionQuery(name="d", threshold=99.0, gap_s=60,
+                         agg="duration_s", op=int(ComparisonOp.GTE))
+        c = compile_query(q, capacity=4)
+        rows = [(0, 0, M, 1, 1.0), (0, 50, M, 1, 1.0),
+                (0, 100, M, 1, 1.0), (0, 500, M, 1, 1.0)]
+        assert _matches(c, rows) == [(0, 0, 100, 100.0)]
+
+    def test_pattern_state_carry_across_batches(self):
+        q = PatternQuery(name="p", steps=[
+            PatternStep(event_type=M, has_value=True,
+                        op=int(ComparisonOp.GT), threshold=10.0),
+            PatternStep(event_type=A, within_s=5),
+        ])
+        c = compile_query(q, capacity=8)
+        rows = [
+            (0, 100, M, 1, 12.0), (0, 103, A, -1, 0.0),   # match
+            (1, 100, M, 1, 5.0), (1, 101, A, -1, 0.0),    # no arm
+            (2, 100, M, 1, 20.0), (2, 110, A, -1, 0.0),   # deadline passed
+            (2, 111, M, 1, 30.0), (2, 112, A, -1, 0.0),   # re-arm + match
+        ]
+        full = _matches(c, rows)
+        assert [(d, s, e) for d, s, e, _ in full] == [
+            (0, 100, 103), (2, 111, 112)]
+        for split in (1, 2, 3):
+            assert _matches(c, rows, split) == full
+
+    def test_pattern_default_within_is_unbounded(self):
+        # a pattern registered WITHOUT withinS has no deadline — the
+        # second step matches hours later instead of never
+        spec = parse_query({
+            "kind": "pattern", "name": "nodl",
+            "steps": [{"eventType": "measurement", "threshold": 10.0},
+                      {"eventType": "alert"}],
+        })
+        c = compile_query(spec, capacity=4)
+        rows = [(0, 100, M, 1, 50.0), (0, 7300, A, -1, 0.0)]
+        assert [(d, s, e) for d, s, e, _ in _matches(c, rows)] == \
+            [(0, 100, 7300)]
+
+    def test_pattern_two_matches_one_batch(self):
+        q = PatternQuery(name="p2", steps=[
+            PatternStep(event_type=M, has_value=True,
+                        op=int(ComparisonOp.GT), threshold=10.0),
+            PatternStep(event_type=A, within_s=5),
+        ])
+        c = compile_query(q, capacity=8)
+        rows = [(3, 10, M, 1, 50.0), (3, 11, A, -1, 0.0),
+                (3, 12, M, 1, 50.0), (3, 13, A, -1, 0.0)]
+        assert len(_matches(c, rows)) == 2
+
+    def test_window_cross_pattern(self):
+        # the acceptance shape: 5-min mean crossing X, then an alert
+        # within Y — as one compiled two-step pattern
+        q = PatternQuery(
+            name="cx",
+            steps=[PatternStep(window_cross=True),
+                   PatternStep(event_type=A, within_s=60)],
+            window_s=300, cross_op=int(ComparisonOp.GT),
+            cross_threshold=25.0)
+        c = compile_query(q, capacity=8)
+        rows = [
+            (0, 1000, M, 1, 20.0), (0, 1010, M, 1, 24.0),
+            (0, 1020, M, 1, 40.0),                  # mean 28 > 25: cross
+            (0, 1050, A, -1, 0.0),                  # within 60 → match
+            (1, 1000, M, 1, 20.0), (1, 1100, A, -1, 0.0),   # no cross
+            (2, 1000, M, 1, 30.0), (2, 1200, A, -1, 0.0),   # too late
+        ]
+        full = _matches(c, rows)
+        assert [(d, s, e) for d, s, e, _ in full] == [(0, 1020, 1050)]
+        for split in (1, 2, 3):
+            assert _matches(c, rows, split) == full
+
+    def test_parse_and_describe_round_trip(self):
+        spec = parse_query({
+            "kind": "pattern", "name": "p",
+            "windowS": 120, "crossThreshold": 5.5,
+            "steps": [{"windowCross": True},
+                      {"eventType": "alert", "withinS": 30}],
+        })
+        assert isinstance(spec, PatternQuery)
+        assert spec.steps[1].event_type == A
+        assert spec.steps[1].within_s == 30
+        with pytest.raises(ValueError):
+            parse_query({"kind": "window", "name": "x", "op": "junk"})
+        with pytest.raises(ValueError):
+            parse_query({"kind": "nope", "name": "x"})
+        with pytest.raises(ValueError):
+            parse_query({"kind": "window"})
+
+
+# ---------------------------------------------------------------------------
+# event-store retrospective scan API
+# ---------------------------------------------------------------------------
+
+
+class TestStoreScanFilters:
+    def test_iter_chunks_filters_and_prunes(self, tmp_path):
+        from sitewhere_tpu.services.event_store import EventStore
+
+        store = EventStore(str(tmp_path), flush_rows=4)
+        store.start()
+        for i in range(8):
+            store.add_event(device_id=i % 2, tenant_id=0,
+                            event_type=M if i % 2 == 0 else A,
+                            ts_s=T0 + i * 10, mtype_id=1, value=float(i))
+        store.flush()
+        all_rows = sum(len(c["ts_s"]) for c in store.iter_chunks())
+        assert all_rows == 8
+        meas = list(store.iter_chunks(event_type=M))
+        assert sum(len(c["ts_s"]) for c in meas) == 4
+        assert all((c["event_type"] == M).all() for c in meas)
+        ranged = list(store.iter_chunks(start_s=T0 + 30, end_s=T0 + 50))
+        assert sum(len(c["ts_s"]) for c in ranged) == 3
+        dev = list(store.iter_chunks(device_id=1))
+        assert sum(len(c["ts_s"]) for c in dev) == 4
+        none = list(store.iter_chunks(device_id=7))
+        assert sum(len(c["ts_s"]) for c in none) == 0
+        store.stop()
+
+
+# ---------------------------------------------------------------------------
+# instance wiring: live vs retrospective golden equivalence
+# ---------------------------------------------------------------------------
+
+
+def _make_instance(tmp_path, **overrides):
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+
+    tree = {
+        "instance": {"id": "analytics-test",
+                     "data_dir": str(tmp_path / "data")},
+        "pipeline": {"width": 64, "registry_capacity": 256,
+                     "mtype_slots": 4, "deadline_ms": 2.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+        "tracing": {"sample_rate": 1.0},
+    }
+    tree.update(overrides)
+    inst = Instance(Config(tree, apply_env=False))
+    inst.start()
+    inst.device_management.create_device_type(token="sensor",
+                                              name="Sensor")
+    for d in range(3):
+        inst.device_management.create_device(token=f"dev-{d}",
+                                             device_type="sensor")
+        inst.device_management.create_device_assignment(device=f"dev-{d}")
+    return inst
+
+
+def _measurement(tok, ts, v):
+    return json.dumps({"deviceToken": tok, "type": "Measurement",
+                       "request": {"name": "temp", "value": v,
+                                   "eventDate": ts}})
+
+
+def _alert(tok, ts):
+    return json.dumps({"deviceToken": tok, "type": "Alert",
+                       "request": {"type": "overheat", "level": "warning",
+                                   "message": "hot", "eventDate": ts}})
+
+
+class TestGoldenEquivalence:
+    @pytest.fixture()
+    def inst(self, tmp_path):
+        inst = _make_instance(tmp_path)
+        yield inst
+        inst.stop()
+        inst.terminate()
+
+    def test_live_vs_retrospective_window_and_pattern(self, inst):
+        inst.analytics.register({
+            "kind": "window", "name": "hot-mean", "mtype": "temp",
+            "agg": "mean", "op": "gt", "threshold": 25.0, "windowS": 300})
+        inst.analytics.register({
+            "kind": "pattern", "name": "cross-then-alert",
+            "windowS": 300, "crossOp": "gt", "crossThreshold": 25.0,
+            "crossMtype": "temp",
+            "steps": [{"windowCross": True},
+                      {"eventType": "alert", "withinS": 60}]})
+        inst.analytics.register({
+            "kind": "session", "name": "bursts", "gapS": 60,
+            "agg": "count", "op": "gte", "threshold": 3.0})
+        lines = [
+            _measurement("dev-0", T0 + 0, 20.0),
+            _measurement("dev-0", T0 + 10, 24.0),
+            _measurement("dev-0", T0 + 20, 40.0),   # win0 mean 28
+            _alert("dev-0", T0 + 50),               # pattern completes
+            _measurement("dev-1", T0 + 0, 10.0),
+            _alert("dev-1", T0 + 40),
+            _measurement("dev-0", T0 + 300, 10.0),  # finalizes win0
+            _measurement("dev-1", T0 + 310, 12.0),
+        ]
+        # live: varied payload sizes exercise the batch-carry logic
+        for lo in range(0, len(lines), 2):
+            inst.dispatcher.ingest_wire_lines(
+                "\n".join(lines[lo:lo + 2]).encode())
+        inst.dispatcher.flush()
+        inst.analytics.drain()
+        inst.analytics.flush_live()
+        for name in ("hot-mean", "cross-then-alert", "bursts"):
+            live = inst.analytics.recent_matches(name)
+            retro = inst.analytics.run_retrospective(name)["matches"]
+            assert live == retro, name
+        # the window query found dev-0's hot window, the pattern its
+        # cross→alert sequence, the session its 4-event burst
+        assert [m["device_id"] for m in
+                inst.analytics.recent_matches("hot-mean")] == [0]
+        assert [m["device_id"] for m in
+                inst.analytics.recent_matches("cross-then-alert")] == [0]
+        assert [(m["device_id"], m["count"]) for m in
+                inst.analytics.recent_matches("bursts")] == [(0, 4)]
+
+        # per-query metrics + spans are visible (acceptance criterion)
+        snap = inst.metrics.snapshot()
+        assert snap["counters"]["analytics.matches.hot-mean"] >= 2
+        assert "analytics.eval_s.hot-mean" in snap["timers"]
+        # retrospective scans land in their own timer, never the live one
+        assert snap["timers"]["analytics.retro_s.hot-mean"]["count"] >= 1
+        # the live window eval populated the occupancy gauge
+        assert inst.metrics.gauge("analytics.window_occupancy").value > 0
+        names = {s["name"] for s in inst.tracer.recent(500)}
+        assert "egress.analytics" in names
+        assert "analytics.scan" in names
+
+    def test_match_fanout_through_outbound(self, inst):
+        from sitewhere_tpu.outbound.connectors import CallbackConnector
+
+        seen = []
+
+        def on_batch(cols, mask):
+            seen.append({k: np.asarray(v)[mask].copy()
+                         for k, v in cols.items()})
+
+        inst.outbound.add_connector(
+            CallbackConnector(connector_id="match-sink", fn=on_batch))
+        inst.analytics.register({
+            "kind": "window", "name": "hot", "mtype": "temp",
+            "agg": "mean", "op": "gt", "threshold": 25.0, "windowS": 300})
+        inst.dispatcher.ingest_wire_lines("\n".join([
+            _measurement("dev-0", T0, 50.0),
+            _measurement("dev-0", T0 + 300, 1.0),
+        ]).encode())
+        inst.dispatcher.flush()
+        inst.analytics.drain()
+        inst.outbound.drain()
+        # the finalized hot window fanned out as a STATE_CHANGE row
+        sc = [b for b in seen
+              if (b["event_type"] == int(EventType.STATE_CHANGE)).any()]
+        assert sc, "match rows never reached the connector path"
+        assert float(sc[0]["value"][0]) == pytest.approx(50.0)
+
+    def test_rest_surface_and_overload_gate(self, tmp_path):
+        import http.client
+
+        from sitewhere_tpu.runtime.overload import OverloadState
+        from sitewhere_tpu.web import WebServer
+
+        inst = _make_instance(tmp_path)
+        web = WebServer(inst, port=0)
+        web.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", web.port,
+                                              timeout=10)
+
+            def call(method, path, body=None, token=None):
+                hdrs = {}
+                if token:
+                    hdrs["Authorization"] = f"Bearer {token}"
+                conn.request(method, path,
+                             body=json.dumps(body).encode()
+                             if body is not None else None,
+                             headers=hdrs)
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, (json.loads(data) if data else None)
+
+            status, doc = call("POST", "/api/jwt",
+                               {"username": "admin",
+                                "password": "password"})
+            assert status == 200
+            token = doc["token"]
+            status, doc = call("POST", "/api/analytics/queries", {
+                "kind": "window", "name": "rest-q", "mtype": "temp",
+                "agg": "mean", "op": "gt", "threshold": 25.0,
+                "windowS": 300}, token)
+            assert status == 200
+            status, doc = call("GET", "/api/analytics/queries", None,
+                               token)
+            assert status == 200
+            assert [q["query"]["name"] for q in doc["queries"]] == \
+                ["rest-q"]
+            # junk spec → 400, not 200-and-ignore
+            status, _ = call("POST", "/api/analytics/queries",
+                             {"kind": "window", "name": "bad",
+                              "op": "junk"}, token)
+            assert status == 400
+            # retrospective run OK in NORMAL
+            status, doc = call("POST",
+                               "/api/analytics/queries/rest-q/run",
+                               {}, token)
+            assert status == 200 and doc["matches"] == []
+            # … and refused from DEGRADED up (degradation ladder)
+            inst.overload.force(OverloadState.DEGRADED, "test")
+            status, doc = call("POST",
+                               "/api/analytics/queries/rest-q/run",
+                               {}, token)
+            assert status == 503
+            # match fetch + flush stay cheap and ungated
+            status, doc = call(
+                "GET", "/api/analytics/queries/rest-q/matches",
+                None, token)
+            assert status == 200 and doc["matches"] == []
+            inst.overload.force(OverloadState.NORMAL, "test")
+            status, doc = call("DELETE",
+                               "/api/analytics/queries/rest-q",
+                               None, token)
+            assert status == 200
+        finally:
+            web.stop()
+            inst.stop()
+            inst.terminate()
+
+    def test_live_eval_sheds_from_shedding(self, inst):
+        from sitewhere_tpu.runtime.overload import OverloadState
+
+        inst.analytics.register({
+            "kind": "window", "name": "shedded", "mtype": "temp",
+            "agg": "mean", "op": "gt", "threshold": 0.0, "windowS": 300})
+        inst.overload.force(OverloadState.SHEDDING, "test")
+        shed_before = inst.metrics.counter("analytics.live_shed").value
+        cols = _cols([(0, T0, M, 1, 1.0)])
+        inst.analytics.submit_live(cols, np.ones(1, bool))
+        assert inst.metrics.counter("analytics.live_shed").value == \
+            shed_before + 1
+        inst.analytics.drain()
+        # nothing was queued: no live matches even after a flush
+        inst.overload.force(OverloadState.NORMAL, "test")
+        inst.analytics.flush_live("shedded")
+        assert inst.analytics.recent_matches("shedded") == []
+
+    def test_query_registry_limits_and_errors(self, inst):
+        from sitewhere_tpu.services.common import (
+            EntityNotFound,
+            ValidationError,
+        )
+
+        with pytest.raises(ValidationError):
+            inst.analytics.register({"kind": "window", "name": "x",
+                                     "op": "junk"})
+        with pytest.raises(EntityNotFound):
+            inst.analytics.run_retrospective("nope")
+        with pytest.raises(EntityNotFound):
+            inst.analytics.recent_matches("nope")
+        with pytest.raises(EntityNotFound):
+            inst.analytics.flush_live("nope")
+        inst.analytics.max_queries = 1
+        inst.analytics.register({"kind": "window", "name": "only",
+                                 "threshold": 1.0})
+        with pytest.raises(ValidationError):
+            inst.analytics.register({"kind": "window", "name": "two",
+                                     "threshold": 1.0})
+        # replacing an existing query is allowed at the limit
+        inst.analytics.register({"kind": "window", "name": "only",
+                                 "threshold": 2.0})
+        # names that sanitize to the same metric tag are rejected, not
+        # silently merged into one timer/counter
+        inst.analytics.max_queries = 8
+        inst.analytics.register({"kind": "window", "name": "temp high",
+                                 "threshold": 2.0})
+        with pytest.raises(ValidationError):
+            inst.analytics.register({"kind": "window",
+                                     "name": "temp_high",
+                                     "threshold": 2.0})
+
+    def test_stop_drains_queued_batches(self, tmp_path):
+        # batches offered just before shutdown still evaluate — the
+        # analytics analog of the dispatcher's final-flush contract
+        from sitewhere_tpu.analytics.runner import QueryRunner
+
+        runner = QueryRunner(capacity=16)
+        runner.start()
+        runner.register({"kind": "window", "name": "w", "agg": "mean",
+                         "op": "gt", "threshold": 5.0, "windowS": 100})
+        rows = [(0, T0, M, 1, 50.0), (0, T0 + 100, M, 1, 1.0)]
+        runner.submit_live(_cols(rows), np.ones(2, bool))
+        runner.stop()
+        assert [m["device_id"] for m in runner.recent_matches("w")] == [0]
+
+
+# ---------------------------------------------------------------------------
+# tools/analytics_bench.py smoke (tier-1, like hostpath/overload bench)
+# ---------------------------------------------------------------------------
+
+
+class TestAnalyticsBenchSmoke:
+    def test_tool_reports_throughput_and_latency(self):
+        import importlib.util
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "tools", "analytics_bench.py")
+        spec = importlib.util.spec_from_file_location("analytics_bench",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        result = mod.run(n_devices=64, n_events=4096, batch=1024)
+        assert result["grid_events_per_s"] > 0
+        assert result["window_query_events_per_s"] > 0
+        assert result["cep_match_latency_ms"] > 0
+        # the armed pattern must actually match, every trial
+        assert result["cep_matches"] == 5
+        table = mod._render(result)
+        assert "cep match latency" in table
