@@ -440,6 +440,12 @@ def bench_dispatcher() -> None:
         inst.dispatcher.ingest_wire_lines(payloads[0])
         inst.dispatcher.flush()
         inst.dispatcher.latencies_s.clear()
+        snap0 = inst.dispatcher.metrics_snapshot()
+        _STAGES = ("decode", "batch", "dispatch", "ring_dispatch", "egress")
+        stage0 = {}
+        for stage in _STAGES:
+            t = inst.metrics.timer(f"pipeline.stage_{stage}_s")
+            stage0[stage] = (t.total, t.count)
 
         import jax as _jax
 
@@ -461,6 +467,23 @@ def bench_dispatcher() -> None:
         events_per_sec = n / (t1 - t0)
         snap = inst.dispatcher.metrics_snapshot()
         p99 = snap.get("latency_p99_ms")
+
+        # Device-resident dispatch loop accounting (ISSUE 8): how often
+        # the host touched the device in the timed region — the ring's
+        # whole point is driving this to 1/K — plus the per-stage host
+        # attribution so every remaining millisecond of config-2 latency
+        # reads against a named stage, not a black box.
+        d_steps = max(1, snap["steps"] - snap0["steps"])
+        host_syncs_per_batch = round(
+            (snap["host_syncs"] - snap0["host_syncs"]) / d_steps, 4)
+        stage_ms = {}
+        for stage in _STAGES:
+            t = inst.metrics.timer(f"pipeline.stage_{stage}_s")
+            total0, count0 = stage0[stage]
+            if t.count > count0:  # timed-region delta: the warm-up
+                # compile must not masquerade as steady-state stage cost
+                stage_ms[stage] = round(
+                    (t.total - total0) / (t.count - count0) * 1e3, 3)
 
         # Latency-tuned profile (co-located backends only: through a
         # network tunnel every egress fetch pays >=1 RTT and the result
@@ -486,6 +509,15 @@ def bench_dispatcher() -> None:
             "host_rtt_ms": round(rtt_ms, 3),
             "deadline_ms": 5.0,
             "inflight_depth": inst.dispatcher.inflight_depth,
+            # host-sync amortization: ≤1/K with the ring engaged, ~1.0
+            # on the single-step path — alongside the stage attribution
+            # this is how an RTT-bound p99 reads honestly
+            "host_syncs_per_batch": host_syncs_per_batch,
+            "ring_depth": inst.dispatcher.ring_depth,
+            # timed-region delta, like host_syncs: warm-up chains must
+            # not inflate the measured run's chained coverage
+            "ring_chains": int(snap["ring_chains"] - snap0["ring_chains"]),
+            "stage_ms": stage_ms,
             "accepted": int(snap["accepted"]),
             "steps": int(snap["steps"]),
             "backend": _jax.default_backend(),
@@ -987,7 +1019,8 @@ _FINAL_DROP = ("attempts", "cache_attempts", "cpu_fallback", "note",
 
 _CFG_KEEP = ("value", "unit", "vs_baseline", "backend", "latency_p99_ms",
              "latency_target_met", "latency_tuned_p99_ms",
-             "latency_tuned_target_met", "host_rtt_ms", "stream_mb_per_sec",
+             "latency_tuned_target_met", "host_rtt_ms",
+             "host_syncs_per_batch", "stream_mb_per_sec",
              "qr_labels_per_sec", "cache_captured_at")
 
 
@@ -1018,6 +1051,7 @@ def _compact_final(doc: dict) -> dict:
     trims = (
         _cfg_pop("cache_captured_at"),
         _cfg_pop("unit"),
+        _cfg_pop("host_syncs_per_batch"),
         _cfg_pop("latency_target_met"),
         lambda d: d.pop("latency_path", None),
         lambda d: d.pop("cache_captured_at", None),
@@ -1297,6 +1331,7 @@ def _update_summary(results: dict, all_configs: bool) -> None:
                 "latency_p50_ms", "latency_p99_ms", "latency_target_met",
                 "latency_tuned_p99_ms", "latency_tuned_target_met",
                 "host_rtt_ms", "device_step_ms", "device_events_per_sec",
+                "host_syncs_per_batch", "ring_depth",
                 "cache_captured_at", "stream_mb_per_sec",
                 "qr_labels_per_sec")
                 if v.get(f) is not None}

@@ -115,6 +115,16 @@ class BatchPlan:
     # plan N+1 overlaps plan N's device step.  None = unstaged (sync
     # transfer at step-call time, the CPU-backend fallback).
     staged: Optional[tuple] = None
+    # Emission bookkeeping for the device-resident dispatch ring:
+    # ``seq`` is the batcher's monotonic emission number (commit/egress
+    # attribution of a chained step — "slot 3 of chain N" traces back to
+    # one concrete plan), ``reason`` the emit trigger ("fill" |
+    # "deadline" | "flush").  Only full-width fill emissions ride the
+    # ring; deadline/flush partials are latency-sensitive and take the
+    # single-step path (flushing ring-held predecessors first, so
+    # per-device event order is preserved).
+    seq: int = -1
+    reason: str = "fill"
 
     @property
     def fill(self) -> float:
@@ -637,9 +647,11 @@ class Batcher:
             return BatchPlan(
                 batch=None, n_events=n, width=self.width, created_at=now,
                 max_wait_s=wait, host_cols=out, packed_i=ibuf, packed_f=fbuf,
+                seq=self.emitted_batches - 1, reason=reason,
             )
         batch = EventBatch(**{k: jnp.asarray(v) for k, v in out.items()})
         return BatchPlan(
             batch=batch, n_events=n, width=self.width, created_at=now,
             max_wait_s=wait, host_cols=out,
+            seq=self.emitted_batches - 1, reason=reason,
         )

@@ -354,6 +354,13 @@ class Instance(LifecycleComponent):
             on_host_request=self._on_host_request,
             inflight_depth=int(self.config.get("pipeline.inflight_depth", 0)),
             egress_offload=self.config.get("pipeline.egress_offload"),
+            # Device-resident dispatch ring (pipeline/packed.py
+            # build_packed_chain): unset → backend-adaptive (8 on TPU,
+            # off elsewhere); 0/1 disables; ≥2 forces — the tier-1 CPU
+            # smoke forces 2 so the chained path runs on every backend.
+            ring_depth=(int(self.config["pipeline.ring_depth"])
+                        if self.config.get("pipeline.ring_depth")
+                        is not None else None),
             mesh=self.mesh,
             journal_reader=JournalReader(self.ingest_journal, "pipeline"),
             recovery_decoder=recovery_decoder,
@@ -637,7 +644,9 @@ class Instance(LifecycleComponent):
         return OverloadSignals(
             seal_lag_s=d.oldest_unsealed_wait_s(),
             decode_backlog=decode_backlog,
-            egress_inflight=(len(d._inflight)
+            # ring-held plans are emitted-but-unstepped work the egress
+            # window hasn't seen yet — in-flight pressure all the same
+            egress_inflight=((len(d._inflight) + len(d._ring))
                              / max(1, d.egress_queue_depth)),
             batcher_backlog=self.batcher.pending / max(1, self.batcher.width),
             fsync_latency_s=float(self.ingest_journal.last_fsync_s),

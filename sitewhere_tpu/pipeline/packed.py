@@ -26,12 +26,15 @@ envelope, keep the payload identical.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+import logging
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import struct
+
+logger = logging.getLogger("sitewhere_tpu.packed")
 
 from sitewhere_tpu.ids import NULL_ID
 from sitewhere_tpu.pipeline.step import (
@@ -201,6 +204,87 @@ def packed_pipeline_step(
     return pack_state(new_state), *pack_outputs(out)
 
 
+def build_packed_chain(k: int, donate: bool = True) -> Callable:
+    """K packed steps chained in ONE compiled program — the device-resident
+    dispatch loop's kernel (the production form of ``bench.py``'s phase-C
+    ``packed_chain``).
+
+    The returned jitted callable takes ``(tables, ps, *slots)`` where
+    ``slots`` is K staged ``bi`` arrays followed by K staged ``bf`` arrays
+    (the ring's pre-staged input slots, H2D'd ahead of time by
+    :func:`stage_packed_batch`).  A ``lax.fori_loop`` cycles the slots
+    through :func:`packed_pipeline_step`, threading the ``PackedState``
+    carry on device, so the host pays ONE dispatch — and later one D2H
+    fetch — per K steps instead of per step.
+
+    Returns ``(ps', ois [K, 10, B], metrics [K, 12], present [D])``:
+    per-step output blocks stacked along a leading slot axis (egress
+    slices its step's block from one shared fetch) and ``present`` the
+    OR over the chain's per-step presence maps — the devices this chain
+    merged, which is exactly what the state manager's presence
+    reconciliation needs at chain granularity.
+
+    ``donate=True`` donates the carry (slot 1): the caller must own the
+    buffers exclusively (``DeviceStateManager.lease_packed``).  The CPU
+    backend ignores donation with a warning, so the dispatcher passes
+    ``donate=False`` there.
+    """
+    from sitewhere_tpu.pipeline.step import NUM_EVENT_TYPES
+
+    n_out = len(OUT_I)
+    n_met = len(METRIC_SCALARS) + NUM_EVENT_TYPES
+
+    def chain(tables, ps, *slots):
+        ring_i = jnp.stack(slots[:k])   # [K, 12, B]
+        ring_f = jnp.stack(slots[k:])   # [K, 4, B]
+        width = ring_i.shape[-1]
+
+        def body(i, carry):
+            c, ois, mets, present = carry
+            bi = jax.lax.dynamic_index_in_dim(ring_i, i, keepdims=False)
+            bf = jax.lax.dynamic_index_in_dim(ring_f, i, keepdims=False)
+            c, oi, met, pres = packed_pipeline_step(tables, c, bi, bf)
+            ois = jax.lax.dynamic_update_index_in_dim(ois, oi, i, 0)
+            mets = jax.lax.dynamic_update_index_in_dim(mets, met, i, 0)
+            return c, ois, mets, present | pres
+
+        init = (
+            ps,
+            jnp.zeros((k, n_out, width), jnp.int32),
+            jnp.zeros((k, n_met), jnp.int32),
+            jnp.zeros((ps.capacity,), bool),
+        )
+        return jax.lax.fori_loop(0, k, body, init)
+
+    return jax.jit(chain, donate_argnums=(1,) if donate else ())
+
+
+def ring_depth_default() -> int:
+    """Backend-adaptive ring depth for the device-resident dispatch loop.
+
+    On TPU the per-step host round-trip is the config-2 latency floor
+    (~70 ms RTT vs a 7.9 ms device step through a network-attached chip,
+    r05), so chaining 8 steps per dispatch amortizes the host sync 8×.
+    On CPU the "RTT" is a function call — the chain only adds compile
+    time and batching delay, so the ring defaults OFF (forcible via
+    ``pipeline.ring_depth`` for the tier-1 smoke of the fallback path).
+    ``SW_TPU_RING_DEPTH`` overrides the default on any backend (operator
+    tuning knob; an explicit ``pipeline.ring_depth`` config still wins).
+    """
+    import os
+
+    env = os.environ.get("SW_TPU_RING_DEPTH")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            logger.warning("ignoring non-integer SW_TPU_RING_DEPTH=%r", env)
+    try:
+        return 8 if jax.default_backend() == "tpu" else 0
+    except Exception:  # no backend at all
+        return 0
+
+
 def packed_env_override() -> Optional[bool]:
     """``SW_TPU_PACKED_STEP`` as a tristate (None = unset) — the ONE
     parser for every consumer, so the dispatcher default and the pure-
@@ -269,16 +353,47 @@ def supports_async_host_copy() -> bool:
     return _ASYNC_HOST_COPY
 
 
-def start_host_copy(*arrays) -> None:
+# Unexpected async-copy failures (anything that is NOT the benign
+# deleted/donated-buffer race).  The copy itself is an optimization — the
+# blocking fetch still lands the bytes — but a backend refusing the
+# async form is a capability regression an operator must be able to see,
+# not a silent fall-back to one-RTT-per-fetch behavior.
+host_copy_errors = 0
+
+
+def _is_deleted_buffer_error(e: BaseException) -> bool:
+    """The ONE benign async-copy failure: the array was deleted/donated
+    between dispatch and the copy call (a later step's donation won the
+    race).  Everything else is unexpected and must be counted."""
+    s = str(e).lower()
+    return "delete" in s or "donat" in s
+
+
+def start_host_copy(*arrays, on_error: Optional[Callable] = None) -> None:
     """Kick off async device→host copies (no-op without the capability):
-    by the time egress blocks on ``np.asarray`` the bytes are host-side."""
+    by the time egress blocks on ``np.asarray`` the bytes are host-side.
+
+    Only the deleted/donated-buffer race is swallowed silently; any other
+    failure increments :data:`host_copy_errors`, logs, and calls
+    ``on_error(exc)`` (the dispatcher wires a metric counter) — then the
+    remaining arrays still get their copies attempted."""
+    global host_copy_errors
     if not supports_async_host_copy():
         return
     for dev in arrays:
+        fn = getattr(dev, "copy_to_host_async", None)
+        if fn is None:
+            continue  # committed host / numpy array — nothing to copy
         try:
-            dev.copy_to_host_async()
-        except (AttributeError, RuntimeError):
-            return  # deleted/donated buffer or committed host array
+            fn()
+        except Exception as e:  # noqa: BLE001 — classified below
+            if isinstance(e, RuntimeError) and _is_deleted_buffer_error(e):
+                continue
+            host_copy_errors += 1
+            logger.warning("async host copy failed (%s): %s",
+                           type(e).__name__, e)
+            if on_error is not None:
+                on_error(e)
 
 
 def supports_batch_staging() -> bool:
@@ -332,19 +447,24 @@ class PackedView:
     array — it feeds the next commit, never the host.
     """
 
-    def __init__(self, oi, metrics, present_now):
+    def __init__(self, oi, metrics, present_now, on_fetch=None):
         self._oi_dev = oi
         self._metrics_dev = metrics
         self.present_now = present_now
         self._oi = None
         self._metrics = None
         self._metrics_host = None
+        # host-sync instrumentation: called ONCE, at the blocking fetch
+        # (the dispatcher wires its ``pipeline.host_syncs`` counter)
+        self._on_fetch = on_fetch
 
     def _fetch(self) -> None:
         """Materialize BOTH host copies in one device_get: it starts the
         copies for every leaf before blocking on any, so a
         network-attached chip charges one RTT for the pair even when the
         dispatcher's dispatch-time copy_to_host_async was a no-op."""
+        if self._on_fetch is not None:
+            self._on_fetch()
         oi, metrics = jax.device_get((self._oi_dev, self._metrics_dev))
         self._oi = np.asarray(oi)
         self._metrics_host = np.asarray(metrics)
@@ -412,10 +532,57 @@ class PackedView:
         )
 
 
+class RingFetch:
+    """ONE D2H fetch shared by every step view of a chained dispatch.
+
+    The packed chain returns the whole ring's outputs stacked
+    (``ois [K, 10, B]``, ``metrics [K, 12]``); the first step view that
+    egress touches blocks on a single ``device_get`` for the pair, and
+    every sibling slot reads its slice from the same host copy — K steps,
+    one host sync.  The copies were started asynchronously at dispatch
+    (:func:`start_host_copy`), so in steady state the blocking fetch
+    finds the bytes already host-side.
+    """
+
+    def __init__(self, ois, metrics, on_fetch=None):
+        self._ois_dev = ois
+        self._metrics_dev = metrics
+        self._host: Optional[tuple] = None
+        self._on_fetch = on_fetch
+
+    def fetch(self) -> tuple:
+        if self._host is None:
+            if self._on_fetch is not None:
+                self._on_fetch()
+            ois, mets = jax.device_get((self._ois_dev, self._metrics_dev))
+            self._host = (np.asarray(ois), np.asarray(mets))
+        return self._host
+
+
+class RingStepView(PackedView):
+    """One chained step's :class:`PackedView`, backed by the ring's
+    shared fetch — slot ``k``'s ``[10, B]`` block and ``[12]`` metrics
+    row sliced from the stacked host copy.  ``present_now`` is None:
+    presence commits at chain granularity (the chain's OR'd map), never
+    per slot."""
+
+    def __init__(self, ring: RingFetch, slot: int):
+        super().__init__(None, None, None)
+        self._ring_fetch = ring
+        self.slot = slot
+
+    def _fetch(self) -> None:
+        ois, mets = self._ring_fetch.fetch()
+        self._oi = ois[self.slot]
+        self._metrics_host = mets[self.slot]
+
+
 __all__ = [
     "PackedTables", "PackedState", "PackedView",
+    "RingFetch", "RingStepView",
     "pack_tables", "unpack_tables", "pack_state", "unpack_state",
     "unpack_batch", "pack_outputs", "packed_pipeline_step",
+    "build_packed_chain", "ring_depth_default",
     "pack_batch_host", "stage_packed_batch", "start_host_copy",
     "supports_async_host_copy", "supports_batch_staging",
     "F_ACCEPTED", "F_UNREGISTERED", "F_UNASSIGNED", "F_DERIVED",

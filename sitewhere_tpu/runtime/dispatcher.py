@@ -40,6 +40,18 @@ jitted-step launch:
   the journal offset only advances past plans whose egress COMPLETED —
   a crashed egress leaves its plan outstanding and the commit gate
   fails closed.
+- The STEP itself is device-resident at depth (the promoted phase-C
+  packed chain): full-width fill plans collect in a K-slot ring of
+  pre-staged H2D inputs, and ONE jitted ``lax.fori_loop`` chain steps
+  all K with the ``PackedState`` carry threading on device — the host
+  dispatches once and, via the ring's shared output fetch, syncs once
+  per K steps instead of per step (``pipeline.host_syncs`` counts it).
+  Commits stay per batch: each slot windows as its own plan, so a
+  mid-ring egress crash leaves exactly the uncommitted steps
+  outstanding.  Deadline/flush partials, re-injected plans, mesh and
+  CPU-default deployments all take the single-step path (draining
+  ring-held predecessors in order first), so the ring only engages
+  where it pays: sustained full-width traffic on a host-attached chip.
 
 Output fetches stay selective: batch columns never round-trip (the
 batcher keeps its numpy originals in ``BatchPlan``), device→host copies
@@ -115,6 +127,7 @@ class PipelineDispatcher(LifecycleComponent):
         metrics=None,
         egress_offload: Optional[bool] = None,
         overload=None,
+        ring_depth: Optional[int] = None,
         name: str = "pipeline-dispatcher",
     ):
         super().__init__(name)
@@ -224,6 +237,41 @@ class PipelineDispatcher(LifecycleComponent):
         if inflight_depth is None or inflight_depth <= 0:
             inflight_depth = 8 if jax.default_backend() == "tpu" else 1
         self.inflight_depth = int(inflight_depth)
+        # Device-resident dispatch ring (the promoted phase-C packed
+        # chain): full-width packed plans collect in `_ring` until
+        # `ring_depth` are staged, then ONE jitted K-step chain
+        # (pipeline/packed.py build_packed_chain) steps them all with a
+        # single host dispatch and — via the shared RingFetch — a single
+        # D2H sync for the whole ring's egress.  None = backend-adaptive
+        # (8 on TPU where the ~70 ms host RTT dwarfs the device step, off
+        # elsewhere); any value < 2 disables.  Mesh dispatch keeps its
+        # sharded per-step path (the chain is a single-chip program).
+        # Latency stays bounded: deadline/flush/replay plans — and the
+        # loop thread, once the ring's oldest plan ages past the batcher
+        # deadline — drain the ring through the single-step path IN
+        # ORDER, so per-device event order is never reordered around
+        # ring-held predecessors and an idle trickle degrades to exactly
+        # the pre-ring behavior.
+        if ring_depth is None or ring_depth < 0:
+            from sitewhere_tpu.pipeline.packed import ring_depth_default
+
+            ring_depth = ring_depth_default()
+        if mesh is not None:
+            ring_depth = 0
+        self.ring_depth = int(ring_depth) if int(ring_depth) >= 2 else 0
+        self._ring: List[BatchPlan] = []
+        self._ring_chains: Dict[int, Callable] = {}
+        # Donate the chain carry only where donation is real (the CPU
+        # backend ignores it with a warning per call): the state manager
+        # hands the epoch over exclusively via lease_packed, so donation
+        # can never delete buffers a concurrent reader still holds.
+        self._ring_donate = jax.default_backend() != "cpu"
+        if self.ring_depth:
+            # the in-flight window must hold at least two rings so chain
+            # N+1 dispatches while ring N's egress drains (double
+            # buffering at ring granularity)
+            self.inflight_depth = max(self.inflight_depth,
+                                      2 * self.ring_depth)
         self._inflight: collections.deque = collections.deque()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -287,8 +335,20 @@ class PipelineDispatcher(LifecycleComponent):
         # wall elapsed` is the measurable proof the stages overlap.
         self._m_stage = {
             s: metrics.timer(f"pipeline.stage_{s}_s")
-            for s in ("decode", "batch", "dispatch", "egress")
+            for s in ("decode", "batch", "dispatch", "egress",
+                      # ring stages: per-slot wait before its chain
+                      # launches, and the chain's host dispatch cost
+                      "ring_wait", "ring_dispatch")
         }
+        # "How often does the host touch the device" as a first-class
+        # metric: one inc per BLOCKING device→host sync on the dispatch/
+        # egress path (the packed views' lazy fetch, the ring's shared
+        # fetch, the unpacked fallback's egress fetch).  The ring's whole
+        # point is host_syncs/steps → 1/K.
+        self._m_host_syncs = metrics.counter("pipeline.host_syncs")
+        self._m_ring_chains = metrics.counter("pipeline.ring_chains")
+        self._m_ring_flushes = metrics.counter("pipeline.ring_flushes")
+        self._m_host_copy_err = metrics.counter("pipeline.host_copy_errors")
         self._m_egress_fail = metrics.counter("pipeline.egress_failures")
         self._m_stall_overflow = metrics.counter(
             "pipeline.egress_stall_overflows")
@@ -659,10 +719,65 @@ class PipelineDispatcher(LifecycleComponent):
                 max_restarts=8, min_uptime_s=5.0,
                 metrics=self.metrics)
             self._egress_super.start()
+        self._warm_ring()
         self._thread = threading.Thread(
             target=self._loop, name=f"{self.name}-loop", daemon=True
         )
         self._thread.start()
+
+    def _warm_ring(self) -> None:
+        """Compile the K-step chain at boot with an all-invalid ring (a
+        semantic no-op: zero valid rows touch no state), so the first
+        REAL chain doesn't charge a multi-second jit compile to live
+        traffic's p99.  Best-effort: a failure only defers the compile
+        to the first chain."""
+        if not (self.ring_depth and self.mesh is None):
+            return
+        try:
+            from sitewhere_tpu.pipeline.packed import BATCH_F, BATCH_I
+
+            width = self.batcher.width
+            bi = np.zeros((len(BATCH_I), width), np.int32)
+            bf = np.zeros((len(BATCH_F), width), np.float32)
+            chain = self._ring_chain(self.ring_depth)
+            tables = self._tables_packed()
+            with self._step_lock:
+                # block=True: completion is forced BEFORE the commit, so
+                # an asynchronously-surfacing execution failure raises
+                # here (state manager still holds the pre-chain epoch)
+                # instead of poisoning the adopted epoch for every
+                # subsequent live dispatch
+                self._dispatch_chain(
+                    chain, tables, [bi] * self.ring_depth,
+                    [bf] * self.ring_depth, block=True)
+        except Exception:
+            logger.warning("ring warm-up failed (compile deferred to the "
+                           "first chain)", exc_info=True)
+
+    def _dispatch_chain(self, chain, tables, slots_i, slots_f,
+                        block: bool = False):
+        """ONE chained dispatch with the donation-aware state hand-off
+        (shared by the live ring and the boot warm-up so the
+        donation-sensitive commit semantics cannot diverge): leased +
+        donated carry where donation is real, plain epoch + read_epoch
+        commit otherwise.  ``block=True`` forces completion before the
+        commit — warm-up only; the live path keeps dispatch async and
+        relies on the fail-closed window for execution failures."""
+        if self._ring_donate:
+            ps, token = self.state_manager.lease_packed()
+            out = chain(tables, ps, *slots_i, *slots_f)
+            if block:
+                jax.block_until_ready(out)
+            self.state_manager.commit_packed(
+                out[0], present_now=out[3], lease_token=token)
+        else:
+            epoch = self.state_manager.current_packed
+            out = chain(tables, epoch, *slots_i, *slots_f)
+            if block:
+                jax.block_until_ready(out)
+            self.state_manager.commit_packed(
+                out[0], present_now=out[3], read_epoch=epoch)
+        return out
 
     def stop(self) -> None:
         self._stop.set()
@@ -705,8 +820,10 @@ class PipelineDispatcher(LifecycleComponent):
                 if plans:
                     self._run_plans(plans)
                 else:
-                    # No new batch: drain the deferred steps so egress
-                    # latency stays bounded when traffic pauses.
+                    # No new batch: age out a partial ring, then drain
+                    # the deferred steps so egress latency stays bounded
+                    # when traffic pauses.
+                    self._flush_ring_if_due()
                     self._drain_inflight()
                     self._maybe_commit_offset()
             except Exception:
@@ -722,6 +839,7 @@ class PipelineDispatcher(LifecycleComponent):
         concurrent sources can keep refilling under sustained traffic).
         """
         self._run_plans(self._take(self.batcher.flush))
+        self._flush_ring()
         self._drain_inflight()
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
@@ -738,6 +856,7 @@ class PipelineDispatcher(LifecycleComponent):
             # re-take: rows ingested since the first take must not rely on
             # the loop thread (stop() joins it BEFORE this flush)
             self._run_plans(self._take(self.batcher.flush))
+            self._flush_ring()
             self._drain_inflight()
             time.sleep(0.001)
         self._maybe_commit_offset()
@@ -912,37 +1031,193 @@ class PipelineDispatcher(LifecycleComponent):
         return t
 
     def _run_plan(self, plan: BatchPlan, replay_depth: int = 0) -> None:
+        """Route one emitted plan: full-width fill plans join the
+        device-resident dispatch ring (chained K at a time); everything
+        else — deadline/flush partials, re-injected plans, unpacked or
+        mesh plans — takes the single-step path, draining any ring-held
+        predecessors first so per-device event order is preserved."""
+        if self._ring_eligible(plan, replay_depth):
+            self._stage_plan(plan)
+            with self._step_lock:
+                self._ring.append(plan)
+                due = len(self._ring) >= self.ring_depth
+            if due:
+                self._stall_for_egress_room()
+                with self._step_lock:
+                    if len(self._ring) >= self.ring_depth:
+                        self._run_ring()
+            return
+        if self.ring_depth and self._ring:
+            # ordering barrier: rows already queued in the ring precede
+            # this plan — step them first (stall only outside the egress
+            # worker's own context, same rule as the single-step path).
+            # Bounded by this plan's emission seq: concurrently appended
+            # NEWER fill plans are successors, and draining them here
+            # would both reorder them ahead of this plan and starve it
+            # indefinitely under a sustained full-width stream.
+            self._flush_ring(stall=replay_depth == 0,
+                             upto_seq=plan.seq if plan.seq >= 0 else None)
+        self._dispatch_plan(plan, replay_depth)
+
+    def _ring_eligible(self, plan: BatchPlan, replay_depth: int) -> bool:
+        """May this plan wait in the ring for a chained dispatch?  Only
+        depth-0 full-width fill emissions on the single-chip packed path:
+        deadline/flush partials are latency-sensitive, re-injected plans
+        (derived alerts, replay) must not recurse through the ring, and
+        mesh plans keep the sharded per-step program.  The explicit
+        width check matters with n_shards > 1, where a single skewed
+        shard segment triggers a "fill" emission far below full width —
+        those are latency-carrying partials too."""
+        return (self.ring_depth > 0
+                and replay_depth == 0
+                and self.mesh is None
+                and plan.packed_i is not None
+                and plan.reason == "fill"
+                and plan.n_events == plan.width)
+
+    def _stall_for_egress_room(self) -> None:
+        """Bounded offload queue: stall — never while holding the step
+        lock — once egress has fallen a full window behind."""
+        if not self._offloaded():
+            return
+        deadline = time.monotonic() + 10.0
+        while (len(self._inflight) >= self.egress_queue_depth
+               and self._offloaded()
+               and time.monotonic() < deadline):
+            self._room_evt.clear()
+            # re-check AFTER the clear: a slot freed between the
+            # check above and the clear must not be lost to a full
+            # poll interval
+            if len(self._inflight) < self.egress_queue_depth:
+                break
+            self._room_evt.wait(0.05)
+        else:
+            if (self._offloaded()
+                    and len(self._inflight) >= self.egress_queue_depth):
+                # gave up on the stall bound: the window overfills
+                # rather than deadlocking the producer, but an
+                # operator must be able to see it happening
+                self._m_stall_overflow.inc()
+                logger.warning(
+                    "egress stalled > 10s with %d plans in flight "
+                    "(bound %d) — proceeding past the window bound",
+                    len(self._inflight), self.egress_queue_depth)
+
+    def _flush_ring(self, stall: bool = True,
+                    upto_seq: Optional[int] = None) -> None:
+        """Drain ring-held plans through the single-step path in emission
+        order: the partial-ring deadline/flush path, and the ordering
+        barrier ahead of a non-ring plan.  ``stall=False`` when called
+        from the egress worker's own context (it must never block on its
+        own backlog); ``upto_seq`` bounds the drain to plans emitted
+        BEFORE that sequence number (the barrier's predecessors — newer
+        arrivals stay ringed for their own chain).
+
+        Each pop+dispatch happens under ONE step-lock hold: the ring is
+        the ordered dispatch queue, so a concurrently refilled ring can
+        never chain newer plans ahead of an older plan this drain has
+        taken but not yet stepped (the stall, which must never run under
+        the lock, sits between holds)."""
+        while True:
+            if stall:
+                self._stall_for_egress_room()
+            with self._step_lock:
+                if not self._ring:
+                    return
+                if upto_seq is not None and self._ring[0].seq >= upto_seq:
+                    return
+                plan = self._ring.pop(0)
+                self._m_ring_flushes.inc()
+                self._dispatch_plan(plan, 0, stall=False)
+
+    def _flush_ring_if_due(self) -> None:
+        """Loop-thread linger bound: a partial ring whose oldest plan has
+        aged past the batcher deadline drains single-step, so the ring
+        adds at most ~one deadline of latency under trickle traffic."""
+        if not self.ring_depth:
+            return
+        with self._step_lock:
+            due = bool(self._ring) and (
+                time.monotonic() - self._ring[0].created_at
+                >= self.batcher.deadline_s)
+        if due:
+            self._flush_ring()
+
+    def _ring_chain(self, k: int):
+        """The jitted K-step chain, built once per K (K is always
+        ``ring_depth`` in steady state; the cache tolerates a mid-chaos
+        variation without recompiling every dispatch)."""
+        chain = self._ring_chains.get(k)
+        if chain is None:
+            from sitewhere_tpu.pipeline.packed import build_packed_chain
+
+            chain = build_packed_chain(k, donate=self._ring_donate)
+            self._ring_chains[k] = chain
+        return chain
+
+    def _run_ring(self) -> None:
+        """Dispatch one chained K-step program over the ring's staged
+        slots (called under ``_step_lock`` with a full ring): one host
+        dispatch covers K steps, the carry threads on device (donated —
+        the state manager leased it exclusively), per-step output blocks
+        come back stacked, and their D2H copies start immediately so the
+        egress worker's ONE shared fetch per ring finds the bytes
+        host-side.  Each slot then windows as its own plan: commits stay
+        fail-closed per batch, attributed to the step that produced them."""
+        # chaos hook: a chain-dispatch failure leaves every plan in the
+        # ring — all stay outstanding, the commit gate fails closed, and
+        # journal replay recovers their rows (at-least-once)
+        faults.fire("dispatcher.step")
+        from sitewhere_tpu.pipeline.packed import (
+            RingFetch,
+            RingStepView,
+            start_host_copy,
+        )
+
+        plans = self._ring[:self.ring_depth]
+        del self._ring[:self.ring_depth]
+        k = len(plans)
+        chain = self._ring_chain(k)
+        now = time.monotonic()
+        for plan in plans:
+            self._m_stage["ring_wait"].observe(
+                max(0.0, now - plan.created_at))
+        slots = [plan.staged or (plan.packed_i, plan.packed_f)
+                 for plan in plans]
+        t0 = time.perf_counter()
+        tables = self._tables_packed()
+        ctrace = self.tracer.trace("pipeline.chain")
+        with ctrace.span("ring.dispatch").tag("steps", k):
+            _, ois, mets, _present = self._dispatch_chain(
+                chain, tables,
+                [s[0] for s in slots], [s[1] for s in slots])
+        start_host_copy(ois, mets, on_error=self._on_host_copy_error)
+        ctrace.end()
+        self._m_stage["ring_dispatch"].observe(time.perf_counter() - t0)
+        self._m_ring_chains.inc()
+        fetch = RingFetch(ois, mets, on_fetch=self._m_host_syncs.inc)
+        for slot, plan in enumerate(plans):
+            trace = self.tracer.trace("pipeline.plan")
+            trace.record("batch.assemble", plan.max_wait_s,
+                         rows=plan.n_events, fill=round(plan.fill, 3))
+            trace.record("ring.slot", max(0.0, now - plan.created_at),
+                         slot=slot, seq=plan.seq, chain_k=k)
+            self._m_assemble.observe(plan.max_wait_s)
+            self._window_step(plan, RingStepView(fetch, slot), 0, trace)
+
+    def _on_host_copy_error(self, exc) -> None:
+        self._m_host_copy_err.inc()
+
+    def _dispatch_plan(self, plan: BatchPlan, replay_depth: int = 0,
+                       stall: bool = True) -> None:
         # chaos hook: a step-dispatch failure (device OOM, donation bug)
         # — the plan stays outstanding, so the commit gate fails closed
         faults.fire("dispatcher.step")
-        if replay_depth == 0 and self._offloaded():
-            # Bounded offload queue: stall HERE — before taking the step
-            # lock, never while holding it — once egress has fallen a
-            # full window behind.  Re-injected plans (depth > 0, which
-            # includes everything the egress worker itself submits) skip
-            # the wait so the worker can never block on its own backlog.
-            deadline = time.monotonic() + 10.0
-            while (len(self._inflight) >= self.egress_queue_depth
-                   and self._offloaded()
-                   and time.monotonic() < deadline):
-                self._room_evt.clear()
-                # re-check AFTER the clear: a slot freed between the
-                # check above and the clear must not be lost to a full
-                # poll interval
-                if len(self._inflight) < self.egress_queue_depth:
-                    break
-                self._room_evt.wait(0.05)
-            else:
-                if (self._offloaded()
-                        and len(self._inflight) >= self.egress_queue_depth):
-                    # gave up on the stall bound: the window overfills
-                    # rather than deadlocking the producer, but an
-                    # operator must be able to see it happening
-                    self._m_stall_overflow.inc()
-                    logger.warning(
-                        "egress stalled > 10s with %d plans in flight "
-                        "(bound %d) — proceeding past the window bound",
-                        len(self._inflight), self.egress_queue_depth)
+        if stall and replay_depth == 0:
+            # Re-injected plans (depth > 0, which includes everything the
+            # egress worker itself submits) skip the wait so the worker
+            # can never block on its own backlog.
+            self._stall_for_egress_room()
         self._stage_plan(plan)
         trace = self.tracer.trace("pipeline.plan")
         # the batcher wait of the oldest row = the "batch assemble" stage
@@ -981,11 +1256,15 @@ class PipelineDispatcher(LifecycleComponent):
                 # complete in the background while later plans step, so the
                 # blocking np.asarray at the window's egress end finds the
                 # bytes already on the host (≈0 RTT in steady state).
-                start_host_copy(oi, metrics)
+                start_host_copy(oi, metrics,
+                                on_error=self._on_host_copy_error)
                 self._m_stage["dispatch"].observe(
                     time.perf_counter() - t_dispatch)
-                self._window_step(plan, PackedView(oi, metrics, present),
-                                  replay_depth, trace)
+                self._window_step(
+                    plan,
+                    PackedView(oi, metrics, present,
+                               on_fetch=self._m_host_syncs.inc),
+                    replay_depth, trace)
                 return
             batch = plan.batch
             state = self.state_manager.current
@@ -1114,6 +1393,11 @@ class PipelineDispatcher(LifecycleComponent):
         if trace is None:
             trace = _NOOP_TRACE
         host_cols = plan.host_cols
+        if not hasattr(out, "_fetch"):
+            # unpacked fallback: the as_numpy/np.asarray below IS a
+            # blocking device→host sync (packed/ring views count their
+            # own lazy fetch via on_fetch instead)
+            self._m_host_syncs.inc()
         with trace.span("egress.fetch-outputs"):
             m = as_numpy(out.metrics)
             accepted = np.asarray(out.accepted)
@@ -1356,6 +1640,15 @@ class PipelineDispatcher(LifecycleComponent):
             wait = max(wait, now - plan.created_at + plan.max_wait_s)
         except IndexError:
             pass
+        # Ring-held plans are in flight too (emitted, not yet stepped):
+        # with multiple steps buffered for a chained dispatch, the
+        # overload signal must reflect the OLDEST of them, not only the
+        # already-windowed steps — otherwise a wedged ring reads healthy.
+        try:
+            plan = self._ring[0]
+            wait = max(wait, now - plan.created_at + plan.max_wait_s)
+        except IndexError:
+            pass
         return max(0.0, wait)
 
     def metrics_snapshot(self) -> Dict[str, object]:
@@ -1365,6 +1658,12 @@ class PipelineDispatcher(LifecycleComponent):
         snap: Dict[str, object] = {
             "steps": self.steps,
             "pending_rows": pending,
+            # device-resident dispatch loop surface: how often the host
+            # touched the device, and how much of the traffic rode chains
+            "host_syncs": int(self._m_host_syncs.value),
+            "ring_depth": self.ring_depth,
+            "ring_chains": int(self._m_ring_chains.value),
+            "ring_flushed_plans": int(self._m_ring_flushes.value),
             **self.totals,
         }
         if samples:

@@ -101,19 +101,54 @@ class DeviceStateManager(LifecycleComponent):
                 self._packed = pack(self.current)
             return self._packed
 
+    def lease_packed(self):
+        """Exclusive hand-off of the packed epoch for a DONATED step
+        chain (the device-resident dispatch loop's carry).
+
+        Donation deletes the input buffers once the chain runs, so the
+        manager must stop being a co-owner: the unpacked twin is
+        materialized FIRST (one async unpack dispatch — readers arriving
+        mid-chain see the pre-chain epoch from fresh buffers, never the
+        donated ones) and ``_packed`` is dropped.  Returns
+        ``(packed, lease_token)``; pass the token to :meth:`commit_packed`
+        so it can tell whether anything (a presence sweep, a migration
+        import) intervened during the chain.
+
+        If the chain crashes before commit, the manager simply still
+        holds the pre-chain epoch — the chain's plans stay outstanding
+        and journal replay re-steps them (at-least-once), identical to a
+        single-step dispatch failure.
+        """
+        with self._lock:
+            packed = self.current_packed
+            if self._state is None:
+                _, unpack = _packed_codecs()
+                self._state = unpack(packed)
+            self._packed = None
+            # token = the materialized twin's identity: every out-of-band
+            # state write (commit/sweep/import) replaces _state, so
+            # `self._state is token` at commit time means nothing
+            # intervened and the presence merge can be skipped
+            return packed, self._state
+
     def commit_packed(self, new_packed, present_now,
-                      read_epoch=None) -> None:
+                      read_epoch=None, lease_token=None) -> None:
         """Adopt a packed step's output state (the packed-loop analog of
         :meth:`commit`): re-apply ``presence_missing`` flags a concurrent
         sweep set on the current epoch for devices this step did not merge
-        (``present_now`` = the step's winner map).
+        (``present_now`` = the step's — or the whole chain's OR'd —
+        winner map).
 
         Pass ``read_epoch`` (the PackedState the step consumed): when the
         current epoch is still that object, nothing intervened and the
-        merge — an extra per-step dispatch — is skipped entirely.
+        merge — an extra per-step dispatch — is skipped entirely.  A
+        donated chain passes ``lease_token`` from :meth:`lease_packed`
+        instead (the consumed epoch's buffers no longer exist to compare).
         """
         with self._lock:
-            unchanged = read_epoch is not None and self._packed is read_epoch
+            unchanged = (
+                (read_epoch is not None and self._packed is read_epoch)
+                or (lease_token is not None and self._state is lease_token))
             if not unchanged:
                 cur = self.current_packed
                 new_packed = new_packed.replace(

@@ -590,6 +590,64 @@ class TestDispatcherChaos:
             inst.stop()
             inst.terminate()
 
+    def test_egress_crash_mid_ring_replays_exactly_the_uncommitted(
+            self, tmp_path):
+        """Device-resident ring under chaos: two full windows dispatch as
+        ONE chained program; the egress fault kills slot 0's plan, slot 1
+        still lands, the journal offset never moves past the dead step,
+        and a 'restart' replay re-ingests from the committed offset —
+        the uncommitted step's rows recover (at-least-once; the sibling
+        re-delivers too, Kafka-rewind semantics)."""
+        from sitewhere_tpu.instance import Instance
+
+        inst = Instance(_instance_config(
+            tmp_path, egress_offload=True, ring_depth=2,
+            deadline_ms=60_000.0))
+        inst.start()
+        try:
+            inst.device_management.create_device_type(
+                token="sensor", name="Sensor")
+            for i in range(64):
+                inst.device_management.create_device(
+                    token=f"d-{i}", device_type="sensor")
+                inst.device_management.create_device_assignment(
+                    device=f"d-{i}")
+            width = 64
+
+            def payload(r):
+                return "\n".join(
+                    _measurement_line(f"d-{i}", 7.0, 1_753_800_000 + r)
+                    for i in range(width)).encode()
+
+            faults.inject("dispatcher.egress", times=1)
+            inst.dispatcher.ingest_wire_lines(payload(0))
+            inst.dispatcher.ingest_wire_lines(payload(1))  # chain of 2
+            assert _wait(lambda: faults.fired("dispatcher.egress") == 1)
+            assert inst.dispatcher.metrics_snapshot()["ring_chains"] == 1
+            inst.dispatcher.flush(timeout_s=0.5)
+            # slot 1 (the sibling step) landed; slot 0 stays outstanding
+            inst.event_store.flush()
+            assert inst.event_store.total_events == width
+            with inst.dispatcher._lock:
+                assert inst.dispatcher._plans_outstanding == 1
+            assert inst.ingest_journal.end_offset == 2
+            assert inst.dispatcher.journal_reader.committed == 0
+
+            # "restart": the crash loses the outstanding count; replay
+            # re-ingests BOTH journal records past the committed offset
+            # (the replayed full windows ride the ring again)
+            with inst.dispatcher._lock:
+                inst.dispatcher._plans_outstanding = 0
+            replayed = inst.dispatcher.replay_journal()
+            assert replayed == 2 * width
+            inst.event_store.flush()
+            assert inst.event_store.total_events == 3 * width
+            assert inst.dispatcher.journal_reader.committed == 2
+        finally:
+            faults.clear()
+            inst.stop()
+            inst.terminate()
+
     def test_step_fault_fails_closed_then_replays(self, tmp_path):
         from sitewhere_tpu.instance import Instance
 

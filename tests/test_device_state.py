@@ -249,6 +249,66 @@ class TestCommitMergeRace:
         assert manager.missing_device_ids() == [5]
 
 
+class TestLeasePacked:
+    """The donated-chain hand-off (``lease_packed`` → chain →
+    ``commit_packed(lease_token=...)``) — the dispatcher ring's
+    production path wherever donation is real (TPU).  Donation is a
+    no-op on CPU, but the token protocol, the reader-safety twin
+    materialization, and the sweep-intervened merge all run fully."""
+
+    def _packed_step(self, manager_ps, rows):
+        import jax
+
+        from sitewhere_tpu.pipeline.packed import (
+            BATCH_F,
+            BATCH_I,
+            pack_batch_host,
+            pack_tables,
+            packed_pipeline_step,
+        )
+        from sitewhere_tpu.schema import as_numpy
+
+        registry = make_registry(capacity=CAP, n_devices=8)
+        tables = pack_tables(registry, RuleTable.empty(4), ZoneTable.empty(4))
+        host = as_numpy(make_batch(rows))
+        cols = {f: np.asarray(getattr(host, f)) for f in BATCH_I + BATCH_F}
+        bi, bf = pack_batch_host(cols, len(rows))
+        return jax.jit(packed_pipeline_step)(tables, manager_ps, bi, bf)
+
+    def test_fast_path_and_reader_survives_donation(self, manager):
+        run_step(manager, [measurement(0, ts=1000)])
+        ps, token = manager.lease_packed()
+        new_ps, _oi, _mets, present = self._packed_step(
+            ps, [measurement(0, ts=5000)])
+        # simulate the donation: the chain consumed the leased buffers
+        ps.si.delete()
+        ps.sf.delete()
+        # a reader arriving mid-chain sees the pre-chain epoch from the
+        # materialized twin — never the deleted/donated buffers
+        assert manager.get_device_state("dev-0")["last_event_ts_s"] == 1000
+        manager.commit_packed(new_ps, present_now=present,
+                              lease_token=token)
+        assert manager.get_device_state("dev-0")["last_event_ts_s"] == 5000
+
+    def test_sweep_during_lease_merges_at_commit(self, manager):
+        """A presence sweep landing mid-chain invalidates the lease
+        token: the commit must re-apply the sweep's flags for devices
+        the chain did not merge (same lost-update rule as the unpacked
+        commit race)."""
+        run_step(manager, [measurement(0, ts=1000), measurement(5, ts=1000)])
+        ps, token = manager.lease_packed()
+        new_ps, _oi, _mets, present = self._packed_step(
+            ps, [measurement(0, ts=90_000)])
+        swept = manager.apply_presence_sweep(
+            now_s=80_000, missing_after_s=10_000)
+        assert swept is not None
+        assert sorted(manager.missing_device_ids()) == [0, 5]
+        manager.commit_packed(new_ps, present_now=present,
+                              lease_token=token)
+        # dev-0 (chain-merged, fresh event) cleared; dev-5 keeps the flag
+        assert manager.missing_device_ids() == [5]
+
+
 def test_update_state_false_rows_do_not_touch_state(manager):
     """System-generated events (presence STATE_CHANGEs, derived alerts)
     carry update_state=False: persisted/fanned out but never merged —

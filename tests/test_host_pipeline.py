@@ -201,6 +201,13 @@ class FakeStateManager:
     def commit(self, new_state, present_now=None):
         pass
 
+    def commit_packed(self, new_packed, present_now=None,
+                      read_epoch=None, lease_token=None):
+        pass
+
+    def lease_packed(self):
+        return None, None
+
 
 class SlowStore:
     """Event-store stand-in whose append costs ``delay_s`` host time."""
@@ -210,6 +217,8 @@ class SlowStore:
         self.rows = 0
         self.batches = 0
         self.append_threads = set()
+        self.first_ids = []  # first device_id of each appended batch
+        # (egress-order probe for the ring's ordering barrier)
 
     def append_columns(self, cols, mask=None):
         self.append_threads.add(threading.current_thread().name)
@@ -218,6 +227,7 @@ class SlowStore:
         self.rows += int(mask.sum()) if mask is not None \
             else len(cols["device_id"])
         self.batches += 1
+        self.first_ids.append(int(np.asarray(cols["device_id"])[0]))
 
     def flush(self):
         pass
@@ -255,6 +265,61 @@ def make_dispatcher(step_s=0.0, egress_s=0.0, egress_offload=True,
 
 def ingest_window(disp):
     disp.ingest_arrays(device_id=np.arange(WIDTH, dtype=np.int32))
+
+
+def make_ring_dispatcher(ring_depth=2, egress_s=0.0, egress_offload=True,
+                         **kw):
+    """Dispatcher on the device-resident ring path with a STUBBED chain:
+    packed plans from an emit_packed batcher, a fake K-step chain whose
+    stacked outputs accept every row, and no real jax dispatch — the
+    ring's windowing/commit/ordering semantics in isolation."""
+    from sitewhere_tpu.pipeline.packed import METRIC_SCALARS
+
+    metrics = MetricsRegistry()
+    batcher = Batcher(
+        width=WIDTH, n_shards=1, registry_capacity=64,
+        resolve_device=lambda t: NULL_ID, resolve_mtype=lambda n: 0,
+        resolve_alert=lambda n: 0, deadline_ms=60_000.0, emit_packed=True)
+    store = SlowStore(egress_s)
+    disp = PipelineDispatcher(
+        batcher=batcher,
+        registry_provider=lambda: None,
+        state_manager=FakeStateManager(),
+        rules_provider=lambda: None,
+        zones_provider=lambda: None,
+        event_store=store,
+        egress_offload=egress_offload,
+        ring_depth=ring_depth,
+        metrics=metrics,
+        **kw,
+    )
+    disp._tables_packed = lambda: None
+    chain_calls = []
+
+    def _step_out(bi):
+        valid = (np.asarray(bi)[0] != 0).astype(np.int32)
+        oi = np.zeros((10, WIDTH), np.int32)
+        oi[0] = valid  # flags row: F_ACCEPTED for every valid row
+        mets = np.zeros(len(METRIC_SCALARS) + 6, np.int32)
+        mets[0] = mets[1] = int(valid.sum())  # processed / accepted
+        return oi, mets
+
+    def fake_chain(tables, ps, *slots):
+        k = len(slots) // 2
+        chain_calls.append(k)
+        outs = [_step_out(slots[i]) for i in range(k)]
+        return (ps, np.stack([o for o, _ in outs]),
+                np.stack([m for _, m in outs]), np.zeros(64, bool))
+
+    def fake_packed_step(tables, ps, bi, bf):
+        oi, mets = _step_out(bi)
+        return ps, oi, mets, np.zeros(64, bool)
+
+    for k in range(1, ring_depth + 1):
+        disp._ring_chains[k] = fake_chain
+    disp._packed_step = fake_packed_step
+    disp._chain_calls = chain_calls
+    return disp, store, metrics
 
 
 # ---------------------------------------------------------------------------
@@ -346,6 +411,279 @@ class TestEgressOffload:
 
 
 # ---------------------------------------------------------------------------
+# device-resident dispatch ring: multi-step in-flight semantics
+# ---------------------------------------------------------------------------
+
+def ingest_window_at(disp, base):
+    """One full-width fill window with device ids base..base+WIDTH-1
+    (distinguishable in the store's egress-order probe)."""
+    disp.ingest_arrays(
+        device_id=(base + np.arange(WIDTH)).astype(np.int32))
+
+
+class TestDeviceResidentRing:
+    def test_full_windows_chain_k_steps_one_sync_per_chain(self):
+        disp, store, metrics = make_ring_dispatcher(ring_depth=2)
+        disp.start()
+        try:
+            for i in range(4):
+                ingest_window_at(disp, i * WIDTH % 64)
+            disp.flush()
+            assert store.rows == 4 * WIDTH
+            # first call is the boot-time warm-up (all-invalid ring)
+            assert disp._chain_calls == [2, 2, 2]
+            # the whole point: ONE blocking host sync per K-step chain
+            assert metrics.counter("pipeline.host_syncs").value == 2
+            assert metrics.counter("pipeline.ring_chains").value == 2
+            assert not disp._ring
+            with disp._lock:
+                assert disp._plans_outstanding == 0
+        finally:
+            disp.stop()
+
+    def test_flush_drains_partial_ring_no_lost_commits(self):
+        disp, store, metrics = make_ring_dispatcher(ring_depth=2)
+        disp.start()
+        try:
+            for i in range(3):   # one chain + one plan stranded in ring
+                ingest_window_at(disp, i * WIDTH)
+            disp.flush()
+            # flush's contract holds through the ring: every row
+            # ingested before the call completed egress on return
+            assert store.rows == 3 * WIDTH
+            assert not disp._ring
+            with disp._lock:
+                assert disp._plans_outstanding == 0
+            assert metrics.counter("pipeline.ring_flushes").value == 1
+        finally:
+            disp.stop()
+
+    def test_stop_drains_ring(self):
+        disp, store, _ = make_ring_dispatcher(ring_depth=4)
+        disp.start()
+        ingest_window_at(disp, 0)   # sits in the ring, chain never fills
+        disp.stop()                 # shutdown flush must not strand it
+        assert store.rows == WIDTH
+        with disp._lock:
+            assert disp._plans_outstanding == 0
+
+    def test_non_ring_plan_drains_ring_first_in_order(self):
+        """A deadline/flush partial must not overtake ring-held
+        predecessors: per-device event order across plans is preserved
+        by the ordering barrier (ring drains single-step first)."""
+        disp, store, _ = make_ring_dispatcher(ring_depth=3)
+        disp.start()
+        try:
+            ingest_window_at(disp, 0)    # ring slot 0
+            ingest_window_at(disp, 8)    # ring slot 1 (chain needs 3)
+            disp.ingest_arrays(
+                device_id=np.full(4, 16, np.int32))  # partial, pending
+            disp.flush()                 # emits the partial (reason=flush)
+            assert store.rows == 2 * WIDTH + 4
+            assert store.first_ids == [0, 8, 16]
+        finally:
+            disp.stop()
+
+    def test_barrier_drains_only_predecessors_by_seq(self):
+        """The ordering barrier is seq-bounded: ring plans emitted AFTER
+        the non-ring plan are successors — draining them would reorder
+        them ahead of it (and starve it under sustained fill traffic)."""
+        disp, store, _ = make_ring_dispatcher(ring_depth=4)
+        disp.start()
+        try:
+            ingest_window_at(disp, 0)    # seq 0 → ring
+            ingest_window_at(disp, 8)    # seq 1 → ring
+            disp.ingest_arrays(device_id=np.full(4, 16, np.int32))
+            partial = disp._take(disp.batcher.flush)[0]   # seq 2
+            ingest_window_at(disp, 24)   # seq 3 → ring (a successor)
+            disp._run_plan(partial)
+            # predecessors stepped, then the partial; successor stays
+            with disp._step_lock:
+                assert [p.seq for p in disp._ring] == [3]
+            disp.flush()
+            assert store.first_ids == [0, 8, 16, 24]
+            assert store.rows == 3 * WIDTH + 4
+        finally:
+            disp.stop()
+
+    def test_egress_crash_mid_ring_fails_closed_on_dead_step_only(self):
+        """An egress fault on slot 0 of a chained dispatch kills the
+        worker; the supervisor restarts it, slot 1 still drains, and
+        ONLY the dead step stays outstanding — the commit gate fails
+        closed on exactly the uncommitted slice of the ring."""
+        faults.clear()
+        disp, store, _ = make_ring_dispatcher(ring_depth=2)
+        disp.start()
+        try:
+            faults.inject("dispatcher.egress", times=1)
+            ingest_window_at(disp, 0)
+            ingest_window_at(disp, 8)   # chain of 2 dispatches here
+            assert _wait(lambda: faults.fired("dispatcher.egress") == 1)
+            disp.flush(timeout_s=1.0)
+            assert store.rows == WIDTH          # only the sibling landed
+            assert disp.egress_failures == 1
+            assert _wait(lambda: disp._egress_super.restarts >= 1)
+            assert not disp._egress_super.escalated
+            with disp._lock:
+                assert disp._plans_outstanding == 1
+        finally:
+            faults.clear()
+            disp.stop()
+
+    def test_overload_signal_reflects_oldest_ring_plan(self):
+        """The seal-lag watermark must see plans buffered for a chain:
+        with steps in flight beyond the windowed FIFO, the signal is the
+        age of the OLDEST in-flight batch, not the last fetched one."""
+        disp, _, _ = make_ring_dispatcher(ring_depth=4)
+        # no start(): plans stay in the ring (no loop thread to age them
+        # out), which is exactly the wedged state the signal must see
+        disp.steps = 1  # past the warm-up gate
+        ingest_window_at(disp, 0)
+        ingest_window_at(disp, 8)
+        assert len(disp._ring) == 2
+        time.sleep(0.05)
+        assert disp.oldest_unsealed_wait_s() >= 0.04
+        disp._flush_ring()
+
+    def test_ring_ineligible_plans_take_the_single_step_path(self):
+        """Re-injected (replay-depth) plans and deadline partials never
+        wait in the ring."""
+        disp, store, _ = make_ring_dispatcher(ring_depth=2)
+        # depth > 0 == egress-worker context: must dispatch immediately
+        plan = disp._take(lambda: disp.batcher.add_arrays(
+            device_id=np.arange(WIDTH, dtype=np.int32)))[0]
+        assert not disp._ring_eligible(plan, replay_depth=1)
+        assert disp._ring_eligible(plan, replay_depth=0)
+        disp._run_plan(plan, replay_depth=1)
+        assert not disp._ring   # never waited for a chain
+        disp.flush()
+        assert store.rows == WIDTH
+
+
+# ---------------------------------------------------------------------------
+# start_host_copy: only the deleted-buffer race is silent
+# ---------------------------------------------------------------------------
+
+class _FakeDeviceArray:
+    def __init__(self, exc=None):
+        self.exc = exc
+        self.calls = 0
+
+    def copy_to_host_async(self):
+        self.calls += 1
+        if self.exc is not None:
+            raise self.exc
+
+
+class TestStartHostCopy:
+    @pytest.fixture(autouse=True)
+    def _force_capability(self, monkeypatch):
+        from sitewhere_tpu.pipeline import packed
+
+        monkeypatch.setattr(packed, "_ASYNC_HOST_COPY", True)
+        yield
+
+    def test_deleted_buffer_race_stays_silent(self):
+        from sitewhere_tpu.pipeline import packed
+
+        before = packed.host_copy_errors
+        errors = []
+        packed.start_host_copy(
+            _FakeDeviceArray(RuntimeError("Array has been deleted.")),
+            on_error=errors.append)
+        assert packed.host_copy_errors == before
+        assert errors == []
+
+    def test_unexpected_error_is_counted_and_does_not_stop_siblings(self):
+        from sitewhere_tpu.pipeline import packed
+
+        before = packed.host_copy_errors
+        errors = []
+        ok = _FakeDeviceArray()
+        packed.start_host_copy(
+            _FakeDeviceArray(RuntimeError("transfer engine wedged")),
+            ok, on_error=errors.append)
+        assert packed.host_copy_errors == before + 1
+        assert len(errors) == 1
+        # the failure must not abort the remaining arrays' copies
+        # (the old bare guard returned on ANY error)
+        assert ok.calls == 1
+
+    def test_host_arrays_are_skipped(self):
+        from sitewhere_tpu.pipeline import packed
+
+        before = packed.host_copy_errors
+        packed.start_host_copy(np.zeros(4), object())
+        assert packed.host_copy_errors == before
+
+
+# ---------------------------------------------------------------------------
+# tier-1 CPU smoke: the ring end-to-end through a real Instance
+# ---------------------------------------------------------------------------
+
+class TestRingEndToEnd:
+    def test_forced_ring_runs_journal_to_egress_on_cpu(self, tmp_path):
+        """The device-resident dispatch loop exercised on EVERY tier-1
+        run, not only on TPU: a real Instance with forced ``ring_depth=2``
+        drives NDJSON wire payloads journal→dispatch(chained)→egress, and
+        the host-sync counter proves the amortization (1 blocking sync
+        per 2-step chain)."""
+        import json as _json
+
+        from sitewhere_tpu.instance import Instance
+        from sitewhere_tpu.runtime.config import Config
+
+        width = 64
+        inst = Instance(Config({
+            "instance": {"id": "ring-smoke",
+                         "data_dir": str(tmp_path / "data")},
+            "pipeline": {"width": width, "registry_capacity": 128,
+                         "mtype_slots": 4, "deadline_ms": 60_000.0,
+                         "n_shards": 1, "ring_depth": 2},
+            "presence": {"scan_interval_s": 3600.0,
+                         "missing_after_s": 1800},
+        }, apply_env=False))
+        inst.start()
+        try:
+            inst.device_management.create_device_type(
+                token="sensor", name="Sensor")
+            for i in range(width):
+                inst.device_management.create_device(
+                    token=f"d-{i}", device_type="sensor")
+                inst.device_management.create_device_assignment(
+                    device=f"d-{i}")
+
+            def payload(r):
+                return "\n".join(_json.dumps({
+                    "deviceToken": f"d-{i}", "type": "Measurement",
+                    "request": {"name": "temp", "value": 1.0 + i,
+                                "eventDate": 1_753_800_000 + r},
+                }) for i in range(width)).encode()
+
+            for r in range(4):
+                inst.dispatcher.ingest_wire_lines(payload(r))
+            inst.dispatcher.flush()
+            snap = inst.dispatcher.metrics_snapshot()
+            assert snap["ring_depth"] == 2
+            assert snap["ring_chains"] == 2          # 4 steps, 2 chains
+            assert snap["accepted"] == 4 * width     # no lost commits
+            # host syncs amortized to 1 per K steps (the tentpole claim)
+            assert snap["host_syncs"] == 2
+            assert snap["steps"] == 4
+            # egress really landed (journal→dispatch→egress, not a stub)
+            inst.event_store.flush()
+            assert inst.event_store.total_events == 4 * width
+            # chained commits merged state correctly
+            row = inst.device_state.get_device_state("d-5")
+            assert row["last_event_ts_s"] == 1_753_800_003
+            # commit gate advanced past every journaled record
+            assert inst.dispatcher.journal_reader.committed == 4
+        finally:
+            inst.stop()
+            inst.terminate()
+
+
+# ---------------------------------------------------------------------------
 # the overlap acceptance proof
 # ---------------------------------------------------------------------------
 
@@ -362,11 +700,17 @@ class TestHostpathBenchSmoke:
         spec = importlib.util.spec_from_file_location("hostpath_bench", path)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        r = mod.run(width=128, iters=2, capacity=1024,
+        r = mod.run(width=128, iters=2, capacity=1024, ring_k=2,
                     data_dir=str(tmp_path))
         for key in ("decode_s", "batch_s", "dispatch_s", "egress_s",
+                    "h2d_stage_s", "d2h_fetch_s", "host_rtt_s",
                     "seal_s", "serial_s", "pipeline_bound_s"):
             assert r[key] > 0.0, key
+        # dwell is RTT-clamped: ≥ 0, and positive wherever the chain
+        # outruns the trivial-program probe (every real backend)
+        assert r["device_dwell_s"] >= 0.0
+        assert r["ring_chain_k"] == 2
+        assert r["host_syncs_per_batch_ring"] == 0.5
         assert r["pipeline_bound_s"] <= r["serial_s"]
         assert r["overlapped_events_per_s"] >= r["serial_events_per_s"]
 

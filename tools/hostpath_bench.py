@@ -12,10 +12,24 @@ an end-to-end number:
   payload (the decode-pool worker's unit of work);
 - **batch**    — ``Batcher.add_arrays`` intake + packed emission (the
   dispatch thread's assembly stage);
-- **dispatch** — the jitted packed pipeline step, post-warmup (the
-  device stage the host stages must hide behind);
+- **h2d**      — ``device_put`` staging of one packed batch (the
+  double-buffer front half — hidden behind compute when staged ahead);
+- **dispatch** — the jitted packed pipeline step, post-warmup (h2d sync
+  + device dwell + output allocation: the single-step host view);
+- **dwell**    — the DEVICE-side step time alone, from a chained
+  ``ring_k``-step program (one host round-trip covers the chain, the
+  measured RTT is subtracted — the phase-C methodology, and the cost a
+  ring slot actually pays on device);
+- **d2h**      — blocking fetch of one step's output block + metrics
+  (what egress pays when the async copy did NOT land in time);
 - **egress**   — ``EventStore.append_columns`` + seal of one batch (the
   offload worker's unit of work).
+
+Also reports ``host_rtt_s`` (trivial-program round-trip: the per-sync
+floor on a network-attached chip) and ``host_syncs_per_batch`` for the
+single-step (1.0) vs ring (1/ring_k) dispatch paths — every remaining
+millisecond of config-2 latency attributes to exactly one of these
+rows.
 
 Prints one line per stage (per-batch host ms + events/s), the serial
 sum, and the pipeline bound (the max stage — what the overlapped
@@ -64,8 +78,26 @@ def _payload(width: int) -> bytes:
     return ("\n".join(lines)).encode()
 
 
+def _measure_rtt(samples: int = 5) -> float:
+    """Median dispatch round-trip of a trivial jitted program (seconds)
+    — same probe as ``bench.measure_rtt``, local so the tool has no
+    bench.py import."""
+    import jax
+    import jax.numpy as jnp
+
+    trivial = jax.jit(lambda x: x + 1)
+    int(trivial(jnp.int32(0)))
+    rtts = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        int(trivial(jnp.int32(0)))
+        rtts.append(time.perf_counter() - t0)
+    rtts.sort()
+    return rtts[len(rtts) // 2]
+
+
 def run(width: int = 2048, iters: int = 16, capacity: int = 16_384,
-        data_dir: str | None = None) -> dict:
+        ring_k: int = 8, data_dir: str | None = None) -> dict:
     import numpy as np
 
     from sitewhere_tpu.ids import NULL_ID, HandleSpace
@@ -135,6 +167,54 @@ def run(width: int = 2048, iters: int = 16, capacity: int = 16_384,
 
     results["dispatch_s"] = _time_stage(dispatch_once, iters)
 
+    # -- h2d (device_put staging of one packed batch, the ring slot fill) ----
+    def h2d_once():
+        jax.block_until_ready((jax.device_put(bi), jax.device_put(bf)))
+
+    h2d_once()
+    results["h2d_stage_s"] = _time_stage(h2d_once, iters)
+
+    # -- dwell (device-side step time from a chained ring_k-step program) ----
+    from sitewhere_tpu.pipeline.packed import build_packed_chain
+
+    rtt = _measure_rtt()
+    results["host_rtt_s"] = rtt
+    staged_bi = jax.device_put(bi)
+    staged_bf = jax.device_put(bf)
+    chain = build_packed_chain(ring_k, donate=True)
+    carry = pack_state(DeviceState.empty(capacity))
+    slots = [staged_bi] * ring_k + [staged_bf] * ring_k
+    carry, ois, mets, present = chain(tables, carry, *slots)  # compile
+    jax.block_until_ready(mets)
+    samples = []
+    for _ in range(max(2, iters // 4)):
+        t0 = time.perf_counter()
+        carry, ois, mets, present = chain(tables, carry, *slots)
+        int(jax.device_get(mets)[0][0])  # force the whole chain
+        samples.append(max(0.0, time.perf_counter() - t0 - rtt) / ring_k)
+    samples.sort()
+    results["device_dwell_s"] = samples[len(samples) // 2]
+    results["ring_chain_k"] = ring_k
+    # how often the host must touch the device per dispatched batch
+    results["host_syncs_per_batch_single"] = 1.0
+    results["host_syncs_per_batch_ring"] = 1.0 / ring_k
+
+    # -- d2h (blocking fetch of one step's outputs — the per-sync cost) ------
+    # fresh outputs per sample: jax caches a fetched array's host copy,
+    # so re-fetching the same buffer would measure a dict lookup
+    outs = []
+    for _ in range(iters):
+        o = step(tables, state, bi, bf)
+        outs.append((o[1], o[2]))
+    jax.block_until_ready(outs)
+    samples = []
+    for oi_dev, met_dev in outs:
+        t0 = time.perf_counter()
+        jax.device_get((oi_dev, met_dev))
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    results["d2h_fetch_s"] = samples[len(samples) // 2]
+
     # -- egress (event-store append + seal of one batch) ---------------------
     from sitewhere_tpu.services.event_store import EventStore
 
@@ -194,6 +274,9 @@ def main(argv=None) -> int:
     parser.add_argument("--iters", type=int, default=16,
                         help="timing iterations per stage (median)")
     parser.add_argument("--capacity", type=int, default=16_384)
+    parser.add_argument("--ring-k", type=int, default=8,
+                        help="chain depth for the device-dwell probe "
+                             "(the dispatcher ring's K)")
     parser.add_argument("--cpu", action="store_true",
                         help="force the CPU backend")
     parser.add_argument("--json", action="store_true",
@@ -205,14 +288,18 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", "cpu")
 
-    r = run(width=args.width, iters=args.iters, capacity=args.capacity)
+    r = run(width=args.width, iters=args.iters, capacity=args.capacity,
+            ring_k=args.ring_k)
     if args.json:
         print(json.dumps(r, indent=2))
         return 0
     print(f"host-path stage breakdown  (width={r['width']}, "
           f"iters={r['iters']}, median)")
-    for stage in ("decode", "batch", "dispatch", "egress"):
-        s = r[f"{stage}_s"]
+    for stage, key in (("decode", "decode_s"), ("batch", "batch_s"),
+                       ("h2d", "h2d_stage_s"), ("dispatch", "dispatch_s"),
+                       ("dwell", "device_dwell_s"), ("d2h", "d2h_fetch_s"),
+                       ("egress", "egress_s")):
+        s = r[key]
         rate = r["width"] / s if s else float("inf")
         print(f"  {stage:<9} {s * 1e3:9.3f} ms/batch   {rate:12,.0f} events/s")
     print(f"  {'serial':<9} {r['serial_s'] * 1e3:9.3f} ms/batch   "
@@ -220,6 +307,10 @@ def main(argv=None) -> int:
     print(f"  pipeline bound (max stage): "
           f"{r['pipeline_bound_s'] * 1e3:.3f} ms/batch → "
           f"{r['overlapped_events_per_s']:,.0f} events/s overlapped")
+    print(f"  host sync floor: rtt {r['host_rtt_s'] * 1e3:.3f} ms — "
+          f"host_syncs/batch 1.0 single-step, "
+          f"{r['host_syncs_per_batch_ring']:.3f} ring "
+          f"(K={r['ring_chain_k']} chained)")
     print(f"  (one-time seal of {r['iters'] + 1} buffered batches: "
           f"{r['seal_s'] * 1e3:.3f} ms — amortized at commit points)")
     return 0
