@@ -173,18 +173,12 @@ def emit(doc: dict) -> None:
 
 def measure_rtt(samples: int = 5) -> float:
     """Median dispatch round-trip of a trivial jitted program (seconds).
-    ~0.1 ms co-located; ~70 ms through the bench tunnel."""
-    import jax
-    import jax.numpy as jnp
+    ~0.1 ms co-located; ~70 ms through the bench tunnel.  The shared
+    probe from the telemetry library, so bench evidence and the
+    production device.stage_ms calibration subtract the SAME floor."""
+    from sitewhere_tpu.pipeline.telemetry import measure_rtt as probe
 
-    trivial = jax.jit(lambda x: x + 1)
-    int(trivial(jnp.int32(0)))
-    rtts = []
-    for _ in range(samples):
-        t = time.perf_counter()
-        int(trivial(jnp.int32(0)))
-        rtts.append(time.perf_counter() - t)
-    return float(np.median(rtts))
+    return probe(samples)
 
 
 def packed_chain(tables, staged, chain_k: int):
@@ -496,6 +490,32 @@ def bench_dispatcher() -> None:
         if rtt_ms < 5.0:
             tuned = _dispatcher_tuned_latency(payloads, events_per_sec,
                                               n_devices=n_devices)
+
+        # Device-side stage attribution (ISSUE 9): the fori-chain probes
+        # at the bench width, so every r06+ evidence file carries BOTH
+        # halves of the latency story — host stage_ms above, device
+        # stage ms here.  Skippable (SW_BENCH_DEVICE_TELEMETRY=0): the
+        # probes compile one chain per stage.
+        device_stage_ms = None
+        if os.environ.get("SW_BENCH_DEVICE_TELEMETRY", "1") != "0":
+            try:
+                from sitewhere_tpu.pipeline.telemetry import (
+                    profile_device_stages,
+                )
+
+                prof = profile_device_stages(
+                    width=width, capacity=16_384,
+                    iters=(4 if reduced else 16),
+                    repeats=(2 if reduced else 3))
+                device_stage_ms = {
+                    stage: prof[f"{stage}_ms"]
+                    for stage in ("validate", "rules", "zones", "state",
+                                  "full")
+                    if f"{stage}_ms" in prof
+                }
+            except Exception as e:
+                print(f"device-stage telemetry probe failed: {e}",
+                      file=sys.stderr)
         emit({
             "metric": "dispatcher_events_per_sec_per_chip",
             "value": round(events_per_sec, 1),
@@ -518,6 +538,10 @@ def bench_dispatcher() -> None:
             # not inflate the measured run's chained coverage
             "ring_chains": int(snap["ring_chains"] - snap0["ring_chains"]),
             "stage_ms": stage_ms,
+            # device-side per-stage ms (fori-chain probes) next to the
+            # host attribution — both sides of the config-2 latency story
+            **({"device_stage_ms": device_stage_ms}
+               if device_stage_ms else {}),
             "accepted": int(snap["accepted"]),
             "steps": int(snap["steps"]),
             "backend": _jax.default_backend(),

@@ -125,6 +125,10 @@ class BatchPlan:
     # per-device event order is preserved).
     seq: int = -1
     reason: str = "fill"
+    # Host dispatch time this plan paid (single-step: the jitted call;
+    # ring slot: its 1/K share of the chain dispatch) — flight-recorder
+    # stage attribution, stamped by the dispatcher.
+    dispatch_s: float = 0.0
 
     @property
     def fill(self) -> float:

@@ -189,6 +189,69 @@ class Instance(LifecycleComponent):
 
         self.scripts = ScriptManager(self.data_dir)
 
+        # Flight recorder (runtime/flightrec.py): always-on bounded ring
+        # of per-batch records, snapshotted to JSONL on anomaly (SLO
+        # burn alert, egress crash, overload transition, supervisor
+        # restart) and served at /api/instance/flightrecorder.
+        self.flightrec = None
+        if bool(self.config.get("flightrec.enabled", True)):
+            from sitewhere_tpu.runtime.flightrec import FlightRecorder
+
+            self.flightrec = FlightRecorder(
+                data_dir=self.data_dir,
+                capacity=int(self.config.get("flightrec.capacity", 2048)),
+                min_snapshot_interval_s=float(self.config.get(
+                    "flightrec.min_snapshot_interval_s", 5.0)),
+                max_snapshots=int(self.config.get(
+                    "flightrec.max_snapshots", 32)),
+                metrics=self.metrics,
+            )
+
+        # SLO burn-rate engine (runtime/metrics.py BurnRateEngine):
+        # multi-window burn evaluation against the BASELINE.json targets
+        # (1M ev/s throughput, <10ms p99, shed rate), ticked by the
+        # dispatcher loop; alerts emit slo.burn spans + dump the flight
+        # recorder.  slo.throughput_eps=0 disables that objective (e.g.
+        # a CPU-fallback deployment that can never meet the TPU number).
+        self.slo = None
+        if bool(self.config.get("slo.enabled", True)):
+            from sitewhere_tpu.runtime.metrics import (
+                BurnRateEngine,
+                SloTargets,
+            )
+
+            self.slo = BurnRateEngine(
+                targets=SloTargets(
+                    throughput_eps=float(self.config.get(
+                        "slo.throughput_eps", 1_000_000.0)),
+                    p99_ms=float(self.config.get("slo.p99_ms", 10.0)),
+                    shed_rate=float(self.config.get(
+                        "slo.shed_rate", 0.01))),
+                windows_s=(float(self.config.get("slo.fast_window_s",
+                                                 60.0)),
+                           float(self.config.get("slo.slow_window_s",
+                                                 600.0))),
+                error_budget=float(self.config.get(
+                    "slo.error_budget", 0.05)),
+                alert_burn=float(self.config.get("slo.alert_burn", 2.0)),
+                min_samples=int(self.config.get("slo.min_samples", 5)),
+                lag_tolerance_s=float(self.config.get(
+                    "slo.lag_tolerance_s", 2.0)),
+                sample_interval_s=float(self.config.get(
+                    "slo.sample_interval_s", 1.0)),
+                sample_fn=self._slo_sample,
+                metrics=self.metrics,
+                tracer=self.tracer,
+                on_alert=self._on_slo_alert,
+            )
+        self._slo_last = {"processed": 0, "shed": 0, "admitted": 0,
+                          "at": None}
+        import threading as _threading
+
+        # serializes the jax.profiler start/stop check-then-act pair
+        self._profiler_lock = _threading.Lock()
+        self._profiler_dir: Optional[str] = None
+
         # Overload control (runtime/overload.py): a watermark-driven
         # state machine over signals the pipeline already exports.  The
         # dispatcher ticks it every loop cycle; admission at ingest and
@@ -222,6 +285,14 @@ class Instance(LifecycleComponent):
                 tracer=self.tracer,
             )
             self.labels.load_gate = self.overload.allow_optional
+            if self.flightrec is not None:
+                # every ladder move dumps the recorder: the batches
+                # surrounding a transition are the evidence items 1-2
+                # of the roadmap tune against
+                self.overload.on_transition(
+                    lambda old, new, signals: self._flightrec_dump_async(
+                        f"overload-{new.name.lower()}",
+                        f"{old.name}->{new.name}"))
 
         # domain services the dispatcher egresses into — registered as
         # children BEFORE it so the reverse-order stop keeps them alive
@@ -367,6 +438,9 @@ class Instance(LifecycleComponent):
             tracer=self.tracer,
             metrics=self.metrics,
             overload=self.overload,
+            flightrec=self.flightrec,
+            slo=self.slo,
+            cost_analysis=self.config.get("telemetry.cost_analysis"),
         ))
         self.presence = self.add_child(PresenceManager(
             self.device_state,
@@ -651,6 +725,137 @@ class Instance(LifecycleComponent):
             batcher_backlog=self.batcher.pending / max(1, self.batcher.width),
             fsync_latency_s=float(self.ingest_journal.last_fsync_s),
         )
+
+    def _slo_sample(self):
+        """One SLO burn-rate sample: counter DELTAS since the previous
+        sample (events processed, shed vs admitted) plus the rolling p99
+        — the engine judges each delta against the BASELINE targets."""
+        import time as _time
+
+        now = _time.monotonic()
+        last = self._slo_last
+        snap = self.dispatcher.metrics_snapshot()
+        processed = int(snap.get("processed", 0))
+        shed = (int(self.overload.shed_total)
+                if self.overload is not None else 0)
+        admitted = (int(self.overload.admitted_total)
+                    if self.overload is not None else processed)
+        sample = None
+        if last["at"] is not None:
+            events = processed - last["processed"]
+            sample = {
+                "events": events,
+                "elapsed_s": max(1e-9, now - last["at"]),
+                # the rolling p99 is only evidence while traffic flows:
+                # the latency reservoir is never time-pruned, so after a
+                # burst it would keep reporting the burst's percentile
+                # forever and an idle instance would read as burning
+                "p99_ms": (snap.get("latency_p99_ms")
+                           if events > 0 else None),
+                "shed": shed - last["shed"],
+                "admitted": admitted - last["admitted"],
+                # queue SNAPSHOT (not a delta): the engine's wedge
+                # witness for deployments whose admitted counter aliases
+                # processed (overload disabled) — rows pending while
+                # nothing completes judges as a stall, never as idle
+                "backlog": int(snap.get("pending_rows", 0)),
+            }
+        self._slo_last = {"processed": processed, "shed": shed,
+                          "admitted": admitted, "at": now}
+        return sample
+
+    def _flightrec_dump_async(self, reason: str, detail: str) -> None:
+        """Anomaly dump OFF the calling thread: overload transitions and
+        SLO alerts fire on the dispatcher loop, and a snapshot is a file
+        write — during a disk-stressed incident (slow fsync is itself an
+        overload signal) an inline dump would stall the dispatch loop at
+        the exact moment it is overloaded.  The per-reason rate limit is
+        checked inside anomaly(), so a storm spawns counted no-op
+        threads, not files."""
+        import threading as _threading
+
+        _threading.Thread(
+            target=lambda: self.flightrec.anomaly(reason, detail=detail),
+            daemon=True, name="flightrec-dump").start()
+
+    def _on_slo_alert(self, objective: str, burn: float) -> None:
+        """A burn alert armed: stamp the tail sampler (traces around
+        the breach are retained) and dump the flight recorder."""
+        note = getattr(self.tracer, "note_anomaly", None)
+        if note is not None:
+            note()
+        if self.flightrec is not None:
+            self._flightrec_dump_async(f"slo-{objective}",
+                                       f"burn {burn:.2f}x budget")
+
+    def run_device_profile(self, iters: int = 16,
+                           repeats: int = 3) -> dict:
+        """On-demand device-stage calibration (the ``profile_step.py``
+        fori-chain methodology at this instance's width/capacity):
+        records ``device.stage_ms.*`` histogram samples and returns the
+        stage medians.  Compiles one probe chain per stage — seconds of
+        work; REST exposes it admin-only for exactly that reason."""
+        from sitewhere_tpu.pipeline.telemetry import profile_device_stages
+
+        # the LIVE table shapes: rule/zone eval cost is shape-driven, so
+        # the probes must run at this deployment's actual capacities
+        rules = self.rules.publish()
+        zones = self.mirror.publish_zones()
+        return profile_device_stages(
+            width=int(self.config["pipeline.width"]),
+            capacity=int(self.config["pipeline.registry_capacity"]),
+            rules_capacity=int(rules.threshold.shape[0]),
+            zones_capacity=int(zones.nvert.shape[0]),
+            iters=iters, repeats=repeats, metrics=self.metrics)
+
+    def start_profiler_capture(self) -> dict:
+        """Start an on-demand ``jax.profiler`` trace into the data dir
+        (the device-side flamegraph an operator opens in TensorBoard /
+        XProf).  One capture at a time; returns the trace directory."""
+        import time as _time
+
+        import jax as _jax
+
+        from sitewhere_tpu.services.common import ValidationError
+
+        # the lock makes check-then-start atomic: two racing starts must
+        # yield one capture and one honest "already running" error, not
+        # a misdiagnosed "profiler unavailable" from the loser
+        with self._profiler_lock:
+            if getattr(self, "_profiler_dir", None):
+                raise ValidationError(
+                    "profiler capture already running: "
+                    f"{self._profiler_dir}")
+            trace_dir = os.path.join(
+                self.data_dir, "profiles", f"capture-{int(_time.time())}")
+            os.makedirs(trace_dir, exist_ok=True)
+            try:
+                _jax.profiler.start_trace(trace_dir)
+            except Exception as e:
+                raise ValidationError(f"jax profiler unavailable: {e}")
+            self._profiler_dir = trace_dir
+        logger.info("jax profiler capture started -> %s", trace_dir)
+        return {"capturing": True, "trace_dir": trace_dir}
+
+    def stop_profiler_capture(self) -> dict:
+        import jax as _jax
+
+        from sitewhere_tpu.services.common import ValidationError
+
+        with self._profiler_lock:
+            trace_dir = getattr(self, "_profiler_dir", None)
+            if not trace_dir:
+                raise ValidationError("no profiler capture running")
+            try:
+                _jax.profiler.stop_trace()
+            except Exception as e:
+                # keep _profiler_dir: a failed stop must stay retryable
+                # — clearing it first would wedge BOTH endpoints (stop
+                # says "nothing running", start "already started")
+                raise ValidationError(f"profiler stop failed: {e}")
+            self._profiler_dir = None
+        logger.info("jax profiler capture stopped (%s)", trace_dir)
+        return {"capturing": False, "trace_dir": trace_dir}
 
     def _tenant_dense_id(self, token: str) -> int:
         return self.identity.tenant.mint(token)
@@ -954,6 +1159,19 @@ class Instance(LifecycleComponent):
         # never double-ingests a fresh append racing the replay.
         recover_upto = self.ingest_journal.end_offset
         super().start()
+        if bool(self.config.get("telemetry.device_profile_on_start",
+                                False)):
+            # boot-time device-stage calibration OFF the data path: the
+            # probe chains compile on a background thread and land in
+            # the device.stage_ms.* histograms when done
+            def _calibrate():
+                try:
+                    self.run_device_profile()
+                except Exception:
+                    logger.exception("device-stage calibration failed")
+
+            _threading.Thread(target=_calibrate, daemon=True,
+                              name="device-profile").start()
         # Crash recovery: re-ingest journal records past the committed
         # offset (at-least-once; MicroserviceKafkaConsumer.java:116-139).
         replayed = self.dispatcher.replay_journal(upto=recover_upto)
@@ -1022,6 +1240,10 @@ class Instance(LifecycleComponent):
         }
         if self.overload is not None:
             topo["overload"] = self.overload.snapshot()
+        if self.flightrec is not None:
+            topo["flightrec"] = self.flightrec.stats()
+        if self.slo is not None:
+            topo["slo"] = self.slo.snapshot()
         if self.forwarder is not None:
             topo["forwarding"] = self.forwarder.metrics()
         return topo

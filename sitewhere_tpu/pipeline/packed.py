@@ -10,7 +10,9 @@ packs the step's interface into ELEVEN buffers total:
 
   inputs:  PackedTables (6: epoch-cached) + PackedState (2, donated)
            + batch ints [12, B] + batch floats [4, B]
-  outputs: PackedState' (2) + out ints [10, B] + metrics [12] + present[D]
+  outputs: PackedState' (2) + out ints [10, B] + metrics [15] + present[D]
+           (metrics = step scalars + per-type counts + the on-device
+           occupancy telemetry block, ``TELEMETRY_SCALARS``)
 
 Column-major ``[C, B]`` layout so every unpacked column is a contiguous
 row slice (free under XLA fusion) and the host packs each column with one
@@ -72,6 +74,18 @@ OUT_I = ("flags", "device_type_id", "assignment_id", "area_id",
          "derived_code", "derived_level")
 METRIC_SCALARS = ("processed", "accepted", "unregistered", "unassigned",
                   "threshold_alerts", "zone_alerts")
+# On-device occupancy telemetry, appended after the step metrics in the
+# SAME packed metrics vector — it rides the one shared D2H fetch per
+# ring, so device-side visibility costs ZERO additional host syncs:
+#   rows_invalid     width minus valid rows.  On a partial plan this
+#                    INCLUDES batch padding (the device cannot tell a
+#                    padded slot from a dropped row) — the dispatcher's
+#                    device.occupancy.rows_invalid gauge subtracts the
+#                    plan's real row count host-side instead
+#   state_writes     rows that actually merged into DeviceState
+#                    (accepted AND update_state)
+#   presence_merges  devices the step's presence map marked present
+TELEMETRY_SCALARS = ("rows_invalid", "state_writes", "presence_merges")
 
 PRESENCE_ROW = STATE_I.index("presence_missing")
 
@@ -174,8 +188,18 @@ def unpack_batch(bi: jax.Array, bf: jax.Array) -> EventBatch:
     return EventBatch(**cols, **{f: bf[i] for i, f in enumerate(BATCH_F)})
 
 
-def pack_outputs(out: PipelineOutputs) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """PipelineOutputs → (oi [10, B] int32, metrics [12] int32, present[D])."""
+def pack_outputs(out: PipelineOutputs,
+                 batch: Optional[EventBatch] = None
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """PipelineOutputs → (oi [10, B] int32, metrics [15] int32, present[D]).
+
+    The metrics vector is the step scalars + per-type counts + the
+    :data:`TELEMETRY_SCALARS` occupancy block (computed on device from
+    outputs the step already materialized — a handful of fused
+    reductions, free under XLA).  ``batch`` feeds the state-write count
+    (``accepted & update_state`` is the mask ``update_device_state``
+    applies); without it state_writes degrades to the accepted count.
+    """
     derived = out.derived_alerts
     flags = (out.accepted * F_ACCEPTED
              + out.unregistered * F_UNREGISTERED
@@ -187,8 +211,18 @@ def pack_outputs(out: PipelineOutputs) -> Tuple[jax.Array, jax.Array, jax.Array]
         derived.alert_code, derived.alert_level,
     ])
     m = out.metrics
+    width = out.accepted.shape[0]
+    writes = out.accepted
+    if batch is not None:
+        writes = writes & batch.update_state
+    telemetry = jnp.stack([
+        jnp.int32(width) - m.processed,                  # rows_invalid
+        writes.sum(dtype=jnp.int32),                     # state_writes
+        out.present_now.sum(dtype=jnp.int32),            # presence_merges
+    ])
     metrics = jnp.concatenate([
-        jnp.stack([getattr(m, f) for f in METRIC_SCALARS]), m.by_type])
+        jnp.stack([getattr(m, f) for f in METRIC_SCALARS]), m.by_type,
+        telemetry])
     return oi, metrics, out.present_now
 
 
@@ -201,7 +235,7 @@ def packed_pipeline_step(
     state = unpack_state(ps)
     batch = unpack_batch(bi, bf)
     new_state, out = pipeline_step(registry, state, rules, zones, batch)
-    return pack_state(new_state), *pack_outputs(out)
+    return pack_state(new_state), *pack_outputs(out, batch)
 
 
 def build_packed_chain(k: int, donate: bool = True) -> Callable:
@@ -232,7 +266,7 @@ def build_packed_chain(k: int, donate: bool = True) -> Callable:
     from sitewhere_tpu.pipeline.step import NUM_EVENT_TYPES
 
     n_out = len(OUT_I)
-    n_met = len(METRIC_SCALARS) + NUM_EVENT_TYPES
+    n_met = len(METRIC_SCALARS) + NUM_EVENT_TYPES + len(TELEMETRY_SCALARS)
 
     def chain(tables, ps, *slots):
         ring_i = jnp.stack(slots[:k])   # [K, 12, B]
@@ -505,10 +539,26 @@ class PackedView:
             if self._metrics_host is None:
                 self._fetch()
             v = self._metrics_host
+            n = len(METRIC_SCALARS)
             self._metrics = StepMetrics(
-                by_type=v[len(METRIC_SCALARS):],
+                by_type=v[n:n + NUM_EVENT_TYPES],
                 **{f: v[i] for i, f in enumerate(METRIC_SCALARS)})
         return self._metrics
+
+    @property
+    def telemetry(self) -> Dict[str, int]:
+        """The on-device occupancy block (``TELEMETRY_SCALARS``), read
+        from the SAME fetched metrics vector the step metrics ride —
+        never an extra sync.  Empty for pre-telemetry vectors (tests
+        that stub a bare 12-wide metrics array)."""
+        if self._metrics_host is None:
+            self._fetch()
+        v = self._metrics_host
+        base = len(METRIC_SCALARS) + NUM_EVENT_TYPES
+        if len(v) < base + len(TELEMETRY_SCALARS):
+            return {}
+        return {f: int(v[base + i])
+                for i, f in enumerate(TELEMETRY_SCALARS)}
 
     def derived_cols(self, host_cols: Dict[str, np.ndarray],
                      rows: np.ndarray) -> Dict[str, np.ndarray]:
@@ -561,7 +611,7 @@ class RingFetch:
 
 class RingStepView(PackedView):
     """One chained step's :class:`PackedView`, backed by the ring's
-    shared fetch — slot ``k``'s ``[10, B]`` block and ``[12]`` metrics
+    shared fetch — slot ``k``'s ``[10, B]`` block and ``[15]`` metrics
     row sliced from the stacked host copy.  ``present_now`` is None:
     presence commits at chain granularity (the chain's OR'd map), never
     per slot."""
@@ -587,4 +637,5 @@ __all__ = [
     "supports_async_host_copy", "supports_batch_staging",
     "F_ACCEPTED", "F_UNREGISTERED", "F_UNASSIGNED", "F_DERIVED",
     "BATCH_I", "BATCH_F", "OUT_I", "PRESENCE_ROW",
+    "METRIC_SCALARS", "TELEMETRY_SCALARS",
 ]

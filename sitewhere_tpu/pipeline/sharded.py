@@ -164,10 +164,12 @@ def build_sharded_packed_step(mesh: Mesh):
         offset = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int32) * rows_local
         local_ids = jnp.where(batch.device_id >= 0,
                               batch.device_id - offset, -1)
+        local_batch = batch.replace(device_id=local_ids)
         new_state, out = pipeline_step(
-            registry, state, rules, zones,
-            batch.replace(device_id=local_ids))
-        oi, metrics, present = pack_outputs(out)
+            registry, state, rules, zones, local_batch)
+        # telemetry rides the psum-ed metrics vector: occupancy counters
+        # aggregate over shards exactly like the step scalars
+        oi, metrics, present = pack_outputs(out, local_batch)
         metrics = jax.lax.psum(metrics, SHARD_AXIS)
         # derived-alert/enrich ids in `oi` are table indices (replicated
         # tables → already global); device ids never leave the host cols

@@ -128,6 +128,9 @@ class PipelineDispatcher(LifecycleComponent):
         egress_offload: Optional[bool] = None,
         overload=None,
         ring_depth: Optional[int] = None,
+        flightrec=None,
+        slo=None,
+        cost_analysis: Optional[bool] = None,
         name: str = "pipeline-dispatcher",
     ):
         super().__init__(name)
@@ -360,6 +363,31 @@ class PipelineDispatcher(LifecycleComponent):
             for key in ("processed", "accepted", "unregistered",
                         "unassigned", "threshold_alerts", "zone_alerts")
         }
+        # Flight recorder (runtime/flightrec.py): one structured record
+        # per egressed batch, dumped to JSONL on anomaly — egress-worker
+        # crash here, overload transitions and SLO burn alerts via the
+        # instance wiring.  None = recording off (tests composing bare
+        # dispatchers).
+        self.flightrec = flightrec
+        # SLO burn-rate engine (runtime/metrics.py BurnRateEngine): the
+        # loop thread ticks it alongside the overload controller.
+        self.slo = slo
+        # On-device occupancy telemetry (pipeline/packed.py
+        # TELEMETRY_SCALARS rides the packed metrics vector — zero extra
+        # host syncs), surfaced as last-batch gauges.
+        self._m_occ = {
+            key: metrics.gauge(f"device.occupancy.{key}")
+            for key in ("rows_admitted", "rows_invalid", "rules_fired",
+                        "state_writes", "presence_merges")
+        }
+        # XLA cost analysis of the compiled chain at warm-up (flops /
+        # bytes as device.cost.* gauges — the static roofline half).
+        # Backend-adaptive default: the AOT lower+compile costs a second
+        # compile, which boot absorbs on TPU but tier-1 CPU runs (where
+        # the ring is forced on for smoke coverage) should not pay.
+        if cost_analysis is None:
+            cost_analysis = jax.default_backend() != "cpu"
+        self.cost_analysis = bool(cost_analysis)
         # host-aggregated counters (metrics endpoint surface)
         self.steps = 0
         self.totals: Dict[str, int] = {
@@ -717,6 +745,7 @@ class PipelineDispatcher(LifecycleComponent):
                 f"{self.name}-egress", self._egress_worker,
                 policy=RetryPolicy(initial_s=0.01, max_s=1.0),
                 max_restarts=8, min_uptime_s=5.0,
+                on_restart=self._on_egress_restart,
                 metrics=self.metrics)
             self._egress_super.start()
         self._warm_ring()
@@ -750,6 +779,20 @@ class PipelineDispatcher(LifecycleComponent):
                 self._dispatch_chain(
                     chain, tables, [bi] * self.ring_depth,
                     [bf] * self.ring_depth, block=True)
+            if self.cost_analysis:
+                # static roofline of the compiled chain: flops/bytes as
+                # device.cost.* gauges (AOT lower+compile of the same
+                # shapes; best-effort, inside this try on purpose)
+                from sitewhere_tpu.pipeline.telemetry import (
+                    record_cost_metrics,
+                    xla_cost_analysis,
+                )
+
+                k = self.ring_depth
+                cost = xla_cost_analysis(
+                    chain, tables, self.state_manager.current_packed,
+                    *([bi] * k), *([bf] * k))
+                record_cost_metrics(self.metrics, cost)
         except Exception:
             logger.warning("ring warm-up failed (compile deferred to the "
                            "first chain)", exc_info=True)
@@ -805,6 +848,9 @@ class PipelineDispatcher(LifecycleComponent):
                     # sample the pressure signals + run the overload
                     # state machine (rate-limited inside tick)
                     self.overload.tick()
+                if self.slo is not None:
+                    # SLO burn-rate sample (rate-limited inside tick)
+                    self.slo.tick()
                 # Backpressure: with the in-flight window full, a deadline
                 # tick would emit a PARTIAL plan behind `depth` queued
                 # steps — it gains no latency and fragments the width.
@@ -1193,8 +1239,11 @@ class PipelineDispatcher(LifecycleComponent):
                 [s[0] for s in slots], [s[1] for s in slots])
         start_host_copy(ois, mets, on_error=self._on_host_copy_error)
         ctrace.end()
-        self._m_stage["ring_dispatch"].observe(time.perf_counter() - t0)
+        chain_dt = time.perf_counter() - t0
+        self._m_stage["ring_dispatch"].observe(chain_dt)
         self._m_ring_chains.inc()
+        for plan in plans:
+            plan.dispatch_s = chain_dt / k   # per-slot share of the chain
         fetch = RingFetch(ois, mets, on_fetch=self._m_host_syncs.inc)
         for slot, plan in enumerate(plans):
             trace = self.tracer.trace("pipeline.plan")
@@ -1207,6 +1256,44 @@ class PipelineDispatcher(LifecycleComponent):
 
     def _on_host_copy_error(self, exc) -> None:
         self._m_host_copy_err.inc()
+
+    def _on_egress_restart(self, exc) -> None:
+        """Supervisor restart of the egress worker — a flight-recorder
+        anomaly in its own right.  SAME reason as the worker's own
+        crash dump on purpose: the rate limit is per reason, so the
+        restart milliseconds after the crash coalesces into one
+        snapshot instead of burning the retention budget twice."""
+        if self.flightrec is not None:
+            self.flightrec.anomaly(
+                "egress-crash", detail=f"supervisor restart: {exc}")
+
+    def _flight_record(self, plan: BatchPlan, out, replay_depth: int,
+                       commit: str, e2e_s: float = 0.0,
+                       egress_s: float = 0.0, trace=None,
+                       error: Optional[str] = None) -> None:
+        """Append one structured per-batch record to the flight
+        recorder: sequence, ring slot, per-host-stage timings, overload
+        state, trace id, commit outcome — the black-box row an anomaly
+        snapshot serializes.  Pure host dict work, no device access."""
+        rec = {
+            "seq": int(plan.seq),
+            "reason": plan.reason,
+            "rows": int(plan.n_events),
+            "fill": round(plan.fill, 4),
+            "slot": getattr(out, "slot", None),
+            "replay_depth": int(replay_depth),
+            "wait_ms": round(plan.max_wait_s * 1e3, 3),
+            "dispatch_ms": round(plan.dispatch_s * 1e3, 3),
+            "egress_ms": round(egress_s * 1e3, 3),
+            "e2e_ms": round(e2e_s * 1e3, 3),
+            "overload": (self.overload.state.name
+                         if self.overload is not None else "NORMAL"),
+            "trace_id": getattr(trace, "trace_id", None),
+            "commit": commit,
+        }
+        if error is not None:
+            rec["error"] = error
+        self.flightrec.record(**rec)
 
     def _dispatch_plan(self, plan: BatchPlan, replay_depth: int = 0,
                        stall: bool = True) -> None:
@@ -1258,8 +1345,9 @@ class PipelineDispatcher(LifecycleComponent):
                 # bytes already on the host (≈0 RTT in steady state).
                 start_host_copy(oi, metrics,
                                 on_error=self._on_host_copy_error)
-                self._m_stage["dispatch"].observe(
-                    time.perf_counter() - t_dispatch)
+                dt = time.perf_counter() - t_dispatch
+                self._m_stage["dispatch"].observe(dt)
+                plan.dispatch_s = dt   # flight-record stage attribution
                 self._window_step(
                     plan,
                     PackedView(oi, metrics, present,
@@ -1296,8 +1384,9 @@ class PipelineDispatcher(LifecycleComponent):
                                             batch)
                 self.state_manager.commit(new_state,
                                           present_now=out.present_now)
-            self._m_stage["dispatch"].observe(
-                time.perf_counter() - t_dispatch)
+            dt = time.perf_counter() - t_dispatch
+            self._m_stage["dispatch"].observe(dt)
+            plan.dispatch_s = dt
             self._window_step(plan, out, replay_depth, trace)
 
     def _offloaded(self) -> bool:
@@ -1322,7 +1411,7 @@ class PipelineDispatcher(LifecycleComponent):
             self._egress_evt.set()
             return
         while len(self._inflight) > self.inflight_depth:
-            self._egress(*self._inflight.popleft())
+            self._egress_guarded(self._inflight.popleft())
 
     def _drain_inflight(self, max_n: Optional[int] = None) -> None:
         if self._offloaded():
@@ -1338,7 +1427,7 @@ class PipelineDispatcher(LifecycleComponent):
             # (bounded by max_replay_depth).
             n = 0
             while self._inflight and (max_n is None or n < max_n):
-                self._egress(*self._inflight.popleft())
+                self._egress_guarded(self._inflight.popleft())
                 n += 1
 
     def _egress_worker(self) -> None:
@@ -1362,15 +1451,29 @@ class PipelineDispatcher(LifecycleComponent):
                 self._egress_evt.clear()
                 continue
             try:
-                try:
-                    self._egress(*item)
-                except Exception:
-                    self.egress_failures += 1
-                    self._m_egress_fail.inc()
-                    raise
+                self._egress_guarded(item)
             finally:
                 self._egress_busy = False
                 self._room_evt.set()
+
+    def _egress_guarded(self, item) -> None:
+        """:meth:`_egress` with crash accounting — shared by the offload
+        worker AND the inline fallback paths, so an egress failure is
+        counted and flight-recorded (the crashed plan's record with its
+        trace id, THEN the anomaly dump: the snapshot must contain the
+        batch that died) no matter which thread ran it."""
+        try:
+            self._egress(*item)
+        except Exception as e:
+            self.egress_failures += 1
+            self._m_egress_fail.inc()
+            if self.flightrec is not None:
+                self._flight_record(
+                    item[0], item[1], item[2], commit="failed",
+                    trace=item[3],
+                    error=f"{type(e).__name__}: {e}")
+                self.flightrec.anomaly("egress-crash", detail=str(e))
+            raise
 
     def _egress(self, plan: BatchPlan, out, replay_depth: int,
                 trace=None) -> None:
@@ -1408,6 +1511,23 @@ class PipelineDispatcher(LifecycleComponent):
             self.totals[key] += count
             if count:
                 self._m_totals[key].inc(count)
+        # On-device occupancy telemetry: the packed views expose the
+        # TELEMETRY_SCALARS block from the SAME fetched metrics vector
+        # (zero additional syncs); the unpacked fallback still surfaces
+        # the counts derivable from the step metrics alone.
+        self._m_occ["rows_admitted"].set(int(m.processed))
+        self._m_occ["rules_fired"].set(
+            int(m.threshold_alerts) + int(m.zone_alerts))
+        # genuinely lost rows: the device counter is width - valid,
+        # which on a partial plan mostly counts batch PADDING — the
+        # plan's real row count is host knowledge, so subtract here
+        self._m_occ["rows_invalid"].set(
+            max(0, int(plan.n_events) - int(m.processed)))
+        telemetry = getattr(out, "telemetry", None)
+        if telemetry:
+            for key in ("state_writes", "presence_merges"):
+                if key in telemetry:
+                    self._m_occ[key].set(telemetry[key])
         # monotonic receive time of the plan's oldest row — the watermark
         # the per-stage ingest→seal / ingest→ack gauges measure from
         ingest_t0 = plan.created_at - plan.max_wait_s
@@ -1478,7 +1598,12 @@ class PipelineDispatcher(LifecycleComponent):
             lat, trace_id=(trace.trace_id if trace.sampled else None))
         self._m_queue.set(self.batcher.pending)
         self._m_inflight.set(len(self._inflight))
-        self._m_stage["egress"].observe(time.perf_counter() - t_egress)
+        egress_dt = time.perf_counter() - t_egress
+        self._m_stage["egress"].observe(egress_dt)
+        if self.flightrec is not None:
+            self._flight_record(plan, out, replay_depth, commit="ok",
+                                e2e_s=lat, egress_s=egress_dt,
+                                trace=trace)
 
     def _columns(self, host_cols: Dict[str, np.ndarray], out) -> Dict[str, np.ndarray]:
         cols = {

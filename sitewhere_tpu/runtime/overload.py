@@ -440,6 +440,12 @@ class OverloadController:
             for key, value in signals.as_dict().items():
                 sp.tag(key, round(float(value), 4))
         trace.end()
+        # tail-sampling anomaly stamp: every trace overlapping this
+        # transition's window is retained, not only the errored/slow —
+        # the batches surrounding a ladder move ARE the evidence
+        note = getattr(self.tracer, "note_anomaly", None)
+        if note is not None:
+            note()
         self._transition_trace_id = (
             trace.trace_id if getattr(trace, "sampled", False) else None)
         self._m_dwell.observe(dwell, trace_id=self._transition_trace_id)

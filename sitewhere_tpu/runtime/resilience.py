@@ -366,10 +366,15 @@ class Supervisor:
                  max_restarts: int = 8,
                  min_uptime_s: float = 5.0,
                  on_escalate: Optional[Callable[[BaseException], None]] = None,
+                 on_restart: Optional[Callable[[BaseException], None]] = None,
                  seed: Optional[int] = None,
                  metrics: Optional[MetricsRegistry] = None):
         self.name = name
         self.run = run
+        # observability hook: called (with the exception) on every crash
+        # that leads to a restart — the flight recorder's
+        # supervisor-restart anomaly trigger rides this
+        self.on_restart = on_restart
         self.policy = policy if policy is not None else RetryPolicy(
             initial_s=0.1, max_s=30.0)
         self.max_restarts = int(max_restarts)
@@ -437,6 +442,12 @@ class Supervisor:
                 self.restarts += 1
                 self._metrics.counter(
                     f"resilience.supervisor.{self.name}.restarts").inc()
+                if self.on_restart is not None:
+                    try:
+                        self.on_restart(e)
+                    except Exception:
+                        logger.exception(
+                            "supervisor %s restart hook failed", self.name)
                 delay = self.policy.delay(consecutive - 1, self._rng)
                 self.restart_delays.append(delay)
                 logger.warning(

@@ -237,11 +237,23 @@ class Tracer:
                  tail_latency_s: Optional[float] = None,
                  tail_errors: bool = False,
                  pending_capacity: int = 512,
+                 tail_anomaly_window_s: float = 30.0,
                  seed: int = 0xC0FFEE):
         self.sample_rate = float(sample_rate)
         self.tail_latency_s = tail_latency_s
         self.tail_errors = bool(tail_errors)
         self.pending_capacity = int(pending_capacity)
+        # anomaly-overlap retention: note_anomaly() stamps a moment
+        # (an overload state transition, an SLO alert); any tail-
+        # candidate trace whose span extent overlaps the window
+        # [stamp, stamp + tail_anomaly_window_s] is retained regardless
+        # of the error/latency rules — the traces surrounding a state
+        # transition are exactly the forensic record an operator needs,
+        # and before this only errored/slow traces were guaranteed.
+        self.tail_anomaly_window_s = float(tail_anomaly_window_s)
+        self._anomalies: collections.deque = collections.deque(maxlen=64)
+        self.anomalies_noted = 0
+        self.retained_anomaly = 0
         self._spans: collections.deque = collections.deque(maxlen=capacity)
         self._pending: "collections.OrderedDict[str, _PendingTrace]" = \
             collections.OrderedDict()
@@ -255,7 +267,18 @@ class Tracer:
 
     @property
     def _tail_enabled(self) -> bool:
-        return self.tail_errors or self.tail_latency_s is not None
+        return (self.tail_errors or self.tail_latency_s is not None
+                or bool(self._anomalies))
+
+    def note_anomaly(self, ts: Optional[float] = None) -> None:
+        """Stamp an anomaly moment (wall-clock ``time.time`` space, the
+        same clock spans carry): tail candidates overlapping the
+        retention window from this stamp are ALWAYS kept.  Called by the
+        overload controller on every state transition and by the SLO
+        burn engine on alert."""
+        with self._lock:
+            self._anomalies.append(time.time() if ts is None else ts)
+            self.anomalies_noted += 1
 
     def trace(self, name: str):
         """Trace root: head-sample, else tail-candidate, else no-op.
@@ -348,10 +371,24 @@ class Tracer:
         its handle decided.  Caller holds ``_lock``."""
         spans = entry.spans
         keep = self.tail_errors and any(s.error for s in spans)
-        if not keep and self.tail_latency_s is not None and spans:
+        if not keep and spans and (self.tail_latency_s is not None
+                                   or self._anomalies):
             starts = [s.start_s for s in spans]
             ends = [s.start_s + (s.duration_s or 0.0) for s in spans]
-            keep = (max(ends) - min(starts)) >= self.tail_latency_s
+            if self.tail_latency_s is not None:
+                keep = (max(ends) - min(starts)) >= self.tail_latency_s
+            if not keep and self._anomalies:
+                # expire stamps whose retention window closed long ago
+                horizon = time.time() - 2 * self.tail_anomaly_window_s
+                while self._anomalies and self._anomalies[0] < horizon:
+                    self._anomalies.popleft()
+                # overlap: the trace's span extent intersects
+                # [stamp, stamp + window] for any noted anomaly
+                window = self.tail_anomaly_window_s
+                if any(min(starts) <= ts + window and max(ends) >= ts
+                       for ts in self._anomalies):
+                    keep = True
+                    self.retained_anomaly += 1
         if keep:
             self._spans.extend(spans)
             self.retained_tail += 1
@@ -379,7 +416,9 @@ class Tracer:
             "traces_sampled": self.sampled,
             "traces_joined": self.joined,
             "traces_retained_tail": self.retained_tail,
+            "traces_retained_anomaly": self.retained_anomaly,
             "traces_dropped_tail": self.dropped_tail,
             "traces_pending": pending,
             "spans_buffered": buffered,
+            "anomalies_noted": self.anomalies_noted,
         }

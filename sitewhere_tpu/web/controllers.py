@@ -697,6 +697,95 @@ def register_routes(gw: RestGateway, inst) -> None:
     r("GET", "/api/instance/metrics.prom", metrics_prom,
       auth_required=False)
 
+    # ---- flight recorder + SLO + on-demand profiling ----------------------
+    def _flightrec():
+        rec = getattr(inst, "flightrec", None)
+        require(rec is not None,
+                EntityNotFound("flight recorder is disabled"))
+        return rec
+
+    def flightrecorder(q: Request):
+        try:
+            limit = int(q.q1("limit", "100"))
+        except ValueError:
+            limit = 100
+        rec = _flightrec()
+        return {"stats": rec.stats(), "records": rec.recent(limit),
+                "snapshots": rec.snapshots()}
+
+    r("GET", "/api/instance/flightrecorder", flightrecorder)
+
+    def flightrec_snapshot_download(q: Request):
+        try:
+            data = _flightrec().read_snapshot(q.params["name"])
+        except KeyError:
+            raise EntityNotFound(f"no snapshot {q.params['name']!r}")
+        return RawResponse(data, content_type="application/jsonl")
+
+    r("GET", "/api/instance/flightrecorder/snapshots/{name}",
+      flightrec_snapshot_download)
+
+    def flightrec_dump(q: Request):
+        from sitewhere_tpu.services.common import ServiceError
+
+        body = q.json()
+        rec = _flightrec()
+        require(rec.dir is not None,
+                ValidationError("snapshots are disabled (no data dir)"))
+        path = rec.snapshot(reason=str(body.get("reason", "manual")))
+        # dir configured but no file: the WRITE failed (disk full,
+        # permissions) — a server-side fault, not a config 400
+        require(path is not None,
+                ServiceError("snapshot write failed; see server logs"))
+        import os as _os
+
+        return {"snapshot": _os.path.basename(path)}
+
+    # operator-forced dump — a write to the data dir, admin-only
+    r("POST", "/api/instance/flightrecorder/snapshot", flightrec_dump,
+      authority="ROLE_ADMIN")
+
+    def slo_status(q):
+        engine = getattr(inst, "slo", None)
+        require(engine is not None,
+                EntityNotFound("SLO engine is disabled"))
+        return engine.snapshot()
+
+    r("GET", "/api/instance/slo", slo_status)
+
+    def device_profile(q: Request):
+        """Fori-chain device-stage calibration at the instance's width
+        — compiles probe chains (seconds of work), so admin-only."""
+        body = q.json()
+
+        def _pos_int(key, default, cap):
+            # ceiling too: iters is a STATIC fori_loop trip count at
+            # production width — an unbounded value would occupy the
+            # shared device for hours with no way to cancel
+            try:
+                return min(cap, max(1, int(body.get(key, default))))
+            except (TypeError, ValueError):
+                raise ValidationError(f"{key} must be an integer")
+
+        return inst.run_device_profile(
+            iters=_pos_int("iters", 16, 1024),
+            repeats=_pos_int("repeats", 3, 32))
+
+    r("POST", "/api/instance/profile/device", device_profile,
+      authority="ROLE_ADMIN")
+
+    def profiler_capture(q: Request):
+        action = str(q.json().get("action", "")).lower()
+        if action == "start":
+            return inst.start_profiler_capture()
+        if action == "stop":
+            return inst.stop_profiler_capture()
+        raise ValidationError("body must carry action: start|stop")
+
+    # on-demand jax.profiler capture (TensorBoard/XProf trace dump)
+    r("POST", "/api/instance/profile/xla", profiler_capture,
+      authority="ROLE_ADMIN")
+
     # ---- dead letters: inspect + requeue (reprocess-topic analog) ---------
     def _int_arg(raw, field: str) -> int:
         try:

@@ -628,6 +628,22 @@ class TestDispatcherChaos:
             # slot 1 (the sibling step) landed; slot 0 stays outstanding
             inst.event_store.flush()
             assert inst.event_store.total_events == width
+
+            # flight recorder (ISSUE 9 satellite): the chaos-injected
+            # egress crash must have dumped a snapshot containing the
+            # crashed chain's records — the failed slot with its error
+            # attributed, the surviving sibling committed
+            from sitewhere_tpu.runtime.flightrec import parse_snapshot
+
+            snaps = inst.flightrec.snapshots()
+            crash = [s for s in snaps if "egress-crash" in s["name"]]
+            assert crash, f"no egress-crash snapshot in {snaps}"
+            snap = parse_snapshot(
+                inst.flightrec.read_snapshot(crash[0]["name"]))
+            failed = [r for r in snap["records"]
+                      if r["commit"] == "failed"]
+            assert len(failed) == 1 and failed[0]["slot"] == 0
+            assert "error" in failed[0]
             with inst.dispatcher._lock:
                 assert inst.dispatcher._plans_outstanding == 1
             assert inst.ingest_journal.end_offset == 2

@@ -713,6 +713,10 @@ class TestHostpathBenchSmoke:
         assert r["host_syncs_per_batch_ring"] == 0.5
         assert r["pipeline_bound_s"] <= r["serial_s"]
         assert r["overlapped_events_per_s"] >= r["serial_events_per_s"]
+        # ISSUE 9 acceptance: the always-on flight recorder's per-batch
+        # record cost stays under 1% of the throughput-bounding stage
+        assert r["flightrec_record_s"] > 0.0
+        assert r["flightrec_overhead_frac"] < 0.01
 
 
 class TestStageOverlap:

@@ -175,6 +175,15 @@ def test_packed_step_bit_exact():
         assert int(getattr(ref.metrics, f)) == int(getattr(m, f)), f
     np.testing.assert_array_equal(np.asarray(ref.metrics.by_type), m.by_type)
 
+    # the on-device occupancy telemetry block (rides the same metrics
+    # vector) matches the unpacked reference outputs exactly
+    tel = view.telemetry
+    assert tel["rows_invalid"] == width - int(ref.metrics.processed)
+    assert tel["state_writes"] == int(
+        (np.asarray(ref.accepted)
+         & np.asarray(batch.update_state)).sum())
+    assert tel["presence_merges"] == int(np.asarray(ref.present_now).sum())
+
     # derived alerts reconstruct from host cols + packed outputs
     np.testing.assert_array_equal(
         np.asarray(ref.derived_alerts.valid), view.derived_valid)

@@ -78,22 +78,13 @@ def _payload(width: int) -> bytes:
     return ("\n".join(lines)).encode()
 
 
-def _measure_rtt(samples: int = 5) -> float:
+def _measure_rtt() -> float:
     """Median dispatch round-trip of a trivial jitted program (seconds)
-    — same probe as ``bench.measure_rtt``, local so the tool has no
-    bench.py import."""
-    import jax
-    import jax.numpy as jnp
+    — the shared probe from the telemetry library, so the tool, the
+    bench, and the production calibration subtract the same floor."""
+    from sitewhere_tpu.pipeline.telemetry import measure_rtt
 
-    trivial = jax.jit(lambda x: x + 1)
-    int(trivial(jnp.int32(0)))
-    rtts = []
-    for _ in range(samples):
-        t0 = time.perf_counter()
-        int(trivial(jnp.int32(0)))
-        rtts.append(time.perf_counter() - t0)
-    rtts.sort()
-    return rtts[len(rtts) // 2]
+    return measure_rtt()
 
 
 def run(width: int = 2048, iters: int = 16, capacity: int = 16_384,
@@ -255,6 +246,24 @@ def run(width: int = 2048, iters: int = 16, capacity: int = 16_384,
         if data_dir is None:
             shutil.rmtree(tmp, ignore_errors=True)
 
+    # -- flight recorder (the always-on per-batch record cost) ---------------
+    # The recorder's acceptance bar is <1% of per-batch host budget: one
+    # dict build + deque append, memory-only here (snapshot I/O happens
+    # only on anomaly, off the steady-state path).
+    from sitewhere_tpu.runtime.flightrec import FlightRecorder
+
+    rec = FlightRecorder(data_dir=None, capacity=2048)
+
+    def record_once():
+        rec.record(seq=1, reason="fill", rows=width, fill=1.0, slot=0,
+                   replay_depth=0, wait_ms=0.1, dispatch_ms=0.2,
+                   egress_ms=0.3, e2e_ms=1.0, overload="NORMAL",
+                   trace_id=None, commit="ok")
+
+    record_once()
+    results["flightrec_record_s"] = _time_stage(
+        record_once, max(iters, 256))
+
     serial = sum(results[k] for k in
                  ("decode_s", "batch_s", "dispatch_s", "egress_s"))
     bound = max(results[k] for k in
@@ -263,6 +272,10 @@ def run(width: int = 2048, iters: int = 16, capacity: int = 16_384,
     results["pipeline_bound_s"] = bound
     results["serial_events_per_s"] = width / serial if serial else 0.0
     results["overlapped_events_per_s"] = width / bound if bound else 0.0
+    # per-batch recorder cost over the stage that bounds throughput —
+    # the "<1% throughput delta" acceptance number
+    results["flightrec_overhead_frac"] = (
+        results["flightrec_record_s"] / bound if bound else 0.0)
     return results
 
 
@@ -311,6 +324,10 @@ def main(argv=None) -> int:
           f"host_syncs/batch 1.0 single-step, "
           f"{r['host_syncs_per_batch_ring']:.3f} ring "
           f"(K={r['ring_chain_k']} chained)")
+    print(f"  flight recorder: {r['flightrec_record_s'] * 1e6:.2f} "
+          f"µs/batch record — "
+          f"{r['flightrec_overhead_frac'] * 100:.4f}% of the pipeline "
+          f"bound (<1% = always-on is free)")
     print(f"  (one-time seal of {r['iters'] + 1} buffered batches: "
           f"{r['seal_s'] * 1e3:.3f} ms — amortized at commit points)")
     return 0

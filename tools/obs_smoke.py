@@ -8,6 +8,11 @@ Proof obligations (the PR-2 acceptance criteria, end to end over HTTP):
   (``parse_exposition`` VALIDATES — it does not best-effort skip);
 - at least one latency histogram has non-zero bucket counts;
 - the ingest→seal watermark gauge is populated after traffic;
+- the ``slo.*`` burn-rate and ``device.occupancy.*`` families are on
+  the scrape surface;
+- a forced flight-recorder anomaly produces a JSONL snapshot that the
+  REST surface lists and serves, and that parses back with committed
+  batch records in it (ISSUE 9 acceptance);
 - a forced-error RPC call leaves a retained trace on BOTH sides of the
   boundary (tail sampling at a 0% head rate) with the same trace_id.
 
@@ -145,6 +150,46 @@ def main() -> int:
         if seal_v <= 0.0:
             failures.append("ingest->seal watermark gauge not populated")
 
+        # -- SLO + device-occupancy families on the scrape ----------------
+        for family in ("slo_burn_rate_p99_ms_fast",
+                       "device_occupancy_rows_admitted"):
+            if family not in families:
+                failures.append(f"{family} missing from the exposition")
+
+        # -- flight recorder: trigger an anomaly dump, read it back -------
+        from sitewhere_tpu.runtime.flightrec import parse_snapshot
+
+        if not inst.flightrec.recent(10):
+            failures.append("flight recorder captured no batch records")
+        dump = inst.flightrec.anomaly("obs-smoke",
+                                      detail="forced by obs_smoke")
+        if dump is None:
+            failures.append("anomaly did not produce a snapshot")
+        else:
+            token = inst.tokens.mint("admin", ["ROLE_ADMIN"])
+            base = f"http://127.0.0.1:{web.port}/api/instance"
+            req = urllib.request.Request(
+                f"{base}/flightrecorder",
+                headers={"Authorization": f"Bearer {token}"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                listing = json.loads(resp.read())
+            names = [s["name"] for s in listing.get("snapshots", [])]
+            name = os.path.basename(dump)
+            if name not in names:
+                failures.append(
+                    f"snapshot {name} not listed by the REST surface")
+            req = urllib.request.Request(
+                f"{base}/flightrecorder/snapshots/{name}",
+                headers={"Authorization": f"Bearer {token}"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                snap = parse_snapshot(resp.read())   # raises on malformed
+            if snap["header"]["reason"] != "obs-smoke":
+                failures.append("snapshot header lost the anomaly reason")
+            if not any(r.get("commit") == "ok"
+                       for r in snap["records"]):
+                failures.append(
+                    "snapshot carries no committed batch records")
+
         stats = inst.tracer.stats()
         if stats["traces_retained_tail"] < 1:
             failures.append(
@@ -159,6 +204,7 @@ def main() -> int:
             "histograms_populated": populated,
             "ingest_to_seal_latency_s": seal_v,
             "tracer": stats,
+            "flightrec": inst.flightrec.stats(),
             "ok": not failures,
         }, indent=2))
     finally:

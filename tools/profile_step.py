@@ -1,18 +1,15 @@
 """On-chip profiler for the fused pipeline step and its stages.
 
-The measurement methodology that produced TPU_EVIDENCE_r03.md §7:
-
-- every probe is a ``fori_loop`` CHAIN inside one jit call, so per-call
-  dispatch (~30 ms through the axon tunnel, µs on a host-attached chip)
-  amortizes away;
-- inputs are perturbed by the LOOP INDEX (ids rotated, timestamps
-  advanced) — without that, XLA hoists loop-invariant work out of the
-  chain and the probe measures an empty loop (observed: a "0.07 ms"
-  winner-map that really costs 3 ms);
-- the chain's result is FETCHED (``float(...)``), never
-  ``block_until_ready`` — through the axon tunnel block_until_ready has
-  returned before execution completes;
-- the tunnel round-trip (median of 7 trivial-jit fetches) is subtracted.
+The measurement methodology that produced TPU_EVIDENCE_r03.md §7 —
+fori-chain probes inside one jit call, loop-index input perturbation so
+XLA cannot hoist the work, a FETCHED result (never ``block_until_ready``,
+which returns early through the axon tunnel), and median-RTT
+subtraction — now lives in :mod:`sitewhere_tpu.pipeline.telemetry`
+(``profile_device_stages``), where the instance's on-demand calibration
+endpoint and ``bench.py`` config-2 share it.  This tool is the CLI
+front-end over that ONE implementation, so bench evidence and the
+production ``device.stage_ms.*`` histograms can never measure different
+things.
 
 Usage::
 
@@ -29,9 +26,16 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STAGE_LABELS = (
+    ("validate", "validate+enrich"),
+    ("rules", "threshold rules"),
+    ("zones", "zone rules (geofence)"),
+    ("state", "state update"),
+    ("full", "FULL pipeline step"),
+)
 
 
 def main() -> None:
@@ -42,6 +46,8 @@ def main() -> None:
     parser.add_argument("--capacity", type=int, default=16_384)
     parser.add_argument("--active", type=int, default=10_000)
     parser.add_argument("--iters", type=int, default=64)
+    parser.add_argument("--repeats", type=int, default=3,
+                        help="timed chain runs per stage (median)")
     args = parser.parse_args()
 
     import jax
@@ -49,111 +55,20 @@ def main() -> None:
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
-    import jax.numpy as jnp
-    import numpy as np
-    from jax import lax
+    from sitewhere_tpu.pipeline.telemetry import profile_device_stages
 
-    from bench import build_tables, host_batches
-    from sitewhere_tpu.pipeline.step import (
-        eval_threshold_rules,
-        eval_zone_rules,
-        pipeline_step,
-        update_device_state,
-        validate_and_enrich,
-    )
-    from sitewhere_tpu.schema import EventBatch
-
-    B, K = args.width, args.iters
-    registry, state, rules, zones = build_tables(args.capacity, args.active)
-    raw = host_batches(B, args.active, n_batches=1)
-    batch = EventBatch(**{k: jax.device_put(v) for k, v in raw[0].items()})
-    jax.block_until_ready(batch)
-    print(f"backend={jax.default_backend()} width={B} "
-          f"capacity={args.capacity} iters={K}")
-
-    trivial = jax.jit(lambda x: x + 1)
-    int(trivial(jnp.int32(0)))
-
-    def get_rtt() -> float:
-        rtts = []
-        for _ in range(7):
-            t = time.perf_counter()
-            int(trivial(jnp.int32(0)))
-            rtts.append(time.perf_counter() - t)
-        return float(np.median(rtts))
-
-    def chain_time(body, carry0, label):
-        @jax.jit
-        def chain(c):
-            return lax.fori_loop(0, K, body, c)
-
-        out = chain(carry0)
-        jax.tree.map(lambda x: x.block_until_ready(), out)
-        rtt = get_rtt()
-        t0 = time.perf_counter()
-        out = chain(carry0)
-        # fetch the SCALAR accumulator (the carry's last leaf) — pulling a
-        # width-sized array would add an untimed transfer the subtracted
-        # scalar RTT does not cover
-        float(np.asarray(jax.tree.leaves(out)[-1]).reshape(-1)[0])
-        ms = (time.perf_counter() - t0 - rtt) / K * 1e3
-        print(f"{label:<24} {ms:8.3f} ms/iter   (rtt {rtt * 1e3:.1f} ms)")
-        return ms
-
-    def pb(i):
-        i = jnp.int32(i)
-        return batch.replace(
-            device_id=(batch.device_id + i) % args.active,
-            ts_s=batch.ts_s + i,
-            value=batch.value + i.astype(jnp.float32) * 1e-6,
-        )
-
-    def b_validate(i, acc):
-        a, u, un, e = validate_and_enrich(registry, pb(i))
-        return acc + a.sum(dtype=jnp.int32) + e["area_id"].sum()
-
-    chain_time(b_validate, jnp.int32(0), "validate+enrich")
-
-    def b_rules(i, c):
-        st, acc = c
-        bt = pb(i)
-        a, _, _, _ = validate_and_enrich(registry, bt)
-        f, rid, ew = eval_threshold_rules(rules, st, bt, a)
-        return (st, acc + f.sum(dtype=jnp.int32) + rid.sum()
-                + ew.sum().astype(jnp.int32))
-
-    chain_time(b_rules, (state, jnp.int32(0)), "threshold rules")
-
-    def b_zones(i, acc):
-        bt = pb(i)
-        a, _, _, e = validate_and_enrich(registry, bt)
-        f, zid = eval_zone_rules(zones, bt, a, e["area_id"])
-        return acc + f.sum(dtype=jnp.int32) + zid.sum()
-
-    chain_time(b_zones, jnp.int32(0), "zone rules (geofence)")
-
-    def b_state(i, c):
-        st, acc = c
-        bt = pb(i)
-        st2, present = update_device_state(st, bt, bt.valid)
-        return (st2, acc + st2.last_event_ts_s.sum()
-                + present.sum(dtype=jnp.int32))
-
-    chain_time(b_state, (state, jnp.int32(0)), "state update")
-
-    def b_full(i, c):
-        st, acc = c
-        st2, out = pipeline_step(registry, st, rules, zones, pb(i))
-        # fold EVERY output leg into the carry or XLA dead-code-eliminates
-        # the rules/geofence/enrichment work
-        return (st2, acc + out.metrics.accepted + out.rule_id.sum()
-                + out.zone_id.sum() + out.assignment_id.sum()
-                + out.derived_alerts.alert_code.sum()
-                + out.present_now.sum(dtype=jnp.int32))
-
-    ms = chain_time(b_full, (state, jnp.int32(0)), "FULL pipeline step")
-    if ms > 0:
-        print(f"device-side rate: {B / ms * 1e3:,.0f} events/s")
+    print(f"backend={jax.default_backend()} width={args.width} "
+          f"capacity={args.capacity} iters={args.iters}")
+    result = profile_device_stages(
+        width=args.width, capacity=args.capacity, active=args.active,
+        iters=args.iters, repeats=args.repeats)
+    rtt_ms = result["host_rtt_ms"]
+    for stage, label in STAGE_LABELS:
+        print(f"{label:<24} {result[f'{stage}_ms']:8.3f} ms/iter   "
+              f"(rtt {rtt_ms:.1f} ms)")
+    if result.get("device_events_per_s"):
+        print(f"device-side rate: {result['device_events_per_s']:,.0f} "
+              "events/s")
 
 
 if __name__ == "__main__":
