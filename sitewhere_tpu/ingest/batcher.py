@@ -67,6 +67,25 @@ _FILL = {name: fill for name, _, fill in _FIELDS}
 # views are read-only; nothing on the intake/emit path writes into a
 # queued chunk's columns, only the freshly-allocated batch buffers.
 _FILL_0D = {name: np.full((), fill, dt) for name, dt, fill in _FIELDS}
+# Bytes one emitted row occupies across every batch column (the unit of
+# the pipeline.bytes_copied.batch accounting).
+_ROW_BYTES = sum(np.dtype(dt).itemsize for _, dt, _ in _FIELDS)
+
+# Packed wire layout (pipeline/packed.py BATCH_I/BATCH_F), cached on
+# first use — reservations allocate their columns AS rows of a packed
+# buffer pair so a full-width reserved segment is H2D-ready as-is.
+_PACKED_LAYOUT = None
+
+
+def _packed_layout():
+    global _PACKED_LAYOUT
+    if _PACKED_LAYOUT is None:
+        from sitewhere_tpu.pipeline.packed import BATCH_F, BATCH_I
+
+        _PACKED_LAYOUT = (BATCH_I, BATCH_F,
+                          {f: i for i, f in enumerate(BATCH_I)},
+                          {f: i for i, f in enumerate(BATCH_F)})
+    return _PACKED_LAYOUT
 
 
 @dataclasses.dataclass
@@ -76,17 +95,178 @@ class _Chunk:
     ``start`` = rows already emitted; ``length`` = rows written.  A chunk
     whose backing arrays are longer than ``length`` is a *staging* chunk —
     the scalar add paths append into it in place (amortizing allocation);
-    vectorized chunks arrive full (``length == capacity``).
+    vectorized chunks arrive full (``length == capacity``).  A chunk
+    carrying a ``reserved`` back-reference was filled in place by the
+    fill-direct wire scanner (:meth:`Batcher.reserve`); when such a chunk
+    is the sole content of a full-width packed emission, ``_emit`` adopts
+    its buffers as the batch outright instead of copying.
     """
 
     cols: Dict[str, np.ndarray]
     length: int
     arrival: float
     start: int = 0
+    reserved: Optional["Reservation"] = None
 
     @property
     def capacity(self) -> int:
         return len(self.cols["device_id"])
+
+
+class Reservation:
+    """A writable, packed-layout column segment for the fill-direct scan.
+
+    :meth:`Batcher.reserve` hands the native wire scanner
+    (``decode_measurement_lines_resolved_into``) direct int32/float32
+    views into a fresh packed buffer pair — the same ``[C, B]`` rows the
+    emitted batch ships H2D — so the hot path is recv → C scan+validate →
+    in-place columnar write → H2D stage with zero intermediate copies.
+
+    Contract:
+
+    - the buffers are PRIVATE to this reservation until :meth:`commit`
+      enqueues them under the dispatcher's intake lock, so concurrent
+      decode workers can fill reservations in parallel and commit in
+      delivery order — and a mid-payload bail simply never commits,
+      leaving no torn rows by construction (:meth:`abort` just drops it);
+    - the scanner writes ``device_id``, ``mtype_id`` (via the
+      ``name_idx`` scratch + one remap), ``value``, ``ts_s``, ``ts_ns``
+      and ``update_state``; every other column is a 0-stride fill
+      template (PR 3's layout) or a per-payload constant
+      (:meth:`set_const`) — nothing is materialized per row;
+    - a full-width reservation that is the sole pending content when the
+      batch emits is ADOPTED: its buffers become the packed plan and the
+      batch-assembly copy disappears entirely.  Adopted ``host_cols``
+      expose ``valid``/``update_state`` as int32 rows (not bool) — no
+      egress consumer reads them, only the device does.
+    """
+
+    __slots__ = ("_batcher", "ibuf", "fbuf", "name_idx", "cap", "n",
+                 "tenant_id", "payload_ref", "_open")
+
+    def __init__(self, batcher: "Batcher", cap: int):
+        _, _, bi, bf = _packed_layout()
+        self._batcher = batcher
+        self.cap = cap
+        self.n = 0
+        self.tenant_id = 0
+        self.payload_ref = NULL_ID
+        self._open = True
+        self.ibuf = np.empty((len(bi), cap), np.int32)
+        self.fbuf = np.empty((len(bf), cap), np.float32)
+        self.name_idx = np.empty(cap, np.int32)
+        if cap == batcher.width:
+            # adoption candidate: pre-fill the columns the scanner never
+            # writes (off the intake lock — commit stays O(1))
+            for f in ("event_type", "alert_code", "alert_level",
+                      "command_id"):
+                self.ibuf[bi[f]].fill(_FILL[f])
+            for f in ("lat", "lon", "elevation"):
+                self.fbuf[bf[f]].fill(_FILL[f])
+
+    # -- scanner-facing views (full-capacity, contiguous) -------------------
+
+    def _irow(self, f: str) -> np.ndarray:
+        return self.ibuf[_packed_layout()[2][f]]
+
+    @property
+    def device_id(self) -> np.ndarray:
+        return self._irow("device_id")
+
+    @property
+    def mtype_id(self) -> np.ndarray:
+        return self._irow("mtype_id")
+
+    @property
+    def ts_s(self) -> np.ndarray:
+        return self._irow("ts_s")
+
+    @property
+    def ts_ns(self) -> np.ndarray:
+        return self._irow("ts_ns")
+
+    @property
+    def update_state(self) -> np.ndarray:
+        return self._irow("update_state")
+
+    @property
+    def value(self) -> np.ndarray:
+        return self.fbuf[_packed_layout()[3]["value"]]
+
+    def set_const(self, *, tenant_id: int, payload_ref: int) -> None:
+        """Per-payload constants, applied as 0-stride broadcasts at
+        commit (and materialized into their rows only on adoption)."""
+        self.tenant_id = int(tenant_id)
+        self.payload_ref = int(payload_ref)
+
+    def abort(self) -> None:
+        """Discard: nothing was shared, so nothing needs undoing."""
+        self._open = False
+
+    def commit(self) -> List[BatchPlan]:
+        """Enqueue the ``self.n`` scanned rows (call under the intake
+        lock, i.e. via the dispatcher's ``_take``).  Returns every plan
+        that became ready, like :meth:`Batcher.add_arrays`."""
+        b = self._batcher
+        if not self._open:
+            raise RuntimeError("reservation already committed/aborted")
+        self._open = False
+        n = self.n
+        if n <= 0:
+            return []
+        # in-place NULL_ID rewrite (same contract as add_arrays): the C
+        # table can hold ids at/past the registry capacity, and unknown
+        # tokens are already NULL_ID.  The buffers are ours — no
+        # defensive copy needed.
+        d = self.device_id[:n]
+        bad = (d < 0) | (d >= b.capacity)
+        if bad.any():
+            d[bad] = NULL_ID
+        cols: Dict[str, np.ndarray] = {
+            f: self._irow(f)[:n]
+            for f in ("device_id", "mtype_id", "ts_s", "ts_ns",
+                      "update_state")
+        }
+        cols["value"] = self.value[:n]
+        cols["tenant_id"] = np.broadcast_to(
+            np.int32(self.tenant_id), n)
+        cols["payload_ref"] = np.broadcast_to(
+            np.int32(self.payload_ref), n)
+        for f in _COL_FIELDS:
+            if f not in cols:
+                cols[f] = np.broadcast_to(_FILL_0D[f], n)
+        now = b.clock()
+        b._pending[0].append(
+            _Chunk(cols=cols, length=n, arrival=now, reserved=self))
+        b._counts[0] += n
+        if b._oldest is None:
+            b._oldest = now
+        plans: List[BatchPlan] = []
+        while max(b._counts) >= b.seg:
+            plans.append(b._emit())
+        return plans
+
+    def finalize_adopted(self, n: int) -> Dict[str, np.ndarray]:
+        """Emission-time completion of an adopted full-width buffer:
+        write validity, the per-payload constants and the padding fills
+        into their rows, and return the host-column views."""
+        BATCH_I, BATCH_F, bi, bf = _packed_layout()
+        ibuf, fbuf = self.ibuf, self.fbuf
+        valid = ibuf[bi["valid"]]
+        valid[:n] = 1
+        valid[n:] = 0
+        ibuf[bi["tenant_id"]][:n] = self.tenant_id
+        ibuf[bi["payload_ref"]][:n] = self.payload_ref
+        if n < self.cap:
+            ibuf[bi["tenant_id"]][n:] = _FILL["tenant_id"]
+            ibuf[bi["payload_ref"]][n:] = _FILL["payload_ref"]
+            for f in ("device_id", "mtype_id", "ts_s", "ts_ns",
+                      "update_state"):
+                ibuf[bi[f]][n:] = _FILL[f]
+            fbuf[bf["value"]][n:] = _FILL["value"]
+        host_cols = {f: ibuf[i] for i, f in enumerate(BATCH_I)}
+        host_cols.update({f: fbuf[i] for i, f in enumerate(BATCH_F)})
+        return host_cols
 
 
 @dataclasses.dataclass
@@ -275,6 +455,10 @@ class Batcher:
         self._rr = 0  # round-robin shard for unknown devices
         self.emitted_batches = 0
         self.emitted_events = 0
+        # Bytes memcpy'd during batch assembly (intake copies + emission
+        # slice copies; adopted reserved buffers contribute zero) — the
+        # measured half of the zero-copy ingest story.
+        self.copied_bytes = 0
         # registry fold-in (per EMIT, never per row): batch fill/wait are
         # the assemble-stage watermark the lag attribution story needs
         self.metrics = metrics
@@ -283,6 +467,9 @@ class Batcher:
             self._m_rows = metrics.counter("ingest.rows_emitted")
             self._m_fill = metrics.gauge("ingest.batch_fill")
             self._m_wait = metrics.histogram("ingest.batch_wait_s")
+            self._m_copied = metrics.counter("pipeline.bytes_copied.batch")
+        else:
+            self._m_copied = None
 
     @property
     def deadline_s(self) -> float:
@@ -475,11 +662,14 @@ class Batcher:
             if _copy:
                 # Fill broadcasts are immutable templates — copying them
                 # would just re-materialize the np.full this path dropped.
+                copied = {
+                    f for f, c in cols.items()
+                    if f not in filled
+                    and (c is columns.get(f) or c.base is not None)
+                }
+                self._count_copied(sum(cols[f].nbytes for f in copied))
                 cols = {
-                    f: (np.array(c, copy=True)
-                        if f not in filled
-                        and (c is columns.get(f) or c.base is not None)
-                        else c)
+                    f: (np.array(c, copy=True) if f in copied else c)
                     for f, c in cols.items()
                 }
             self._pending[0].append(_Chunk(cols=cols, length=n, arrival=now))
@@ -495,6 +685,7 @@ class Batcher:
                     length=c,
                     arrival=now,
                 ))
+                self._count_copied(c * (_ROW_BYTES - 1))  # mask gathers
                 self._counts[s] += c
         if self._oldest is None:
             self._oldest = now
@@ -503,6 +694,23 @@ class Batcher:
         while max(self._counts) >= self.seg:
             plans.append(self._emit())
         return plans
+
+    def reserve(self, cap: int) -> Optional["Reservation"]:
+        """Hand out a :class:`Reservation` of up to ``cap`` rows for the
+        fill-direct wire scanner, or None when ineligible (sharded
+        batchers route rows by device id AFTER resolution, which a
+        direct scan cannot know, and a payload wider than one batch
+        cannot land in one segment).  The buffers are private until
+        ``commit`` — reserve is safe from any thread."""
+        if self.n_shards != 1 or not 0 < cap <= self.width:
+            return None
+        return Reservation(self, cap)
+
+    def _count_copied(self, nbytes: int) -> None:
+        if nbytes:
+            self.copied_bytes += nbytes
+            if self._m_copied is not None:
+                self._m_copied.inc(nbytes)
 
     def _invocation_id(self, req: DecodedRequest) -> int:
         """Invocation rows MINT their token (host- or replay-created);
@@ -577,9 +785,52 @@ class Batcher:
 
     # -- emission -----------------------------------------------------------
 
+    def _emit_tail(self, n: int, reason: str):
+        """Shared emission bookkeeping: wait accounting, counters,
+        adaptive-controller feedback.  Returns ``(now, wait)``."""
+        now = self.clock()
+        wait = now - self._oldest if self._oldest is not None else 0.0
+        # Carried-over rows keep their chunk arrival time for the deadline.
+        remaining = [q[0].arrival for q in self._pending if q]
+        self._oldest = min(remaining) if remaining else None
+        self.emitted_batches += 1
+        self.emitted_events += n
+        if self.metrics is not None:
+            self._m_batches.inc()
+            self._m_rows.inc(n)
+            self._m_fill.set(n / self.width)
+            self._m_wait.observe(wait)
+        if self.controller is not None:
+            self.controller.on_emit(n, self.width, self.pending, reason)
+        return now, wait
+
+    def _emit_adopted(self, reason: str) -> BatchPlan:
+        """Zero-copy emission: the sole pending chunk is a full-width
+        reserved segment — its packed buffers BECOME the batch.  Only
+        validity, the per-payload constants and any padding are written;
+        no row data moves."""
+        ch = self._pending[0].popleft()
+        res = ch.reserved
+        n = ch.length
+        self._counts[0] -= n
+        host_cols = res.finalize_adopted(n)
+        now, wait = self._emit_tail(n, reason)
+        return BatchPlan(
+            batch=None, n_events=n, width=self.width, created_at=now,
+            max_wait_s=wait, host_cols=host_cols,
+            packed_i=res.ibuf, packed_f=res.fbuf,
+            seq=self.emitted_batches - 1, reason=reason,
+        )
+
     def _emit(self, reason: str = "fill") -> BatchPlan:
         import jax.numpy as jnp
 
+        if self.emit_packed and self.n_shards == 1:
+            q = self._pending[0]
+            if len(q) == 1 and q[0].reserved is not None \
+                    and q[0].start == 0 \
+                    and q[0].reserved.cap == self.width:
+                return self._emit_adopted(reason)
         ibuf = fbuf = None
         if self.emit_packed:
             # Build the host columns directly as rows of the packed wire
@@ -628,26 +879,15 @@ class Batcher:
                     q.popleft()
             self._counts[s] -= filled
             n += filled
+        self._count_copied(n * _ROW_BYTES)
 
-        now = self.clock()
-        wait = now - self._oldest if self._oldest is not None else 0.0
-        # Carried-over rows keep their chunk arrival time for the deadline.
-        remaining = [q[0].arrival for q in self._pending if q]
-        self._oldest = min(remaining) if remaining else None
-        self.emitted_batches += 1
-        self.emitted_events += n
-        if self.metrics is not None:
-            self._m_batches.inc()
-            self._m_rows.inc(n)
-            self._m_fill.set(n / self.width)
-            self._m_wait.observe(wait)
-        if self.controller is not None:
-            self.controller.on_emit(n, self.width, self.pending, reason)
+        now, wait = self._emit_tail(n, reason)
         if self.emit_packed:
             from sitewhere_tpu.pipeline.packed import BATCH_I
 
             ibuf[BATCH_I.index("valid")] = out["valid"]
             ibuf[BATCH_I.index("update_state")] = out["update_state"]
+            self._count_copied(2 * 4 * self.width)  # bool→int32 rows
             return BatchPlan(
                 batch=None, n_events=n, width=self.width, created_at=now,
                 max_wait_s=wait, host_cols=out, packed_i=ibuf, packed_f=fbuf,

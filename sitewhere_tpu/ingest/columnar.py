@@ -22,6 +22,7 @@ Host-plane lines (registration etc.) are rare; they fall out as scalar
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -41,6 +42,33 @@ from sitewhere_tpu.ingest.decoders import (
 from sitewhere_tpu.schema import AlertLevel
 
 _MISS = object()  # dict-get sentinel (kind 0 is falsy — `or` won't do)
+
+
+class CopyTally:
+    """Per-call accumulator of intermediate-buffer bytes a decode path
+    materializes (anything that is neither the wire payload nor a final
+    batch column: the C scanner's returned bytes objects, ``frombuffer``
+    copies, ``astype`` outputs, the ``_split_epoch`` temporaries).  The
+    dispatcher feeds the total into ``pipeline.bytes_copied.decode`` —
+    the fill-direct path adds ZERO here, which is the measured (not
+    asserted) half of the zero-copy story.  Boolean masks are excluded;
+    the methodology only needs to be consistent across the A/B paths.
+    """
+
+    __slots__ = ("n",)
+
+    def __init__(self) -> None:
+        self.n = 0
+
+    def add(self, nbytes: int) -> None:
+        self.n += int(nbytes)
+
+
+# _split_epoch materializes this many temp/output bytes per row (np.where
+# f64 + int64 seconds + f64 diff + f64 scaled + f64 round + int64 nanos +
+# two int32 casts = 8+8+8+8+8+8+4+4); counted as a constant so the hot
+# path never introspects numpy internals.
+_SPLIT_EPOCH_BYTES_PER_ROW = 56
 
 # Request kinds that are pipeline events (EventType 0..5).
 _EVENT_KINDS = frozenset(int(k) for k in RequestKind if k <= RequestKind.STATE_CHANGE)
@@ -89,9 +117,72 @@ def n_rows(columns: Dict[str, object]) -> int:
                else columns["device_token"])
 
 
+def fill_direct_ready(payload, device_space) -> bool:
+    """Cheap fill-direct eligibility gate, run BEFORE allocating a
+    reservation — a deployment without the native toolchain (or a
+    non-NDJSON payload) must not pay a per-payload buffer allocation
+    just to abort it."""
+    if not isinstance(payload, bytes) or payload[:1] == b"[":
+        return False
+    from sitewhere_tpu.native import load_swwire
+
+    mod = load_swwire()
+    if mod is None \
+            or not hasattr(mod, "decode_measurement_lines_resolved_into"):
+        return False
+    return device_space.native_table() is not None
+
+
+def decode_fill_direct(payload, device_space, reservation, resolve_mtype):
+    """Fill-direct decode: C scan straight into a batcher reservation.
+
+    The zero-copy resolved measurement path — the native scanner writes
+    validated int32/float32 values DIRECTLY into ``reservation``'s
+    packed column rows (device ids resolved through the TokenTable
+    mirror, timestamps split to ``(ts_s, ts_ns)`` in C), and the only
+    Python objects created are the handful of distinct measurement
+    names.  Returns the row count on success; on ANY shape deviation the
+    reservation is aborted (nothing was shared — no torn rows) and None
+    is returned so the caller falls back to :func:`decode_json_lines`,
+    which reproduces the current behavior bit-for-bit, errors included.
+    """
+    from sitewhere_tpu.native import load_swwire
+
+    mod = load_swwire()
+    if mod is None \
+            or not hasattr(mod, "decode_measurement_lines_resolved_into") \
+            or not isinstance(payload, bytes) or payload[:1] == b"[":
+        reservation.abort()
+        return None
+    table = device_space.native_table()
+    if table is None:
+        reservation.abort()
+        return None
+    res = reservation
+    out = mod.decode_measurement_lines_resolved_into(
+        payload, table, res.device_id, res.name_idx, res.value,
+        res.ts_s, res.ts_ns, res.update_state)
+    if out is None:
+        res.abort()
+        return None
+    n, uniq = out
+    # Resolve the distinct names, then remap the scratch indices into
+    # the mtype row in place — np.take with `out=` over DISTINCT
+    # source/destination arrays, so no temporary is gathered.
+    uniq_ids = np.asarray([resolve_mtype(u) for u in uniq], np.int32)
+    row = res.mtype_id
+    if len(uniq_ids) == 1:
+        row[:n] = uniq_ids[0]
+    else:
+        np.take(uniq_ids, res.name_idx[:n], out=row[:n])
+    res.n = n
+    return n
+
+
 def decode_json_lines(
     payload: bytes,
     device_space=None,
+    copied: Optional[CopyTally] = None,
 ) -> Tuple[Dict[str, object], List[DecodedRequest]]:
     """Decode one NDJSON (or JSON-array) wire payload columnar-ly.
 
@@ -120,10 +211,10 @@ def decode_json_lines(
     devices — the dominant per-line cost of the unresolved path.
     """
     if device_space is not None:
-        resolved = _native_decode_resolved(payload, device_space)
+        resolved = _native_decode_resolved(payload, device_space, copied)
         if resolved is not None:
             return resolved
-    native = _native_decode(payload)
+    native = _native_decode(payload, copied)
     if native is not None:
         return native
     try:
@@ -140,6 +231,7 @@ def decode_json_lines(
 def _native_decode_resolved(
     payload: bytes,
     device_space,
+    copied: Optional[CopyTally] = None,
 ) -> Optional[Tuple[Dict[str, object], List[DecodedRequest]]]:
     """C fast path with device tokens resolved in C (TokenTable mirror).
 
@@ -160,10 +252,15 @@ def _native_decode_resolved(
     if out is None:
         return None
     ids_b, uniq_names, idx_b, values_b, ts_b, us_b = out
-    # copy: frombuffer views are read-only and the batcher may rewrite
-    # device_id in place for out-of-range rows (2 KB per 512-line payload)
-    device_id = np.frombuffer(ids_b, np.int32).copy()
+    # ids come back as a WRITABLE bytearray, so the batcher's in-place
+    # NULL_ID rewrite for out-of-range rows needs no defensive copy
+    device_id = np.frombuffer(ids_b, np.int32)
     n = len(device_id)
+    if copied is not None:
+        copied.add(len(ids_b) + len(idx_b) + len(values_b) + len(ts_b)
+                   + len(us_b)                   # C scratch → PyBytes
+                   + 4 * n + n                   # value/update astype
+                   + _SPLIT_EPOCH_BYTES_PER_ROW * n)
     ts_s, ts_ns = _split_epoch(np.frombuffer(ts_b, np.float64))
     zeros = np.zeros(n, np.float32)
     return {
@@ -180,8 +277,66 @@ def _native_decode_resolved(
     }, []
 
 
+def _host_requests(host_lines) -> List[DecodedRequest]:
+    """Registration/host-plane lines → scalar requests (shared by the
+    event-family branches; a line ``json.loads`` rejects dead-letters
+    the whole payload, exactly like the pure path)."""
+    import json as _json
+
+    host: List[DecodedRequest] = []
+    for line in host_lines:
+        try:
+            doc = _json.loads(line)
+        except ValueError as e:
+            raise DecodeError(f"bad wire batch: {e}") from e
+        host.append(_decode_one(*envelope_fields(doc)))
+    return host
+
+
+def _native_decode_events_into(
+    mod, payload: bytes,
+) -> Optional[Tuple[Dict[str, object], List[DecodedRequest]]]:
+    """Fill-direct generic event-family decode: the C scanner writes the
+    numeric columns straight into freshly allocated FINAL arrays (int32/
+    float32/bool) — no intermediate bytes objects, no frombuffer/astype
+    re-materialization.  None = fall through to the two-phase scanner
+    (which reproduces errors like out-of-range timestamps exactly)."""
+    cap = payload.count(b"\n") + 1
+    kinds = np.empty(cap, np.int32)
+    ts_s = np.empty(cap, np.int32)
+    ts_ns = np.empty(cap, np.int32)
+    value = np.empty(cap, np.float32)
+    lat = np.empty(cap, np.float32)
+    lon = np.empty(cap, np.float32)
+    elev = np.empty(cap, np.float32)
+    level = np.empty(cap, np.int32)
+    us = np.empty(cap, np.bool_)
+    out = mod.decode_event_lines_into(
+        payload, kinds, ts_s, ts_ns, value, lat, lon, elev, level, us)
+    if out is None:
+        return None
+    n, tokens, names, alert_types, host_lines = out
+    if n == 0 and not host_lines:
+        return None  # preserve the Python path's empty-payload error
+    host = _host_requests(host_lines)
+    if n == 0:
+        return {"device_token": [], "mtype": [], "alert_type": []}, host
+    return {
+        "device_token": tokens,
+        "event_type": kinds[:n],
+        "ts_s": ts_s[:n], "ts_ns": ts_ns[:n],
+        "mtype": names,
+        "value": value[:n],
+        "lat": lat[:n], "lon": lon[:n], "elevation": elev[:n],
+        "alert_type": alert_types,
+        "alert_level": level[:n],
+        "update_state": us[:n],
+    }, host
+
+
 def _native_decode(
     payload: bytes,
+    copied: Optional[CopyTally] = None,
 ) -> Optional[Tuple[Dict[str, object], List[DecodedRequest]]]:
     """The C fast path for NDJSON event payloads — measurements,
     locations and alerts in any mix, with registration lines split out
@@ -193,8 +348,6 @@ def _native_decode(
     registration line the native scanner accepted but ``json.loads``
     rejects dead-letters the whole payload, exactly like the pure path.
     """
-    import json as _json
-
     from sitewhere_tpu.native import load_swwire
 
     mod = load_swwire()
@@ -211,6 +364,9 @@ def _native_decode(
         n = len(tokens)
         if n == 0:
             return None  # preserve the Python path's empty-payload error
+        if copied is not None:
+            copied.add(len(values_b) + len(ts_b) + len(us_b)
+                       + 4 * n + n + _SPLIT_EPOCH_BYTES_PER_ROW * n)
         ts_s, ts_ns = _split_epoch(np.frombuffer(ts_b, np.float64))
         zeros = np.zeros(n, np.float32)
         return {
@@ -224,6 +380,14 @@ def _native_decode(
             "alert_level": np.zeros(n, np.int32),
             "update_state": np.frombuffer(us_b, np.uint8).astype(np.bool_),
         }, []
+    if hasattr(mod, "decode_event_lines_into") \
+            and os.environ.get("SW_NATIVE_FILL", "1") != "0":
+        # SW_NATIVE_FILL=0 must bypass BOTH fill-direct scanners (this
+        # one and the resolved measurement path) so the documented A/B
+        # escape hatch isolates every new code path, not just one
+        filled = _native_decode_events_into(mod, payload)
+        if filled is not None:
+            return filled
     out = mod.decode_event_lines(payload)
     if out is None:
         return None
@@ -232,15 +396,13 @@ def _native_decode(
     n = len(tokens)
     if n == 0 and not host_lines:
         return None  # preserve the Python path's empty-payload error
-    host: List[DecodedRequest] = []
-    for line in host_lines:
-        try:
-            doc = _json.loads(line)
-        except ValueError as e:
-            raise DecodeError(f"bad wire batch: {e}") from e
-        host.append(_decode_one(*envelope_fields(doc)))
+    host = _host_requests(host_lines)
     if n == 0:
         return {"device_token": [], "mtype": [], "alert_type": []}, host
+    if copied is not None:
+        copied.add(len(kinds_b) + len(values_b) + len(ts_b) + len(lat_b)
+                   + len(lon_b) + len(elev_b) + len(lvl_b) + len(us_b)
+                   + 4 * n * 6 + n + _SPLIT_EPOCH_BYTES_PER_ROW * n)
     ts_s, ts_ns = _split_epoch(np.frombuffer(ts_b, np.float64))
     columns: Dict[str, object] = {
         "device_token": tokens,

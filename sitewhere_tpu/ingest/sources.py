@@ -271,7 +271,12 @@ class InboundEventSource(LifecycleComponent):
         # delivery stage (journal + forward) keeps per-source submission
         # order.  ``on_wire_decode``/``on_wire_decoded`` are the split
         # halves of the wire lane (PipelineDispatcher.decode_wire_lines /
-        # ingest_wire_decoded).  The pool is ONLY used when no receiver
+        # ingest_wire_decoded).  On the fill-direct path the decode half
+        # returns a batcher Reservation (scanned in place on the pool
+        # worker, PRIVATE until commit) riding the same ``(columns,
+        # host_reqs)`` tuple — the delivery half commits it in
+        # submission order, so the zero-copy scan parallelizes without
+        # reordering rows.  The pool is ONLY used when no receiver
         # gates a broker ack on the emit call returning
         # (``acks_on_emit``): for those (MQTT broker intake, STOMP
         # client-individual) an async decode would acknowledge a payload

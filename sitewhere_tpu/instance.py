@@ -1137,7 +1137,10 @@ class Instance(LifecycleComponent):
         self.bootstrap()
         # Warm the native wire decoder OFF the data path: its first-use
         # build (cc subprocess) must never stall a receiver thread's
-        # decode into the <10ms p99 budget.
+        # decode into the <10ms p99 budget.  Decodes that arrive while
+        # the build is in flight take the Python path silently — the
+        # dispatcher surfaces that count as the ``native.build_fallbacks``
+        # gauge, and kicking the build HERE is what keeps it near zero.
         import threading as _threading
 
         from sitewhere_tpu.native import load_swwire

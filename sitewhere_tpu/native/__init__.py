@@ -32,6 +32,12 @@ _swwire = None
 _tried = False
 _load_lock = __import__("threading").Lock()
 
+# Decodes that arrived while the first-use build was in flight and took
+# the Python path instead (load_swwire's non-blocking lock).  Surfaced
+# as the ``native.build_fallbacks`` gauge so a seconds-long compile
+# silently degrading the intake tier is visible, not inferred.
+build_fallbacks = 0
+
 
 def _build_path() -> str:
     with open(_SRC, "rb") as f:
@@ -44,7 +50,10 @@ def _compile(out: str) -> bool:
     cc = os.environ.get("CC", "cc")
     include = sysconfig.get_paths()["include"]
     tmp = f"{out}.tmp.{os.getpid()}.so"
-    cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", tmp]
+    # -lm for llrint (the fill-direct epoch split), -pthread for the
+    # TokenTable rwlock the GIL-free resolved scan reads under
+    cmd = [cc, "-O2", "-shared", "-fPIC", "-pthread", f"-I{include}",
+           _SRC, "-o", tmp, "-lm"]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
@@ -68,8 +77,12 @@ def load_swwire():
         return _swwire
     # Non-blocking: while the (possibly seconds-long) first-use build is
     # in flight on the warmup thread, decode callers get None and take
-    # the Python path instead of parking on the lock.
+    # the Python path instead of parking on the lock.  Each such miss is
+    # counted — Instance.start() kicks the build on the warmup thread
+    # precisely so this stays near zero in production.
     if not _load_lock.acquire(blocking=False):
+        global build_fallbacks
+        build_fallbacks += 1
         return None
     try:
         if _swwire is not None or _tried:

@@ -28,6 +28,8 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <math.h>
+#include <pthread.h>
 #include <stdint.h>
 #include <stdlib.h>
 #include <string.h>
@@ -1278,6 +1280,14 @@ typedef struct {
     Py_ssize_t nslots;  /* power of two */
     Py_ssize_t used;    /* live entries */
     Py_ssize_t fill;    /* live + tombstones */
+    /* GIL-held readers/mutators need no locking (the original
+     * contract); the fill-direct scanner looks up DURING its GIL-free
+     * scan, so mutators additionally take the write side of this lock
+     * and the scanner holds the read side for the payload scan.  No
+     * deadlock is possible: the scanner only holds rdlock inside
+     * Py_BEGIN_ALLOW_THREADS (never while wanting the GIL), and
+     * mutators hold the GIL while wanting wrlock. */
+    pthread_rwlock_t rwlock;
 } TokenTableObject;
 
 static uint32_t tt_hash(const char *p, Py_ssize_t n) {
@@ -1397,6 +1407,14 @@ static PyObject *TokenTable_new(PyTypeObject *type, PyObject *args,
         Py_DECREF(t);
         return PyErr_NoMemory();
     }
+    if (pthread_rwlock_init(&t->rwlock, NULL) != 0) {
+        free(t->slots);
+        t->slots = NULL;
+        t->nslots = 0;  /* dealloc key: lock was never initialized */
+        Py_DECREF(t);
+        PyErr_SetString(PyExc_RuntimeError, "rwlock init failed");
+        return NULL;
+    }
     return (PyObject *)t;
 }
 
@@ -1406,6 +1424,8 @@ static void TokenTable_dealloc(TokenTableObject *t) {
         if (k != NULL && k != TT_TOMB) free(k);
     }
     free(t->slots);
+    if (t->nslots)
+        pthread_rwlock_destroy(&t->rwlock);
     Py_TYPE(t)->tp_free((PyObject *)t);
 }
 
@@ -1415,14 +1435,19 @@ static PyObject *TokenTable_set(TokenTableObject *t, PyObject *args) {
     if (!PyArg_ParseTuple(args, "Oi", &key, &id)) return NULL;
     const char *p; Py_ssize_t len;
     if (tt_key_arg(key, &p, &len) != 0) return NULL;
-    if (tt_set(t, p, len, (int32_t)id) != 0) return PyErr_NoMemory();
+    pthread_rwlock_wrlock(&t->rwlock);
+    int rc = tt_set(t, p, len, (int32_t)id);
+    pthread_rwlock_unlock(&t->rwlock);
+    if (rc != 0) return PyErr_NoMemory();
     Py_RETURN_NONE;
 }
 
 static PyObject *TokenTable_discard(TokenTableObject *t, PyObject *key) {
     const char *p; Py_ssize_t len;
     if (tt_key_arg(key, &p, &len) != 0) return NULL;
+    pthread_rwlock_wrlock(&t->rwlock);
     tt_discard(t, p, len);
+    pthread_rwlock_unlock(&t->rwlock);
     Py_RETURN_NONE;
 }
 
@@ -1433,6 +1458,7 @@ static PyObject *TokenTable_get(TokenTableObject *t, PyObject *key) {
 }
 
 static PyObject *TokenTable_clear(TokenTableObject *t, PyObject *ignored) {
+    pthread_rwlock_wrlock(&t->rwlock);
     for (Py_ssize_t i = 0; i < t->nslots; i++) {
         char *k = t->slots[i].key;
         if (k != NULL && k != TT_TOMB) free(k);
@@ -1440,6 +1466,7 @@ static PyObject *TokenTable_clear(TokenTableObject *t, PyObject *ignored) {
         t->slots[i].len = 0;
     }
     t->used = t->fill = 0;
+    pthread_rwlock_unlock(&t->rwlock);
     Py_RETURN_NONE;
 }
 
@@ -1546,7 +1573,11 @@ static PyObject *decode_measurement_lines_resolved(PyObject *self,
             PyList_SET_ITEM(uniq, m, o);
         }
         {
-            PyObject *ib = PyBytes_FromStringAndSize(
+            /* ids come back as a WRITABLE bytearray: the batcher rewrites
+             * out-of-range device ids to NULL_ID in place, and a bytes
+             * return would force np.frombuffer(...).copy() on every
+             * payload just to regain writability. */
+            PyObject *ib = PyByteArray_FromStringAndSize(
                 (const char *)ids, count * (Py_ssize_t)sizeof(int32_t));
             PyObject *xb = PyBytes_FromStringAndSize(
                 (const char *)nidx, count * (Py_ssize_t)sizeof(int32_t));
@@ -1586,6 +1617,607 @@ fail:
     return NULL;
 }
 
+/* ---- fill-direct scanners -------------------------------------------
+ *
+ * The zero-copy ingest tier: scan the wire payload STRAIGHT INTO the
+ * batcher's preallocated int32/float32 column buffers (via the buffer
+ * protocol) instead of materializing intermediate bytes objects that
+ * Python re-columnarizes.  Two layers:
+ *
+ * 1. A LINE TEMPLATE built from the first accepted line: fleet senders
+ *    emit one JSON shape per stream, so after line 1 the literal
+ *    byte spans between the variable fields (token, name, value,
+ *    eventDate/timestamp, updateState) are memcmp'd in one shot and only
+ *    the fields themselves are parsed.  Any deviation falls back to the
+ *    full per-line parser (parse_line) for THAT line — never a semantic
+ *    change, only a slow path — and the template path's field validation
+ *    uses the same primitives (plain-string scan, strict number grammar,
+ *    per-field UTF-8 gate), so a template-matched line is byte-isomorphic
+ *    to line 1 modulo field contents and parse_line would accept it with
+ *    identical semantics.
+ *
+ * 2. fill_push converts each accepted line's fields to their FINAL batch
+ *    representation in place: token -> int32 id (TokenTable, read under
+ *    the table rwlock so the scan stays GIL-free), name -> uniq index,
+ *    value -> float32, eventDate -> (ts_s, ts_ns) int32 pair via a
+ *    bit-exact mirror of columnar._split_epoch (llrint == np.round:
+ *    round-half-even).  Timestamps the Python path would REJECT
+ *    (non-finite / out of int32 epoch range) bail the payload so the
+ *    error surfaces through the existing path identically.
+ */
+
+typedef struct {
+    const char *token; Py_ssize_t token_len;
+    const char *name; Py_ssize_t name_len;
+    double value, ts;
+    uint8_t update;
+} mline;
+
+#define TF_LIT 0
+#define TF_TOKEN 1
+#define TF_NAME 2
+#define TF_VALUE 3
+#define TF_EVENTDATE 4
+#define TF_TIMESTAMP 5
+#define TF_UPDATE 6
+
+typedef struct {
+    int kind;
+    const char *lit;       /* TF_LIT: bytes of the template line */
+    Py_ssize_t lit_len;
+} tmpl_seg;
+
+#define TMPL_MAX 16
+#define TMPL_FLD_MAX 6
+
+typedef struct {
+    tmpl_seg segs[TMPL_MAX];
+    int nsegs;
+    int valid;
+} line_tmpl;
+
+typedef struct { int kind; const char *start; const char *end; } fldrec;
+
+/* Build the template from an ALREADY-ACCEPTED first line (parse_line
+ * returned 0 on it): re-scan the simple shape and record the variable
+ * field spans.  Returns 0 and sets t->valid on success; any structure
+ * outside the simple single-occurrence shape just leaves the template
+ * invalid (every line then takes the full parser — slower, never
+ * wrong). */
+static int tmpl_build(const char *q, const char *line_end, line_tmpl *t) {
+    fldrec flds[TMPL_FLD_MAX];
+    int nf = 0;
+    int seen_tok = 0, seen_type = 0, seen_req = 0;
+    int seen_name = 0, seen_val = 0, seen_ed = 0, seen_ts = 0, seen_up = 0;
+    cursor c = { q, line_end };
+    t->valid = 0;
+    if (expect(&c, '{') != 0) return -1;
+    for (;;) {
+        const char *k; Py_ssize_t klen;
+        skip_ws(&c);
+        if (parse_plain_string(&c, &k, &klen) != 0) return -1;
+        if (expect(&c, ':') != 0) return -1;
+        skip_ws(&c);
+        if (key_is(k, klen, "deviceToken")) {
+            const char *s; Py_ssize_t sl;
+            if (seen_tok || nf == TMPL_FLD_MAX) return -1;
+            if (parse_plain_string(&c, &s, &sl) != 0) return -1;
+            flds[nf].kind = TF_TOKEN;
+            flds[nf].start = s; flds[nf].end = s + sl; nf++;
+            seen_tok = 1;
+        } else if (key_is(k, klen, "type")) {
+            const char *s; Py_ssize_t sl;
+            if (seen_type) return -1;
+            /* the type VALUE stays inside a literal segment: a line
+             * with a different (even equivalent-alias) type string
+             * simply misses the template and takes the full parser */
+            if (parse_plain_string(&c, &s, &sl) != 0) return -1;
+            seen_type = 1;
+        } else if (key_is(k, klen, "request")) {
+            if (seen_req) return -1;
+            if (expect(&c, '{') != 0) return -1;
+            skip_ws(&c);
+            if (c.p < c.end && *c.p == '}') { c.p++; goto req_done; }
+            for (;;) {
+                const char *rk; Py_ssize_t rklen;
+                skip_ws(&c);
+                if (parse_plain_string(&c, &rk, &rklen) != 0) return -1;
+                if (expect(&c, ':') != 0) return -1;
+                skip_ws(&c);
+                if (key_is(rk, rklen, "name")) {
+                    const char *s; Py_ssize_t sl;
+                    if (seen_name || nf == TMPL_FLD_MAX) return -1;
+                    if (parse_plain_string(&c, &s, &sl) != 0) return -1;
+                    flds[nf].kind = TF_NAME;
+                    flds[nf].start = s; flds[nf].end = s + sl; nf++;
+                    seen_name = 1;
+                } else if (key_is(rk, rklen, "value") ||
+                           key_is(rk, rklen, "eventDate") ||
+                           key_is(rk, rklen, "timestamp")) {
+                    double v;
+                    int kind = key_is(rk, rklen, "value") ? TF_VALUE
+                        : key_is(rk, rklen, "eventDate") ? TF_EVENTDATE
+                        : TF_TIMESTAMP;
+                    int *seen = kind == TF_VALUE ? &seen_val
+                        : kind == TF_EVENTDATE ? &seen_ed : &seen_ts;
+                    const char *s = c.p;
+                    if (*seen || nf == TMPL_FLD_MAX) return -1;
+                    if (parse_number(&c, &v) != 0) return -1;
+                    flds[nf].kind = kind;
+                    flds[nf].start = s; flds[nf].end = c.p; nf++;
+                    *seen = 1;
+                } else if (key_is(rk, rklen, "updateState")) {
+                    const char *s = c.p;
+                    if (seen_up || nf == TMPL_FLD_MAX) return -1;
+                    if (c.end - c.p >= 4 && memcmp(c.p, "true", 4) == 0)
+                        c.p += 4;
+                    else if (c.end - c.p >= 5 &&
+                             memcmp(c.p, "false", 5) == 0)
+                        c.p += 5;
+                    else return -1;
+                    flds[nf].kind = TF_UPDATE;
+                    flds[nf].start = s; flds[nf].end = c.p; nf++;
+                    seen_up = 1;
+                } else {
+                    return -1; /* unknown request key: no template */
+                }
+                skip_ws(&c);
+                if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+                if (c.p < c.end && *c.p == '}') { c.p++; break; }
+                return -1;
+            }
+req_done:
+            seen_req = 1;
+        } else {
+            return -1; /* hardwareId/measurementId/unknown: no template */
+        }
+        skip_ws(&c);
+        if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+        if (c.p < c.end && *c.p == '}') { c.p++; break; }
+        return -1;
+    }
+    skip_ws(&c);
+    if (c.p < c.end) return -1;
+    if (!seen_tok || !seen_type || !seen_req || !seen_name || !seen_val)
+        return -1;
+    /* convert field spans (strictly increasing by construction) into
+     * alternating literal/field segments over [q, line_end) */
+    {
+        int ns = 0;
+        const char *prev = q;
+        for (int i = 0; i < nf; i++) {
+            if (flds[i].start > prev) {
+                if (ns == TMPL_MAX) return -1;
+                t->segs[ns].kind = TF_LIT;
+                t->segs[ns].lit = prev;
+                t->segs[ns].lit_len = flds[i].start - prev;
+                ns++;
+            }
+            if (ns == TMPL_MAX) return -1;
+            t->segs[ns].kind = flds[i].kind;
+            t->segs[ns].lit = NULL;
+            t->segs[ns].lit_len = 0;
+            ns++;
+            prev = flds[i].end;
+        }
+        if (line_end > prev) {
+            if (ns == TMPL_MAX) return -1;
+            t->segs[ns].kind = TF_LIT;
+            t->segs[ns].lit = prev;
+            t->segs[ns].lit_len = line_end - prev;
+            ns++;
+        }
+        t->nsegs = ns;
+    }
+    t->valid = 1;
+    return 0;
+}
+
+/* Exact fast-path number parse for template-matched lines: literals
+ * with <= 15 significant digits, no exponent, and <= 22 fractional
+ * digits compute m / 10^f in integer arithmetic plus ONE correctly-
+ * rounded IEEE division — bit-identical to (glibc's correctly-rounded)
+ * strtod, because m and 10^f are both exactly representable and the
+ * division result is the correctly-rounded decimal value.  Everything
+ * else (exponents, long mantissas) falls back to parse_number/strtod.
+ * Grammar acceptance is IDENTICAL to parse_number. */
+static const double pow10_tab[23] = {
+    1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11,
+    1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22,
+};
+
+static int parse_number_fast(cursor *c, double *out) {
+    const char *q = c->p, *end = c->end;
+    int neg = 0;
+    if (q < end && *q == '-') { neg = 1; q++; }
+    const char *digs = q;
+    uint64_t m = 0;
+    int nd = 0, ni = 0, nf = 0;
+    while (q < end && *q >= '0' && *q <= '9') {
+        if (nd < 16) m = m * 10 + (uint64_t)(*q - '0');
+        nd++; ni++; q++;
+    }
+    if (ni == 0) return -1;
+    if (ni > 1 && digs[0] == '0') return -1;  /* "01": grammar error */
+    if (q < end && *q == '.') {
+        q++;
+        if (q >= end || *q < '0' || *q > '9') return -1;
+        while (q < end && *q >= '0' && *q <= '9') {
+            if (nd < 16) m = m * 10 + (uint64_t)(*q - '0');
+            nd++; nf++; q++;
+        }
+    }
+    if ((q < end && (*q == 'e' || *q == 'E')) || nd > 15 || nf > 22)
+        return parse_number(c, out);  /* exactness not guaranteed: strtod */
+    {
+        double v = (double)m;         /* nd <= 15: m < 2^53, exact */
+        if (nf) v /= pow10_tab[nf];
+        *out = neg ? -v : v;
+    }
+    c->p = q;
+    return 0;
+}
+
+/* Match one line against the template.  0 = matched (fields in *out),
+ * 1 = mismatch (caller runs the full parser on the line).  Field
+ * validation matches parse_line's primitives exactly; token/name get a
+ * per-field UTF-8 gate (the template path skips the whole-line gate —
+ * literal segments were validated once with the first line, and
+ * number/bool fields are ASCII by grammar). */
+static int tmpl_match(const line_tmpl *t, const char *p, const char *end,
+                      mline *out) {
+    double ed = 0.0, ts2 = 0.0;
+    out->token = NULL; out->token_len = 0;
+    out->name = NULL; out->name_len = 0;
+    out->value = 0.0; out->update = 1;
+    for (int i = 0; i < t->nsegs; i++) {
+        const tmpl_seg *s = &t->segs[i];
+        switch (s->kind) {
+        case TF_LIT:
+            if (end - p < s->lit_len ||
+                memcmp(p, s->lit, (size_t)s->lit_len) != 0)
+                return 1;
+            p += s->lit_len;
+            break;
+        case TF_TOKEN:
+        case TF_NAME: {
+            const char *st = p;
+            while (p < end) {
+                unsigned char ch = (unsigned char)*p;
+                if (ch == '"') break;
+                if (ch == '\\' || ch < 0x20) return 1;
+                p++;
+            }
+            if (p >= end) return 1; /* the closing quote opens the next lit */
+            if (!utf8_ok(st, p - st)) return 1;
+            if (s->kind == TF_TOKEN) { out->token = st; out->token_len = p - st; }
+            else { out->name = st; out->name_len = p - st; }
+            break;
+        }
+        case TF_VALUE:
+        case TF_EVENTDATE:
+        case TF_TIMESTAMP: {
+            cursor nc = { p, end };
+            double v;
+            if (parse_number_fast(&nc, &v) != 0) return 1;
+            p = nc.p;
+            if (s->kind == TF_VALUE) out->value = v;
+            else if (s->kind == TF_EVENTDATE) ed = v;
+            else ts2 = v;
+            break;
+        }
+        default: /* TF_UPDATE */
+            if (end - p >= 4 && memcmp(p, "true", 4) == 0) {
+                out->update = 1; p += 4;
+            } else if (end - p >= 5 && memcmp(p, "false", 5) == 0) {
+                out->update = 0; p += 5;
+            } else {
+                return 1;
+            }
+            break;
+        }
+    }
+    if (p != end) return 1;
+    /* semantic tail, mirroring parse_line: empty token/name bail — fall
+     * back so the full parser (then the Python path) owns the error */
+    if (out->token_len == 0 || out->name == NULL || out->name_len == 0)
+        return 1;
+    out->ts = (ed != 0.0) ? ed : ts2;
+    return 0;
+}
+
+typedef struct {
+    int32_t *ids, *nidx, *ts_s, *ts_ns, *us;
+    float *values;
+    Py_ssize_t cap, count;
+    slice uq[UNIQ_CAP];
+    int uq_n;
+} fillctx;
+
+/* Convert one accepted line's fields to final batch representation,
+ * writing DIRECTLY into the caller's column buffers.  0 ok, 1 bail
+ * (buffer overflow / timestamp the Python path rejects / wild payload).
+ */
+static int fill_push(fillctx *f, TokenTableObject *table,
+                     const mline *ml) {
+    if (f->count >= f->cap) return 1;
+    /* _split_epoch mirror (columnar.py): millis heuristic, int32 epoch
+     * range, trunc-toward-zero seconds, round-half-even nanos */
+    double raw = ml->ts;
+    if (raw - raw != 0.0) return 1;                    /* inf/nan */
+    if (raw > 1e11) raw /= 1e3;                        /* epoch millis */
+    if (raw >= 2147483648.0 || raw <= -2147483649.0) return 1;
+    long long sec = (long long)raw;
+    int m = 0;
+    for (; m < f->uq_n; m++)
+        if (f->uq[m].len == ml->name_len &&
+            memcmp(f->uq[m].p, ml->name, (size_t)ml->name_len) == 0)
+            break;
+    if (m == f->uq_n) {
+        if (f->uq_n == UNIQ_CAP) return 1;             /* wild payload */
+        f->uq[f->uq_n].p = ml->name;
+        f->uq[f->uq_n].len = ml->name_len;
+        f->uq_n++;
+    }
+    {
+        Py_ssize_t i = f->count++;
+        f->ids[i] = tt_find(table, ml->token, ml->token_len);
+        f->nidx[i] = (int32_t)m;
+        f->values[i] = (float)ml->value;
+        f->ts_s[i] = (int32_t)sec;
+        f->ts_ns[i] = (int32_t)llrint((raw - (double)sec) * 1e9);
+        f->us[i] = (int32_t)ml->update;
+    }
+    return 0;
+}
+
+/* GIL-free one-pass scan+convert+resolve.  0 ok, 1 bail. */
+static int fill_scan(const char *buf, Py_ssize_t n,
+                     TokenTableObject *table, fillctx *f) {
+    line_tmpl tmpl;
+    int have_first = 0;
+    tmpl.valid = 0;
+    const char *p = buf, *end = buf + n;
+    while (p < end) {
+        const char *nl = memchr(p, '\n', (size_t)(end - p));
+        const char *line_end = nl ? nl : end;
+        const char *q = p;
+        while (q < line_end &&
+               (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+        if (q == line_end) { p = nl ? nl + 1 : end; continue; }
+
+        mline ml;
+        int matched = 0;
+        if (tmpl.valid && tmpl_match(&tmpl, q, line_end, &ml) == 0)
+            matched = 1;
+        if (!matched) {
+            /* full parser path: whole-line UTF-8 gate first, exactly
+             * like scan_lines (json.loads decodes the line up front) */
+            int hv;
+            if (!utf8_ok(q, line_end - q)) return 1;
+            cursor c = { q, line_end };
+            if (parse_line(&c, &ml.token, &ml.token_len,
+                           &ml.name, &ml.name_len,
+                           &ml.value, &hv, &ml.ts, &ml.update) != 0)
+                return 1;
+            if (!have_first)
+                tmpl_build(q, line_end, &tmpl);
+        }
+        have_first = 1;
+        if (fill_push(f, table, &ml) != 0) return 1;
+        p = nl ? nl + 1 : end;
+    }
+    return 0;
+}
+
+/* Acquire one writable 4-byte-item buffer; returns capacity (items) or
+ * -1 with the exception set. */
+static Py_ssize_t fill_buf(PyObject *obj, Py_buffer *view, void **data) {
+    if (PyObject_GetBuffer(obj, view, PyBUF_WRITABLE) != 0) return -1;
+    if (view->len % 4 != 0) {
+        PyBuffer_Release(view);
+        PyErr_SetString(PyExc_ValueError,
+                        "column buffer length not a multiple of 4");
+        return -1;
+    }
+    *data = view->buf;
+    return view->len / 4;
+}
+
+static PyObject *decode_measurement_lines_resolved_into(PyObject *self,
+                                                        PyObject *args) {
+    PyObject *payload, *bids, *bnidx, *bvals, *bts_s, *bts_ns, *bus;
+    TokenTableObject *table;
+    if (!PyArg_ParseTuple(args, "SO!OOOOOO", &payload,
+                          &TokenTableType, &table,
+                          &bids, &bnidx, &bvals, &bts_s, &bts_ns, &bus))
+        return NULL;
+    Py_buffer views[6];
+    PyObject *bufs[6] = { bids, bnidx, bvals, bts_s, bts_ns, bus };
+    void *data[6];
+    Py_ssize_t cap = PY_SSIZE_T_MAX;
+    int nv = 0;
+    for (; nv < 6; nv++) {
+        Py_ssize_t c = fill_buf(bufs[nv], &views[nv], &data[nv]);
+        if (c < 0) {
+            for (int j = 0; j < nv; j++) PyBuffer_Release(&views[j]);
+            return NULL;
+        }
+        if (c < cap) cap = c;
+    }
+    const char *buf = PyBytes_AS_STRING(payload);
+    Py_ssize_t n = PyBytes_GET_SIZE(payload);
+
+    fillctx f;
+    f.ids = (int32_t *)data[0];
+    f.nidx = (int32_t *)data[1];
+    f.values = (float *)data[2];
+    f.ts_s = (int32_t *)data[3];
+    f.ts_ns = (int32_t *)data[4];
+    f.us = (int32_t *)data[5];
+    f.cap = cap;
+    f.count = 0;
+    f.uq_n = 0;
+
+    int rc;
+    Py_BEGIN_ALLOW_THREADS
+    pthread_rwlock_rdlock(&table->rwlock);
+    rc = fill_scan(buf, n, table, &f);
+    pthread_rwlock_unlock(&table->rwlock);
+    Py_END_ALLOW_THREADS
+
+    if (rc != 0 || f.count == 0) {
+        /* bail — including the empty payload, whose error the Python
+         * path owns.  Nothing committed: the caller aborts its
+         * reservation, so a mid-payload bail can never leave torn rows. */
+        for (int j = 0; j < 6; j++) PyBuffer_Release(&views[j]);
+        Py_RETURN_NONE;
+    }
+    {
+        PyObject *uniq = PyList_New(f.uq_n);
+        PyObject *out = NULL;
+        if (uniq) {
+            for (int m = 0; m < f.uq_n; m++) {
+                PyObject *o = PyUnicode_DecodeUTF8(f.uq[m].p, f.uq[m].len,
+                                                   NULL);
+                if (!o) { Py_DECREF(uniq); uniq = NULL; break; }
+                PyList_SET_ITEM(uniq, m, o);
+            }
+        }
+        if (uniq) {
+            PyObject *count = PyLong_FromSsize_t(f.count);
+            if (count) {
+                out = PyTuple_Pack(2, count, uniq);
+                Py_DECREF(count);
+            }
+            Py_DECREF(uniq);
+        }
+        for (int j = 0; j < 6; j++) PyBuffer_Release(&views[j]);
+        return out; /* NULL propagates the error */
+    }
+}
+
+/* ---- decode_event_lines_into: generic family, fill-direct ------------
+ *
+ * Same acceptance contract as decode_event_lines (shared
+ * scan_event_lines), but the numeric columns are written DIRECTLY into
+ * caller-provided buffers in their FINAL dtypes (int32/float32/uint8 —
+ * no intermediate bytes objects, no frombuffer/astype re-materialization
+ * in Python).  Timestamps the Python path would reject (non-finite /
+ * out-of-int32-epoch) bail so the existing path surfaces the error.
+ *
+ * Buffers: kinds i32, ts_s i32, ts_ns i32, value f32, lat f32, lon f32,
+ * elevation f32, alert_level i32, update u8 (bool).
+ * Returns (n, tokens, names, alert_types, host_lines) or None.
+ */
+static PyObject *decode_event_lines_into(PyObject *self, PyObject *args) {
+    PyObject *payload;
+    PyObject *bufs4[8]; /* 4-byte columns */
+    PyObject *bus;      /* 1-byte update column */
+    if (!PyArg_ParseTuple(args, "SOOOOOOOOO", &payload,
+                          &bufs4[0], &bufs4[1], &bufs4[2], &bufs4[3],
+                          &bufs4[4], &bufs4[5], &bufs4[6], &bufs4[7],
+                          &bus))
+        return NULL;
+    Py_buffer views[9];
+    void *data[9];
+    Py_ssize_t cap = PY_SSIZE_T_MAX;
+    int nv = 0;
+    for (; nv < 8; nv++) {
+        Py_ssize_t c = fill_buf(bufs4[nv], &views[nv], &data[nv]);
+        if (c < 0) {
+            for (int j = 0; j < nv; j++) PyBuffer_Release(&views[j]);
+            return NULL;
+        }
+        if (c < cap) cap = c;
+    }
+    if (PyObject_GetBuffer(bus, &views[8], PyBUF_WRITABLE) != 0) {
+        for (int j = 0; j < 8; j++) PyBuffer_Release(&views[j]);
+        return NULL;
+    }
+    data[8] = views[8].buf;
+    if (views[8].len < cap) cap = views[8].len;
+
+    const char *buf = PyBytes_AS_STRING(payload);
+    Py_ssize_t n = PyBytes_GET_SIZE(payload);
+    evcols e;
+    memset(&e, 0, sizeof e);
+    int rc;
+    Py_BEGIN_ALLOW_THREADS
+    rc = scan_event_lines(buf, n, &e);
+    Py_END_ALLOW_THREADS
+    if (rc == -1) {
+        evcols_free(&e);
+        for (int j = 0; j < 9; j++) PyBuffer_Release(&views[j]);
+        return PyErr_NoMemory();
+    }
+    if (rc == 1 || e.toks.len > cap ||
+        (e.toks.len == 0 && e.hosts.len == 0)) {
+        evcols_free(&e);
+        for (int j = 0; j < 9; j++) PyBuffer_Release(&views[j]);
+        Py_RETURN_NONE;
+    }
+    {
+        int32_t *kinds = (int32_t *)data[0];
+        int32_t *ts_s = (int32_t *)data[1];
+        int32_t *ts_ns = (int32_t *)data[2];
+        float *value = (float *)data[3];
+        float *lat = (float *)data[4];
+        float *lon = (float *)data[5];
+        float *elev = (float *)data[6];
+        int32_t *level = (int32_t *)data[7];
+        uint8_t *us = (uint8_t *)data[8];
+        for (Py_ssize_t i = 0; i < e.toks.len; i++) {
+            double raw = e.tss.data[i];
+            if (raw - raw != 0.0) goto ts_bail;          /* inf/nan */
+            if (raw > 1e11) raw /= 1e3;
+            if (raw >= 2147483648.0 || raw <= -2147483649.0) goto ts_bail;
+            {
+                long long sec = (long long)raw;
+                ts_s[i] = (int32_t)sec;
+                ts_ns[i] = (int32_t)llrint((raw - (double)sec) * 1e9);
+            }
+            kinds[i] = (int32_t)e.kinds.data[i];
+            value[i] = (float)e.values.data[i];
+            lat[i] = (float)e.lats.data[i];
+            lon[i] = (float)e.lons.data[i];
+            elev[i] = (float)e.elevs.data[i];
+            level[i] = e.lvls.data[i];
+            us[i] = e.us.data[i];
+        }
+    }
+    {
+        PyObject *tokens = NULL, *names = NULL, *atys = NULL;
+        PyObject *hosts = NULL, *out = NULL, *count = NULL;
+        tokens = slices_to_list(&e.toks);
+        names = slices_to_list(&e.nms);
+        atys = slices_to_list(&e.atys);
+        if (!tokens || !names || !atys) goto ev_fail;
+        hosts = PyList_New(e.hosts.len);
+        if (!hosts) goto ev_fail;
+        for (Py_ssize_t i = 0; i < e.hosts.len; i++) {
+            PyObject *b = PyBytes_FromStringAndSize(e.hosts.data[i].p,
+                                                    e.hosts.data[i].len);
+            if (!b) goto ev_fail;
+            PyList_SET_ITEM(hosts, i, b);
+        }
+        count = PyLong_FromSsize_t(e.toks.len);
+        if (count)
+            out = PyTuple_Pack(5, count, tokens, names, atys, hosts);
+ev_fail:
+        Py_XDECREF(count);
+        Py_XDECREF(tokens); Py_XDECREF(names); Py_XDECREF(atys);
+        Py_XDECREF(hosts);
+        evcols_free(&e);
+        for (int j = 0; j < 9; j++) PyBuffer_Release(&views[j]);
+        return out; /* NULL propagates the error */
+    }
+ts_bail:
+    evcols_free(&e);
+    for (int j = 0; j < 9; j++) PyBuffer_Release(&views[j]);
+    Py_RETURN_NONE;
+}
+
 static PyMethodDef methods[] = {
     {"decode_measurement_lines", decode_measurement_lines, METH_O,
      "Scan NDJSON measurement envelopes into column buffers; None = "
@@ -1595,10 +2227,22 @@ static PyMethodDef methods[] = {
      "Scan NDJSON measurement envelopes with device tokens resolved "
      "through a TokenTable (unknown -> -1) and names deduped to "
      "(uniques, index); None = shape mismatch, caller falls back."},
+    {"decode_measurement_lines_resolved_into",
+     decode_measurement_lines_resolved_into, METH_VARARGS,
+     "Fill-direct scan: NDJSON measurement envelopes written straight "
+     "into caller-provided writable int32/float32 column buffers (ids, "
+     "name_idx, values, ts_s, ts_ns, update_state) with tokens resolved "
+     "through a TokenTable.  Returns (n, uniq_names); None = shape "
+     "mismatch/overflow, nothing written is committed."},
     {"decode_event_lines", decode_event_lines, METH_O,
      "Scan NDJSON measurement/location/alert envelopes into column "
      "buffers, splitting registration lines out as raw bytes; None = "
      "shape mismatch, caller must fall back to the Python decoder."},
+    {"decode_event_lines_into", decode_event_lines_into, METH_VARARGS,
+     "Fill-direct event-family scan: numeric columns written straight "
+     "into caller-provided buffers (kinds, ts_s, ts_ns, value, lat, lon, "
+     "elevation, alert_level i32/f32 + update u8) in their final dtypes; "
+     "returns (n, tokens, names, alert_types, host_lines) or None."},
     {"split_owner_lines", split_owner_lines, METH_VARARGS,
      "Rendezvous-hash owner per non-blank NDJSON line; -1 = "
      "local/malformed; None = bail, caller must use the Python splitter."},

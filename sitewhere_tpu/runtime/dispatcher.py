@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -349,6 +350,24 @@ class PipelineDispatcher(LifecycleComponent):
         # fetch, the unpacked fallback's egress fetch).  The ring's whole
         # point is host_syncs/steps → 1/K.
         self._m_host_syncs = metrics.counter("pipeline.host_syncs")
+        # Zero-copy ingest evidence: bytes memcpy'd per host stage.  The
+        # fill-direct wire path contributes ZERO to decode (the C scan
+        # writes once, into the batcher's packed rows) and an adopted
+        # full-width reservation contributes zero to batch — measured
+        # here, not asserted.  h2d counts the staged transfer bytes.
+        self._m_bytes = {
+            key: metrics.counter(f"pipeline.bytes_copied.{key}")
+            for key in ("decode", "batch", "h2d")
+        }
+        # Decodes that raced the seconds-long first-use native build and
+        # silently took the Python path (native/__init__.py counter,
+        # sampled by the loop thread).
+        self._m_native_fb = metrics.gauge("native.build_fallbacks")
+        # Fill-direct wire decode (zero-copy native ingest).  SW_NATIVE=0
+        # still disables the whole native tier; SW_NATIVE_FILL=0 keeps
+        # the classic native scanners but turns the fill-direct path off
+        # (the bench's A/B knob).
+        self._fill_enabled = os.environ.get("SW_NATIVE_FILL", "1") != "0"
         self._m_ring_chains = metrics.counter("pipeline.ring_chains")
         self._m_ring_flushes = metrics.counter("pipeline.ring_flushes")
         self._m_host_copy_err = metrics.counter("pipeline.host_copy_errors")
@@ -443,6 +462,9 @@ class PipelineDispatcher(LifecycleComponent):
             from sitewhere_tpu.pipeline.packed import stage_packed_batch
 
             plan.staged = stage_packed_batch(plan.packed_i, plan.packed_f)
+            if plan.staged is not None:
+                self._m_bytes["h2d"].inc(
+                    plan.packed_i.nbytes + plan.packed_f.nbytes)
 
     def _shed_intake(self, payload: bytes, shed: Dict[object, int],
                      source_id: str, tenant: str) -> None:
@@ -583,15 +605,38 @@ class PipelineDispatcher(LifecycleComponent):
         """The pure DECODE stage of :meth:`ingest_wire_lines` — no
         journal append, no state mutation, so a decode-pool worker can
         run it for window N+1 while window N is on device.  Raises
-        :class:`DecodeError`; returns ``(columns, host_requests)``."""
+        :class:`DecodeError`; returns ``(columns, host_requests)``.
+
+        Fill-direct fast path: resolved measurement payloads scan
+        STRAIGHT into a private batcher reservation (zero intermediate
+        copies; the reservation rides the ``columns`` slot through the
+        decode pool and commits in delivery order at
+        :meth:`ingest_wire_decoded`).  Any shape deviation falls back to
+        :func:`decode_json_lines` bit-for-bit, errors included.
+        """
         from sitewhere_tpu.ingest.columnar import (
+            CopyTally,
+            decode_fill_direct,
             decode_json_lines,
+            fill_direct_ready,
             space_of,
         )
 
         with self._m_stage["decode"].time():
-            return decode_json_lines(
-                payload, device_space=space_of(self.batcher.resolve_device))
+            space = space_of(self.batcher.resolve_device)
+            if space is not None and self._fill_enabled \
+                    and fill_direct_ready(payload, space):
+                res = self.batcher.reserve(payload.count(b"\n") + 1)
+                if res is not None and decode_fill_direct(
+                        payload, space, res,
+                        self.batcher.resolve_mtype) is not None:
+                    return res, []
+            tally = CopyTally()
+            out = decode_json_lines(payload, device_space=space,
+                                    copied=tally)
+            if tally.n:
+                self._m_bytes["decode"].inc(tally.n)
+            return out
 
     def _admit_columns(self, columns, payload: bytes, source_id: str):
         """Admission-filter one decoded wire-column dict (vectorized:
@@ -659,6 +704,10 @@ class PipelineDispatcher(LifecycleComponent):
         Must run in per-source submission order (the decode pool's
         delivery contract) so per-device event order and the journal's
         offset↔row correspondence are preserved."""
+        from sitewhere_tpu.ingest.batcher import Reservation
+
+        if isinstance(columns, Reservation):
+            return self._ingest_reserved(payload, columns, source_id)
         if self.overload is not None:
             columns, shed = self._admit_columns(columns, payload, source_id)
             if columns is None:
@@ -694,6 +743,31 @@ class PipelineDispatcher(LifecycleComponent):
         if not columns:
             return 0   # every event row was shed; host-plane lines routed
         return self._ingest_resolved_columns(columns, ref)
+
+    def _ingest_reserved(self, payload: bytes, res, source_id: str) -> int:
+        """Ordered ingest tail of the fill-direct path: admission, ONE
+        journal append, the per-payload constants, then commit under the
+        intake lock.  Every scanned row is a MEASUREMENT (the resolved
+        scanner accepts nothing else), so admission is exactly the
+        whole-payload TELEMETRY decision the vector path would make —
+        same audit record, same backpressure exception."""
+        n = res.n
+        if self.overload is not None:
+            from sitewhere_tpu.runtime.overload import PriorityClass
+
+            if not self.overload.admit(PriorityClass.TELEMETRY,
+                                       source=source_id, n=n):
+                res.abort()
+                self._shed_intake(payload, {PriorityClass.TELEMETRY: n},
+                                  source_id, "default")
+                raise self.overload.shed_exception(PriorityClass.TELEMETRY)
+        ref = NULL_ID
+        if self.journal is not None and payload:
+            ref = self.journal.append(payload)
+        res.set_const(tenant_id=self.resolve_tenant("default"),
+                      payload_ref=ref)
+        self._run_plans(self._take(res.commit))
+        return n
 
     def _ingest_resolved_columns(self, columns, ref: int) -> int:
         """Resolve one decoded column dict and queue its rows (shared by
@@ -844,6 +918,9 @@ class PipelineDispatcher(LifecycleComponent):
         # the loop thread at sub-millisecond cadence
         while not self._stop.wait(max(self.batcher.deadline_s / 2, 0.002)):
             try:
+                from sitewhere_tpu import native as _native
+
+                self._m_native_fb.set(_native.build_fallbacks)
                 if self.overload is not None:
                     # sample the pressure signals + run the overload
                     # state machine (rate-limited inside tick)

@@ -394,6 +394,15 @@ def test_instance_metric_names_follow_dotted_convention(tmp_path):
         assert "pipeline.e2e_latency_s" in names
         assert "pipeline.ingest_to_seal_latency_s" in names
         assert "ingest.batch_wait_s" in names
+        # zero-copy ingest evidence family (ISSUE 10): per-stage bytes
+        # copied + the native-build fallback gauge, lint-clean and
+        # pre-registered so the exposition carries them from boot
+        for name in ("pipeline.bytes_copied.decode",
+                     "pipeline.bytes_copied.batch",
+                     "pipeline.bytes_copied.h2d",
+                     "native.build_fallbacks"):
+            assert name in names, name
+            assert METRIC_NAME_RE.match(name), name
     finally:
         inst.stop()
         inst.terminate()
