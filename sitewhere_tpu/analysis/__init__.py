@@ -1,0 +1,56 @@
+"""swlint: project-invariant static analysis for sitewhere_tpu.
+
+The pipeline's flagship guarantees — host syncs == steps/K, fail-closed
+commits under a donated ring carry, zero-copy reserve/commit, bounded
+per-batch host work — are invariants of the SOURCE, not just of the
+paths the dynamic tests happen to execute.  This package makes them
+statically checkable and exhaustive:
+
+- ``trace_purity``  (TP): no host syncs inside jit-traced code; no
+  uncounted blocking D2H on the dispatch path.
+- ``locks``         (LK): lock-order inversions, self-deadlocks, and
+  blocking / device work under the hot-path locks.
+- ``donation``      (DN): no use of a buffer after ``donate_argnums``
+  hand-off, lease commit, or reservation commit/abort.
+- ``hotpath``       (HP): allocations under ``@hot_path`` markers — the
+  machine-generated worklist for ROADMAP item 2.
+- ``metric_names``  (MN): the registry-driven metric naming contract
+  (the old dynamic name-lint test, folded in and extended to the
+  ``device.* / slo.* / flightrec.* / pipeline.bytes_copied.*``
+  families).
+
+Run it: ``python tools/swlint.py sitewhere_tpu/`` (CLI with baseline /
+JSON output) or via the tier-1 gate in ``tests/test_swlint.py``.  The
+suite must stay CLEAN: zero unsuppressed findings — new findings are
+either fixed or triaged into ``tools/swlint_baseline.json`` with a
+one-line justification.
+
+Only the inert ``hot_path`` marker is imported eagerly (the hot
+production modules decorate with it); the analysis machinery itself
+loads lazily (PEP 562) so marking a function never drags the AST
+passes into a serving process.
+"""
+
+from sitewhere_tpu.analysis.markers import hot_path, is_hot_path  # noqa: F401
+
+_LAZY = {
+    "Baseline": "sitewhere_tpu.analysis.core",
+    "Finding": "sitewhere_tpu.analysis.core",
+    "Project": "sitewhere_tpu.analysis.core",
+    "run_suite": "sitewhere_tpu.analysis.suite",
+    "check_clean": "sitewhere_tpu.analysis.suite",
+    "default_passes": "sitewhere_tpu.analysis.suite",
+    "PASS_FACTORIES": "sitewhere_tpu.analysis.suite",
+    "default_baseline_path": "sitewhere_tpu.analysis.suite",
+}
+
+__all__ = ["hot_path", "is_hot_path", *_LAZY]
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

@@ -1,0 +1,391 @@
+"""Lock-discipline pass (LK): ordering and blocking-work invariants.
+
+Catalogs every ``threading.Lock`` / ``RLock`` / ``Condition`` created in
+the scanned tree (lock identity = ``module.Class.attr`` or a
+module-level name), infers acquisition ORDER from ``with``-statement
+nesting propagated through the project call graph, and enforces:
+
+- ``LK001 lock-order-inversion``: the pair (A, B) is acquired in both
+  orders somewhere in the project — the classic two-thread deadlock.
+  Both edge sites are reported with their full evidence chains.
+- ``LK002 self-deadlock``: a plain (non-reentrant) ``Lock`` re-acquired
+  while lexically held — directly or through a resolvable call chain.
+  ``RLock`` is exempt (re-entrancy is its purpose).
+- ``LK003 blocking-under-hot-lock``: a blocking operation — fsync,
+  sleep, socket send/recv/accept/connect, subprocess, thread/event
+  join/wait, blocking ``queue.put``/``get`` — reached while one of the
+  configured HOT locks is held.  The hot set defaults to the three
+  locks the per-batch path serializes on: the dispatcher intake lock
+  (``PipelineDispatcher._lock``), the step/ring lock
+  (``PipelineDispatcher._step_lock``) and the state-manager lease lock
+  (``DeviceStateManager._lock``).
+- ``LK004 device-sync-under-hot-lock``: device work under a hot lock —
+  an H2D transfer (``jnp.asarray`` / ``jax.device_put``), a blocking
+  D2H (``jax.device_get`` / ``block_until_ready`` / ``.item()``), or —
+  for classes configured as holding device-resident state —
+  ``numpy.asarray`` (which IS the blocking D2H when the argument lives
+  on device).  One slow transfer under the lease lock stalls every
+  commit; this is how a REST scan turns into a p99 cliff.
+
+Some functions run under a hot lock held by their CALLER through an
+unresolvable indirection (the batcher intake family runs under the
+dispatcher's ``_take``, which receives them as closures).  Those are
+declared as CONTRACTS — qualname suffixes mapped to the lock they run
+under — so the analysis covers the documented "call under the intake
+lock" surface the call graph cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from sitewhere_tpu.analysis.core import (
+    Finding,
+    FuncInfo,
+    Project,
+    dotted_name,
+    iter_scope,
+)
+
+PASS_ID = "lock-discipline"
+
+_LOCK_CTORS = {"threading.Lock": "Lock", "threading.RLock": "RLock",
+               "threading.Condition": "Condition"}
+
+# canonical call names that block the calling thread
+_BLOCKING_CALLS = {
+    "os.fsync", "os.fdatasync", "time.sleep", "select.select",
+    "subprocess.run", "subprocess.check_output", "subprocess.check_call",
+    "subprocess.call", "socket.create_connection",
+}
+# method names that block regardless of receiver type
+_BLOCKING_METHODS = {"fsync", "sendall", "recv", "recv_into", "accept",
+                     "connect", "join", "wait", "wait_for", "select"}
+# device-work calls (LK004)
+_H2D_CALLS = {"jax.numpy.asarray", "jax.device_put", "jax.numpy.array"}
+_D2H_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_D2H_METHODS = {"item", "block_until_ready"}
+
+
+@dataclasses.dataclass(frozen=True)
+class LockId:
+    module: str      # defining module name
+    cls: str         # class name or "" for module level
+    attr: str        # attribute / variable name
+    kind: str        # Lock | RLock | Condition
+
+    @property
+    def label(self) -> str:
+        owner = f"{self.cls}." if self.cls else ""
+        return f"{self.module}:{owner}{self.attr}"
+
+    def matches(self, suffix: str) -> bool:
+        """``suffix`` like ``"PipelineDispatcher._step_lock"`` or just
+        ``"_lock"`` (class-qualified wins precision)."""
+        if "." in suffix:
+            cls, attr = suffix.rsplit(".", 1)
+            return self.cls == cls and self.attr == attr
+        return self.attr == suffix and not self.cls
+
+
+# The repo's hot-path locks (class-qualified attribute suffixes).
+DEFAULT_HOT_LOCKS: FrozenSet[str] = frozenset({
+    "PipelineDispatcher._lock",        # batcher intake / commit gate
+    "PipelineDispatcher._step_lock",   # step + ring dispatch order
+    "DeviceStateManager._lock",        # packed-epoch lease lock
+})
+
+# Functions whose docstring contract is "call under <hot lock>" but whose
+# call edge is a closure the graph cannot resolve: qualname suffix ->
+# human label of the lock they run under.
+DEFAULT_LOCK_CONTRACTS: Dict[str, str] = {
+    "Batcher._emit": "batcher intake lock (dispatcher._take)",
+    "Batcher._emit_adopted": "batcher intake lock (dispatcher._take)",
+    "Batcher.add_arrays": "batcher intake lock (dispatcher._take)",
+    "Batcher._enqueue_row": "batcher intake lock (dispatcher._take)",
+    "Reservation.commit": "batcher intake lock (dispatcher._take)",
+}
+
+# Classes whose instance state lives on device: numpy.asarray under their
+# locks is a blocking D2H.
+DEFAULT_DEVICE_STATE_CLASSES: FrozenSet[str] = frozenset(
+    {"DeviceStateManager"})
+
+
+class LockDisciplinePass:
+    pass_id = PASS_ID
+
+    def __init__(self,
+                 hot_locks: Optional[Sequence[str]] = None,
+                 contracts: Optional[Dict[str, str]] = None,
+                 device_state_classes: Optional[Sequence[str]] = None,
+                 max_depth: int = 4):
+        self.hot_locks = frozenset(
+            DEFAULT_HOT_LOCKS if hot_locks is None else hot_locks)
+        self.contracts = dict(
+            DEFAULT_LOCK_CONTRACTS if contracts is None else contracts)
+        self.device_state_classes = frozenset(
+            DEFAULT_DEVICE_STATE_CLASSES if device_state_classes is None
+            else device_state_classes)
+        self.max_depth = max_depth
+
+    # -- inventory -----------------------------------------------------------
+
+    def catalog(self, project: Project) -> Dict[Tuple[str, str, str], LockId]:
+        """(module, cls, attr) -> LockId for every lock construction."""
+        locks: Dict[Tuple[str, str, str], LockId] = {}
+        for mod in project.modules.values():
+
+            def walk(node: ast.AST, cls: str) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, ast.ClassDef):
+                        walk(child, child.name)
+                    else:
+                        self._catalog_assign(project, mod, cls, child,
+                                             locks)
+                        walk(child, cls)
+
+            walk(mod.tree, "")
+        return locks
+
+    def _catalog_assign(self, project: Project, mod, cls: str,
+                        node: ast.AST, locks) -> None:
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            return
+        canon = project.canonical(mod, node.value.func)
+        kind = _LOCK_CTORS.get(canon or "")
+        if kind is None:
+            return
+        for tgt in node.targets:
+            attr = None
+            owner = cls
+            if isinstance(tgt, ast.Attribute) and isinstance(
+                    tgt.value, ast.Name) and tgt.value.id == "self":
+                attr = tgt.attr
+            elif isinstance(tgt, ast.Name):
+                attr = tgt.id
+                if cls:
+                    owner = cls   # class-body assignment
+                else:
+                    owner = ""
+            if attr is not None:
+                locks[(mod.name, owner, attr)] = LockId(
+                    mod.name, owner, attr, kind)
+
+    # -- acquisition analysis ------------------------------------------------
+
+    def _lock_of_with_item(self, project: Project, fi: FuncInfo,
+                           item: ast.withitem, locks) -> Optional[LockId]:
+        expr = item.context_expr
+        d = dotted_name(expr)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if parts[0] == "self" and len(parts) == 2 and fi.cls:
+            return locks.get((fi.module.name, fi.cls, parts[1]))
+        if len(parts) == 1:
+            return locks.get((fi.module.name, "", parts[0]))
+        return None
+
+    def _with_regions(self, project: Project, fi: FuncInfo, locks
+                      ) -> List[Tuple[LockId, ast.With]]:
+        out = []
+        for node in iter_scope(fi.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lk = self._lock_of_with_item(project, fi, item, locks)
+                    if lk is not None:
+                        out.append((lk, node))
+        return out
+
+    def _events_under(self, project: Project, fi: FuncInfo, body, locks,
+                      depth: int, seen: Set[str]):
+        """Yield (kind, node_or_lock, func, chain) events lexically inside
+        ``body`` statements, following resolvable calls.  Kinds:
+        ``acquire`` (LockId), ``blocking`` / ``h2d`` / ``d2h`` (Call)."""
+        if fi.qualname in seen or depth > self.max_depth:
+            return
+        seen = seen | {fi.qualname}
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lk = self._lock_of_with_item(project, fi, item, locks)
+                    if lk is not None:
+                        yield ("acquire", lk, fi, node,
+                               (f"{fi.qualname} acquires {lk.label} "
+                                f"({fi.module.rel}:{node.lineno})",))
+            if isinstance(node, ast.Call):
+                kind = self._classify_call(project, fi, node)
+                if kind is not None:
+                    yield (kind, None, fi, node, ())
+                callee = project.resolve_call(fi.module, fi, node.func)
+                if callee is not None and callee.qualname != fi.qualname:
+                    for ev in self._events_under(
+                            project, callee, callee.node.body, locks,
+                            depth + 1, seen):
+                        k, lk, efi, enode, chain = ev
+                        yield (k, lk, efi, enode,
+                               (f"{fi.qualname} calls {callee.qualname} "
+                                f"({fi.module.rel}:{node.lineno})",)
+                               + chain)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _classify_call(self, project: Project, fi: FuncInfo,
+                       call: ast.Call) -> Optional[str]:
+        canon = project.canonical(fi.module, call.func)
+        if canon in _BLOCKING_CALLS:
+            return "blocking"
+        if canon in _H2D_CALLS:
+            return "h2d"
+        if canon in _D2H_CALLS:
+            return "d2h"
+        if canon == "numpy.asarray" \
+                and fi.cls in self.device_state_classes:
+            return "d2h"
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in _BLOCKING_METHODS:
+                # queue.put()/get() style blocking only when no
+                # block=False / timeout present softens it; for
+                # event.wait(t) a timeout still blocks — keep it simple
+                # and flag, except wait(0)/nowait forms
+                if attr in ("put", "get"):
+                    return None
+                return "blocking"
+            if attr in ("put", "get"):
+                for kw in call.keywords:
+                    if kw.arg == "block" and isinstance(
+                            kw.value, ast.Constant) \
+                            and kw.value.value is False:
+                        return None
+                # bare obj.get()/dict.get(...) is unknowable — only flag
+                # explicit queue semantics (block=True or timeout kw)
+                if any(kw.arg in ("timeout", "block")
+                       for kw in call.keywords):
+                    return "blocking"
+                return None
+            if attr in _D2H_METHODS and not call.args:
+                return "d2h"
+        return None
+
+    # -- the pass ------------------------------------------------------------
+
+    def run(self, project: Project) -> List[Finding]:
+        locks = self.catalog(project)
+        findings: List[Finding] = []
+        edges: Dict[Tuple[str, str], Tuple[FuncInfo, ast.AST,
+                                           Tuple[str, ...]]] = {}
+
+        for qn, fi in sorted(project.functions.items()):
+            if fi.module.name not in project.modules:
+                continue
+            for lk, wnode in self._with_regions(project, fi, locks):
+                held_hot = self._hot_label(lk)
+                for ev in self._events_under(project, fi, wnode.body,
+                                             locks, 0, set()):
+                    kind, inner, efi, enode, chain = ev
+                    if kind == "acquire":
+                        pair = (lk.label, inner.label)
+                        if pair not in edges:
+                            edges[pair] = (fi, enode, chain)
+                        if inner == lk and lk.kind == "Lock":
+                            findings.append(project.finding(
+                                self.pass_id, "LK002", efi, enode,
+                                f"non-reentrant {lk.label} re-acquired "
+                                "while already held (self-deadlock)",
+                                (f"outer hold in {fi.qualname} "
+                                 f"({fi.module.rel}:{wnode.lineno})",)
+                                + chain))
+                    elif held_hot is not None:
+                        rule = "LK003" if kind == "blocking" else "LK004"
+                        what = {"blocking": "blocking call",
+                                "h2d": "host→device transfer",
+                                "d2h": "blocking device→host sync"}[kind]
+                        findings.append(project.finding(
+                            self.pass_id, rule, efi, enode,
+                            f"{what} while holding hot-path lock "
+                            f"{lk.label}",
+                            (f"lock held by {fi.qualname} "
+                             f"({fi.module.rel}:{wnode.lineno})",)
+                            + chain))
+
+        findings.extend(self._check_contracts(project, locks))
+        findings.extend(self._inversions(project, edges))
+        # nested with-regions walk overlapping bodies — dedup by site
+        seen: Set[Tuple[str, str, str, int]] = set()
+        unique: List[Finding] = []
+        for f in findings:
+            key = (f.rule, f.path, f.qualname, f.line)
+            if key not in seen:
+                seen.add(key)
+                unique.append(f)
+        return unique
+
+    def _hot_label(self, lk: LockId) -> Optional[str]:
+        for suffix in self.hot_locks:
+            if lk.matches(suffix):
+                return suffix
+        return None
+
+    def _check_contracts(self, project: Project, locks) -> List[Finding]:
+        """Functions documented to run under a hot lock the call graph
+        cannot see (closure hand-off): their whole body is a hot
+        region."""
+        out: List[Finding] = []
+        for qn, fi in sorted(project.functions.items()):
+            label = None
+            for suffix, lock_label in self.contracts.items():
+                if qn.endswith(suffix):
+                    label = lock_label
+                    break
+            if label is None:
+                continue
+            for ev in self._events_under(project, fi, fi.node.body,
+                                         locks, 0, set()):
+                kind, inner, efi, enode, chain = ev
+                if kind in ("blocking", "h2d", "d2h"):
+                    rule = "LK003" if kind == "blocking" else "LK004"
+                    what = {"blocking": "blocking call",
+                            "h2d": "host→device transfer",
+                            "d2h": "blocking device→host sync"}[kind]
+                    out.append(project.finding(
+                        self.pass_id, rule, efi, enode,
+                        f"{what} inside a function contracted to run "
+                        f"under the {label}",
+                        (f"contract: {qn} runs under the {label}",)
+                        + chain))
+        return out
+
+    def _inversions(self, project: Project, edges) -> List[Finding]:
+        out: List[Finding] = []
+        reported: Set[Tuple[str, str]] = set()
+        for (a, b), (fi, node, chain) in sorted(edges.items()):
+            if a == b:
+                continue
+            rev = edges.get((b, a))
+            if rev is None:
+                continue
+            key = tuple(sorted((a, b)))
+            if key in reported:
+                continue
+            reported.add(key)
+            rfi, rnode, rchain = rev
+            out.append(project.finding(
+                self.pass_id, "LK001", fi, node,
+                f"lock-order inversion: {a} → {b} here but {b} → {a} at "
+                f"{rfi.module.rel}:{rnode.lineno} ({rfi.qualname})",
+                chain + ("reverse order:",) + rchain))
+        return out
+
+
+__all__ = ["LockDisciplinePass", "LockId", "PASS_ID",
+           "DEFAULT_HOT_LOCKS", "DEFAULT_LOCK_CONTRACTS",
+           "DEFAULT_DEVICE_STATE_CLASSES"]

@@ -1,0 +1,36 @@
+"""Hot-path markers: the contract half of the swlint allocation pass.
+
+``@hot_path`` declares a function to be on the per-batch critical path
+(dispatch, egress, flight-recorder append).  The marker itself is inert
+at runtime — a single attribute write at import — but it is a CONTRACT
+the static-analysis suite enforces: inside a marked function (and its
+project-local callees one level down) every new-object allocation —
+list/dict/set displays and comprehensions, ndarray construction,
+f-strings, closure creation — is flagged by the hot-path allocation
+pass (``sitewhere_tpu/analysis/hotpath.py``).  Findings are either
+eliminated or triaged into the checked-in baseline with a
+justification, which makes the baseline the machine-generated
+"strip allocations off the per-batch path" worklist ROADMAP item 2
+consumes.
+
+This module must stay dependency-free (stdlib only): it is imported by
+the hottest modules in the package and must never pull jax/numpy into
+an import chain that otherwise avoids them.
+"""
+
+from __future__ import annotations
+
+HOT_PATH_ATTR = "__sw_hot_path__"
+
+
+def hot_path(fn):
+    """Mark ``fn`` as per-batch hot-path code (see module docstring)."""
+    setattr(fn, HOT_PATH_ATTR, True)
+    return fn
+
+
+def is_hot_path(fn) -> bool:
+    return bool(getattr(fn, HOT_PATH_ATTR, False))
+
+
+__all__ = ["hot_path", "is_hot_path", "HOT_PATH_ATTR"]

@@ -1,0 +1,269 @@
+"""Trace-purity pass (TP): no host syncs inside jit-traced code.
+
+The pipeline's flagship number — ``host_syncs == steps / K`` — only
+holds if nothing inside a traced region silently forces a device→host
+transfer.  One stray ``.item()`` in the packed chain turns every ring
+dispatch into a blocking round-trip; a ``np.asarray`` inside a jitted
+operator either crashes under jit or (worse, under ``jax.disable_jit``
+style fallbacks) silently de-optimizes.
+
+The pass builds the traced-region set from jit ENTRYPOINTS —
+
+- ``jax.jit(f)`` / ``@jax.jit`` / ``@partial(jax.jit, ...)``,
+- ``shard_map(f, ...)`` (the sharded packed step),
+- control-flow bodies: ``lax.fori_loop`` / ``scan`` / ``while_loop`` /
+  ``cond`` / ``switch``, ``jax.vmap`` / ``grad`` / ``checkpoint``,
+
+then propagates reachability through the project call graph (the chain
+body calls ``packed_pipeline_step`` calls ``pipeline_step`` — all
+traced) and flags host-sync operations inside any traced function:
+
+- ``TP001 host-sync-in-trace``: ``.item()`` / ``.tolist()`` /
+  ``.block_until_ready()`` method calls, ``numpy.asarray`` /
+  ``numpy.array`` / ``numpy.frombuffer``, ``jax.device_get`` /
+  ``jax.block_until_ready``, and ``print``.
+- ``TP002 host-scalar-coercion``: ``int()/float()/bool()`` applied to
+  an expression that subscripts a traced parameter or calls a
+  ``jnp``/``lax`` function — coercions of BARE names are not flagged
+  (static arguments are routinely normalized with ``int(op)``).
+- ``TP003 uncounted-d2h``: on the host DISPATCH-PATH modules (the
+  dispatcher and the packed host side), a blocking ``jax.device_get``
+  / ``block_until_ready`` in a function that does not reference the
+  counted ``pipeline.host_syncs`` helper surface (``host_syncs`` /
+  ``on_fetch``/``_fetch``) — the rule that keeps the metric honest.
+
+Every finding carries the evidence chain from the jit root that made
+the function traced.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from sitewhere_tpu.analysis.core import (
+    Finding,
+    FuncInfo,
+    Project,
+    dotted_name,
+    iter_scope,
+)
+
+PASS_ID = "trace-purity"
+
+# canonical external names that ARE jit wrappers (arg 0 is traced)
+_JIT_WRAPPERS = {"jax.jit", "jit", "jax.shard_map", "shard_map",
+                 "jax.experimental.shard_map.shard_map",
+                 "jax.vmap", "jax.grad", "jax.value_and_grad",
+                 "jax.checkpoint", "jax.pmap"}
+# control-flow primitives: {canonical: indices of function-valued args}
+_FLOW_BODIES = {
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.scan": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+}
+
+_SYNC_CALLS = {"numpy.asarray", "numpy.array", "numpy.frombuffer",
+               "numpy.copyto", "jax.device_get", "jax.block_until_ready",
+               "print", "breakpoint"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# jnp/lax prefixes whose results are definitely traced values (TP002)
+_TRACED_PRODUCERS = ("jax.numpy.", "jax.lax.", "jax.nn.")
+
+
+class TracePurityPass:
+    pass_id = PASS_ID
+
+    def __init__(self, dispatch_modules: Optional[Set[str]] = None):
+        # module-name suffixes whose HOST code is the counted dispatch
+        # path (TP003); default = the production dispatch surface
+        self.dispatch_modules = dispatch_modules if dispatch_modules \
+            is not None else {"runtime.dispatcher", "pipeline.packed"}
+
+    # -- root discovery ------------------------------------------------------
+
+    def _jit_roots(self, project: Project) -> Dict[str, str]:
+        """qualname -> root description for every function handed to a
+        jit wrapper or a control-flow primitive."""
+        roots: Dict[str, str] = {}
+
+        def note(fi: Optional[FuncInfo], why: str) -> None:
+            if fi is not None:
+                roots.setdefault(fi.qualname, why)
+
+        by_node = {id(fi.node): fi for fi in project.functions.values()}
+        for mod in project.modules.values():
+
+            def walk(node: ast.AST, scope: Optional[FuncInfo]) -> None:
+                for child in ast.iter_child_nodes(node):
+                    inner = scope
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        inner = by_node.get(id(child))
+                        if inner is not None:
+                            # decorators: @jax.jit / @partial(jax.jit,...)
+                            for dec in child.decorator_list:
+                                if self._is_jit_decorator(project, mod, dec):
+                                    note(inner,
+                                         f"decorator at {mod.rel}:"
+                                         f"{dec.lineno}")
+                    elif isinstance(child, ast.Call):
+                        self._roots_in_call(project, mod, scope, child,
+                                            note)
+                    walk(child, inner)
+
+            walk(mod.tree, None)
+        return roots
+
+    def _is_jit_decorator(self, project: Project, mod, dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            canon = project.canonical(mod, dec.func)
+            if canon in _JIT_WRAPPERS:
+                return True
+            # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+            if canon in ("functools.partial", "partial") and dec.args:
+                return project.canonical(mod, dec.args[0]) in _JIT_WRAPPERS
+            return False
+        return project.canonical(mod, dec) in _JIT_WRAPPERS
+
+    def _roots_in_call(self, project: Project, mod, scope, call: ast.Call,
+                       note) -> None:
+        canon = project.canonical(mod, call.func)
+        if canon in _JIT_WRAPPERS and call.args:
+            arg = call.args[0]
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                note(project.resolve_call(mod, scope, arg),
+                     f"{canon}() at {mod.rel}:{call.lineno}")
+        indices = _FLOW_BODIES.get(canon or "")
+        if indices:
+            for i in indices:
+                if i < len(call.args) and isinstance(
+                        call.args[i], (ast.Name, ast.Attribute)):
+                    note(project.resolve_call(mod, scope, call.args[i]),
+                         f"{canon}() body at {mod.rel}:{call.lineno}")
+
+    # -- propagation ---------------------------------------------------------
+
+    def _traced_set(self, project: Project
+                    ) -> Dict[str, Tuple[str, ...]]:
+        roots = self._jit_roots(project)
+        traced: Dict[str, Tuple[str, ...]] = {
+            qn: (why,) for qn, why in roots.items()}
+        frontier = list(traced)
+        while frontier:
+            qn = frontier.pop()
+            fi = project.functions.get(qn)
+            if fi is None:
+                continue
+            chain = traced[qn]
+            if len(chain) >= 12:
+                continue
+            for call, callee in project.callees(fi):
+                if callee.qualname not in traced:
+                    traced[callee.qualname] = chain + (
+                        f"called from {qn} ({fi.module.rel}:"
+                        f"{call.lineno})",)
+                    frontier.append(callee.qualname)
+        return traced
+
+    # -- the pass ------------------------------------------------------------
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        traced = self._traced_set(project)
+        for qn, chain in sorted(traced.items()):
+            fi = project.functions.get(qn)
+            if fi is None:
+                continue
+            findings.extend(self._check_traced(project, fi, chain))
+        findings.extend(self._check_dispatch_path(project, traced))
+        return findings
+
+    def _check_traced(self, project: Project, fi: FuncInfo,
+                      chain: Tuple[str, ...]) -> List[Finding]:
+        out: List[Finding] = []
+        params = {a.arg for a in fi.node.args.args
+                  + fi.node.args.posonlyargs + fi.node.args.kwonlyargs}
+        for node in iter_scope(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = project.canonical(fi.module, node.func)
+            if canon in _SYNC_CALLS:
+                out.append(project.finding(
+                    self.pass_id, "TP001", fi, node,
+                    f"host-sync operation `{canon}` inside jit-traced "
+                    "code (forces a device round-trip or fails to "
+                    "trace)", chain))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_METHODS \
+                    and not node.args and not node.keywords:
+                out.append(project.finding(
+                    self.pass_id, "TP001", fi, node,
+                    f"`.{node.func.attr}()` inside jit-traced code is a "
+                    "blocking host sync", chain))
+            elif canon in ("int", "float", "bool") and len(node.args) == 1 \
+                    and self._coerces_traced_value(
+                        project, fi, node.args[0], params):
+                out.append(project.finding(
+                    self.pass_id, "TP002", fi, node,
+                    f"`{canon}()` on a traced value inside jit-traced "
+                    "code concretizes the tracer (host sync / trace "
+                    "error)", chain))
+        return out
+
+    def _coerces_traced_value(self, project: Project, fi: FuncInfo,
+                              arg: ast.AST, params: Set[str]) -> bool:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Subscript):
+                base = node.value
+                if isinstance(base, ast.Name) and base.id in params:
+                    return True
+            elif isinstance(node, ast.Call):
+                canon = project.canonical(fi.module, node.func) or ""
+                if canon.startswith(_TRACED_PRODUCERS):
+                    return True
+        return False
+
+    def _check_dispatch_path(self, project: Project,
+                             traced: Dict[str, Tuple[str, ...]]
+                             ) -> List[Finding]:
+        """TP003: blocking D2H on the host dispatch path that bypasses
+        the counted ``pipeline.host_syncs`` surface."""
+        out: List[Finding] = []
+        for qn, fi in sorted(project.functions.items()):
+            if qn in traced:
+                continue
+            if not any(fi.module.name.endswith(m)
+                       for m in self.dispatch_modules):
+                continue
+            body_text = "\n".join(
+                fi.module.line_at(i)
+                for i in range(fi.node.lineno,
+                               (fi.node.end_lineno or fi.node.lineno) + 1))
+            counted = ("host_syncs" in body_text or "on_fetch" in body_text
+                       or "_fetch" in body_text)
+            if counted:
+                continue
+            for node in iter_scope(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                canon = project.canonical(fi.module, node.func)
+                is_block = canon in ("jax.device_get",
+                                     "jax.block_until_ready")
+                if not is_block and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "block_until_ready":
+                    is_block = True
+                if is_block:
+                    out.append(project.finding(
+                        self.pass_id, "TP003", fi, node,
+                        "blocking device→host sync on the dispatch path "
+                        "bypasses the counted pipeline.host_syncs "
+                        "surface"))
+        return out
+
+
+__all__ = ["TracePurityPass", "PASS_ID"]

@@ -33,6 +33,7 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from sitewhere_tpu.analysis.markers import hot_path
 from sitewhere_tpu.ids import NULL_ID
 from sitewhere_tpu.ingest.decoders import DecodedRequest
 from sitewhere_tpu.parallel.mesh import shard_for_device
@@ -269,46 +270,85 @@ class Reservation:
         return host_cols
 
 
-@dataclasses.dataclass
 class BatchPlan:
     """A ready-to-dispatch batch plus its host-side bookkeeping.
 
     ``host_cols`` keeps the numpy columns the device batch was built from
     so egress never has to fetch the input batch back off the device —
     only step *outputs* cross the host boundary after dispatch.
+
+    The device :class:`EventBatch` is materialized LAZILY: ``_emit``
+    runs under the dispatcher's intake lock, and building the unpacked
+    batch there meant 16 host→device transfers while every source
+    thread's intake was blocked (swlint lock-discipline LK004).  The
+    emitter now hands over only the numpy ``host_cols``; the first
+    ``plan.batch`` access — the dispatcher stages plans before taking
+    any lock — pays the transfers off-lock.
     """
 
-    batch: Optional[EventBatch]
-    n_events: int
-    width: int
-    created_at: float
-    max_wait_s: float  # how long the oldest row waited before emit
-    host_cols: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
-    # Packed wire form ([12, B] int32 / [4, B] float32, pipeline/packed.py)
-    # when the batcher was built with ``emit_packed`` — then ``batch`` is
-    # None and the dispatcher feeds the packed step directly (2 transfers
-    # instead of 16).
-    packed_i: Optional[np.ndarray] = None
-    packed_f: Optional[np.ndarray] = None
-    # Device-resident (bi, bf) pair staged ahead of the step by the
-    # dispatcher (pipeline/packed.py stage_packed_batch): the H2D copy of
-    # plan N+1 overlaps plan N's device step.  None = unstaged (sync
-    # transfer at step-call time, the CPU-backend fallback).
-    staged: Optional[tuple] = None
-    # Emission bookkeeping for the device-resident dispatch ring:
-    # ``seq`` is the batcher's monotonic emission number (commit/egress
-    # attribution of a chained step — "slot 3 of chain N" traces back to
-    # one concrete plan), ``reason`` the emit trigger ("fill" |
-    # "deadline" | "flush").  Only full-width fill emissions ride the
-    # ring; deadline/flush partials are latency-sensitive and take the
-    # single-step path (flushing ring-held predecessors first, so
-    # per-device event order is preserved).
-    seq: int = -1
-    reason: str = "fill"
-    # Host dispatch time this plan paid (single-step: the jitted call;
-    # ring slot: its 1/K share of the chain dispatch) — flight-recorder
-    # stage attribution, stamped by the dispatcher.
-    dispatch_s: float = 0.0
+    __slots__ = ("_batch", "n_events", "width", "created_at", "max_wait_s",
+                 "host_cols", "packed_i", "packed_f", "staged", "seq",
+                 "reason", "dispatch_s")
+
+    def __init__(
+        self,
+        batch: Optional[EventBatch] = None,
+        n_events: int = 0,
+        width: int = 1,
+        created_at: float = 0.0,
+        max_wait_s: float = 0.0,  # how long the oldest row waited
+        host_cols: Optional[Dict[str, np.ndarray]] = None,
+        # Packed wire form ([12, B] int32 / [4, B] float32,
+        # pipeline/packed.py) when the batcher was built with
+        # ``emit_packed`` — then ``batch`` is None and the dispatcher
+        # feeds the packed step directly (2 transfers instead of 16).
+        packed_i: Optional[np.ndarray] = None,
+        packed_f: Optional[np.ndarray] = None,
+        # Device-resident (bi, bf) pair staged ahead of the step by the
+        # dispatcher (pipeline/packed.py stage_packed_batch): the H2D
+        # copy of plan N+1 overlaps plan N's device step.  None =
+        # unstaged (sync transfer at step-call time, the CPU fallback).
+        staged: Optional[tuple] = None,
+        # Emission bookkeeping for the device-resident dispatch ring:
+        # ``seq`` is the batcher's monotonic emission number (commit/
+        # egress attribution of a chained step), ``reason`` the emit
+        # trigger ("fill" | "deadline" | "flush").  Only full-width fill
+        # emissions ride the ring; deadline/flush partials are latency-
+        # sensitive and take the single-step path.
+        seq: int = -1,
+        reason: str = "fill",
+        # Host dispatch time this plan paid (single-step: the jitted
+        # call; ring slot: its 1/K share of the chain dispatch) —
+        # flight-recorder stage attribution, stamped by the dispatcher.
+        dispatch_s: float = 0.0,
+    ):
+        self._batch = batch
+        self.n_events = n_events
+        self.width = width
+        self.created_at = created_at
+        self.max_wait_s = max_wait_s
+        self.host_cols = host_cols if host_cols is not None else {}
+        self.packed_i = packed_i
+        self.packed_f = packed_f
+        self.staged = staged
+        self.seq = seq
+        self.reason = reason
+        self.dispatch_s = dispatch_s
+
+    def materialize_batch(self) -> Optional[EventBatch]:
+        """Build (and cache) the device EventBatch from ``host_cols`` —
+        call OFF the intake/step locks; packed plans return None (they
+        ship ``packed_i``/``packed_f`` instead)."""
+        if self._batch is None and self.packed_i is None and self.host_cols:
+            import jax.numpy as jnp
+
+            self._batch = EventBatch(
+                **{k: jnp.asarray(v) for k, v in self.host_cols.items()})
+        return self._batch
+
+    @property
+    def batch(self) -> Optional[EventBatch]:
+        return self.materialize_batch()
 
     @property
     def fill(self) -> float:
@@ -804,6 +844,7 @@ class Batcher:
             self.controller.on_emit(n, self.width, self.pending, reason)
         return now, wait
 
+    @hot_path
     def _emit_adopted(self, reason: str) -> BatchPlan:
         """Zero-copy emission: the sole pending chunk is a full-width
         reserved segment — its packed buffers BECOME the batch.  Only
@@ -822,9 +863,8 @@ class Batcher:
             seq=self.emitted_batches - 1, reason=reason,
         )
 
+    @hot_path
     def _emit(self, reason: str = "fill") -> BatchPlan:
-        import jax.numpy as jnp
-
         if self.emit_packed and self.n_shards == 1:
             q = self._pending[0]
             if len(q) == 1 and q[0].reserved is not None \
@@ -893,9 +933,11 @@ class Batcher:
                 max_wait_s=wait, host_cols=out, packed_i=ibuf, packed_f=fbuf,
                 seq=self.emitted_batches - 1, reason=reason,
             )
-        batch = EventBatch(**{k: jnp.asarray(v) for k, v in out.items()})
+        # No device work here: _emit runs under the dispatcher's intake
+        # lock, so the EventBatch H2D materializes lazily at first
+        # plan.batch access (the dispatcher stages plans off-lock).
         return BatchPlan(
-            batch=batch, n_events=n, width=self.width, created_at=now,
+            batch=None, n_events=n, width=self.width, created_at=now,
             max_wait_s=wait, host_cols=out,
             seq=self.emitted_batches - 1, reason=reason,
         )

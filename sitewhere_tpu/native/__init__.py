@@ -98,9 +98,22 @@ def _load_locked():
     if os.environ.get("SW_NATIVE", "1") == "0":
         return None
     try:
-        path = _build_path()
-        if not os.path.exists(path) and not _compile(path):
-            return None
+        # SW_NATIVE_LIB: load a PREBUILT extension instead of the
+        # hash-keyed first-use build — how tools/native_sanitize.sh
+        # injects its ASan/UBSan-instrumented build under the normal
+        # test suite (the sanitizer runtime must be LD_PRELOADed by the
+        # harness; this loader only swaps the .so path).
+        override = os.environ.get("SW_NATIVE_LIB")
+        if override:
+            path = override
+            if not os.path.exists(path):
+                logger.warning("SW_NATIVE_LIB=%s missing; Python path",
+                               path)
+                return None
+        else:
+            path = _build_path()
+            if not os.path.exists(path) and not _compile(path):
+                return None
         import importlib.util
 
         spec = importlib.util.spec_from_file_location("_swwire", path)

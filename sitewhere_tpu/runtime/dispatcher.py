@@ -74,6 +74,7 @@ from typing import Callable, Dict, List, Optional
 import jax
 import numpy as np
 
+from sitewhere_tpu.analysis.markers import hot_path
 from sitewhere_tpu.ids import NULL_ID
 from sitewhere_tpu.ingest.batcher import Batcher, BatchPlan
 from sitewhere_tpu.ingest.decoders import DecodedRequest
@@ -342,7 +343,11 @@ class PipelineDispatcher(LifecycleComponent):
             for s in ("decode", "batch", "dispatch", "egress",
                       # ring stages: per-slot wait before its chain
                       # launches, and the chain's host dispatch cost
-                      "ring_wait", "ring_dispatch")
+                      "ring_wait", "ring_dispatch",
+                      # unpacked plans' lazy EventBatch H2D (moved off
+                      # the intake lock out of _emit — its own stage so
+                      # the batch timer's per-plan sample count stays 1)
+                      "h2d")
         }
         # "How often does the host touch the device" as a first-class
         # metric: one inc per BLOCKING device→host sync on the dispatch/
@@ -465,6 +470,18 @@ class PipelineDispatcher(LifecycleComponent):
             if plan.staged is not None:
                 self._m_bytes["h2d"].inc(
                     plan.packed_i.nbytes + plan.packed_f.nbytes)
+        elif plan.packed_i is None and plan._batch is None \
+                and plan.host_cols:
+            # Unpacked plans: materialize the EventBatch HERE, off the
+            # intake and step locks — _emit no longer pays the 16 H2D
+            # transfers under the intake lock (swlint LK004 fix).
+            # Timed as its OWN stage (pipeline.stage_h2d_s): folding it
+            # into the batch timer would double that timer's per-plan
+            # sample count and halve the per-batch attribution the
+            # bench derives from totals/counts.
+            t0 = time.perf_counter()
+            plan.materialize_batch()
+            self._m_stage["h2d"].observe(time.perf_counter() - t0)
 
     def _shed_intake(self, payload: bytes, shed: Dict[object, int],
                      source_id: str, tenant: str) -> None:
@@ -1278,6 +1295,7 @@ class PipelineDispatcher(LifecycleComponent):
             self._ring_chains[k] = chain
         return chain
 
+    @hot_path
     def _run_ring(self) -> None:
         """Dispatch one chained K-step program over the ring's staged
         slots (called under ``_step_lock`` with a full ring): one host
@@ -1344,6 +1362,7 @@ class PipelineDispatcher(LifecycleComponent):
             self.flightrec.anomaly(
                 "egress-crash", detail=f"supervisor restart: {exc}")
 
+    @hot_path
     def _flight_record(self, plan: BatchPlan, out, replay_depth: int,
                        commit: str, e2e_s: float = 0.0,
                        egress_s: float = 0.0, trace=None,
@@ -1372,6 +1391,7 @@ class PipelineDispatcher(LifecycleComponent):
             rec["error"] = error
         self.flightrec.record(**rec)
 
+    @hot_path
     def _dispatch_plan(self, plan: BatchPlan, replay_depth: int = 0,
                        stall: bool = True) -> None:
         # chaos hook: a step-dispatch failure (device OOM, donation bug)
@@ -1474,6 +1494,7 @@ class PipelineDispatcher(LifecycleComponent):
         sup = self._egress_super
         return sup is not None and sup.alive and not sup.escalated
 
+    @hot_path
     def _window_step(self, plan, out, replay_depth: int, trace) -> None:
         """Window the dispatched step in flight (dispatch is async).
         Offloaded: hand the window to the egress worker and return — the
@@ -1552,6 +1573,7 @@ class PipelineDispatcher(LifecycleComponent):
                 self.flightrec.anomaly("egress-crash", detail=str(e))
             raise
 
+    @hot_path
     def _egress(self, plan: BatchPlan, out, replay_depth: int,
                 trace=None) -> None:
         """Host fan-out of one step's outputs.

@@ -41,6 +41,8 @@ from typing import Dict, List, Optional
 
 logger = logging.getLogger("sitewhere_tpu.flightrec")
 
+from sitewhere_tpu.analysis.markers import hot_path  # noqa: E402
+
 _REASON_RE = re.compile(r"[^a-z0-9_-]")
 
 
@@ -110,6 +112,7 @@ class FlightRecorder:
 
     # -- hot path ------------------------------------------------------------
 
+    @hot_path
     def record(self, **fields) -> None:
         """Append one per-batch record (O(1), no I/O — always-on)."""
         fields["ts"] = round(time.time(), 6)

@@ -315,9 +315,17 @@ class DeviceStateManager(LifecycleComponent):
         return True
 
     def missing_device_ids(self) -> List[int]:
-        """Devices currently flagged missing (vectorized scan + index copy)."""
+        """Devices currently flagged missing (vectorized scan + index copy).
+
+        The lock covers only the epoch snapshot; the blocking
+        device→host transfer runs OUTSIDE it (epochs are immutable —
+        commit replaces, never mutates).  A REST scan must never hold
+        the lease lock through a D2H round-trip: ``commit_packed`` takes
+        this lock on every batch, so a slow transfer here would stall
+        dispatch (swlint lock-discipline LK004)."""
         with self._lock:
-            mask = np.asarray(self.current.presence_missing)
+            s = self.current
+        mask = np.asarray(s.presence_missing)
         return [int(i) for i in np.nonzero(mask)[0]]
 
     def missing_device_tokens(self) -> List[str]:
@@ -335,19 +343,23 @@ class DeviceStateManager(LifecycleComponent):
                 if t is not None]
 
     def seen_since(self, since_s: int) -> List[int]:
-        """Devices with any event at/after ``since_s``."""
+        """Devices with any event at/after ``since_s``.  Snapshot under
+        the lock, compute + transfer outside it (see
+        :meth:`missing_device_ids`)."""
         with self._lock:
             s = self.current
-            mask = np.asarray(
-                (s.last_event_type != NULL_ID) & (s.last_event_ts_s >= since_s)
-            )
+        mask = np.asarray(
+            (s.last_event_type != NULL_ID) & (s.last_event_ts_s >= since_s)
+        )
         return [int(i) for i in np.nonzero(mask)[0]]
 
     def summary(self) -> Dict[str, int]:
+        # snapshot under the lock, transfer outside it (see
+        # missing_device_ids — the lease lock must never ride a D2H)
         with self._lock:
             s = self.current
-            has = np.asarray(s.last_event_type != NULL_ID)
-            missing = np.asarray(s.presence_missing)
+        has = np.asarray(s.last_event_type != NULL_ID)
+        missing = np.asarray(s.presence_missing)
         return {
             "devices_with_state": int(has.sum()),
             "devices_missing": int(missing.sum()),

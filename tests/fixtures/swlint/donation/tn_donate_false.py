@@ -1,0 +1,8 @@
+"""TN: built with donate=False — the carry survives the call."""
+from sitewhere_tpu.pipeline.packed import build_packed_chain
+
+
+def dispatch(tables, ps, slots):
+    chain = build_packed_chain(4, donate=False)
+    out = chain(tables, ps, *slots)
+    return out, ps.si

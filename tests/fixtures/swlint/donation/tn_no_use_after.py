@@ -1,0 +1,12 @@
+"""TN: donation with no later use of the donated buffer."""
+import jax
+
+
+def step(carry, x):
+    return carry + x
+
+
+def run(carry, x):
+    g = jax.jit(step, donate_argnums=(0,))
+    out = g(carry, x)
+    return out

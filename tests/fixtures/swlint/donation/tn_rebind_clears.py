@@ -1,0 +1,12 @@
+"""TN: re-binding the name after donation is a fresh buffer."""
+import jax
+
+
+def step(carry, x):
+    return carry + x
+
+
+def run(carry, x):
+    g = jax.jit(step, donate_argnums=(0,))
+    carry = g(carry, x)
+    return carry + 1

@@ -1,0 +1,9 @@
+"""TN: every buffer access happens before the reservation closes."""
+
+
+def ingest(batcher, n):
+    r = batcher.reserve(n)
+    r.device_id[:n] = 0
+    r.value[:n] = 1.5
+    r.set_const(tenant_id=0, payload_ref=3)
+    return r.commit()

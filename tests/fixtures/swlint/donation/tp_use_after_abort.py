@@ -1,0 +1,7 @@
+"""DN003: reservation read after abort()."""
+
+
+def bail(batcher, n):
+    r = batcher.reserve(n)
+    r.abort()
+    return r.ibuf

@@ -1,0 +1,9 @@
+"""DN001: build_packed_chain donates the carry (arg 1)."""
+from sitewhere_tpu.pipeline.packed import build_packed_chain
+
+
+def dispatch(tables, ps, slots):
+    chain = build_packed_chain(4)
+    out = chain(tables, ps, *slots)
+    stale = ps.si
+    return out, stale

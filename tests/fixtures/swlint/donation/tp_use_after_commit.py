@@ -1,0 +1,9 @@
+"""DN003: reservation buffers touched after commit()."""
+
+
+def ingest(batcher, n):
+    r = batcher.reserve(n)
+    r.device_id[:n] = 0
+    plans = r.commit()
+    r.device_id[0] = 7
+    return plans

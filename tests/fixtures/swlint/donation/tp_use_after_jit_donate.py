@@ -1,0 +1,12 @@
+"""DN001: the donated buffer is read after the donating call."""
+import jax
+
+
+def step(carry, x):
+    return carry + x
+
+
+def run(carry, x):
+    g = jax.jit(step, donate_argnums=(0,))
+    out = g(carry, x)
+    return out + carry.sum()

@@ -1,0 +1,8 @@
+"""DN002: the leased epoch is read after its lease was committed."""
+
+
+def run_chain(mgr, step, tables, slots):
+    ps, token = mgr.lease_packed()
+    out = step(tables, ps, *slots)
+    mgr.commit_packed(out[0], present_now=out[3], lease_token=token)
+    return ps.capacity

@@ -1,0 +1,16 @@
+"""TN: allocations two call levels below the marker are outside the
+default propagation depth (they get their own marker when promoted)."""
+from sitewhere_tpu.analysis.markers import hot_path
+
+
+def deep_helper(n):
+    return list(range(n))
+
+
+def mid_helper(n):
+    return deep_helper(n)
+
+
+@hot_path
+def egress(n):
+    return mid_helper(n)

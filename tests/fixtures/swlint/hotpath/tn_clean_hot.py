@@ -1,0 +1,8 @@
+"""TN: a marked function that only mutates preallocated state."""
+from sitewhere_tpu.analysis.markers import hot_path
+
+
+@hot_path
+def record(ring, slot, seq):
+    ring[slot] = seq
+    return ring

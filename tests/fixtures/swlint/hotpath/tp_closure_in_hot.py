@@ -1,0 +1,7 @@
+"""HP004: a closure minted per call."""
+from sitewhere_tpu.analysis.markers import hot_path
+
+
+@hot_path
+def dispatch(rows, submit):
+    submit(lambda: sum(rows))

@@ -1,0 +1,7 @@
+"""HP003: per-batch f-string construction."""
+from sitewhere_tpu.analysis.markers import hot_path
+
+
+@hot_path
+def label(plan):
+    return f"plan-{plan.seq}"

@@ -1,0 +1,7 @@
+"""HP001: list comprehension on the hot path."""
+from sitewhere_tpu.analysis.markers import hot_path
+
+
+@hot_path
+def egress(rows):
+    return [r * 2 for r in rows]

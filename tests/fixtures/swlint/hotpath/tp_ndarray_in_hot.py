@@ -1,0 +1,9 @@
+"""HP002: fresh ndarray per batch."""
+import numpy as np
+
+from sitewhere_tpu.analysis.markers import hot_path
+
+
+@hot_path
+def assemble(width):
+    return np.zeros(width, np.int32)

@@ -1,0 +1,11 @@
+"""HP001 one level down: the marked function's helper allocates."""
+from sitewhere_tpu.analysis.markers import hot_path
+
+
+def build_record(plan):
+    return {"seq": plan.seq, "rows": plan.n_events}
+
+
+@hot_path
+def egress(plan):
+    return build_record(plan)

@@ -1,0 +1,14 @@
+"""TN: the fsync runs after the lock is released."""
+import os
+import threading
+
+
+class Cold:
+    def __init__(self, f):
+        self._lock = threading.Lock()
+        self._f = f
+
+    def append(self, data):
+        with self._lock:
+            self._f.write(data)
+        os.fsync(self._f.fileno())

@@ -1,0 +1,18 @@
+"""TN: consistent a->b order everywhere — no inversion."""
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def two(self):
+        with self._a:
+            with self._b:
+                return 2

@@ -1,0 +1,15 @@
+"""TN: RLock re-entry is its purpose — no self-deadlock."""
+import threading
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def outer(self):
+        with self._lock:
+            return self.inner()
+
+    def inner(self):
+        with self._lock:
+            return 1

@@ -1,0 +1,16 @@
+"""TN: snapshot under the lock, transfer outside it — the fixed
+DeviceStateManager pattern."""
+import threading
+
+import numpy as np
+
+
+class Mgr:
+    def __init__(self, state):
+        self._lock = threading.Lock()
+        self._state = state
+
+    def snapshot(self):
+        with self._lock:
+            s = self._state
+        return np.asarray(s)

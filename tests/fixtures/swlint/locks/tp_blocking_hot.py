@@ -1,0 +1,14 @@
+"""LK003: fsync held under a hot-path lock."""
+import os
+import threading
+
+
+class Hot:
+    def __init__(self, f):
+        self._lock = threading.Lock()
+        self._f = f
+
+    def append(self, data):
+        with self._lock:
+            self._f.write(data)
+            os.fsync(self._f.fileno())

@@ -1,0 +1,9 @@
+"""LK003 via contract: this function is documented to run under the
+intake lock; sleeping in it stalls every source thread."""
+import time
+
+
+class Contracted:
+    def run_under_intake(self, rows):
+        time.sleep(0.01)
+        return len(rows)

@@ -1,0 +1,19 @@
+"""LK004: blocking D2H + H2D under a device-state manager's lock."""
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Mgr:
+    def __init__(self, state):
+        self._lock = threading.Lock()
+        self._state = state
+
+    def snapshot(self):
+        with self._lock:
+            return np.asarray(self._state)
+
+    def adopt(self, host_rows):
+        with self._lock:
+            self._state = jnp.asarray(host_rows)

@@ -1,0 +1,15 @@
+"""LK002: a plain Lock re-acquired through a call made under it."""
+import threading
+
+
+class Selfish:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            return self.inner()
+
+    def inner(self):
+        with self._lock:
+            return 1

@@ -1,0 +1,5 @@
+"""TN: f-string names with legal literal fragments."""
+
+
+def wire(metrics, stages):
+    return {s: metrics.timer(f"pipeline.stage_{s}_s") for s in stages}

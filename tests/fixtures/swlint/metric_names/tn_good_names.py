@@ -1,0 +1,8 @@
+"""TN: well-formed names in registered families."""
+
+
+def wire(metrics):
+    metrics.counter("pipeline.steps")
+    metrics.gauge("device.occupancy.rows_admitted")
+    metrics.counter("pipeline.bytes_copied.decode")
+    metrics.counter("flightrec.records")

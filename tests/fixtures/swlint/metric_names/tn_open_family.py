@@ -1,0 +1,7 @@
+"""TN: open families allow dynamic members."""
+
+
+def wire(metrics):
+    metrics.histogram("device.stage_ms.full")
+    metrics.gauge("slo.alert.p99_ms")
+    metrics.gauge("slo.burn_rate.throughput.fast")

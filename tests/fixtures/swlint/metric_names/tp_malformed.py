@@ -1,0 +1,5 @@
+"""MN001: mixed case / spaces violate the dotted convention."""
+
+
+def wire(metrics):
+    return metrics.counter("Outbound.Queue Depth")

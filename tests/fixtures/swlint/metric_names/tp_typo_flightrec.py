@@ -1,0 +1,5 @@
+"""MN002: singular typo splits the flightrec.snapshots series."""
+
+
+def wire(metrics):
+    return metrics.counter("flightrec.snapshot")

@@ -1,0 +1,5 @@
+"""MN002: not a member of the closed pipeline.bytes_copied family."""
+
+
+def wire(metrics):
+    return metrics.counter("pipeline.bytes_copied.total")

@@ -1,0 +1,5 @@
+"""MN003: a governed device.* prefix with no declared family."""
+
+
+def wire(metrics):
+    return metrics.gauge("device.thermals.max_c")

@@ -1,0 +1,12 @@
+"""TN: a pure jnp jitted function with helpers."""
+import jax
+import jax.numpy as jnp
+
+
+def helper(a, b):
+    return jnp.where(a > b, a, b)
+
+
+@jax.jit
+def step(x, y):
+    return helper(x, y) + jnp.sum(x)

@@ -1,0 +1,10 @@
+"""TN: int() of static shape data inside jit is trace-safe."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def padded(x):
+    width = int(x.shape[0])
+    op = int(3)
+    return jnp.pad(x, (0, width % 8)), op

@@ -1,0 +1,8 @@
+"""TP002: float() of a jnp reduction concretizes the tracer."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_mean(x):
+    return float(jnp.mean(x)) * 2.0

@@ -1,0 +1,7 @@
+"""TP003: blocking D2H on the dispatch path without the counted
+pipeline.host_syncs surface."""
+import jax
+
+
+def fetch_outputs(outputs):
+    return jax.device_get(outputs)

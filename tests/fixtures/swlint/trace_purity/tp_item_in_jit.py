@@ -1,0 +1,9 @@
+"""TP001: .item() inside a jitted function is a blocking host sync."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_sum(x):
+    total = jnp.sum(x)
+    return total.item()

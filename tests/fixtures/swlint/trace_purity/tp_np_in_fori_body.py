@@ -1,0 +1,11 @@
+"""TP001: numpy.asarray inside a lax.fori_loop body breaks the trace."""
+import jax
+import numpy as np
+
+
+def run(x):
+    def body(i, carry):
+        host = np.asarray(carry)
+        return carry + host[0]
+
+    return jax.lax.fori_loop(0, 4, body, x)

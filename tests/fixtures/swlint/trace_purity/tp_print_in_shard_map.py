@@ -1,0 +1,11 @@
+"""TP001: print() inside a shard_map-ped function."""
+from jax import shard_map
+
+
+def local_step(block):
+    print("step", block)
+    return block * 2
+
+
+def build(mesh):
+    return shard_map(local_step, mesh=mesh, in_specs=None, out_specs=None)

@@ -556,9 +556,15 @@ class TestNewFamiliesExposition:
         rec.record(seq=0, commit="ok")
         rec.anomaly("lint")
 
-        # every registered name obeys the linted dotted convention
+        # every registered name obeys the linted dotted convention AND
+        # the swlint family registry (closed memberships for
+        # device.occupancy/device.cost/flightrec, governed device./slo.
+        # prefixes) — one contract shared with the static pass
+        from sitewhere_tpu.analysis.metric_names import lint_names
+
         for name in reg.names():
             assert METRIC_NAME_RE.match(name), name
+        assert lint_names(reg.names()) == []
 
         families = parse_exposition(render_openmetrics(reg))
         assert families["device_occupancy_rows_admitted"]["samples"][

@@ -1,0 +1,65 @@
+"""ASan/UBSan gate for the C wire scanner (slow-marked).
+
+``tools/native_sanitize.sh`` rebuilds ``native/swwire.c`` with
+AddressSanitizer + UndefinedBehaviorSanitizer (no recover) and runs the
+fill-direct / native wire test suites against the instrumented build
+via ``SW_NATIVE_LIB`` — the scanner parses HOSTILE wire bytes straight
+into the batcher's packed buffers, so an out-of-bounds write there is
+silent column corruption in production.  Any sanitizer report aborts
+the child pytest run and fails this test.
+
+Slow-marked: a full rebuild + child test run per invocation.  Run with
+``pytest -m slow tests/test_native_sanitize.py`` or the script
+directly (see the verify skill).
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(_REPO, "tools", "native_sanitize.sh")
+
+
+def _asan_available() -> bool:
+    cc = os.environ.get("CC", "cc")
+    if shutil.which(cc) is None:
+        return False
+    try:
+        out = subprocess.run([cc, "-print-file-name=libasan.so"],
+                             capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    path = out.stdout.strip()
+    return bool(path) and os.path.exists(path)
+
+
+@pytest.mark.slow
+def test_fill_direct_suite_clean_under_asan_ubsan():
+    if not _asan_available():
+        pytest.skip("no C compiler / ASan runtime in this environment")
+    proc = subprocess.run(
+        ["bash", _SCRIPT], capture_output=True, text=True, timeout=540,
+        cwd=_REPO)
+    assert proc.returncode == 0, (
+        f"sanitized native run failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-4000:]}\n{proc.stderr[-4000:]}")
+    assert "OK (ASan/UBSan clean)" in proc.stdout
+
+
+@pytest.mark.slow
+def test_sanitize_build_produces_instrumented_lib():
+    if not _asan_available():
+        pytest.skip("no C compiler / ASan runtime in this environment")
+    proc = subprocess.run(
+        ["bash", _SCRIPT, "--build"], capture_output=True, text=True,
+        timeout=300, cwd=_REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    path = proc.stdout.strip().splitlines()[-1]
+    assert os.path.exists(path)
+    # the build must actually carry the sanitizer instrumentation
+    syms = subprocess.run(["nm", "-D", "-u", path], capture_output=True,
+                          text=True, timeout=60)
+    assert "__asan" in syms.stdout, "no ASan symbols in sanitized build"

@@ -390,6 +390,14 @@ def test_instance_metric_names_follow_dotted_convention(tmp_path):
         assert names, "no metrics registered — instrumentation unplugged?"
         bad = [n for n in names if not METRIC_NAME_RE.match(n)]
         assert not bad, f"metric names violate the dotted convention: {bad}"
+        # family rules (closed memberships, governed prefixes) are
+        # swlint's registry-driven metric-name pass — the dynamic lint
+        # calls the SAME helper so runtime and static checks enforce
+        # one contract (sitewhere_tpu/analysis/metric_names.py)
+        from sitewhere_tpu.analysis.metric_names import lint_names
+
+        problems = lint_names(names)
+        assert not problems, f"metric family lint: {problems}"
         # the hot-path families the observability story promises
         assert "pipeline.e2e_latency_s" in names
         assert "pipeline.ingest_to_seal_latency_s" in names
