@@ -26,6 +26,16 @@ nesting propagated through the project call graph, and enforces:
   ``numpy.asarray`` (which IS the blocking D2H when the argument lives
   on device).  One slow transfer under the lease lock stalls every
   commit; this is how a REST scan turns into a p99 cliff.
+- ``LK005 checkpoint-under-hot-lock``: a call on the configured
+  forbidden list — by default ``Checkpointer.save``, which deep-copies
+  every store, pickles them, and fsyncs multi-MB snapshot files —
+  reached while a hot lock is held (or inside a contracted hot
+  region).  The checkpointer owns its own thread and its own save
+  lock; the dispatch thread and the three hot-path locks must never
+  pay for a snapshot.  Matching is by attribute-path suffix
+  (``…checkpointer.save``) AND by resolved callee qualname, so both
+  the direct ``self.checkpointer.save()`` and an aliased call are
+  caught.
 
 Some functions run under a hot lock held by their CALLER through an
 unresolvable indirection (the batcher intake family runs under the
@@ -113,6 +123,17 @@ DEFAULT_LOCK_CONTRACTS: Dict[str, str] = {
 DEFAULT_DEVICE_STATE_CLASSES: FrozenSet[str] = frozenset(
     {"DeviceStateManager"})
 
+# Calls that must NEVER execute under a hot-path lock (LK005):
+# attribute-path suffix (lowercased) or resolved-callee qualname suffix
+# -> why.  Checkpointer.save is the archetype: it deep-copies every
+# store under the store locks, pickles the lot, and fsyncs several
+# multi-MB files — seconds of work that would wedge dispatch if a hot
+# lock were held around it.
+DEFAULT_FORBIDDEN_UNDER_HOT: Dict[str, str] = {
+    "checkpointer.save": ("Checkpointer.save pickles every store and "
+                          "fsyncs multi-MB snapshot files"),
+}
+
 
 class LockDisciplinePass:
     pass_id = PASS_ID
@@ -121,6 +142,7 @@ class LockDisciplinePass:
                  hot_locks: Optional[Sequence[str]] = None,
                  contracts: Optional[Dict[str, str]] = None,
                  device_state_classes: Optional[Sequence[str]] = None,
+                 forbidden_under_hot: Optional[Dict[str, str]] = None,
                  max_depth: int = 4):
         self.hot_locks = frozenset(
             DEFAULT_HOT_LOCKS if hot_locks is None else hot_locks)
@@ -129,6 +151,9 @@ class LockDisciplinePass:
         self.device_state_classes = frozenset(
             DEFAULT_DEVICE_STATE_CLASSES if device_state_classes is None
             else device_state_classes)
+        self.forbidden_under_hot = dict(
+            DEFAULT_FORBIDDEN_UNDER_HOT if forbidden_under_hot is None
+            else forbidden_under_hot)
         self.max_depth = max_depth
 
     # -- inventory -----------------------------------------------------------
@@ -227,6 +252,10 @@ class LockDisciplinePass:
                 if kind is not None:
                     yield (kind, None, fi, node, ())
                 callee = project.resolve_call(fi.module, fi, node.func)
+                if callee is not None \
+                        and self._forbidden_reason(callee.qualname) \
+                        is not None and kind != "forbidden":
+                    yield ("forbidden", None, fi, node, ())
                 if callee is not None and callee.qualname != fi.qualname:
                     for ev in self._events_under(
                             project, callee, callee.node.body, locks,
@@ -238,8 +267,19 @@ class LockDisciplinePass:
                                + chain)
             stack.extend(ast.iter_child_nodes(node))
 
+    def _forbidden_reason(self, name: Optional[str]) -> Optional[str]:
+        if name is None:
+            return None
+        low = name.lower()
+        for suffix, reason in self.forbidden_under_hot.items():
+            if low == suffix or low.endswith("." + suffix):
+                return reason
+        return None
+
     def _classify_call(self, project: Project, fi: FuncInfo,
                        call: ast.Call) -> Optional[str]:
+        if self._forbidden_reason(dotted_name(call.func)) is not None:
+            return "forbidden"
         canon = project.canonical(fi.module, call.func)
         if canon in _BLOCKING_CALLS:
             return "blocking"
@@ -305,10 +345,15 @@ class LockDisciplinePass:
                                  f"({fi.module.rel}:{wnode.lineno})",)
                                 + chain))
                     elif held_hot is not None:
-                        rule = "LK003" if kind == "blocking" else "LK004"
+                        rule = {"blocking": "LK003", "h2d": "LK004",
+                                "d2h": "LK004",
+                                "forbidden": "LK005"}[kind]
                         what = {"blocking": "blocking call",
                                 "h2d": "host→device transfer",
-                                "d2h": "blocking device→host sync"}[kind]
+                                "d2h": "blocking device→host sync",
+                                "forbidden":
+                                    "checkpoint save (forbidden under "
+                                    "hot locks)"}[kind]
                         findings.append(project.finding(
                             self.pass_id, rule, efi, enode,
                             f"{what} while holding hot-path lock "
@@ -351,11 +396,15 @@ class LockDisciplinePass:
             for ev in self._events_under(project, fi, fi.node.body,
                                          locks, 0, set()):
                 kind, inner, efi, enode, chain = ev
-                if kind in ("blocking", "h2d", "d2h"):
-                    rule = "LK003" if kind == "blocking" else "LK004"
+                if kind in ("blocking", "h2d", "d2h", "forbidden"):
+                    rule = {"blocking": "LK003", "h2d": "LK004",
+                            "d2h": "LK004", "forbidden": "LK005"}[kind]
                     what = {"blocking": "blocking call",
                             "h2d": "host→device transfer",
-                            "d2h": "blocking device→host sync"}[kind]
+                            "d2h": "blocking device→host sync",
+                            "forbidden":
+                                "checkpoint save (forbidden under hot "
+                                "locks)"}[kind]
                     out.append(project.finding(
                         self.pass_id, rule, efi, enode,
                         f"{what} inside a function contracted to run "
@@ -388,4 +437,4 @@ class LockDisciplinePass:
 
 __all__ = ["LockDisciplinePass", "LockId", "PASS_ID",
            "DEFAULT_HOT_LOCKS", "DEFAULT_LOCK_CONTRACTS",
-           "DEFAULT_DEVICE_STATE_CLASSES"]
+           "DEFAULT_DEVICE_STATE_CLASSES", "DEFAULT_FORBIDDEN_UNDER_HOT"]

@@ -60,6 +60,10 @@ FAMILIES: Dict[str, Optional[Set[str]]] = {
     "flightrec": {"records", "anomalies", "snapshots", "suppressed_dumps"},
     "pipeline.bytes_copied": {"decode", "batch", "h2d"},
     "native": {"build_fallbacks"},
+    # crash-recovery surface (runtime/checkpoint.py + Instance.start):
+    # restore wall time, replayed-event count, replay wall time — the
+    # measured-RTO gauges the kill-point harness asserts on
+    "recovery": {"restore_s", "replay_events", "replay_s"},
 }
 # prefixes where EVERY name must resolve to a declared family (MN003)
 GOVERNED_PREFIXES = ("device.", "slo.")

@@ -665,9 +665,38 @@ def _pad(arr: np.ndarray, n: int, fill=0):
     return out
 
 
+def _state_to_arrays(state) -> Dict[str, np.ndarray]:
+    """Operator-state pytree → host arrays (the checkpoint payload)."""
+    return {f.name: np.asarray(getattr(state, f.name))
+            for f in dataclasses.fields(state)}
+
+
+def _state_from_arrays(empty, arrays: Dict[str, np.ndarray]):
+    """Rebuild an operator state from exported arrays, or None when the
+    field set / shapes no longer match the current operator (capacity or
+    ring length changed since the snapshot) — the caller keeps the empty
+    init and journal replay re-derives the open windows."""
+    flds = dataclasses.fields(empty)
+    if set(arrays) != {f.name for f in flds}:
+        return None
+    updates = {}
+    for f in flds:
+        cur = np.asarray(getattr(empty, f.name))
+        arr = np.asarray(arrays[f.name])
+        if tuple(arr.shape) != tuple(cur.shape):
+            return None
+        updates[f.name] = jnp.asarray(arr.astype(cur.dtype, copy=False))
+    return dataclasses.replace(empty, **updates)
+
+
 class CompiledQuery:
     """Base driver: pads batches to pow2 buckets (bounded recompiles),
     runs the jitted operator, extracts matches host-side."""
+
+    #: schema tag of export_state()'s array set — bump when the operator
+    #: state layout changes so a restore rejects stale snapshots instead
+    #: of resurrecting them into the wrong fields
+    STATE_VERSION = 1
 
     def __init__(self, spec, capacity: int, mtype_id: int = -1):
         self.spec = spec
@@ -681,6 +710,31 @@ class CompiledQuery:
     # subclasses: eval_cols(cols) -> List[QueryMatch]; flush() -> [...]
 
     def reset(self) -> None:
+        raise NotImplementedError
+
+    def export_state(self) -> Dict[str, np.ndarray]:
+        """Carried per-device operator state as host arrays — open
+        windows/rings, open sessions, CEP stages + window accumulators —
+        so a checkpoint preserves exactly what evaporates on kill."""
+        return _state_to_arrays(self._carried_state())
+
+    def import_state(self, arrays: Dict[str, np.ndarray]) -> bool:
+        """Adopt exported state; False resets to empty (shape/schema
+        drift) and the caller's journal replay re-derives it."""
+        state = _state_from_arrays(self._empty_state(), arrays)
+        if state is None:
+            self.reset()
+            return False
+        self._adopt_state(state)
+        return True
+
+    def _carried_state(self):
+        raise NotImplementedError
+
+    def _empty_state(self):
+        raise NotImplementedError
+
+    def _adopt_state(self, state) -> None:
         raise NotImplementedError
 
     def _prep(self, cols: Dict[str, np.ndarray]):
@@ -713,6 +767,15 @@ class CompiledWindowQuery(CompiledQuery):
 
     def reset(self) -> None:
         self.state = WindowOpState.empty(self.capacity, self.spec.length)
+
+    def _carried_state(self):
+        return self.state
+
+    def _empty_state(self):
+        return WindowOpState.empty(self.capacity, self.spec.length)
+
+    def _adopt_state(self, state) -> None:
+        self.state = state
 
     def _row_filter(self, et, mt, valid):
         ok = valid & (et == int(EventType.MEASUREMENT))
@@ -786,6 +849,15 @@ class CompiledSessionQuery(CompiledQuery):
     def reset(self) -> None:
         self.state = SessionOpState.empty(self.capacity)
 
+    def _carried_state(self):
+        return self.state
+
+    def _empty_state(self):
+        return SessionOpState.empty(self.capacity)
+
+    def _adopt_state(self, state) -> None:
+        self.state = state
+
     def eval_cols(self, cols: Dict[str, np.ndarray]) -> List[QueryMatch]:
         s = self.spec
         dev, ts, et, mt, val, valid = self._prep(cols)
@@ -849,6 +921,17 @@ class CompiledPatternQuery(CompiledQuery):
 
     def reset(self) -> None:
         self.evaluator.reset()
+
+    def _carried_state(self):
+        return self.evaluator.state
+
+    def _empty_state(self):
+        from sitewhere_tpu.analytics.cep import CepState
+
+        return CepState.empty(self.capacity)
+
+    def _adopt_state(self, state) -> None:
+        self.evaluator.state = state
 
     def eval_cols(self, cols: Dict[str, np.ndarray]) -> List[QueryMatch]:
         dev, ts, et, mt, val, valid = self._prep(cols)

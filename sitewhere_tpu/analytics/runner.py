@@ -549,7 +549,8 @@ class QueryRunner(LifecycleComponent):
     so priority connectors (alert notifiers) still see them under load.
     """
 
-    _LIVE_COLS = ("device_id", "ts_s", "event_type", "mtype_id", "value")
+    _LIVE_COLS = ("device_id", "ts_s", "event_type", "mtype_id", "value",
+                  "payload_ref")
 
     def __init__(self, capacity: int, resolve_mtype=None, event_store=None,
                  outbound=None, overload=None, metrics=None, tracer=None,
@@ -580,6 +581,31 @@ class QueryRunner(LifecycleComponent):
         self._m_retro_rows = metrics.counter("analytics.retro_rows")
         self._m_retro_runs = metrics.counter("analytics.retro_runs")
         self._m_occupancy = metrics.gauge("analytics.window_occupancy")
+        self._m_replay_skipped = metrics.counter(
+            "analytics.replay_rows_skipped")
+        # Crash-recovery position (the per-component offset contract,
+        # runtime/checkpoint.py).  `applied_upto` is the COMMITTED
+        # journal offset stamped on the latest evaluated batch: queue
+        # order guarantees every row of every record below it has fully
+        # evaluated (commit happens after egress, offers happen during
+        # egress, the queue is FIFO).  A record AT or above it can be
+        # partially applied — the batcher may split one journal
+        # record's rows across plans — so `_applied_partial` tracks the
+        # applied-row count per journaled ref above the watermark
+        # (pruned as the watermark passes them; rows of one record
+        # arrive in stable order, so a count IS a prefix).  A snapshot
+        # stores both; restore sets `replay_floor` + `_replay_partial`,
+        # and submit_live drops replayed rows below the floor outright
+        # and the first `count` rows of each partial ref — row-exact,
+        # so restore + replay converges to the uninterrupted run's
+        # state (batch-split invariance supplies the rest).  Rows lost
+        # to queue-full drops or overload sheds are counted as applied
+        # once the watermark passes them — the uninterrupted run lost
+        # them too (shed semantics are unchanged by recovery).
+        self.applied_upto: Optional[int] = None
+        self.replay_floor = 0
+        self._applied_partial: Dict[int, int] = {}
+        self._replay_partial: Dict[int, int] = {}
         self._lock = threading.RLock()
         # serializes mutation of compiled live state: the worker's
         # eval_cols vs flush_live's flush()/reset() (REST thread) —
@@ -625,7 +651,6 @@ class QueryRunner(LifecycleComponent):
         operator immediately so a bad spec fails the POST, not the
         first batch."""
         from sitewhere_tpu.analytics.query import compile_query, parse_query
-        from sitewhere_tpu.runtime.metrics import sanitize_metric_name
         from sitewhere_tpu.services.common import ValidationError
 
         try:
@@ -634,13 +659,7 @@ class QueryRunner(LifecycleComponent):
                                      resolve_mtype=self.resolve_mtype)
         except ValueError as e:
             raise ValidationError(str(e)) from e
-        tag = sanitize_metric_name(f"analytics.q.{spec.name}").split(
-            ".", 2)[-1]
-        entry = _LiveQuery(
-            spec, compiled, self.max_matches,
-            timer=self.metrics.timer(f"analytics.eval_s.{tag}"),
-            retro_timer=self.metrics.timer(f"analytics.retro_s.{tag}"),
-            counter=self.metrics.counter(f"analytics.matches.{tag}"))
+        entry = self._make_entry(spec, compiled)
         with self._lock:
             # distinct names must not silently share metric instruments
             # through name sanitization ("temp high" vs "temp-high")
@@ -658,6 +677,98 @@ class QueryRunner(LifecycleComponent):
             self._queries[spec.name] = entry
             self._m_queries.set(len(self._queries))
         return self.describe(spec.name)
+
+    def _make_entry(self, spec, compiled) -> "_LiveQuery":
+        from sitewhere_tpu.runtime.metrics import sanitize_metric_name
+
+        tag = sanitize_metric_name(f"analytics.q.{spec.name}").split(
+            ".", 2)[-1]
+        return _LiveQuery(
+            spec, compiled, self.max_matches,
+            timer=self.metrics.timer(f"analytics.eval_s.{tag}"),
+            retro_timer=self.metrics.timer(f"analytics.retro_s.{tag}"),
+            counter=self.metrics.counter(f"analytics.matches.{tag}"))
+
+    # -- checkpoint integration (runtime/checkpoint.py StateProvider) -------
+
+    def snapshot_state(self):
+        """Checkpoint payload: every registered spec + its compiled
+        per-device operator state (open windows/rings, open sessions,
+        CEP stages and window accumulators) plus the exact journal
+        offset the state is consistent as-of.  Drains the eval queue
+        first (bounded) so ``applied_upto`` covers everything already
+        offered; the eval mutex keeps state↔offset pairing atomic."""
+        import pickle
+
+        from sitewhere_tpu.analytics.query import describe_query
+
+        self.drain(timeout_s=2.0)
+        with self._eval_mutex:
+            with self._lock:
+                entries = [self._queries[n] for n in sorted(self._queries)]
+            queries = [{
+                "spec": e.spec,
+                "doc": describe_query(e.spec),
+                "state_version": int(getattr(e.compiled, "STATE_VERSION",
+                                             1)),
+                "arrays": e.compiled.export_state(),
+            } for e in entries]
+            as_of = self.applied_upto
+            partial = dict(self._applied_partial)
+        return (pickle.dumps({"queries": queries, "partial": partial},
+                             protocol=4),
+                {"as_of": as_of, "queries": len(queries)})
+
+    def restore_state(self, header, payload) -> int:
+        """Re-register every snapshotted query and adopt its operator
+        state (checkpoint restore; payload already CRC/version-checked).
+        A query whose state no longer fits (capacity/schema drift)
+        re-registers with FRESH state — journal replay from ``as_of``
+        cannot rebuild it, so the reset is logged loudly.  Returns the
+        number of queries restored."""
+        import pickle
+
+        from sitewhere_tpu.analytics.query import compile_query
+
+        doc = pickle.loads(payload)
+        restored = 0
+        for q in doc.get("queries", []):
+            spec = q.get("spec")
+            try:
+                compiled = compile_query(spec, self.capacity,
+                                         resolve_mtype=self.resolve_mtype)
+            except Exception:
+                logging.getLogger("sitewhere_tpu.analytics").exception(
+                    "restored query %s no longer compiles; dropped",
+                    getattr(spec, "name", "?"))
+                continue
+            if int(q.get("state_version", 1)) != int(
+                    getattr(compiled, "STATE_VERSION", 1)):
+                logging.getLogger("sitewhere_tpu.analytics").warning(
+                    "query %s snapshot state version %s != %s; state "
+                    "reset (open windows lost)", spec.name,
+                    q.get("state_version"), compiled.STATE_VERSION)
+            elif not compiled.import_state(q.get("arrays") or {}):
+                logging.getLogger("sitewhere_tpu.analytics").warning(
+                    "query %s operator shape changed since the snapshot; "
+                    "state reset (open windows lost)", spec.name)
+            entry = self._make_entry(spec, compiled)
+            with self._lock:
+                self._queries[spec.name] = entry
+                self._m_queries.set(len(self._queries))
+            restored += 1
+        as_of = header.get("as_of")
+        if as_of is not None:
+            self.replay_floor = int(as_of)
+            self.applied_upto = int(as_of)
+        # partially-applied records above the floor: replay must drop
+        # exactly the applied prefix of each (and a LATER checkpoint
+        # must keep counting it — the restored state contains it)
+        partial = {int(k): int(v)
+                   for k, v in (doc.get("partial") or {}).items()}
+        self._replay_partial = dict(partial)
+        self._applied_partial = dict(partial)
+        return restored
 
     def describe(self, name: str) -> Dict[str, object]:
         from sitewhere_tpu.analytics.query import describe_query
@@ -715,10 +826,17 @@ class QueryRunner(LifecycleComponent):
     # -- live mode ----------------------------------------------------------
 
     def submit_live(self, cols: Dict[str, np.ndarray], mask: np.ndarray,
-                    trace=None) -> None:
+                    trace=None, committed: Optional[int] = None) -> None:
         """Offer one accepted enriched batch (non-blocking; called from
-        dispatcher egress).  Sheds as a non-priority consumer from
-        SHEDDING up; drops (counted) when the eval queue is full."""
+        dispatcher egress, which stamps its committed journal offset).
+        Sheds as a non-priority consumer from SHEDDING up; drops
+        (counted) when the eval queue is full.  During crash-recovery
+        replay, rows already inside the restored operator state — below
+        the restored ``replay_floor``, or within a partial record's
+        applied prefix — are dropped row-exactly (counted): the
+        restored≡uninterrupted equivalence hinge."""
+        from sitewhere_tpu.ids import NULL_ID
+
         with self._lock:
             if not self._queries:
                 return
@@ -728,10 +846,51 @@ class QueryRunner(LifecycleComponent):
             return
         mask = np.asarray(mask)
         # boolean fancy-indexing already yields fresh arrays — no
-        # second copy on the egress path
-        batch = {k: np.asarray(cols[k])[mask] for k in self._LIVE_COLS}
+        # second copy on the egress path.  The five event columns stay
+        # MANDATORY (a malformed egress batch must fail loudly here,
+        # not as a swallowed worker exception); only payload_ref is
+        # synthesized for synthetic/test batches.
+        batch = {k: np.asarray(cols[k])[mask] for k in self._LIVE_COLS
+                 if k != "payload_ref"}
+        if "payload_ref" in cols:
+            batch["payload_ref"] = np.asarray(cols["payload_ref"])[mask]
+        else:
+            batch["payload_ref"] = np.full(
+                len(batch["device_id"]), NULL_ID, np.int32)
+        refs = batch["payload_ref"]
+        journaled = refs != NULL_ID
+        stale = np.zeros(len(refs), bool)
+        if self.replay_floor > 0:
+            stale |= journaled & (refs < self.replay_floor)
+        if self._replay_partial:
+            # drop the first `remaining` re-offered rows of each
+            # partially-applied record (rows of one record replay in
+            # the same stable order they were applied in)
+            for ref in np.unique(refs[journaled & ~stale]):
+                remaining = self._replay_partial.get(int(ref))
+                if not remaining:
+                    continue
+                idx = np.nonzero(refs == ref)[0][:remaining]
+                stale[idx] = True
+                if remaining > len(idx):
+                    self._replay_partial[int(ref)] = remaining - len(idx)
+                else:
+                    del self._replay_partial[int(ref)]
+        n_stale = int(stale.sum())
+        if n_stale:
+            self._m_replay_skipped.inc(n_stale)
+            keep = ~stale
+            batch = {k: v[keep] for k, v in batch.items()}
+            refs = batch["payload_ref"]
+            journaled = refs != NULL_ID
+            if not len(refs):
+                return
+        tally = ()
+        if journaled.any():
+            uniq, counts = np.unique(refs[journaled], return_counts=True)
+            tally = tuple(zip(uniq.tolist(), counts.tolist()))
         try:
-            self._q.put_nowait(batch)
+            self._q.put_nowait((batch, tally, committed))
         except Exception:
             self._m_dropped.inc()
 
@@ -767,36 +926,55 @@ class QueryRunner(LifecycleComponent):
     def _worker(self) -> None:
         while not self._stop.is_set():
             try:
-                batch = self._q.get(timeout=0.1)
+                item = self._q.get(timeout=0.1)
             except Exception:
                 continue
             try:
-                if batch is None:
+                if item is None:
                     continue
                 self._m_batches.inc()
-                self._eval_batch(batch)
+                self._eval_batch(*item)
             except Exception:
                 logging.getLogger("sitewhere_tpu.analytics").exception(
                     "live analytics eval failed")
             finally:
                 self._q.task_done()
 
-    def _eval_batch(self, batch: Dict[str, np.ndarray]) -> None:
+    def _eval_batch(self, batch: Dict[str, np.ndarray],
+                    tally=(), committed: Optional[int] = None) -> None:
         from sitewhere_tpu.runtime.tracing import _NOOP_TRACE
 
         with self._lock:
             entries = list(self._queries.values())
         trace = (self.tracer.trace("analytics.eval")
                  if self.tracer is not None else _NOOP_TRACE)
-        for entry in entries:
-            with trace.span("analytics.query") as sp:
-                sp.tag("query", entry.spec.name)
-                sp.tag("rows", int(len(batch["device_id"])))
-                with entry.timer.time(), self._eval_mutex:
-                    matches = entry.compiled.eval_cols(batch)
-            occ = getattr(entry.compiled, "last_occupancy", None)
-            if occ is not None:
-                self._m_occupancy.set(occ)
+        results = []
+        # ONE mutex hold for the whole batch: every query's state, the
+        # per-record applied counts, and the fully-applied watermark
+        # advance together, so a checkpoint (snapshot_state holds the
+        # same mutex) can never pair query A's post-batch state with
+        # query B's pre-batch state, or either with the wrong offset.
+        with self._eval_mutex:
+            for entry in entries:
+                with trace.span("analytics.query") as sp:
+                    sp.tag("query", entry.spec.name)
+                    sp.tag("rows", int(len(batch["device_id"])))
+                    with entry.timer.time():
+                        matches = entry.compiled.eval_cols(batch)
+                occ = getattr(entry.compiled, "last_occupancy", None)
+                if occ is not None:
+                    self._m_occupancy.set(occ)
+                results.append((entry, matches))
+            for ref, count in tally:
+                self._applied_partial[ref] = \
+                    self._applied_partial.get(ref, 0) + count
+            if committed is not None \
+                    and committed > (self.applied_upto or 0):
+                self.applied_upto = committed
+                for ref in [r for r in self._applied_partial
+                            if r < committed]:
+                    del self._applied_partial[ref]
+        for entry, matches in results:
             self._record(entry, matches, live=True)
         trace.end()
 

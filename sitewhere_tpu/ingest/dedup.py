@@ -42,3 +42,18 @@ class AlternateIdDeduplicator:
         if len(self._seen) > self.window:
             self._seen.popitem(last=False)
         return False
+
+    # -- checkpoint integration (runtime/checkpoint.py) ---------------------
+
+    def export_keys(self) -> list:
+        """LRU keys, oldest first — the checkpoint payload.  Hashes only
+        (the raw alternate ids were never retained)."""
+        return list(self._seen.keys())
+
+    def import_keys(self, keys) -> None:
+        """Re-seed the window from exported keys (restore): a restarted
+        instance keeps catching duplicates the window had already seen
+        instead of re-admitting them until the LRU refills."""
+        self._seen.clear()
+        for key in keys[-self.window:]:
+            self._seen[int(key)] = None

@@ -216,6 +216,14 @@ class PipelineDispatcher(LifecycleComponent):
         # deployment with binary/composite sources passes its own).
         self.recovery_decoder = recovery_decoder
         self._max_egressed_ref = -1
+        # Crash-recovery store dedup (runtime/checkpoint.py offset
+        # contract): rows whose journal offset is below this floor are
+        # durably in the event store already (the commit gate seals
+        # BEFORE the offset commits), so a replay that starts below the
+        # committed offset — rebuilding volatile component state from an
+        # older snapshot — re-runs their state/analytics effects WITHOUT
+        # duplicating persistence.  0 = inactive; set by replay_journal.
+        self.store_dedup_floor = 0
         # Plans emitted by the batcher whose egress has not completed.
         # Guarded by _lock; the commit gate requires it to be zero so a
         # plan sitting between emission and _run_plan (outside both
@@ -739,6 +747,9 @@ class PipelineDispatcher(LifecycleComponent):
         ref = NULL_ID
         if self.journal is not None and payload:
             ref = self.journal.append(payload)
+            # chaos kill point: journaled, never batched — the record is
+            # the durable truth and MUST reappear via replay
+            faults.crosspoint("crash.post_journal")
         from sitewhere_tpu.ingest.decoders import RequestKind
 
         for req in host_reqs:
@@ -781,6 +792,8 @@ class PipelineDispatcher(LifecycleComponent):
         ref = NULL_ID
         if self.journal is not None and payload:
             ref = self.journal.append(payload)
+            # chaos kill point: same contract as ingest_wire_decoded's
+            faults.crosspoint("crash.post_journal")
         res.set_const(tenant_id=self.resolve_tenant("default"),
                       payload_ref=ref)
         self._run_plans(self._take(res.commit))
@@ -1025,7 +1038,8 @@ class PipelineDispatcher(LifecycleComponent):
                     reader.commit(upto)
 
     def replay_journal(self, decoder=None, max_records: int = 4096,
-                       upto: Optional[int] = None) -> int:
+                       upto: Optional[int] = None,
+                       from_offset: Optional[int] = None) -> int:
         """Re-ingest journal records past the committed offset (crash
         recovery, at-least-once — ``MicroserviceKafkaConsumer.java:116-139``).
 
@@ -1034,7 +1048,12 @@ class PipelineDispatcher(LifecycleComponent):
         original offsets as ``payload_ref``.  Undecodable records
         dead-letter.  ``upto`` (exclusive) bounds the replay — pass the
         journal end captured before live sources start so a racing fresh
-        append is never double-ingested.  Returns replayed event rows.
+        append is never double-ingested.  ``from_offset`` starts the
+        replay BELOW the committed offset (the checkpoint restore's
+        per-component replay floor): those records re-run state and
+        analytics effects but skip event-store persistence (they are
+        durably stored already — ``store_dedup_floor``).  Returns
+        replayed event rows.
         """
         reader = self.journal_reader
         if reader is None:
@@ -1052,7 +1071,14 @@ class PipelineDispatcher(LifecycleComponent):
         # disables the fast path outright.
         use_columnar = decoder is None and self.recovery_decoder is None
         decoder = decoder or self.recovery_decoder or JsonLinesDecoder()
-        reader.seek(reader.committed)
+        start = reader.committed
+        if from_offset is not None:
+            start = min(start, max(0, int(from_offset)))
+        # rows below the committed offset sealed before that offset
+        # committed — replaying them must not duplicate persistence
+        self.store_dedup_floor = max(self.store_dedup_floor,
+                                     reader.committed)
+        reader.seek(start)
         n = 0
         done = False
         while not done:
@@ -1089,6 +1115,15 @@ class PipelineDispatcher(LifecycleComponent):
             logger.info("replayed %d journaled events past offset %d",
                         n, reader.committed)
         self.flush()
+        with self._lock:
+            quiesced = (self._plans_outstanding == 0
+                        and self.batcher.pending == 0
+                        and not self._egress_busy)
+        if quiesced:
+            # every replayed sub-committed row has egressed; retire the
+            # dedup mask so live egress stops paying for it (a timed-out
+            # flush keeps the floor — correctness over the nanoseconds)
+            self.store_dedup_floor = 0
         return n
 
     def _replay_columnar(self, payload: bytes, offset: int) -> Optional[int]:
@@ -1334,6 +1369,9 @@ class PipelineDispatcher(LifecycleComponent):
                 [s[0] for s in slots], [s[1] for s in slots])
         start_host_copy(ois, mets, on_error=self._on_host_copy_error)
         ctrace.end()
+        # chaos kill point: the K-step chain dispatched and committed on
+        # device, but NO slot has egressed — every ring plan must replay
+        faults.crosspoint("crash.mid_ring")
         chain_dt = time.perf_counter() - t0
         self._m_stage["ring_dispatch"].observe(chain_dt)
         self._m_ring_chains.inc()
@@ -1637,12 +1675,21 @@ class PipelineDispatcher(LifecycleComponent):
             self._max_egressed_ref = max(
                 self._max_egressed_ref, int(refs[journaled].max()))
 
-        # 1. persistence (event-management analog)
-        if self.event_store is not None and accepted.any():
+        # 1. persistence (event-management analog).  Replay below the
+        # committed offset (checkpoint-restore floor) skips rows already
+        # durably stored — their state/analytics effects still re-run.
+        store_mask = accepted
+        if self.store_dedup_floor > 0:
+            store_mask = accepted & ((refs == NULL_ID)
+                                     | (refs >= self.store_dedup_floor))
+        if self.event_store is not None and store_mask.any():
             with trace.span("egress.persist").tag(
-                    "rows", int(getattr(m, "accepted"))):
-                self.event_store.append_columns(cols, mask=accepted)
+                    "rows", int(store_mask.sum())):
+                self.event_store.append_columns(cols, mask=store_mask)
             self._m_seal.set(time.monotonic() - ingest_t0)
+        # chaos kill point: stored (possibly sealed) but the offset
+        # commit below never runs — a restart must replay this plan
+        faults.crosspoint("crash.mid_egress")
 
         # 2. enriched fan-out (outbound connectors + rule processor hosts)
         #    — the trace rides along so the async delivery span joins it
@@ -1656,7 +1703,15 @@ class PipelineDispatcher(LifecycleComponent):
         #     non-priority consumer — see QueryRunner.submit_live)
         if self.analytics is not None and accepted.any():
             with trace.span("egress.analytics"):
-                self.analytics.submit_live(cols, accepted, trace=trace)
+                # the committed offset rides along as the runner's
+                # fully-applied watermark: queue order guarantees every
+                # batch carrying rows of records below it was offered
+                # (and thus evaluates) before this one
+                self.analytics.submit_live(
+                    cols, accepted, trace=trace,
+                    committed=(int(self.journal_reader.committed)
+                               if self.journal_reader is not None
+                               else None))
 
         # 3. command invocations (command-delivery analog)
         cmd_mask = accepted & (cols["event_type"] == EventType.COMMAND_INVOCATION)

@@ -28,12 +28,23 @@ Typical test usage::
                          exc=DecodeError("injected")):
         ...  # 4th decode raises; earlier/later ones pass
 
+Crosspoints (kill points) are the harsher sibling of :func:`fire`: a
+:func:`crosspoint` call SIGKILLs the whole process when armed — no
+``finally`` blocks, no flushes, no atexit — which is exactly the failure
+the crash-recovery contract promises to survive.  They are armed from
+the ENVIRONMENT (``SW_CRASHPOINT="crash.mid_ring:3"`` = die on the 3rd
+hit of that point), so a chaos harness can fork a child instance and
+schedule its death without any cooperation from the child's code, or
+programmatically via :func:`arm_crosspoint` for same-process tests that
+only want the hit accounting.  Disarmed cost is one string compare.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 import random
+import signal
 import threading
 from typing import Dict, Iterator, Optional, Union
 
@@ -46,6 +57,10 @@ __all__ = [
     "hits",
     "fired",
     "injected",
+    "crosspoint",
+    "arm_crosspoint",
+    "disarm_crosspoint",
+    "crosspoint_hits",
 ]
 
 
@@ -172,3 +187,71 @@ def injected(point: str, exc: ExcSpec = FaultInjected, *,
         yield
     finally:
         clear(point)
+
+
+# ---------------------------------------------------------------------------
+# crosspoints: named SIGKILL points for the crash-recovery harness
+# ---------------------------------------------------------------------------
+
+# One armed point per process (a kill fires once, by definition).  The
+# disarmed fast path in crosspoint() is a single `!=` against None.
+_kill_point: Optional[str] = None
+_kill_after = 1          # die on the Nth hit (1 = first)
+_kill_hits = 0
+_kill_signal = signal.SIGKILL
+_kill_dry_run = False    # tests: count hits, don't die
+
+
+def _parse_crosspoint_env() -> None:
+    """Arm from ``SW_CRASHPOINT="point[:n]"`` — read once at import so a
+    forked chaos child needs zero in-process cooperation."""
+    spec = os.environ.get("SW_CRASHPOINT")
+    if not spec:
+        return
+    point, _, n = spec.partition(":")
+    try:
+        after = max(1, int(n)) if n else 1
+    except ValueError:
+        after = 1
+    arm_crosspoint(point.strip(), after_n=after)
+
+
+def arm_crosspoint(point: str, after_n: int = 1, *,
+                   dry_run: bool = False) -> None:
+    """Arm ``point``: the ``after_n``-th :func:`crosspoint` hit SIGKILLs
+    this process (``dry_run`` counts instead — unit tests)."""
+    global _kill_point, _kill_after, _kill_hits, _kill_dry_run
+    _kill_after = max(1, int(after_n))
+    _kill_hits = 0
+    _kill_dry_run = bool(dry_run)
+    _kill_point = point
+
+
+def disarm_crosspoint() -> None:
+    global _kill_point
+    _kill_point = None
+
+
+def crosspoint_hits() -> int:
+    return _kill_hits
+
+
+def crosspoint(point: str) -> None:
+    """Kill-point hook: SIGKILL self when ``point`` is the armed
+    crosspoint and its hit count is due.  Safe to call from any hot
+    path — disarmed cost is one comparison, and the armed path never
+    raises (the process simply ceases)."""
+    global _kill_hits
+    if point != _kill_point:
+        return
+    _kill_hits += 1
+    if _kill_hits < _kill_after:
+        return
+    if _kill_dry_run:
+        return
+    # flush nothing, close nothing: the contract under test is that the
+    # durable state alone (journal + snapshot generations) recovers
+    os.kill(os.getpid(), _kill_signal)
+
+
+_parse_crosspoint_env()

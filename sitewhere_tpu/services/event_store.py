@@ -867,6 +867,10 @@ class EventStore(LifecycleComponent):
             for chunk, part, path in work:
                 try:
                     faults.fire("event_store.flush")
+                    # chaos kill point: death mid-seal leaves a partial
+                    # chunk file; boot must tolerate it and journal
+                    # replay must re-derive the chunk's rows
+                    faults.crosspoint("crash.mid_seal")
                     self._write_chunk_file(path, part, chunk, sync=False)
                 except OSError as e:
                     now = time.monotonic()

@@ -1,0 +1,14 @@
+"""TN: the checkpoint save runs after the hot lock is released."""
+import threading
+
+
+class Hot:
+    def __init__(self, checkpointer):
+        self._lock = threading.Lock()
+        self.checkpointer = checkpointer
+        self.committed = 0
+
+    def commit_and_snapshot(self):
+        with self._lock:
+            self.committed += 1
+        self.checkpointer.save()

@@ -1,0 +1,12 @@
+"""LK005: Checkpointer.save invoked while a hot-path lock is held."""
+import threading
+
+
+class Hot:
+    def __init__(self, checkpointer):
+        self._lock = threading.Lock()
+        self.checkpointer = checkpointer
+
+    def commit_and_snapshot(self):
+        with self._lock:
+            self.checkpointer.save()

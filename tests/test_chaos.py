@@ -1029,3 +1029,186 @@ class TestOverloadChaos:
         finally:
             inst.stop()
             inst.terminate()
+
+
+# ---------------------------------------------------------------------------
+# crash-consistent recovery (ISSUE 12): crosspoints + kill-mid-ring restart
+# ---------------------------------------------------------------------------
+
+class TestCrosspoints:
+    """runtime.faults crosspoints: named SIGKILL points.  Unit tests run
+    dry (hit accounting only) — actually dying is the harness's job."""
+
+    def teardown_method(self):
+        faults.disarm_crosspoint()
+
+    def test_disarmed_is_noop(self):
+        faults.disarm_crosspoint()
+        faults.crosspoint("crash.mid_ring")  # must not raise or count
+
+    def test_dry_run_counts_hits_after_n(self):
+        faults.arm_crosspoint("crash.mid_seal", after_n=3, dry_run=True)
+        for _ in range(5):
+            faults.crosspoint("crash.mid_seal")
+        assert faults.crosspoint_hits() == 5  # counted, never died
+        faults.crosspoint("crash.other")      # different point: ignored
+        assert faults.crosspoint_hits() == 5
+
+    def test_env_spec_parsing(self, monkeypatch):
+        monkeypatch.setenv("SW_CRASHPOINT", "crash.mid_egress:4")
+        faults._parse_crosspoint_env()
+        # armed for the 4th hit — but dry-run was not requested, so we
+        # only verify the arming state, never cross it
+        assert faults._kill_point == "crash.mid_egress"
+        assert faults._kill_after == 4
+        faults.disarm_crosspoint()
+
+
+class TestKillMidRingRecovery:
+    def test_kill_mid_ring_replay_is_bit_identical(self, tmp_path):
+        """ISSUE 12 satellite: kill after the K-step chain dispatched
+        but before ANY slot egressed (journal offset never moved), then
+        a TRUE restart — fresh Instance on the survivor's data dir.  The
+        replayed uncommitted slots must produce bit-identical device
+        state to an un-killed control run, and the store must hold every
+        journaled row exactly once."""
+        from dataclasses import fields as dataclass_fields
+
+        from sitewhere_tpu.instance import Instance
+
+        width = 64
+
+        def payload(r):
+            return "\n".join(
+                _measurement_line(f"d-{i}", float((r * width + i) % 37),
+                                  1_753_860_000 + r * width + i)
+                for i in range(width)).encode()
+
+        def seed(inst):
+            inst.device_management.create_device_type(
+                token="sensor", name="Sensor")
+            for i in range(width):
+                inst.device_management.create_device(
+                    token=f"d-{i}", device_type="sensor")
+                inst.device_management.create_device_assignment(
+                    device=f"d-{i}")
+
+        # control: same traffic, never killed
+        ctrl = Instance(_instance_config(
+            tmp_path / "ctrl", egress_offload=True, ring_depth=2,
+            deadline_ms=60_000.0))
+        ctrl.start()
+        try:
+            seed(ctrl)
+            ctrl.dispatcher.ingest_wire_lines(payload(0))
+            ctrl.dispatcher.ingest_wire_lines(payload(1))
+            ctrl.dispatcher.flush()
+            ctrl.event_store.flush()
+            golden_state = {
+                f.name: np.asarray(getattr(ctrl.device_state.current,
+                                           f.name))
+                for f in dataclass_fields(ctrl.device_state.current)}
+            golden_tokens = {
+                f"d-{i}": ctrl.identity.device.lookup(f"d-{i}")
+                for i in range(width)}
+        finally:
+            ctrl.stop()
+            ctrl.terminate()
+
+        # victim: model checkpointed (the anchor), then a 2-deep ring
+        # chain dispatches and BOTH slots fail egress — the journal
+        # offset never moves, exactly the mid-ring kill window.  The
+        # dry-run crosspoint proves the harness's kill point is crossed
+        # on this path.
+        a = Instance(_instance_config(
+            tmp_path / "victim", egress_offload=True, ring_depth=2,
+            deadline_ms=60_000.0))
+        a.start()
+        seed(a)
+        a.dispatcher.flush()
+        a.checkpointer.save()
+        faults.arm_crosspoint("crash.mid_ring", dry_run=True)
+        faults.inject("dispatcher.egress", times=2)
+        a.dispatcher.ingest_wire_lines(payload(0))
+        a.dispatcher.ingest_wire_lines(payload(1))
+        assert _wait(lambda: faults.fired("dispatcher.egress") == 2)
+        assert faults.crosspoint_hits() >= 1, \
+            "crash.mid_ring crosspoint not on the chain-dispatch path"
+        faults.disarm_crosspoint()
+        faults.clear()
+        a.event_store.flush()
+        assert a.event_store.total_events == 0       # nothing egressed
+        assert a.dispatcher.journal_reader.committed == 0
+        assert a.ingest_journal.end_offset == 2      # both journaled
+        a.ingest_journal.close()
+        a.dead_letters.close()
+        del a  # simulated SIGKILL — no stop, no final checkpoint
+
+        b = Instance(_instance_config(
+            tmp_path / "victim", egress_offload=True, ring_depth=2,
+            deadline_ms=60_000.0))
+        assert b.restored
+        b.start()  # replays both uncommitted journal records
+        try:
+            b.dispatcher.flush()
+            b.event_store.flush()
+            # zero committed-event loss, exactly once
+            assert b.event_store.total_events == 2 * width
+            assert b.dispatcher.journal_reader.committed == 2
+            assert b.metrics.snapshot()["gauges"][
+                "recovery.replay_events"] == 2 * width
+            # identity survived the anchor checkpoint: same handles
+            for i in range(width):
+                assert b.identity.device.lookup(f"d-{i}") \
+                    == golden_tokens[f"d-{i}"]
+            # bit-identical device state vs the un-killed control
+            for f in dataclass_fields(b.device_state.current):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(b.device_state.current, f.name)),
+                    golden_state[f.name],
+                    err_msg=f"device_state.{f.name} diverged after "
+                            f"kill-mid-ring recovery")
+        finally:
+            b.stop()
+            b.terminate()
+
+
+class TestCrashRecBench:
+    """tools/crashrec_bench.py: the kill-point harness itself."""
+
+    def _run(self, *args, timeout=560):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env.pop("SW_CRASHPOINT", None)
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        return subprocess.run(
+            [sys.executable, os.path.join(root, "tools",
+                                          "crashrec_bench.py"), *args],
+            capture_output=True, text=True, timeout=timeout, env=env)
+
+    def test_smoke_three_fixed_kill_points(self, tmp_path):
+        """Tier-1: SIGKILL at mid-ring, mid-egress and pre-manifest on a
+        small journal; every kill must recover with zero committed-event
+        loss, golden-equal analytics, and exported recovery gauges."""
+        res = self._run("--smoke", "--json",
+                        str(tmp_path / "crashrec.json"))
+        assert res.returncode == 0, res.stdout + res.stderr
+        doc = json.loads((tmp_path / "crashrec.json").read_text())
+        assert doc["ok"] and doc["summary"]["killed"] == 3
+        for kill in doc["kills"]:
+            assert kill["killed"] and not kill["failures"]
+            assert kill["restore_s"] is not None
+
+    @pytest.mark.slow
+    def test_randomized_sweep(self, tmp_path):
+        """Slow gate: a small randomized sweep across the full kill-point
+        catalog (the ≥50-point acceptance sweep is the tool's own
+        ``--sweep 50``; CRASHREC_r01.json records one)."""
+        res = self._run("--sweep", "6", "--seed", "1234", "--json",
+                        str(tmp_path / "crashrec.json"))
+        assert res.returncode == 0, res.stdout + res.stderr
+        doc = json.loads((tmp_path / "crashrec.json").read_text())
+        assert doc["ok"] and doc["summary"]["killed"] == 6

@@ -384,3 +384,434 @@ def test_replay_columnar_fast_path_matches_scalar_semantics(tmp_path):
     finally:
         b.stop()
         b.terminate()
+
+
+# ---------------------------------------------------------------------------
+# crash-consistent recovery (ISSUE 12): CRC-framed sections, torn-snapshot
+# fallback, version gates, per-component offsets, analytics state
+# ---------------------------------------------------------------------------
+
+def test_framed_section_roundtrip_and_corruption(tmp_path):
+    """write_framed/read_framed: the CRC framing detects every torn-file
+    shape as SnapshotCorrupt (ONE exception type — the restore fallback
+    catches exactly it), never a decoder-specific crash."""
+    from sitewhere_tpu.runtime.checkpoint import (
+        SnapshotCorrupt,
+        read_framed,
+        write_framed,
+    )
+
+    path = str(tmp_path / "x.swsnap")
+    write_framed(path, {"component": "x", "version": 3}, b"payload-bytes")
+    header, payload = read_framed(path, component="x")
+    assert header == {"component": "x", "version": 3}
+    assert payload == b"payload-bytes"
+
+    with pytest.raises(SnapshotCorrupt):  # component tag mismatch
+        read_framed(path, component="y")
+
+    blob = open(path, "rb").read()
+    torn = bytearray(blob)
+    torn[-1] ^= 0xFF                       # bit rot in the payload
+    open(path, "wb").write(bytes(torn))
+    with pytest.raises(SnapshotCorrupt):
+        read_framed(path)
+
+    open(path, "wb").write(blob[: len(blob) // 2])  # truncated write
+    with pytest.raises(SnapshotCorrupt):
+        read_framed(path)
+
+    open(path, "wb").write(b"not a snapshot at all")
+    with pytest.raises(SnapshotCorrupt):
+        read_framed(path)
+
+    with pytest.raises(SnapshotCorrupt):   # missing file
+        read_framed(str(tmp_path / "gone.swsnap"))
+
+
+def test_torn_generation_falls_back_to_previous_complete(tmp_path):
+    """A newer generation whose stores section is bit-rotted must be
+    DETECTED (CRC) and abandoned: restore comes up on the previous
+    complete generation instead of crashing or half-hydrating."""
+    import os
+
+    a = Instance(_cfg(tmp_path))
+    a.start()
+    a.device_management.create_device_type(token="sensor", name="Sensor")
+    a.device_management.create_device(token="dev-old", device_type="sensor")
+    a.checkpointer.save()
+    gen_good = a.checkpointer.generation
+    a.device_management.create_device(token="dev-new", device_type="sensor")
+    a.checkpointer.save()
+    gen_torn = a.checkpointer.generation
+    assert gen_torn == gen_good + 1
+
+    # bit-rot the newer generation's stores section mid-file
+    stores = os.path.join(a.checkpointer.dir,
+                          f"stores-{gen_torn:08d}.swsnap")
+    blob = bytearray(open(stores, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(stores, "wb").write(bytes(blob))
+    a.ingest_journal.close()
+    a.dead_letters.close()
+    del a  # simulated kill
+
+    b = Instance(_cfg(tmp_path))
+    assert b.restored
+    assert b.checkpointer.restored_generation == gen_good
+    assert b.device_management.get_device("dev-old") is not None
+    # dev-new was only in the torn generation — re-derivable, not
+    # resurrected from a corrupt file
+    from sitewhere_tpu.services.common import EntityNotFound
+
+    with pytest.raises(EntityNotFound):
+        b.device_management.get_device("dev-new")
+    b.terminate()
+
+
+def test_unsupported_section_version_skips_not_crashes(tmp_path):
+    """A section whose schema/version tag no longer matches what the
+    provider speaks is SKIPPED with a log line — the rest of the
+    generation restores and boot completes (never a mid-boot raise on a
+    stale pickle)."""
+    import os
+
+    from sitewhere_tpu.runtime.checkpoint import read_framed, write_framed
+
+    a = Instance(_cfg(tmp_path))
+    a.start()
+    a.device_management.create_device_type(token="sensor", name="Sensor")
+    a.device_management.create_device(token="dev-a", device_type="sensor")
+    a.analytics.register({
+        "kind": "window", "name": "w-mean", "mtype": "temp",
+        "agg": "mean", "op": "gt", "threshold": 5.0, "windowS": 60})
+    a.checkpointer.save()
+    gen = a.checkpointer.generation
+
+    # rewrite the analytics section claiming a future schema version
+    path = os.path.join(a.checkpointer.dir,
+                        f"analytics-{gen:08d}.swsnap")
+    header, payload = read_framed(path, component="analytics")
+    header["version"] = 99
+    write_framed(path, header, payload)
+    a.ingest_journal.close()
+    a.dead_letters.close()
+    del a  # simulated kill
+
+    b = Instance(_cfg(tmp_path))
+    assert b.restored  # the generation itself is fine
+    b.start()
+    try:
+        # stores restored; the version-mismatched analytics section was
+        # skipped (its queries are gone, to re-register — not a crash)
+        assert b.device_management.get_device("dev-a") is not None
+        assert b.analytics.list_queries() == []
+        # the skipped section must not anchor the replay floor
+        assert "analytics" not in b.checkpointer.restored_offsets
+    finally:
+        b.stop()
+        b.terminate()
+
+
+def _analytics_cfg(tmp_path, name):
+    return _cfg(tmp_path, instance={
+        "id": name, "data_dir": str(tmp_path / name)})
+
+
+def _register_window_query(inst):
+    inst.analytics.register({
+        "kind": "window", "name": "hot-mean", "mtype": "temp",
+        "agg": "mean", "op": "gt", "threshold": 20.0, "windowS": 60})
+
+
+def _wire_payload(k, width=16):
+    lines = []
+    for r in range(width):
+        i = k * width + r
+        lines.append(json.dumps({
+            "deviceToken": f"d-{i % 4}", "type": "Measurement",
+            "request": {"name": "temp", "value": float(i % 50),
+                        "eventDate": 1_753_810_000 + i},
+        }))
+    return "\n".join(lines).encode()
+
+
+def _query_states(inst):
+    with inst.analytics._lock:
+        return {name: e.compiled.export_state()
+                for name, e in inst.analytics._queries.items()}
+
+
+def test_analytics_state_restored_equals_uninterrupted(tmp_path):
+    """Golden restored≡uninterrupted: kill with an open tumbling window
+    mid-flight, restart, replay — the restored operator state must be
+    BIT-IDENTICAL to a control instance that saw the same rows without
+    interruption (the tentpole's analytics-equivalence hinge)."""
+    def seed(inst):
+        dm = inst.device_management
+        dm.create_device_type(token="sensor", name="Sensor")
+        for i in range(4):
+            dm.create_device(token=f"d-{i}", device_type="sensor")
+            dm.create_device_assignment(device=f"d-{i}")
+        _register_window_query(inst)
+
+    # control: both payloads, uninterrupted
+    c = Instance(_analytics_cfg(tmp_path, "control"))
+    c.start()
+    seed(c)
+    c.dispatcher.ingest_wire_lines(_wire_payload(0), "t")
+    c.dispatcher.ingest_wire_lines(_wire_payload(1), "t")
+    c.dispatcher.flush()
+    c.analytics.drain()
+    golden = _query_states(c)
+    c.stop()
+    c.terminate()
+
+    # victim: payload 0 evaluated + checkpointed; payload 1 journaled
+    # but NEVER processed (the crash window), then killed
+    a = Instance(_analytics_cfg(tmp_path, "victim"))
+    a.start()
+    seed(a)
+    a.dispatcher.ingest_wire_lines(_wire_payload(0), "t")
+    a.dispatcher.flush()
+    a.analytics.drain()
+    a.checkpointer.save()
+    # quiesced save: the conservative committed fallback (1) is sound —
+    # the provider drained its queue, so everything below it is applied
+    assert a.checkpointer._manifest()["offsets"]["analytics"] == 1
+    a.ingest_journal.append(_wire_payload(1))
+    a.ingest_journal.close()
+    a.dead_letters.close()
+    del a  # simulated kill
+
+    b = Instance(_analytics_cfg(tmp_path, "victim"))
+    assert b.restored
+    b.start()  # replays payload 1 through the pipeline into analytics
+    try:
+        assert [q["query"]["name"] for q in b.analytics.list_queries()] \
+            == ["hot-mean"]
+        b.dispatcher.flush()
+        b.analytics.drain()
+        restored = _query_states(b)
+        assert set(restored) == set(golden)
+        for name in golden:
+            for field, arr in golden[name].items():
+                np.testing.assert_array_equal(
+                    restored[name][field], arr,
+                    err_msg=f"{name}.{field} diverged after recovery")
+    finally:
+        b.stop()
+        b.terminate()
+
+
+def test_analytics_replay_floor_skips_fully_applied_records(tmp_path):
+    """A quiesced snapshot's floor covers record 0 entirely: the
+    restart replays nothing below it, re-derives nothing, duplicates
+    nothing — state and store land exactly where the kill left them."""
+    a = Instance(_analytics_cfg(tmp_path, "floor"))
+    a.start()
+    dm = a.device_management
+    dm.create_device_type(token="sensor", name="Sensor")
+    for i in range(4):
+        dm.create_device(token=f"d-{i}", device_type="sensor")
+        dm.create_device_assignment(device=f"d-{i}")
+    _register_window_query(a)
+    a.dispatcher.ingest_wire_lines(_wire_payload(0), "t")
+    a.dispatcher.flush()
+    a.analytics.drain()
+    a.checkpointer.save()
+    a.ingest_journal.close()
+    a.dead_letters.close()
+    golden = _query_states(a)
+    del a  # simulated kill
+
+    b = Instance(_analytics_cfg(tmp_path, "floor"))
+    assert b.restored
+    # conservative committed as-of (1): record 0 fully applied; its
+    # partial-prefix entry rides along and stays inert below the floor
+    assert b.analytics.replay_floor == 1
+    assert b.analytics._replay_partial == {0: 16}
+    b.start()
+    try:
+        b.dispatcher.flush()
+        b.analytics.drain()
+        assert b.metrics.counter(
+            "analytics.replay_rows_skipped").value == 0
+        restored = _query_states(b)
+        for name in golden:
+            for field, arr in golden[name].items():
+                np.testing.assert_array_equal(restored[name][field], arr)
+        # and the store did not double-append the replayed rows either
+        b.event_store.flush()
+        assert b.event_store.total_events == 16
+    finally:
+        b.stop()
+        b.terminate()
+
+
+def test_analytics_partial_record_prefix_is_row_exact():
+    """The review-hardened hinge: one journal record's rows split
+    across two plans, snapshot taken BETWEEN the halves — the snapshot
+    pairs the state with a per-record applied-prefix count, and replay
+    drops exactly that prefix, so the suffix still applies and state
+    converges to the uninterrupted run's (never losing the unapplied
+    half, never double-counting the applied one)."""
+    from sitewhere_tpu.analytics.runner import QueryRunner
+    from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+    def cols(lo, hi):
+        n = hi - lo
+        return {
+            "device_id": np.arange(lo, hi, dtype=np.int32) % 4,
+            "ts_s": np.arange(1_753_840_000 + lo, 1_753_840_000 + hi,
+                              dtype=np.int64),
+            "event_type": np.zeros(n, np.int32),   # MEASUREMENT
+            "mtype_id": np.zeros(n, np.int32),
+            "value": np.arange(lo, hi, dtype=np.float32),
+            "payload_ref": np.zeros(n, np.int32),  # ONE journal record
+        }
+
+    def make_runner():
+        r = QueryRunner(capacity=8, metrics=MetricsRegistry(),
+                        resolve_mtype=lambda name: 0)
+        r.register({"kind": "window", "name": "w", "mtype": "temp",
+                    "agg": "sum", "op": "gt", "threshold": 1e9,
+                    "windowS": 60})
+        r.start()
+        return r
+
+    # control: all 12 rows of record 0, uninterrupted
+    ctrl = make_runner()
+    ctrl.submit_live(cols(0, 12), np.ones(12, bool), committed=0)
+    ctrl.drain()
+    golden = {n: e.compiled.export_state()
+              for n, e in ctrl._queries.items()}
+    ctrl.stop()
+
+    # victim: only the FIRST half of record 0 applied, then snapshot
+    # (exactly what a periodic checkpoint racing a split record sees)
+    a = make_runner()
+    a.submit_live(cols(0, 8), np.ones(8, bool), committed=0)
+    a.drain()
+    payload, header = a.snapshot_state()
+    a.stop()
+    # record 0 never committed → no watermark; the checkpointer stamps
+    # its conservative committed offset (0 here) in this case
+    assert header["as_of"] is None
+    header = dict(header, as_of=0)
+
+    # restore + full-record replay: the 8-row prefix drops, the 4-row
+    # suffix applies
+    b = make_runner()
+    assert b.restore_state(header, payload) == 1
+    b.submit_live(cols(0, 12), np.ones(12, bool), committed=0)
+    b.drain()
+    assert b.metrics.counter("analytics.replay_rows_skipped").value == 8
+    restored = {n: e.compiled.export_state()
+                for n, e in b._queries.items()}
+    b.stop()
+    for name in golden:
+        for field, arr in golden[name].items():
+            np.testing.assert_array_equal(
+                restored[name][field], arr,
+                err_msg=f"{name}.{field} diverged across a split-record "
+                        f"checkpoint boundary")
+
+
+def test_stop_final_checkpoint_offset_never_leads_journal(tmp_path):
+    """Shutdown-ordering audit (ISSUE 12 satellite): Instance.stop runs
+    the final save AFTER the dispatcher flush drains ring + egress and
+    commits the final offset — so the snapshot's claimed offsets can
+    never lead the sealed journal.  Regression-pin the ordering."""
+    import os
+
+    a = Instance(_cfg(tmp_path))
+    a.start()
+    a.device_management.create_device_type(token="sensor", name="Sensor")
+    a.device_management.create_device(token="d-0", device_type="sensor")
+    a.device_management.create_device_assignment(device="d-0")
+    for k in range(3):
+        _ingest_json(a, "d-0", float(k), 1_753_820_000 + k)
+    a.stop()  # flush + drain + commit, THEN the final save
+    a.terminate()
+
+    with open(os.path.join(str(tmp_path / "data"), "checkpoint",
+                           "MANIFEST.json")) as f:
+        manifest = json.load(f)
+    end = a.ingest_journal.end_offset
+    # the final snapshot covers the whole sealed journal…
+    assert manifest["committed"] == end
+    assert manifest["journal_end"] == end
+    # …and no component section claims an offset past it
+    assert manifest["offsets"]
+    for section, off in manifest["offsets"].items():
+        assert off <= end, f"{section} as-of {off} leads journal end {end}"
+
+    # restart replays nothing (clean shutdown == nothing uncommitted)
+    b = Instance(_cfg(tmp_path))
+    assert b.restored
+    b.start()
+    try:
+        assert b.metrics.gauge("recovery.replay_events").value == 0
+    finally:
+        b.stop()
+        b.terminate()
+
+
+def test_dedup_window_survives_restart(tmp_path):
+    """The per-source dedup LRU rides the runtime checkpoint section: a
+    restarted instance keeps rejecting alternate ids the window had
+    already seen instead of re-admitting them until the LRU refills."""
+    from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
+    from sitewhere_tpu.ingest.dedup import AlternateIdDeduplicator
+
+    def req(alt):
+        return DecodedRequest(kind=RequestKind.MEASUREMENT,
+                              device_token="d-0", ts_s=1, alternate_id=alt)
+
+    d = AlternateIdDeduplicator(window=4)
+    assert not d.is_duplicate(req("alpha"))
+    assert not d.is_duplicate(req("beta"))
+    keys = d.export_keys()
+    assert len(keys) == 2
+
+    d2 = AlternateIdDeduplicator(window=4)
+    d2.import_keys(keys)
+    assert d2.is_duplicate(req("alpha")) and d2.is_duplicate(req("beta"))
+    assert not d2.is_duplicate(req("gamma"))
+
+    # truncation: only the newest `window` keys survive a smaller window
+    d3 = AlternateIdDeduplicator(window=1)
+    d3.import_keys(keys)
+    assert d3.is_duplicate(req("beta"))       # newest kept
+    assert not d3.is_duplicate(req("alpha"))  # aged out by the window
+
+
+def test_recovery_metrics_exported_on_restore(tmp_path):
+    """recovery.restore_s / recovery.replay_s / recovery.replay_events:
+    RTO is a measured number on every boot that restored."""
+    a = Instance(_cfg(tmp_path))
+    a.start()
+    a.device_management.create_device_type(token="sensor", name="Sensor")
+    a.device_management.create_device(token="d-0", device_type="sensor")
+    a.device_management.create_device_assignment(device="d-0")
+    _ingest_json(a, "d-0", 1.0, 1_753_830_000)
+    a.dispatcher.flush()
+    a.checkpointer.save()
+    a.ingest_journal.append(_payload("d-0", 2.0, 1_753_830_001))
+    a.ingest_journal.close()
+    a.dead_letters.close()
+    del a  # simulated kill
+
+    b = Instance(_cfg(tmp_path))
+    assert b.restored
+    b.start()
+    try:
+        gauges = b.metrics.snapshot()["gauges"]
+        assert gauges["recovery.restore_s"] > 0
+        assert gauges["recovery.replay_events"] == 1
+        assert gauges["recovery.replay_s"] > 0
+        assert b.checkpointer.restore_s > 0
+    finally:
+        b.stop()
+        b.terminate()

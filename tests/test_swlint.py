@@ -79,6 +79,7 @@ EXPECTED_RULES = {
     ("locks", "tp_blocking_hot.py"): "LK003",
     ("locks", "tp_d2h_hot.py"): "LK004",
     ("locks", "tp_contract.py"): "LK003",
+    ("locks", "tp_checkpoint_hot.py"): "LK005",
     ("donation", "tp_use_after_jit_donate.py"): "DN001",
     ("donation", "tp_use_after_chain.py"): "DN001",
     ("donation", "tp_use_after_lease.py"): "DN002",

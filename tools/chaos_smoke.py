@@ -189,8 +189,48 @@ def main() -> int:
         if restored < stored - inst2.event_store.sealed_dead_lettered:
             failures.append(
                 f"restart lost events: {stored} before, {restored} after")
-        inst2.stop()
-        inst2.terminate()
+
+        # -- kill-restart phase (ISSUE 12): journal records that never
+        # reach the pipeline (the crash window between Journal.append
+        # and egress), kill without stop, and prove the next boot
+        # restores the checkpoint + replays them with measured RTO
+        crash_rows = 3
+        for r in range(crash_rows):
+            inst2.ingest_journal.append(
+                _line(f"d-{r}", 77.0, 1_753_950_000 + r).encode())
+        inst2.ingest_journal.close()
+        inst2.dead_letters.close()
+        del inst2  # simulated SIGKILL — no stop, no final checkpoint
+
+        inst3 = _make_instance(data_dir)
+        if not inst3.restored:
+            failures.append("kill-restart: checkpoint did not restore")
+        inst3.start()  # restore ran in __init__; start replays
+        inst3.dispatcher.flush()
+        inst3.event_store.flush()
+        gauges = inst3.metrics.snapshot()["gauges"]
+        replayed = int(gauges.get("recovery.replay_events", 0))
+        if replayed < crash_rows:
+            failures.append(
+                f"kill-restart: expected >= {crash_rows} replayed "
+                f"events, recovery.replay_events={replayed}")
+        if not gauges.get("recovery.restore_s", 0.0) > 0:
+            failures.append(
+                "kill-restart: recovery.restore_s gauge missing/zero")
+        after_kill = inst3.event_store.total_events
+        if after_kill < restored + crash_rows:
+            failures.append(
+                f"kill-restart lost events: {restored}+{crash_rows} "
+                f"journaled, {after_kill} stored")
+        recovery_report = {
+            "replayed": replayed,
+            "restore_s": round(float(gauges.get("recovery.restore_s",
+                                                0.0)), 4),
+            "replay_s": round(float(gauges.get("recovery.replay_s",
+                                               0.0)), 4),
+        }
+        inst3.stop()
+        inst3.terminate()
 
         print(json.dumps({
             "seed": seed,
@@ -201,6 +241,7 @@ def main() -> int:
             "fault_hits": fault_hits,
             "resilience": resilience,
             "overload": overload_report,
+            "recovery": recovery_report,
             "ok": not failures,
         }, indent=2))
     finally:
