@@ -64,9 +64,25 @@ FAMILIES: Dict[str, Optional[Set[str]]] = {
     # restore wall time, replayed-event count, replay wall time — the
     # measured-RTO gauges the kill-point harness asserts on
     "recovery": {"restore_s", "replay_events", "replay_s"},
+    # segment-store surface (sitewhere_tpu/store): seal queue depth +
+    # background seal/compaction timings, segment/tier counts, bytes
+    # written, scan-lane accounting, checkpoint-manifest drift — the
+    # family tools/store_bench.py and the store dashboards address
+    "store": {
+        # counters
+        "rows_sealed", "bytes_written", "seal_failures",
+        "rows_compacted", "segments_compacted",
+        "scan_rows", "scan_hot_hits", "scan_pruned",
+        "tier_promotions", "tier_demotions",
+        # histograms (background stage timers)
+        "seal_s", "compact_s",
+        # gauges
+        "segments", "segments_hot", "hot_bytes",
+        "seal_queue_depth", "buffered_rows", "catalog_drift",
+    },
 }
 # prefixes where EVERY name must resolve to a declared family (MN003)
-GOVERNED_PREFIXES = ("device.", "slo.")
+GOVERNED_PREFIXES = ("device.", "slo.", "store.")
 
 
 def family_of(name: str) -> Optional[str]:

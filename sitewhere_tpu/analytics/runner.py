@@ -1066,12 +1066,22 @@ class QueryRunner(LifecycleComponent):
         rows = 0
         chunks = 0
         matches = []
+        # segment-store scan-lane accounting for THIS query (per-scan
+        # dict filled by the lane itself — race-free under concurrent
+        # scans, unlike deltas of the shared store.scan_* counters);
+        # legacy stores without the stats kwarg simply omit the section
+        scan_stats: Dict[str, int] = {}
+        try:
+            chunk_iter = store.iter_chunks(stats=scan_stats, **filters)
+        except TypeError:
+            scan_stats = None
+            chunk_iter = store.iter_chunks(**filters)
         with trace.span("analytics.scan") as sp:
             sp.tag("query", name)
             # the retro timer, not the live one: a multi-second whole
             # -history scan must not blow out the per-batch live p99
             with entry.retro_timer.time():
-                for cols in store.iter_chunks(**filters):
+                for cols in chunk_iter:
                     n = len(cols["ts_s"])
                     if n == 0:
                         continue
@@ -1088,12 +1098,15 @@ class QueryRunner(LifecycleComponent):
         self._m_retro_runs.inc()
         with self._lock:
             entry.retro_runs += 1
-        return {
+        report = {
             "query": name,
             "rows": rows,
             "chunks": chunks,
             "matches": [m.to_dict() for m in matches],
         }
+        if scan_stats is not None:
+            report["scan"] = dict(scan_stats)
+        return report
 
 
 class EventTap:

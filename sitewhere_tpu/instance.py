@@ -41,7 +41,7 @@ from sitewhere_tpu.security.users import UserManagement
 from sitewhere_tpu.services.assets import AssetManagement
 from sitewhere_tpu.services.batch_ops import BatchOperationManager
 from sitewhere_tpu.services.device_management import DeviceManagement, RegistryMirror
-from sitewhere_tpu.services.event_store import EventStore
+from sitewhere_tpu.store.segmented import SegmentStore
 from sitewhere_tpu.services.registration import RegistrationManager
 from sitewhere_tpu.services.schedules import ScheduleManager
 from sitewhere_tpu.services.streams import DeviceStreamManagement, DeviceStreamManager
@@ -142,12 +142,28 @@ class Instance(LifecycleComponent):
             num_ewma_scales=len(ewma_halflives),
         ))
 
-        # durable stores
-        self.event_store = self.add_child(EventStore(
+        # instance-scoped metrics registry (the .prom exposition surface;
+        # cross-cutting counters stay in metrics.global_registry()) —
+        # created before the durable stores so the segment store's
+        # store.* family registers here, not in the process-global one
+        from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+
+        # durable stores — the log-structured sharded segment store
+        # (sitewhere_tpu/store): parallel background seal off the hot
+        # path, catalog-governed retention/compaction, packed hot tier
+        self.event_store = self.add_child(SegmentStore(
             self.data_dir,
             flush_interval_s=0.25,
             retention_s=self.config.get("events.retention_s"),
             resident_bytes=int(self.config["events.resident_bytes"]),
+            n_shards=int(self.config["events.shards"]),
+            seal_workers=int(self.config["events.seal_workers"]),
+            hot_bytes=int(self.config["events.hot_bytes"]),
+            compact_interval_s=float(
+                self.config["events.compact_interval_s"]),
+            metrics=self.metrics,
         ))
         self.streams = self.add_child(DeviceStreamManagement(self.data_dir))
         self.stream_manager = self.add_child(DeviceStreamManager(
@@ -179,11 +195,6 @@ class Instance(LifecycleComponent):
                             if tail_ms is not None else None),
             pending_capacity=int(
                 self.config.get("tracing.pending_capacity", 512)))
-        # instance-scoped metrics registry (the .prom exposition surface;
-        # cross-cutting counters stay in metrics.global_registry())
-        from sitewhere_tpu.runtime.metrics import MetricsRegistry
-
-        self.metrics = MetricsRegistry()
         # runtime-uploadable scripts (ScriptSynchronizer analog)
         from sitewhere_tpu.runtime.scripting import ScriptManager
 
@@ -571,6 +582,14 @@ class Instance(LifecycleComponent):
             snapshot_fn=self._snapshot_runtime_state,
             restore_fn=self._restore_runtime_state,
             version=1))
+        # segment-store catalog manifest: rides the same CRC-framed,
+        # generation-committed snapshot protocol; restore cross-checks
+        # the directory-rebuilt catalog against the last committed
+        # generation's view and exports the drift as a gauge
+        from sitewhere_tpu.store.catalog import catalog_state_provider
+
+        self.checkpointer.register_provider(
+            catalog_state_provider(self.event_store))
         self.restored = self.checkpointer.restore()
 
     # -- wiring helpers -----------------------------------------------------
@@ -1320,6 +1339,7 @@ class Instance(LifecycleComponent):
             "pipeline": self.dispatcher.metrics_snapshot(),
             "devices": len(self.identity.device),
             "events_stored": self.event_store.total_events,
+            "store": self.event_store.store_stats(),
             "tracing": self.tracer.stats(),
             # cross-cutting resilience counters (retries, breaker
             # transitions, supervisor restarts, dead-letter totals)

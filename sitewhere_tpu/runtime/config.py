@@ -72,8 +72,15 @@ DEFAULTS: Dict[str, Any] = {
     "journal": {"fsync_every": 256, "segment_bytes": 64 << 20,
                 "prune_after_checkpoint": False},
     # events.retention_s: event-time retention window for the columnar
-    # store, enforced chunk-at-a-time (0 = keep forever)
-    "events": {"retention_s": 0, "resident_bytes": 256 << 20},
+    # store, enforced segment-at-a-time (0 = keep forever).  The
+    # log-structured segment store (sitewhere_tpu/store): shards =
+    # tenant/device shard count (parallel seal lanes), seal_workers =
+    # background seal pool size, hot_bytes = packed-column hot-tier
+    # budget, compact_interval_s = background compaction cadence
+    # (<=0 disables).
+    "events": {"retention_s": 0, "resident_bytes": 256 << 20,
+               "shards": 4, "seal_workers": 2, "hot_bytes": 64 << 20,
+               "compact_interval_s": 30.0},
     # overload control (runtime/overload.py): watermark-driven state
     # machine (NORMAL→DEGRADED→SHEDDING→EMERGENCY) over the exported
     # pressure signals, with priority-class admission at ingest and a

@@ -65,6 +65,7 @@ totals exceed wall elapsed, the stages are provably overlapping.
 from __future__ import annotations
 
 import collections
+import collections.abc
 import logging
 import os
 import threading
@@ -84,8 +85,104 @@ from sitewhere_tpu.runtime import faults
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.runtime.resilience import dead_letter
 from sitewhere_tpu.schema import EventBatch, EventType, as_numpy
+from sitewhere_tpu.store import segment as _segment_schema
 
 logger = logging.getLogger("sitewhere_tpu.dispatcher")
+
+# egress-view split of the canonical storage schema: the 5 step-output
+# enrichment columns, and everything else (minus the store-stamped
+# receive time) resolving straight out of plan.host_cols.  Derived, not
+# hand-maintained — a copy would silently desync from store COLUMNS.
+_EGRESS_ENRICHMENT = ("device_type_id", "assignment_id", "area_id",
+                      "customer_id", "asset_id")
+_EGRESS_HOST = tuple(
+    n for n in _segment_schema.COLUMN_NAMES
+    if n not in _EGRESS_ENRICHMENT and n != "received_s"
+)
+
+
+class EgressColumns(collections.abc.Mapping):
+    """Zero-copy egress column view over one plan's host columns plus
+    the step's enrichment outputs.
+
+    Replaces the per-batch dict build in ``_columns`` (the tagged
+    ROADMAP-2 worklist entry: ~4.0 ms of dispatch bookkeeping in
+    ``HOSTPATH_r06``, dominated by the 5 EAGER ``np.asarray`` enrichment
+    fetches).  Host columns resolve straight out of ``plan.host_cols``;
+    enrichment columns (``device_type_id`` … ``asset_id``) fetch from
+    the step output LAZILY on first access and memoize, so an egress
+    where no consumer touches them — store disabled, outbound-only
+    fan-out — never pays the device sync at all, and the common path
+    pays it exactly once per column (the segment store's
+    ``append_columns`` touches all five, caching them for the async
+    outbound/analytics consumers that run afterwards)."""
+
+    ENRICHMENT_COLUMNS = _EGRESS_ENRICHMENT
+    _ENRICH_SET = frozenset(_EGRESS_ENRICHMENT)
+    HOST_COLUMNS = _EGRESS_HOST
+    # O(1) membership: connectors look fields up per row per batch
+    _HOST_SET = frozenset(_EGRESS_HOST)
+
+    __slots__ = ("_host", "_out", "_fetched", "_fetch_lock")
+
+    def __init__(self, host_cols: Dict[str, np.ndarray], out):
+        self._host = host_cols
+        self._out = out
+        self._fetched: Optional[Dict[str, np.ndarray]] = None
+        # one view is shared across the egress thread AND every async
+        # outbound/analytics consumer; the enrichment fetch must be
+        # thread-safe (the lock is per batch, taken at most once per
+        # consumer — the fast path below is a lock-free memo read)
+        self._fetch_lock = threading.Lock()
+
+    def _enrichment(self) -> Dict[str, np.ndarray]:
+        fetched = self._fetched
+        if fetched is None:
+            with self._fetch_lock:
+                fetched = self._fetched
+                if fetched is None:
+                    out = self._out
+                    # all five at once (matching the old eager cost the
+                    # first time ANY consumer asks), then release the
+                    # step output so a view parked in a lagging
+                    # outbound queue doesn't pin the step's device
+                    # buffers
+                    fetched = {
+                        n: np.asarray(getattr(out, n))
+                        for n in self.ENRICHMENT_COLUMNS
+                    }
+                    self._fetched = fetched
+                    self._out = None
+        return fetched
+
+    def release_output(self) -> None:
+        """Memoize the enrichment columns and drop the step-output
+        reference.  The egress calls this before handing the view to
+        async consumers whenever the store path didn't already fetch —
+        a view parked in a lagging outbound queue must never pin the
+        step's device buffers."""
+        self._enrichment()
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        if name in self._ENRICH_SET:
+            return self._enrichment()[name]
+        if name in self._HOST_SET and name in self._host:
+            return self._host[name]
+        raise KeyError(name)
+
+    def __contains__(self, name) -> bool:
+        return (name in self._ENRICH_SET
+                or (name in self._HOST_SET and name in self._host))
+
+    def __iter__(self):
+        for name in self.HOST_COLUMNS:
+            if name in self._host:
+                yield name
+        yield from self.ENRICHMENT_COLUMNS
+
+    def __len__(self) -> int:
+        return (sum(1 for n in self.HOST_COLUMNS if n in self._host)
+                + len(self.ENRICHMENT_COLUMNS))
 
 
 class PipelineDispatcher(LifecycleComponent):
@@ -1687,6 +1784,17 @@ class PipelineDispatcher(LifecycleComponent):
                     "rows", int(store_mask.sum())):
                 self.event_store.append_columns(cols, mask=store_mask)
             self._m_seal.set(time.monotonic() - ingest_t0)
+        elif accepted.any() and (self.outbound is not None
+                                 or self.analytics is not None):
+            # the store path would have fetched the enrichment columns
+            # (releasing the step output); without it, fetch-and-release
+            # here so async outbound/analytics queues holding the view
+            # never pin this step's device buffers.  With no async
+            # consumer at all, the view dies with this frame and the
+            # device sync is genuinely skipped.
+            release = getattr(cols, "release_output", None)
+            if release is not None:
+                release()
         # chaos kill point: stored (possibly sealed) but the offset
         # commit below never runs — a restart must replay this plan
         faults.crosspoint("crash.mid_egress")
@@ -1759,19 +1867,12 @@ class PipelineDispatcher(LifecycleComponent):
                                 e2e_s=lat, egress_s=egress_dt,
                                 trace=trace)
 
-    def _columns(self, host_cols: Dict[str, np.ndarray], out) -> Dict[str, np.ndarray]:
-        cols = {
-            name: host_cols[name]
-            for name in (
-                "device_id", "tenant_id", "event_type", "ts_s", "ts_ns",
-                "mtype_id", "value", "lat", "lon", "elevation",
-                "alert_code", "alert_level", "command_id", "payload_ref",
-            )
-        }
-        for name in ("device_type_id", "assignment_id", "area_id",
-                     "customer_id", "asset_id"):
-            cols[name] = np.asarray(getattr(out, name))
-        return cols
+    def _columns(self, host_cols: Dict[str, np.ndarray], out):
+        """Egress columns as a zero-copy view (see :class:`EgressColumns`)
+        — no per-batch dict build, no eager enrichment fetches (the
+        retired ROADMAP-2 worklist entry: the 4.0 ms dispatch-bookkeeping
+        suspect)."""
+        return EgressColumns(host_cols, out)
 
     def _handle_unregistered(self, host_cols, out, replay_depth: int) -> None:
         mask = np.asarray(out.unregistered)

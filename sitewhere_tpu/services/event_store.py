@@ -61,41 +61,37 @@ from sitewhere_tpu.services.common import (
 
 logger = logging.getLogger("sitewhere_tpu.event_store")
 
-# Column schema of one stored event row: the EventBatch columns that matter
-# post-pipeline, plus the enrichment context (IDeviceEventContext analog).
-COLUMNS = (
-    ("device_id", np.int32),
-    ("tenant_id", np.int32),
-    ("event_type", np.int32),
-    ("ts_s", np.int32),
-    ("ts_ns", np.int32),
-    ("mtype_id", np.int32),
-    ("value", np.float32),
-    ("lat", np.float32),
-    ("lon", np.float32),
-    ("elevation", np.float32),
-    ("alert_code", np.int32),
-    ("alert_level", np.int32),
-    ("command_id", np.int32),
-    ("payload_ref", np.int32),
-    ("device_type_id", np.int32),
-    ("assignment_id", np.int32),
-    ("area_id", np.int32),
-    ("customer_id", np.int32),
-    ("asset_id", np.int32),
-    ("received_s", np.int32),  # server-side receive time (reference: receivedDate)
+# The storage format — column schema, zone-map/Bloom prune metadata,
+# the lazy Segment (né _Chunk) and its byte-bounded column LRU — now
+# lives in sitewhere_tpu/store/segment.py, the canonical home shared
+# with the log-structured segment store (sitewhere_tpu/store).  The
+# legacy private names stay importable here: this module's chunk
+# machinery IS the segment format, single-writer edition.
+from sitewhere_tpu.store.segment import (  # noqa: E402
+    COLUMNS,
+    ROW_BITS as _ROW_BITS,
+    ColumnCache as _ColumnCache,
+    Segment as _Chunk,
+    SegmentPruned as _ChunkPruned,
+    bloom_probe as _bloom_probe,
+    bloom_member as _bloom_member,
+    event_id,
+    open_segment,
+    segment_pruned as _chunk_pruned,
+    split_event_id,
+    write_segment_file,
 )
-_COLUMN_NAMES = tuple(name for name, _ in COLUMNS)
-_ROW_BITS = 24  # up to 16M rows per chunk
+from sitewhere_tpu.store.segment import (  # noqa: E402
+    BLOOM_BITS as _BLOOM_BITS,
+    BLOOM_COLUMNS as _BLOOM_COLUMNS,
+    COLUMN_NAMES as _COLUMN_NAMES,
+    FILTER_COLUMNS as _FILTER_COLUMNS,
+    META_BOUNDS as _META_BOUNDS,
+    META_CORE as _META_CORE,
+    META_VERSION as _META_VERSION,
+)
+
 _CHUNK_RE = re.compile(r"^events-(\d{10})\.npz$")
-
-
-def event_id(chunk_seq: int, row: int) -> int:
-    return (chunk_seq << _ROW_BITS) | row
-
-
-def split_event_id(eid: int) -> tuple:
-    return eid >> _ROW_BITS, eid & ((1 << _ROW_BITS) - 1)
 
 
 @dataclasses.dataclass
@@ -123,276 +119,6 @@ class EventRecord:
     customer_id: int
     asset_id: int
     received_s: int
-
-
-# Filterable columns carrying per-chunk min/max zone-maps (the Cassandra
-# denormalized-table analog: a chunk whose [min, max] excludes the wanted
-# key is skipped without touching its rows).
-_FILTER_COLUMNS = (
-    "tenant_id", "device_id", "assignment_id", "customer_id", "area_id",
-    "asset_id", "event_type", "mtype_id", "alert_code", "command_id",
-)
-
-
-# High-cardinality exact-match columns get a per-chunk Bloom filter on
-# top of the min/max bounds: random device ids never prune on range, but
-# a 128 Kbit two-hash Bloom (16 KB packed per chunk; fill ~22% at 16k
-# rows → ~5% false positives) skips almost every non-containing chunk.
-_BLOOM_COLUMNS = ("device_id", "assignment_id")
-_BLOOM_BITS = 17  # 131072-bit filter
-_H1 = 0x9E3779B97F4A7C15
-_H2 = 0xC2B2AE3D27D4EB4F
-_SHIFT = np.uint64(64 - _BLOOM_BITS)
-
-
-def _bloom_probe(want: int) -> tuple:
-    """(h1, h2) bit positions for one lookup key (pure-int: the prune
-    loop tests these against hundreds of chunks per query)."""
-    v = want & 0xFFFFFFFFFFFFFFFF
-    return (((v * _H1) & 0xFFFFFFFFFFFFFFFF) >> int(_SHIFT),
-            ((v * _H2) & 0xFFFFFFFFFFFFFFFF) >> int(_SHIFT))
-
-
-# npz members carrying prune metadata alongside the column arrays, so a
-# restart reads ONLY these (np.load decompresses zip members on demand —
-# opening a chunk never materializes its columns).
-_META_CORE = "_meta_core"        # int64 [version, n, min_ts, max_ts]
-_META_BOUNDS = "_meta_bounds"    # int64 (len(_FILTER_COLUMNS), 2)
-_META_VERSION = 1
-
-
-def _bloom_member(name: str) -> str:
-    return f"_bloom_{name}"
-
-
-class _ChunkPruned(Exception):
-    """A lazy read found the chunk file gone.
-
-    Sealed columns used to be memory-resident, which made chunk-list
-    snapshots prune-safe by construction; with lazy loading the readers
-    must handle the file vanishing mid-read (query retries on a fresh
-    snapshot, scans skip the expired chunk, id lookups report the id
-    expired).  Carries the seq so the store can self-heal when the file
-    vanished OUTSIDE ``prune_older_than`` (manual deletion, disk fault)
-    — the chunk is then discarded from the list, keeping the query
-    retry loop genuinely bounded by the chunk count."""
-
-    def __init__(self, seq: int):
-        super().__init__(seq)
-        self.seq = seq
-
-
-class _ColumnCache:
-    """Byte-bounded LRU over sealed-chunk column arrays.
-
-    The store's durability layer (npz chunk files) doubles as its memory
-    manager: sealed columns load on first touch and evict least-recently
-    -used once ``max_bytes`` of materialized columns accumulate, so a
-    store holding billions of rows keeps only blooms + zone-map bounds
-    (+ whatever the current query touches) resident.  Reference analog:
-    Cassandra pages event rows from disk per query
-    (``CassandraDeviceEventManagement.java:374-428``) instead of pinning
-    the table in heap.
-    """
-
-    def __init__(self, max_bytes: int):
-        self.max_bytes = int(max_bytes)
-        self._od: "OrderedDict[Tuple[int, str], np.ndarray]" = OrderedDict()
-        # pruned seqs (never reused: the seq high-water marker only goes
-        # up) — rejects a put() racing drop_seq(), which would otherwise
-        # park a dead column in the LRU that no reader ever asks for.
-        # Bounded: the race window is one in-flight column load, so only
-        # RECENT tombstones matter; older ones expire FIFO (an unbounded
-        # set inside the memory-bounding feature would be ironic).
-        self._dead: set = set()
-        self._dead_order: deque = deque()
-        self._lock = threading.Lock()
-        self.bytes = 0
-        self.loads = 0
-        self.hits = 0
-        self.evictions = 0
-
-    def get(self, key: Tuple[int, str]) -> Optional[np.ndarray]:
-        with self._lock:
-            arr = self._od.get(key)
-            if arr is not None:
-                self._od.move_to_end(key)
-                self.hits += 1
-            return arr
-
-    def put(self, key: Tuple[int, str], arr: np.ndarray) -> None:
-        with self._lock:
-            if key[0] in self._dead:
-                return
-            old = self._od.pop(key, None)
-            if old is not None:
-                self.bytes -= old.nbytes
-            self._od[key] = arr
-            self.bytes += arr.nbytes
-            while self.bytes > self.max_bytes and len(self._od) > 1:
-                _, evicted = self._od.popitem(last=False)
-                self.bytes -= evicted.nbytes
-                self.evictions += 1
-
-    def drop_seq(self, seq: int) -> None:
-        """Forget a pruned chunk's columns (and refuse late arrivals)."""
-        with self._lock:
-            if seq not in self._dead:
-                self._dead.add(seq)
-                self._dead_order.append(seq)
-                while len(self._dead_order) > 1024:
-                    self._dead.discard(self._dead_order.popleft())
-            for key in [k for k in self._od if k[0] == seq]:
-                self.bytes -= self._od.pop(key).nbytes
-
-
-class _Chunk:
-    """An immutable columnar segment (+ zone-map prune metadata).
-
-    Sealed chunks are LAZY: only ``n``/``min_ts``/``max_ts``/``bounds``/
-    ``blooms`` stay resident; column arrays load from the npz file on
-    demand through the store's :class:`_ColumnCache`.  ``light=True``
-    marks the VIRTUAL chunk over the unsealed buffer — fully resident
-    (it IS the write buffer), rebuilt per read call under the append
-    lock, no prune metadata (as the newest data it would rarely prune).
-    """
-
-    __slots__ = ("seq", "n", "min_ts", "max_ts", "bounds", "blooms",
-                 "_cols", "_path", "_cache")
-
-    def __init__(self, seq: int, cols: Dict[str, np.ndarray],
-                 light: bool = False):
-        self.seq = seq
-        self._cols: Optional[Dict[str, np.ndarray]] = cols
-        self._path: Optional[str] = None
-        self._cache: Optional[_ColumnCache] = None
-        self.n = len(cols["ts_s"])
-        self.min_ts = int(cols["ts_s"].min()) if self.n else 0
-        self.max_ts = int(cols["ts_s"].max()) if self.n else 0
-        if light:
-            self.bounds = None
-            self.blooms = {}
-            return
-        self.bounds = {
-            name: ((int(cols[name].min()), int(cols[name].max()))
-                   if self.n else (0, -1))
-            for name in _FILTER_COLUMNS
-        }
-        self.blooms = {}
-        for name in _BLOOM_COLUMNS:
-            bits = np.zeros(1 << _BLOOM_BITS, np.bool_)
-            if self.n:
-                v = cols[name].astype(np.int64).astype(np.uint64)
-                bits[(v * np.uint64(_H1)) >> _SHIFT] = True
-                bits[(v * np.uint64(_H2)) >> _SHIFT] = True
-            self.blooms[name] = np.packbits(bits)  # 16 KB, MSB-first
-
-    @classmethod
-    def lazy(cls, seq: int, path: str, cache: _ColumnCache, n: int,
-             min_ts: int, max_ts: int, bounds: Dict[str, tuple],
-             blooms: Dict[str, np.ndarray]) -> "_Chunk":
-        """A sealed chunk from persisted metadata — no columns resident."""
-        chunk = cls.__new__(cls)
-        chunk.seq = seq
-        chunk._cols = None
-        chunk._path = path
-        chunk._cache = cache
-        chunk.n = n
-        chunk.min_ts = min_ts
-        chunk.max_ts = max_ts
-        chunk.bounds = bounds
-        chunk.blooms = blooms
-        return chunk
-
-    def detach(self, path: str, cache: _ColumnCache) -> None:
-        """Release resident columns (post-seal): reads go via the cache."""
-        self._path = path
-        self._cache = cache
-        self._cols = None
-
-    def _load_members(self, names: List[str]) -> Dict[str, np.ndarray]:
-        """One npz open covering every requested member (a cold chunk
-        must not pay a zip-directory parse per column)."""
-        out: Dict[str, np.ndarray] = {}
-        try:
-            with np.load(self._path) as data:
-                files = set(data.files)
-                for name in names:
-                    if name in files:
-                        out[name] = data[name]
-                    else:  # forward-compat: absent column → default
-                        out[name] = np.full(self.n, NULL_ID,
-                                            dict(COLUMNS)[name])
-        except FileNotFoundError:
-            raise _ChunkPruned(self.seq) from None
-        return out
-
-    def col(self, name: str) -> np.ndarray:
-        """One column's array, loading (and caching) it if not resident."""
-        # local capture: readers run lock-free while the flusher's
-        # detach() may null _cols between a check and a use
-        cols = self._cols
-        if cols is not None:
-            return cols[name]
-        key = (self.seq, name)
-        arr = self._cache.get(key)
-        if arr is None:
-            self._cache.loads += 1
-            arr = self._load_members([name])[name]
-            self._cache.put(key, arr)
-        return arr
-
-    def materialize(self) -> Dict[str, np.ndarray]:
-        """Every column (scan/page API) — via the cache when lazy, with
-        ONE file open for all the columns a cold chunk is missing."""
-        cols = self._cols  # local capture: see col()
-        if cols is not None:
-            return dict(cols)
-        out: Dict[str, np.ndarray] = {}
-        missing: List[str] = []
-        for name in _COLUMN_NAMES:
-            arr = self._cache.get((self.seq, name))
-            if arr is None:
-                missing.append(name)
-            else:
-                out[name] = arr
-        if missing:
-            self._cache.loads += 1
-            loaded = self._load_members(missing)
-            for name, arr in loaded.items():
-                self._cache.put((self.seq, name), arr)
-                out[name] = arr
-        return out
-
-    def may_contain(self, name: str, h1: int, h2: int) -> bool:
-        bloom = self.blooms.get(name)
-        if bloom is None:
-            return True
-        return bool(bloom[h1 >> 3] >> (7 - (h1 & 7)) & 1
-                    and bloom[h2 >> 3] >> (7 - (h2 & 7)) & 1)
-
-
-def _chunk_pruned(c: _Chunk, active, probes, t0, t1) -> bool:
-    """Zone-map + Bloom skip (the hour-bucket/denormalized-table
-    analog) — ONE predicate shared by the indexed ``query`` path and
-    the ``iter_chunks`` scan API, so the two can never disagree about
-    what a chunk's metadata excludes."""
-    if c.n == 0:
-        return True
-    if t0 is not None and c.max_ts < t0:
-        return True
-    if t1 is not None and c.min_ts > t1:
-        return True
-    if c.bounds is None:
-        return False  # light chunk (unsealed buffer): never pruned
-    for name, want in active:
-        lo, hi = c.bounds[name]
-        if want < lo or want > hi:
-            return True
-        probe = probes.get(name)
-        if probe is not None and not c.may_contain(name, *probe):
-            return True
-    return False
 
 
 class EventStore(LifecycleComponent):
@@ -764,6 +490,14 @@ class EventStore(LifecycleComponent):
         }
         return _Chunk(self._next_seq, merged, light=True)
 
+    def _buffer_chunks_locked(self) -> List[_Chunk]:
+        """Virtual chunk(s) over every unsealed row, newest-last.  The
+        single-writer store has exactly one unsealed buffer; the sharded
+        segment store overrides this with one virtual segment per open
+        shard buffer and queued seal job."""
+        chunk = self._buffer_chunk_locked()
+        return [] if chunk is None else [chunk]
+
     def add_event(self, **fields) -> EventRecord:
         """Append one event (REST create path, ``Assignments.java:428-433``).
 
@@ -1002,9 +736,7 @@ class EventStore(LifecycleComponent):
         seq, row = split_event_id(eid)
         with self._lock:
             candidates = list(self._chunks)
-            buffered = self._buffer_chunk_locked()
-        if buffered is not None:
-            candidates.append(buffered)
+            candidates.extend(self._buffer_chunks_locked())
         for chunk in candidates:
             if chunk.seq == seq:
                 if row >= chunk.n:
@@ -1082,9 +814,7 @@ class EventStore(LifecycleComponent):
         t0, t1 = criteria.start_s, criteria.end_s
         with self._lock:
             chunks = list(self._chunks)
-            buffered = self._buffer_chunk_locked()
-        if buffered is not None:
-            chunks.append(buffered)
+            chunks.extend(self._buffer_chunks_locked())
 
         probes = {
             name: _bloom_probe(int(want)) for name, want in active
@@ -1213,17 +943,16 @@ class EventStore(LifecycleComponent):
         its columns — the same pruning the indexed ``query`` API uses —
         and surviving chunks yield row-filtered column dicts with
         relative order preserved (append order, i.e. the order live
-        evaluation saw the events)."""
+        evaluation saw the events).  The filter/straddle rules are the
+        SHARED scan-lane helpers (store/scan.py), so this path and the
+        catalog edition can never disagree about which rows match."""
+        from sitewhere_tpu.store.scan import filters_active, row_mask
+
         self.flush()
         with self._lock:
             chunks = list(self._chunks)
-        active = [
-            (name, int(want))
-            for name, want in (
-                ("event_type", event_type), ("mtype_id", mtype_id),
-                ("device_id", device_id), ("tenant_id", tenant_id))
-            if want is not None
-        ]
+        active = filters_active(event_type, mtype_id, device_id,
+                                tenant_id)
         probes = {
             name: _bloom_probe(want) for name, want in active
             if name in _BLOOM_COLUMNS
@@ -1235,18 +964,7 @@ class EventStore(LifecycleComponent):
                 cols = chunk.materialize()
             except _ChunkPruned:
                 continue  # expired mid-scan: same as scanning after it
-            mask = None
-            for name, want in active:
-                m = cols[name] == want
-                mask = m if mask is None else (mask & m)
-            # time masks only when the chunk STRADDLES the bound (the
-            # query path's rule — a fully-covered chunk's rows all pass)
-            if start_s is not None and chunk.min_ts < start_s:
-                m = cols["ts_s"] >= start_s
-                mask = m if mask is None else (mask & m)
-            if end_s is not None and chunk.max_ts > end_s:
-                m = cols["ts_s"] <= end_s
-                mask = m if mask is None else (mask & m)
+            mask = row_mask(chunk, cols, active, start_s, end_s)
             if mask is None or mask.all():
                 yield cols
             elif mask.any():

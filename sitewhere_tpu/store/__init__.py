@@ -1,0 +1,41 @@
+"""Log-structured sharded segment store (ROADMAP item 3).
+
+Persistence rebuilt as tenant/device-sharded log-structured columnar
+segments so sustained ingest is never gated on seal and history stays
+TPU-scannable:
+
+- :mod:`~sitewhere_tpu.store.segment` — the columnar segment format
+  (zone maps, Blooms, packed ``[C, n]`` layout, compaction provenance);
+- :mod:`~sitewhere_tpu.store.catalog` — the queryable segment manifest
+  (prune/lookup/compaction-swap/tombstones + checkpoint section);
+- :mod:`~sitewhere_tpu.store.sealer` — supervised, fail-closed
+  background seal workers (the parallel replacement for the legacy
+  single-writer flush);
+- :mod:`~sitewhere_tpu.store.compaction` — background segment merge
+  with crash-safe tombstone swap;
+- :mod:`~sitewhere_tpu.store.tiering` — the hot tier: recent segments
+  retained in packed-column form, H2D-ready;
+- :mod:`~sitewhere_tpu.store.scan` — the retrospective scan lane
+  streaming sealed segments through the same packed pipeline the live
+  path uses;
+- :mod:`~sitewhere_tpu.store.segmented` — :class:`SegmentStore`, the
+  drop-in store facade wired by :class:`~sitewhere_tpu.instance.
+  Instance`.
+
+``SegmentStore`` is exposed lazily: ``segmented`` imports the legacy
+:mod:`sitewhere_tpu.services.event_store` (for the shared indexed-query
+machinery), which itself imports :mod:`sitewhere_tpu.store.segment` —
+an eager import here would be circular.
+"""
+
+from __future__ import annotations
+
+
+def __getattr__(name):
+    if name == "SegmentStore":
+        from sitewhere_tpu.store.segmented import SegmentStore
+        return SegmentStore
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["SegmentStore"]

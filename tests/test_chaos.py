@@ -1190,14 +1190,18 @@ class TestCrashRecBench:
             capture_output=True, text=True, timeout=timeout, env=env)
 
     def test_smoke_three_fixed_kill_points(self, tmp_path):
-        """Tier-1: SIGKILL at mid-ring, mid-egress and pre-manifest on a
-        small journal; every kill must recover with zero committed-event
-        loss, golden-equal analytics, and exported recovery gauges."""
+        """Tier-1: SIGKILL at mid-ring, mid-egress, mid-background-seal,
+        mid-compaction-swap and pre-manifest on a small journal; every
+        kill must recover with zero committed-event loss, a consistent
+        segment catalog, golden-equal analytics, and exported recovery
+        gauges."""
         res = self._run("--smoke", "--json",
                         str(tmp_path / "crashrec.json"))
         assert res.returncode == 0, res.stdout + res.stderr
         doc = json.loads((tmp_path / "crashrec.json").read_text())
-        assert doc["ok"] and doc["summary"]["killed"] == 3
+        assert doc["ok"] and doc["summary"]["killed"] == 5
+        points = {k["point"] for k in doc["kills"]}
+        assert {"crash.mid_seal", "crash.mid_compact"} <= points
         for kill in doc["kills"]:
             assert kill["killed"] and not kill["failures"]
             assert kill["restore_s"] is not None

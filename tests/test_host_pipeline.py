@@ -704,8 +704,14 @@ class TestHostpathBenchSmoke:
                     data_dir=str(tmp_path))
         for key in ("decode_s", "batch_s", "dispatch_s", "egress_s",
                     "h2d_stage_s", "d2h_fetch_s", "host_rtt_s",
-                    "seal_s", "serial_s", "pipeline_bound_s"):
+                    "seal_s", "serial_s", "pipeline_bound_s",
+                    "seal_perceived_s", "seal_background_s"):
             assert r[key] > 0.0, key
+        # ISSUE 13 acceptance: the hot path's perceived seal cost is a
+        # packed row copy + enqueue (segment writes run on the worker
+        # pool, attributed to their own background stage timer)
+        assert r["seal_background_segments"] > 0
+        assert r["seal_perceived_s"] < r["seal_s"]
         # dwell is RTT-clamped: ≥ 0, and positive wherever the chain
         # outruns the trivial-program probe (every real backend)
         assert r["device_dwell_s"] >= 0.0

@@ -41,6 +41,10 @@ from sitewhere_tpu.runtime import faults  # noqa: E402
 FAULT_CATALOG = [
     ("dispatcher.step", 0.3),
     ("dispatcher.egress", 0.3),
+    # the segment store's background seal workers (store/sealer.py);
+    # event_store.flush is the legacy single-writer point, kept for
+    # stores still on the base EventStore
+    ("event_store.seal", 0.5),
     ("event_store.flush", 0.5),
 ]
 

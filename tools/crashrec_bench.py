@@ -63,6 +63,15 @@ T0 = 1_754_000_000
 SMOKE_KILLS = [
     ("crash.mid_ring", 2),
     ("crash.mid_egress", 5),
+    # kill a background SEAL WORKER mid-segment-write (the segment
+    # store's parallel seal pool): boot must quarantine/ignore the torn
+    # file and journal replay re-derives the job's rows — zero
+    # committed-event loss, consistent catalog
+    ("crash.mid_seal", 2),
+    # kill between the merged compaction segment landing and the input
+    # unlink: boot's tombstone resolution must drop the inputs (rows
+    # appear exactly once), not double them
+    ("crash.mid_compact", 1),
     ("crash.pre_manifest", 2),
 ]
 SWEEP_CATALOG = {
@@ -70,6 +79,7 @@ SWEEP_CATALOG = {
     "crash.post_journal": (1, N_PAYLOADS - 1),
     "crash.mid_egress": (1, 10),
     "crash.mid_seal": (1, 4),
+    "crash.mid_compact": (1, 2),
     "crash.mid_checkpoint": (1, 3),
     "crash.pre_manifest": (1, 3),
 }
@@ -237,6 +247,12 @@ def run_child(data_dir, matches_path):
             inst.analytics.drain()
             inst.outbound.drain()
             inst.checkpointer.save()
+            # drive one background-compaction round mid-workload (the
+            # interval loop is too slow for this harness), so the
+            # crash.mid_compact crosspoint is certainly crossed
+            compactor = getattr(inst.event_store, "compactor", None)
+            if compactor is not None:
+                compactor.run_once()
     inst.dispatcher.flush()
     inst.analytics.drain()
     inst.analytics.flush_live()
@@ -282,6 +298,17 @@ def verify(data_dir, matches_path, expected, committed_at_kill):
         inst.analytics.flush_live()
         inst.outbound.drain()
         inst.event_store.flush()
+
+        # segment-catalog consistency: the restarted store's manifest
+        # must be internally consistent (no dangling files, no
+        # unresolved compaction tombstones, sorted scan order)
+        verify_catalog = getattr(inst.event_store, "verify_catalog", None)
+        if verify_catalog is not None:
+            problems = verify_catalog()
+            if problems:
+                failures.append(
+                    f"segment catalog inconsistent after restart: "
+                    f"{problems[:3]}")
 
         stored = {}
         for cols in inst.event_store.iter_chunks():
