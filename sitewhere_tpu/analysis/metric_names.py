@@ -80,9 +80,25 @@ FAMILIES: Dict[str, Optional[Set[str]]] = {
         "segments", "segments_hot", "hot_bytes",
         "seal_queue_depth", "buffered_rows", "catalog_drift",
     },
+    # cross-host forwarding + fleet health plane (rpc/forward.py,
+    # rpc/health.py) — the family the fleet chaos bench and the
+    # topology dashboards address; replaces the old dict-only
+    # HostForwarder.metrics() surface
+    "forward": {
+        # counters
+        "local_rows", "forwarded_rows", "dead_lettered",
+        "send_attempts", "probe_sends", "shed_retained",
+        "edge_refusals", "heartbeats_sent", "heartbeats_failed",
+        "deadline_expired",
+        # gauges
+        "pending_rows",
+    },
+    # per-peer health gauges: dynamic <process-id> suffixes
+    "forward.peer_state": None,      # 0 ALIVE / 1 SUSPECT / 2 DOWN
+    "forward.peer_overload": None,   # the peer's advertised OverloadState
 }
 # prefixes where EVERY name must resolve to a declared family (MN003)
-GOVERNED_PREFIXES = ("device.", "slo.", "store.")
+GOVERNED_PREFIXES = ("device.", "slo.", "store.", "forward.")
 
 
 def family_of(name: str) -> Optional[str]:

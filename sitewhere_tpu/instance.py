@@ -476,8 +476,16 @@ class Instance(LifecycleComponent):
             self.rpc_server = self.add_child(RpcServer(
                 host=str(self.config.get("rpc.server.host", "127.0.0.1")),
                 port=int(self.config.get("rpc.server.port", 0)),
-                tokens=self.tokens, tracer=self.tracer))
+                tokens=self.tokens, tracer=self.tracer,
+                metrics=self.metrics))
             bind_instance(self.rpc_server, self)
+            if self.overload is not None:
+                # overload piggyback on every RPC response header: busy
+                # fabrics learn this host's pressure at call rate,
+                # faster than the fleet heartbeat period
+                self.rpc_server.overload_provider = (
+                    lambda: (int(self.overload.state),
+                             self.overload.retry_after()))
         if len(peers) > 1:
             from sitewhere_tpu.rpc import HostForwarder, RpcDemux
 
@@ -509,7 +517,13 @@ class Instance(LifecycleComponent):
                 deadline_ms=float(self.config.get(
                     "rpc.forward_deadline_ms", 25.0)),
                 data_dir=self.data_dir,
-                tracer=self.tracer))
+                tracer=self.tracer,
+                metrics=self.metrics,
+                overload=self.overload,
+                heartbeat_interval_s=float(self.config.get(
+                    "rpc.heartbeat_interval_s", 0.5)),
+                call_timeout_s=float(self.config.get(
+                    "rpc.call_timeout_s", 10.0))))
         else:
             self._peer_demuxes = {}
         self._rpc_peers = list(peers)
@@ -1441,6 +1455,10 @@ class Instance(LifecycleComponent):
           refused (the audit/replay half of the shedding contract) —
           admission applies again, so a requeue during a STILL-overloaded
           window is refused, not silently re-shed.
+        - ``forward-shed``: re-route remote-owned rows the forwarder's
+          shed-retention bound forced out — back through
+          ``HostForwarder.ingest_payload`` so ownership recomputes and
+          the owner's (possibly recovered) admission decides again.
         - ``undelivered-command``: re-invoke the command against its
           target assignment.
         Requeue granularity is the PAYLOAD (at-least-once): a multi-device
@@ -1467,6 +1485,23 @@ class Instance(LifecycleComponent):
                     "reason": "record was already requeued"}
         # same default the dispatcher's crash recovery uses
         decoder = self.dispatcher.recovery_decoder or JsonLinesDecoder()
+        if kind == "forward-shed" and "payload" in doc:
+            from sitewhere_tpu.runtime.overload import OverloadShed
+
+            if self.forwarder is None:
+                return {"requeued": False, "kind": kind,
+                        "reason": "no forwarder on this host"}
+            payload = bytes.fromhex(doc["payload"])
+            try:
+                self.forwarder.ingest_payload(payload, source_id="requeue")
+            except OverloadShed as e:
+                # owner still shedding: the record stays un-requeued so
+                # the operator can retry after the fleet recovers
+                return {"requeued": False, "kind": kind,
+                        "reason": f"owner still shedding: {e}"}
+            self._mark_requeued(offset)
+            return {"requeued": True, "kind": kind,
+                    "rows": payload.count(b"\n") + 1}
         if kind in ("failed-decode", "failed-stream-request",
                     "intake-shed") and "payload" in doc:
             payload = bytes.fromhex(doc["payload"])

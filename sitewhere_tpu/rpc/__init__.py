@@ -13,10 +13,12 @@ keyed cross-host event forwarding (`forward`).
 
 from sitewhere_tpu.rpc.channel import (
     ChannelUnavailable,
+    DeadlineExpired,
     RpcChannel,
     RpcDemux,
     RpcError,
 )
+from sitewhere_tpu.rpc.health import PeerHealthTable, PeerState
 from sitewhere_tpu.rpc.domains import (
     DOMAIN_SURFACE,
     RemoteDomain,
@@ -31,7 +33,10 @@ from sitewhere_tpu.rpc.services import RemoteDeviceManagement, bind_instance
 __all__ = [
     "CallContext",
     "ChannelUnavailable",
+    "DeadlineExpired",
     "HostForwarder",
+    "PeerHealthTable",
+    "PeerState",
     "RemoteDeviceManagement",
     "RpcChannel",
     "RpcDemux",

@@ -43,12 +43,57 @@ RECONNECT_POLICY = RetryPolicy(
 
 
 class RpcError(Exception):
-    """Server-side failure surfaced to the caller."""
+    """Server-side failure surfaced to the caller.
 
-    def __init__(self, error: str, message: str):
+    ``headers`` carries the error response's metadata lane (notably the
+    overload piggyback ``x-overload``/``x-retry-after``) so callers can
+    learn the peer's pressure even from a refusal."""
+
+    def __init__(self, error: str, message: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(f"{error}: {message}")
         self.error = error
         self.message = message
+        self.headers = headers or {}
+
+
+class DeadlineExpired(RpcError):
+    """The call's propagated deadline lapsed — client-side before the
+    send, or server-side before the handler ran (no work was executed).
+
+    RETRYABLE and deliberately distinct from :class:`ChannelUnavailable`:
+    the peer is healthy, the *budget* died (usually behind a slow
+    fabric).  Callers retry with a fresh budget; failure detectors must
+    NOT count it as peer death."""
+
+    def __init__(self, message: str,
+                 headers: Optional[Dict[str, str]] = None):
+        super().__init__("deadline_expired", message, headers)
+
+
+# error code the server answers for pre-dispatch deadline rejections;
+# the client re-raises it as DeadlineExpired (kept in one place so the
+# two sides cannot drift)
+DEADLINE_ERROR_CODE = "deadline_expired"
+HEADER_DEADLINE = "deadline-ms"     # absolute unix epoch milliseconds
+
+
+def deadline_header(budget_s: float) -> str:
+    """Absolute-epoch encoding of a remaining budget.  Wall clock, not
+    monotonic: the value must be comparable on the RECEIVING host."""
+    return str(int((time.time() + budget_s) * 1000.0))
+
+
+def deadline_remaining_s(headers: Dict[str, str]) -> Optional[float]:
+    """Remaining budget encoded in ``headers`` (negative = expired);
+    None when the call carries no deadline."""
+    raw = headers.get(HEADER_DEADLINE)
+    if raw is None:
+        return None
+    try:
+        return int(raw) / 1000.0 - time.time()
+    except (TypeError, ValueError):
+        return None
 
 
 class ChannelUnavailable(Exception):
@@ -77,12 +122,17 @@ class RpcChannel:
     def __init__(self, endpoint: str,
                  token_provider: Optional[Callable[[], str]] = None,
                  tenant: Optional[str] = None,
-                 connect_timeout_s: float = 5.0):
+                 connect_timeout_s: float = 5.0,
+                 header_listener: Optional[
+                     Callable[[Dict[str, str]], None]] = None):
         self.endpoint = endpoint
         self._addr = wire.parse_endpoint(endpoint)
         self._token_provider = token_provider
         self._tenant = tenant
         self._connect_timeout_s = connect_timeout_s
+        # response-header tap: the health table's piggyback intake (a
+        # listener crash must never fail the call it rode on)
+        self.header_listener = header_listener
         self._sock: Optional[socket.socket] = None
         self._reader: Optional[threading.Thread] = None
         self._lock = threading.Lock()          # connection state transitions
@@ -104,7 +154,7 @@ class RpcChannel:
     def in_backoff(self) -> bool:
         return not self.connected and not self._backoff.due()
 
-    def _connect_locked(self) -> None:
+    def _connect_locked(self, timeout_s: Optional[float] = None) -> None:
         if self._sock is not None or self._closed:
             return
         if not self._backoff.due():
@@ -113,8 +163,13 @@ class RpcChannel:
                 f"{self._backoff.remaining():.1f}s")
         try:
             faults.fire("rpc.connect")
+            if faults.net_drops(self.endpoint, "connect"):
+                # injected partition: unreachable exactly like a refused
+                # connect (backoff advances, caller fails over)
+                raise OSError("injected network partition")
             sock = socket.create_connection(
-                self._addr, timeout=self._connect_timeout_s)
+                self._addr, timeout=(timeout_s if timeout_s is not None
+                                     else self._connect_timeout_s))
         except OSError as e:
             self._backoff.defer()
             raise ChannelUnavailable(f"{self.endpoint}: {e}") from e
@@ -174,7 +229,8 @@ class RpcChannel:
     def call(self, method: str, body: object = None,
              attachment: bytes = b"",
              headers: Optional[Dict[str, str]] = None,
-             timeout_s: float = 30.0, trace=None) -> Tuple[object, bytes]:
+             timeout_s: float = 30.0, trace=None,
+             deadline_s: Optional[float] = None) -> Tuple[object, bytes]:
         """One request/reply round trip.  Returns ``(body, attachment)``.
 
         ``trace`` (a :class:`~sitewhere_tpu.runtime.tracing.Trace`) wraps
@@ -182,28 +238,61 @@ class RpcChannel:
         trace context into the frame headers so the server continues the
         SAME trace — the client tracing interceptor analog.
 
-        Raises :class:`RpcError` for server-reported failures,
-        :class:`ChannelUnavailable` for transport failures (the demux
-        catches the latter and fails over).
+        ``deadline_s`` is the call's remaining BUDGET in seconds: it is
+        stamped into the ``deadline-ms`` header (absolute epoch ms, the
+        grpc-timeout analog), the client wait timeout derives from it
+        (never longer than the budget), and a server receiving it
+        already expired rejects the call before executing the handler —
+        no wasted work behind a slow fabric.
+
+        Raises :class:`RpcError` for server-reported failures
+        (:class:`DeadlineExpired` for a lapsed budget — retryable,
+        distinct from peer-down), :class:`ChannelUnavailable` for
+        transport failures (the demux catches the latter and fails
+        over).
         """
         trace = trace or _NOOP_TRACE
         with trace.span(f"rpc.client.{method}") as span:
             span.tag("endpoint", self.endpoint)
             hdrs = trace.propagate(dict(headers or {}), parent=span)
-            return self._call(method, body, attachment, hdrs, timeout_s)
+            return self._call(method, body, attachment, hdrs, timeout_s,
+                              deadline_s)
 
     def _call(self, method: str, body: object, attachment: bytes,
-              hdrs: Dict[str, str], timeout_s: float) -> Tuple[object, bytes]:
+              hdrs: Dict[str, str], timeout_s: float,
+              deadline_s: Optional[float] = None) -> Tuple[object, bytes]:
+        if deadline_s is not None:
+            if deadline_s <= 0:
+                # budget already burned (an upstream hop ate it): fail
+                # here, client-side — the wire would only spread the lapse
+                raise DeadlineExpired(
+                    f"{self.endpoint}: budget exhausted before {method}")
+            hdrs.setdefault(HEADER_DEADLINE, deadline_header(deadline_s))
+            timeout_s = min(timeout_s, deadline_s)
         if self._token_provider is not None and "authorization" not in hdrs:
             hdrs["authorization"] = self._token_provider()
         if self._tenant is not None and "tenant" not in hdrs:
             hdrs["tenant"] = self._tenant
+        # injected network faults (runtime/faults.py net plane): latency
+        # delays the send (consuming real deadline budget, exactly like
+        # a slow fabric); a request-direction drop is a transport fault
+        drop, delay = faults.net_shape(self.endpoint, "request")
+        if drop:
+            raise ChannelUnavailable(
+                f"{self.endpoint}: injected partition on {method}")
+        if delay > 0.0:
+            time.sleep(delay)
         # Encode BEFORE taking any lock, and connect under the state lock
-        # only (bounded by connect_timeout); the write lock serializes just
-        # the sendall so a slow large-attachment writer never stalls other
-        # callers' connect/registration — their own timeout_s governs.
+        # only (bounded by connect_timeout — itself capped by the call's
+        # remaining budget, so a blackholed peer cannot overrun the
+        # deadline by a 5s SYN timeout); the write lock serializes just
+        # the sendall so a slow large-attachment writer never stalls
+        # other callers' connect/registration — their own timeout_s
+        # governs.
         with self._lock:
-            self._connect_locked()
+            self._connect_locked(
+                min(self._connect_timeout_s, deadline_s)
+                if deadline_s is not None else None)
             sock = self._sock
         if sock is None:
             raise ChannelUnavailable(f"{self.endpoint}: not connected")
@@ -221,6 +310,13 @@ class RpcChannel:
                 self._pending.pop(request_id, None)
             self._drop(sock, e)
             raise ChannelUnavailable(f"{self.endpoint}: {e}") from e
+        if faults.net_drops(self.endpoint, "response"):
+            # one-way partition: the request REACHED the server (it may
+            # execute!) but the reply is lost — drop the pending slot so
+            # the read loop discards the response and the caller times
+            # out, exactly the ambiguity a real half-open link produces
+            with self._pending_lock:
+                self._pending.pop(request_id, None)
         if not pending.event.wait(timeout_s):
             with self._pending_lock:
                 self._pending.pop(request_id, None)
@@ -229,10 +325,19 @@ class RpcChannel:
         frame = pending.frame
         if frame is None:
             raise ChannelUnavailable(f"{self.endpoint}: connection lost")
+        if frame.headers and self.header_listener is not None:
+            try:
+                self.header_listener(frame.headers)
+            except Exception:   # noqa: BLE001 — a tap must not fail the call
+                logger.exception("%s: response header listener failed",
+                                 self.endpoint)
         if frame.is_error:
             err = frame.body if isinstance(frame.body, dict) else {}
-            raise RpcError(err.get("error", "internal"),
-                           err.get("message", "unknown error"))
+            code = err.get("error", "internal")
+            message = err.get("message", "unknown error")
+            if code == DEADLINE_ERROR_CODE:
+                raise DeadlineExpired(message, frame.headers)
+            raise RpcError(code, message, frame.headers)
         return frame.body, frame.attachment
 
     def close(self) -> None:
@@ -263,10 +368,13 @@ class RpcDemux:
     def __init__(self, endpoints: List[str],
                  token_provider: Optional[Callable[[], str]] = None,
                  tenant: Optional[str] = None,
-                 connect_timeout_s: float = 5.0):
+                 connect_timeout_s: float = 5.0,
+                 header_listener: Optional[
+                     Callable[[Dict[str, str]], None]] = None):
         self._token_provider = token_provider
         self._tenant = tenant
         self._connect_timeout_s = connect_timeout_s
+        self._header_listener = header_listener
         self._lock = threading.Lock()
         self._channels: Dict[str, RpcChannel] = {}
         self._rr = 0
@@ -275,7 +383,19 @@ class RpcDemux:
     def _make_channel(self, endpoint: str) -> RpcChannel:
         return RpcChannel(endpoint, token_provider=self._token_provider,
                           tenant=self._tenant,
-                          connect_timeout_s=self._connect_timeout_s)
+                          connect_timeout_s=self._connect_timeout_s,
+                          header_listener=self._header_listener)
+
+    def set_header_listener(
+            self, listener: Optional[Callable[[Dict[str, str]], None]],
+    ) -> None:
+        """Install the response-header tap on every current and future
+        channel (the forwarder's health table registers its piggyback
+        intake here)."""
+        with self._lock:
+            self._header_listener = listener
+            for chan in self._channels.values():
+                chan.header_listener = listener
 
     def set_endpoints(self, endpoints: List[str]) -> None:
         """Reconcile the channel set against a new replica list
@@ -306,24 +426,42 @@ class RpcDemux:
     def call(self, method: str, body: object = None,
              attachment: bytes = b"",
              headers: Optional[Dict[str, str]] = None,
-             timeout_s: float = 30.0, trace=None) -> Tuple[object, bytes]:
+             timeout_s: float = 30.0, trace=None,
+             deadline_s: Optional[float] = None) -> Tuple[object, bytes]:
         """Round-robin call with failover: transport failures rotate to
         the next replica; server-reported errors (RpcError) do NOT fail
         over — the reference likewise retries only channel faults, not
         application faults.  ``trace`` propagates per attempt, so a
-        failed-over call shows one client span per replica tried."""
+        failed-over call shows one client span per replica tried.
+
+        ``deadline_s`` is ONE budget for the whole rotation: each
+        failover attempt gets only what the previous attempts left, so
+        k dead replicas cannot multiply the caller's wait."""
         rotation = self._rotation()
         if not rotation:
             raise ChannelUnavailable("no endpoints configured")
+        deadline_at = (time.monotonic() + deadline_s
+                       if deadline_s is not None else None)
         last: Optional[Exception] = None
         for chan in rotation:
             if chan.in_backoff() and len(rotation) > 1:
                 last = last or ChannelUnavailable(
                     f"{chan.endpoint} in backoff")
                 continue
+            remaining = (deadline_at - time.monotonic()
+                         if deadline_at is not None else None)
+            if remaining is not None and remaining <= 0:
+                if isinstance(last, ChannelUnavailable):
+                    # transport failures ate the budget: surface THEM —
+                    # a caller's failure detector must count this
+                    # toward peer death, not file it as a benign
+                    # budget lapse
+                    raise last
+                raise DeadlineExpired(
+                    f"budget exhausted during failover on {method}")
             try:
                 return chan.call(method, body, attachment, headers, timeout_s,
-                                 trace=trace)
+                                 trace=trace, deadline_s=remaining)
             except ChannelUnavailable as e:
                 last = e
                 global_registry().counter(

@@ -38,13 +38,43 @@ import zlib
 from typing import Dict, List, Optional
 
 from sitewhere_tpu.ingest.journal import Journal, JournalReader
-from sitewhere_tpu.rpc.channel import ChannelUnavailable, RpcDemux, RpcError
+from sitewhere_tpu.rpc.channel import (
+    ChannelUnavailable,
+    DeadlineExpired,
+    RpcDemux,
+    RpcError,
+)
+from sitewhere_tpu.rpc.health import PeerHealthTable, PeerState
+from sitewhere_tpu.runtime import faults
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.overload import (
+    OverloadShed,
+    OverloadState,
+    PriorityClass,
+    classify_event_type,
+)
 from sitewhere_tpu.runtime.tracing import _NOOP_TRACE
 
 logger = logging.getLogger("sitewhere_tpu.rpc")
 
 SPOOL_POLL_RECORDS = 64    # batches per send drain
+
+# delivery outcomes (_deliver): terminal-or-delivered / retain-and-pace
+_OK = "ok"        # delivered, or non-retryable rejection (dead-lettered)
+_DOWN = "down"    # unreachable / deadline lapsed: rows retained
+_SHED = "shed"    # the owner's admission refused: rows retained, paced
+
+# payload markers that exempt the device-facing owner-pressure gate: a
+# payload that MIGHT carry an alert / command response is always
+# forwarded (the owner's own admission never sheds CRITICAL) — false
+# positives only skip the gate, never drop rows
+_CRITICAL_MARKERS = (b"alert", b"acknowledge", b"commandresponse")
+
+
+def _has_critical_marker(payload: bytes) -> bool:
+    low = payload.lower()
+    return any(m in low for m in _CRITICAL_MARKERS)
 
 
 def _fmix32(h: int) -> int:
@@ -155,6 +185,16 @@ class HostForwarder(LifecycleComponent):
     peer's sends run on their own thread, so a down peer's connect
     timeouts and backoffs delay only its own rows.  See the module
     docstring for the durable (``data_dir``) vs memory-only contract.
+
+    Fleet health (``rpc/health.py``): the forwarder runs the
+    ``fleet.heartbeat`` loop and keeps a :class:`PeerHealthTable` fed
+    by heartbeats, per-call response piggybacks, and its own send
+    failures.  A SUSPECT/DOWN/SHEDDING peer's sender parks its spool
+    and sends ONE paced probe batch per interval (honoring the peer's
+    Retry-After hint) instead of hammering full drains; a purely
+    remote-owned payload whose owners advertise SHEDDING is refused at
+    intake with the owner's hint so the device-facing edge (429 / 5.03
+    / MQTT pause) reflects fleet-wide pressure.
     """
 
     def __init__(self, dispatcher, process_id: int,
@@ -165,6 +205,12 @@ class HostForwarder(LifecycleComponent):
                  max_retries: int = 3,
                  data_dir: Optional[str] = None,
                  tracer=None,
+                 metrics=None,
+                 overload=None,
+                 health: Optional[PeerHealthTable] = None,
+                 heartbeat_interval_s: float = 0.5,
+                 call_timeout_s: float = 10.0,
+                 max_retained_bytes: Optional[int] = None,
                  name: str = "host-forwarder"):
         super().__init__(name)
         self.dispatcher = dispatcher
@@ -181,6 +227,66 @@ class HostForwarder(LifecycleComponent):
         self.deadline_s = deadline_ms / 1000.0
         self.max_buffer_bytes = max_buffer_bytes
         self.max_retries = max_retries
+        # this host's own overload controller: the heartbeat body and
+        # response piggyback advertise ITS state to peers
+        self.overload = overload
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        # per-call budget: propagated as the deadline-ms header so the
+        # owner rejects work this sender has already given up on
+        self.call_timeout_s = float(call_timeout_s)
+        # memory-mode retention bound for overload-shed rows (satellite
+        # of the at-least-once contract: the owner WILL take them after
+        # recovery, so they buffer instead of dead-lettering — until
+        # this bound forces a replayable forward-shed drop)
+        self.max_retained_bytes = (int(max_retained_bytes)
+                                   if max_retained_bytes is not None
+                                   else 4 * max_buffer_bytes)
+        # restart epoch for the fleet heartbeat: a rebooted sender's
+        # first beat replaces peers' stale view of us atomically
+        self.incarnation = int(time.time())
+        # instance-scoped registry by default (a PRIVATE one when none
+        # is injected — forwarders are per-instance objects and their
+        # counters must never bleed across co-resident instances)
+        self._metrics = metrics if metrics is not None else MetricsRegistry()
+        # the forward.* family (analysis/metric_names.py): the canonical
+        # observable surface — the legacy local_rows/forwarded_rows/
+        # dead_lettered attributes are read-only VIEWS of these (one
+        # source of truth; see the properties below)
+        self._m_local = self._metrics.counter("forward.local_rows")
+        self._m_forwarded = self._metrics.counter("forward.forwarded_rows")
+        self._m_dead = self._metrics.counter("forward.dead_lettered")
+        self._m_pending = self._metrics.gauge("forward.pending_rows")
+        self._m_attempts = self._metrics.counter("forward.send_attempts")
+        self._m_probes = self._metrics.counter("forward.probe_sends")
+        self._m_shed_retained = self._metrics.counter(
+            "forward.shed_retained")
+        self._m_edge = self._metrics.counter("forward.edge_refusals")
+        self._m_hb_sent = self._metrics.counter("forward.heartbeats_sent")
+        self._m_hb_fail = self._metrics.counter("forward.heartbeats_failed")
+        self._m_deadline = self._metrics.counter("forward.deadline_expired")
+        # the peer health table (rpc/health.py): parked senders, paced
+        # probes, and the device-facing owner-pressure gate all read it
+        remote = [p for p, d in peer_demuxes.items() if d is not None]
+        if health is None:
+            if self.heartbeat_interval_s > 0:
+                health = PeerHealthTable(
+                    remote,
+                    heartbeat_interval_s=self.heartbeat_interval_s,
+                    metrics=self._metrics)
+            else:
+                # no heartbeat loop: silence means nothing (only
+                # forward traffic refreshes last_heard), so the
+                # interval detector must not declare idle peers dead —
+                # the send-failure streak remains the liveness signal
+                health = PeerHealthTable(
+                    remote, metrics=self._metrics,
+                    suspect_after_s=float("inf"),
+                    down_after_s=float("inf"))
+        self.health = health
+        # response piggyback: every reply from peer p (any method, error
+        # frames included) refreshes p's overload state in the table
+        self._bind_piggyback(peer_demuxes)
+        self._heartbeater: Optional[threading.Thread] = None
         self._lock = threading.Lock()     # buffers + counters + sender set
         # memory-mode buffers
         self._buffers: Dict[int, List[bytes]] = {}
@@ -193,6 +299,13 @@ class HostForwarder(LifecycleComponent):
         self._spool_readers: Dict[int, JournalReader] = {}
         self._owner_locks: Dict[int, threading.Lock] = {}
         self._spool_since: Dict[int, float] = {}
+        # rows retained per owner in durable spools (records are
+        # multi-row payloads; see the boot-time count below)
+        self._pending_rows: Dict[int, int] = {}
+        # consecutive deadline expiries per owner: a healthy-looking
+        # peer rejecting every call pre-dispatch usually means host
+        # clock skew larger than the call budget — surfaced loudly
+        self._deadline_streaks: Dict[int, int] = {}
         self._data_dir = data_dir
         # membership generation: ownership is computed OUTSIDE the lock
         # (split_lines is the expensive part), then buffered atomically
@@ -211,6 +324,13 @@ class HostForwarder(LifecycleComponent):
                                 fsync_every=64, segment_bytes=4 << 20)
                 self._spools[p] = spool
                 self._spool_readers[p] = JournalReader(spool, "sender")
+                # ROW-accurate backlog: spool records are multi-row
+                # joined payloads, so reader.lag (records) would
+                # under-report; count the surviving uncommitted tail
+                # once at boot, then track appends/commits
+                self._pending_rows[p] = sum(
+                    payload.count(b"\n") + 1 for _, payload in
+                    spool.scan(self._spool_readers[p].committed))
         for p, demux in peer_demuxes.items():
             if demux is not None:
                 self._owner_locks[p] = threading.Lock()
@@ -218,15 +338,69 @@ class HostForwarder(LifecycleComponent):
         self._active_owners: set = set()
         self._flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self.forwarded_rows = 0
-        self.local_rows = 0
-        self.dead_lettered = 0
+
+    # legacy counter surface: read-only views of the registry counters
+    # (one source of truth — an increment site cannot forget one half)
+
+    @property
+    def local_rows(self) -> int:
+        return int(self._m_local.value)
+
+    @property
+    def forwarded_rows(self) -> int:
+        return int(self._m_forwarded.value)
+
+    @property
+    def dead_lettered(self) -> int:
+        return int(self._m_dead.value)
 
     @property
     def durable(self) -> bool:
         return bool(self._spools)
 
+    def _bind_piggyback(self, peer_demuxes) -> None:
+        """Tap every peer demux's response headers into the health
+        table (the per-call overload piggyback intake)."""
+        for p, demux in peer_demuxes.items():
+            if demux is not None and hasattr(demux, "set_header_listener"):
+                demux.set_header_listener(
+                    lambda h, _p=p: self.health.observe_piggyback(_p, h))
+
     # -- intake --------------------------------------------------------------
+
+    def _edge_backpressure(self, remote, n_local: int,
+                           critical_fn) -> None:
+        """Device-facing owner-pressure gate: a payload whose rows are
+        ALL remote-owned by SHEDDING+ owners is refused with the
+        OWNER's Retry-After hint — the receiving transport turns it
+        into HTTP 429 / CoAP 5.03 / an MQTT pause, so fleet-wide
+        backpressure reaches the device that can act on it.
+
+        Refusal is whole-payload (the intake-shed granularity): nothing
+        was accepted or buffered, the device owns the retry, and the
+        owner's admission re-decides then.  Payloads with any local
+        share, any possibly-CRITICAL row, or any healthy owner forward
+        as usual — the spool absorbs the pressure instead."""
+        if n_local or not remote:
+            return
+        worst: Optional[tuple] = None
+        for owner in remote:
+            pressure = self.health.owner_pressure(owner)
+            if pressure is None:
+                return          # at least one owner can take traffic
+            if worst is None or pressure[0] > worst[0]:
+                worst = pressure
+        # the (comparatively pricey) critical scan runs LAST — only for
+        # a purely remote payload whose owners all advertise SHEDDING
+        if critical_fn():
+            return
+        state, retry_after_s = worst
+        self._m_edge.inc()
+        raise OverloadShed(
+            PriorityClass.TELEMETRY,
+            OverloadState(min(int(state), int(OverloadState.EMERGENCY))),
+            retry_after_s,
+            reason=f"remote owner(s) {sorted(remote)} shedding")
 
     def ingest_payload(self, payload: bytes, source_id: str = "wire",
                        raise_on_decode_error: bool = False) -> int:
@@ -249,6 +423,12 @@ class HostForwarder(LifecycleComponent):
                     local.extend(lines)
                 else:
                     remote[owner] = lines
+            # BEFORE buffering anything: a purely-remote payload whose
+            # owners advertise SHEDDING is refused outright with the
+            # owners' hint (the device retries; nothing duplicates)
+            self._edge_backpressure(
+                remote, len(local),
+                lambda p=payload: _has_critical_marker(p))
             if self._route_remote(remote, gen):
                 break  # else: membership changed mid-split; recompute
         accepted = 0
@@ -256,8 +436,7 @@ class HostForwarder(LifecycleComponent):
             accepted = self.dispatcher.ingest_wire_lines(
                 b"\n".join(local), source_id=source_id,
                 raise_on_decode_error=raise_on_decode_error)
-            with self._lock:
-                self.local_rows += accepted
+            self._m_local.inc(accepted)
         return accepted
 
     def ingest_requests(self, reqs, payload: bytes = b"",
@@ -275,12 +454,19 @@ class HostForwarder(LifecycleComponent):
                                self.process_id)
             local = []
             remote: Dict[int, List[bytes]] = {}
+            critical_possible = False
             for req in reqs:
                 owner = owning_process(req.device_token, n)
+                if (req.event_type is None
+                        or classify_event_type(int(req.event_type))
+                        != PriorityClass.TELEMETRY):
+                    critical_possible = True   # decoded: classify exactly
                 if owner == pid:
                     local.append(req)
                 else:
                     remote.setdefault(owner, []).append(encode_envelope(req))
+            self._edge_backpressure(remote, len(local),
+                                    lambda c=critical_possible: c)
             if self._route_remote(remote, gen):
                 break  # else: membership changed mid-split; recompute
         if local:
@@ -291,8 +477,7 @@ class HostForwarder(LifecycleComponent):
             if remote:
                 payload = b"\n".join(encode_envelope(r) for r in local)
             self.dispatcher.ingest_many(local, payload)
-            with self._lock:
-                self.local_rows += len(local)
+            self._m_local.inc(len(local))
         return len(local)
 
     def ingest_registration(self, req, payload: bytes = b"") -> None:
@@ -354,6 +539,8 @@ class HostForwarder(LifecycleComponent):
                                       "no spool for peer"))
                         continue
                     spool.append(b"\n".join(lines))
+                    self._pending_rows[owner] = (
+                        self._pending_rows.get(owner, 0) + len(lines))
                     self._spool_since.setdefault(owner, time.monotonic())
                     if (self._spool_readers[owner].lag
                             >= SPOOL_POLL_RECORDS):
@@ -433,17 +620,44 @@ class HostForwarder(LifecycleComponent):
         """Send everything pending for one peer.  The per-owner lock
         serializes senders so the spool reader's poll→send→commit is
         atomic and batches stay ordered per peer.  Returns True on a
-        clean drain (emptied), False when the peer was unreachable."""
+        clean drain (emptied), False when rows were retained (peer
+        unreachable / shedding / parked).
+
+        Health gate: a SUSPECT/DOWN/SHEDDING peer's sender PARKS — at
+        most one paced probe batch per probe interval instead of a full
+        drain — so an unhealthy peer costs the fleet a bounded trickle,
+        not a retry storm.  A delivered probe whose piggyback shows
+        recovery resumes the full drain in the same pass."""
         lock = self._owner_locks.get(owner)
         if lock is None:
             return True
         with lock:
+            probing = False
+            if not self.health.can_drain(owner):
+                if not self.health.probe_due(owner):
+                    return False     # parked: rows stay put, no attempt
+                probing = True
+                self._m_probes.inc()
             if not self.durable:
                 with self._lock:
                     payload = self._drain_memory_locked(owner)
                 if payload is not None:
-                    delivered = self._deliver(owner, payload)
-                    if not delivered:
+                    outcome = self._deliver(owner, payload, probe=probing)
+                    if outcome == _SHED:
+                        # the owner WILL take these rows after recovery:
+                        # keep them buffered (bounded) instead of
+                        # dead-lettering work that isn't dead
+                        self._retain_shed(owner, payload)
+                        return False
+                    if outcome == _DOWN:
+                        if self._stop.is_set():
+                            # stopping: fire-and-forget mode records the
+                            # loss rather than silently vanishing with
+                            # the process
+                            self._dead_letter(
+                                owner, payload,
+                                f"peer {owner} unreachable at stop")
+                            return True
                         self._dead_letter(
                             owner, payload,
                             f"peer {owner} unreachable after "
@@ -452,36 +666,82 @@ class HostForwarder(LifecycleComponent):
             reader = self._spool_readers[owner]
             while True:
                 start = reader.position
-                records = reader.poll(SPOOL_POLL_RECORDS)
+                records = reader.poll(1 if probing else SPOOL_POLL_RECORDS)
                 if not records:
                     with self._lock:
                         self._spool_since.pop(owner, None)
                     return True
+                # kill window under test: rows polled (reader.position
+                # advanced in memory) but the peer has not acked — a
+                # SIGKILL here must replay this tail from the committed
+                # offset on restart (crashrec_bench crash.mid_forward)
+                faults.crosspoint("crash.mid_forward")
                 payload = b"\n".join(r for _, r in records)
-                if self._deliver(owner, payload):
+                outcome = self._deliver(owner, payload, probe=probing)
+                if outcome == _OK:
                     reader.commit()
+                    with self._lock:
+                        self._pending_rows[owner] = max(
+                            0, self._pending_rows.get(owner, 0)
+                            - (payload.count(b"\n") + 1))
                     # delivered prefix has no future readers: reclaim
                     # whole segments below the commit (Kafka retention
                     # at the commit frontier)
                     self._spools[owner].prune(reader.committed)
-                else:
-                    # peer down: rows stay spooled (a down broker's
-                    # partition log); rewind and retry next flush cycle
-                    reader.seek(start)
-                    logger.warning(
-                        "peer %d unreachable; %d spooled batches retained",
-                        owner, reader.lag)
-                    return False
+                    if probing and not self.health.can_drain(owner):
+                        # probe landed but the owner still sheds (its
+                        # piggyback said so): stay paced
+                        return False
+                    probing = False
+                    continue
+                # peer down or shedding: rows stay spooled (a down
+                # broker's partition log); rewind and let the paced
+                # probe schedule own the redelivery
+                reader.seek(start)
+                logger.warning(
+                    "peer %d %s; %d spooled batches retained", owner,
+                    "shedding" if outcome == _SHED else "unreachable",
+                    reader.lag)
+                return False
 
-    def _deliver(self, owner: int, payload: bytes) -> bool:
-        """One batch to one peer with bounded retries.  True on success
-        or non-retryable rejection (which dead-letters); False when the
-        peer is unreachable (caller decides: spool-retain or
-        dead-letter)."""
+    def _retain_shed(self, owner: int, payload: bytes) -> None:
+        """Memory-mode shed retention: push the refused lines back to
+        the FRONT of the buffer (order preserved) under
+        ``max_retained_bytes``; overflow dead-letters the OLDEST lines
+        with the replayable ``forward-shed`` kind (mirroring the intake
+        path's ``intake-shed`` contract — audit + requeue, not loss)."""
+        lines = payload.split(b"\n")
+        dropped: List[bytes] = []
+        with self._lock:
+            buf = self._buffers.setdefault(owner, [])
+            self._buffer_since.setdefault(owner, time.monotonic())
+            buf[:0] = lines
+            size = self._buffer_bytes.get(owner, 0) \
+                + sum(len(l) + 1 for l in lines)
+            while size > self.max_retained_bytes and buf:
+                line = buf.pop(0)
+                size -= len(line) + 1
+                dropped.append(line)
+            self._buffer_bytes[owner] = size
+        self._m_shed_retained.inc(max(0, len(lines) - len(dropped)))
+        if dropped:
+            self._dead_letter(
+                owner, b"\n".join(dropped),
+                f"shed-retention bound ({self.max_retained_bytes}B) "
+                f"exceeded while peer {owner} sheds",
+                kind="forward-shed")
+
+    def _deliver(self, owner: int, payload: bytes,
+                 probe: bool = False) -> str:
+        """One batch to one peer with bounded retries.  ``_OK`` on
+        success or non-retryable rejection (which dead-letters);
+        ``_DOWN`` when the peer is unreachable; ``_SHED`` when the
+        owner's admission refused the rows (both retain — the caller
+        decides spool-rewind vs re-buffer vs dead-letter)."""
         demux = self.peers.get(owner)
         if demux is None:
             self._dead_letter(owner, payload, "no demux for peer")
-            return True
+            return _OK
         rows = payload.count(b"\n") + 1
         trace = (self.tracer.trace("forward.batch")
                  if self.tracer is not None else _NOOP_TRACE)
@@ -490,63 +750,201 @@ class HostForwarder(LifecycleComponent):
             # rpc.client.events.ingest spans share its trace_id
             with trace.span("forward.batch") as span:
                 span.tag("peer", owner).tag("rows", rows)
-                ok = self._deliver_traced(owner, payload, demux, rows, trace)
-                if not ok:
+                if probe:
+                    span.tag("probe", 1)
+                outcome = self._deliver_traced(owner, payload, demux, rows,
+                                               trace, probe)
+                if outcome == _DOWN:
                     # exhausted retries: flag the hop so tail sampling
                     # retains the trace of an unreachable peer
                     span.error = "peer unreachable: retries exhausted"
-                return ok
+                return outcome
         finally:
             trace.end()
 
     def _deliver_traced(self, owner: int, payload: bytes, demux,
-                        rows: int, trace) -> bool:
-        for attempt in range(self.max_retries):
+                        rows: int, trace, probe: bool = False) -> str:
+        attempts = 1 if probe else self.max_retries
+        for attempt in range(attempts):
+            self._m_attempts.inc()
             try:
                 body, _ = demux.call(
                     "events.ingest",
                     {"sourceId": f"fwd:{self.process_id}"},
-                    attachment=payload, trace=trace)
-                with self._lock:
-                    self.forwarded_rows += int(body.get("accepted", rows))
-                return True
+                    attachment=payload, trace=trace,
+                    timeout_s=self.call_timeout_s,
+                    deadline_s=self.call_timeout_s)
+                self._m_forwarded.inc(int(body.get("accepted", rows)))
+                self._deadline_streaks.pop(owner, None)
+                self.health.observe_alive(owner)
+                return _OK
             except ChannelUnavailable as e:
                 logger.info("forward to %d failed (%d/%d): %s", owner,
-                            attempt + 1, self.max_retries, e)
-                time.sleep(min(0.1 * (2 ** attempt), 2.0))
+                            attempt + 1, attempts, e)
+                self.health.observe_failure(owner)
+                # stop-aware backoff: stop() must not wait out 2s-grade
+                # sleeps on sender threads — the wait aborts the moment
+                # the stop event sets and the retry loop exits
+                if self._stop.wait(min(0.1 * (2 ** attempt), 2.0)):
+                    return _DOWN
+            except DeadlineExpired as e:
+                # the budget died, not the peer: rows are retained and
+                # the next paced pass retries with a fresh budget
+                logger.info("forward to %d deadline expired (%d/%d): %s",
+                            owner, attempt + 1, attempts, e)
+                self._m_deadline.inc()
+                # deadline-ms is wall-clock: a peer that answers but
+                # rejects EVERY call pre-dispatch usually means host
+                # clock skew larger than call_timeout_s — without this
+                # the spool grows silently (the peer looks ALIVE)
+                streak = self._deadline_streaks.get(owner, 0) + 1
+                self._deadline_streaks[owner] = streak
+                if streak % 5 == 0:
+                    logger.warning(
+                        "%d consecutive deadline expiries toward peer "
+                        "%d; if the peer is otherwise healthy, check "
+                        "host clock sync (the deadline-ms header is "
+                        "wall-clock epoch)", streak, owner)
+                if self._stop.wait(min(0.1 * (2 ** attempt), 2.0)):
+                    return _DOWN
             except RpcError as e:
                 if getattr(e, "error", "") == "overloaded":
                     # the owner SHED the rows (admission backpressure):
-                    # retryable exactly like an unreachable peer — the
-                    # spool rewinds and redelivers once it recovers,
+                    # record its advertised state (the error frame's
+                    # piggyback headers carried it) and PARK — the
+                    # paced probe schedule redelivers once it recovers,
                     # never a dead-letter for rows the owner will take
-                    logger.info("forward to %d shed by overload "
-                                "(%d/%d)", owner, attempt + 1,
-                                self.max_retries)
-                    time.sleep(min(0.1 * (2 ** attempt), 2.0))
-                    continue
+                    logger.info("forward to %d shed by overload", owner)
+                    self.health.observe_alive(owner)
+                    pressure = self.health.owner_pressure(owner)
+                    if pressure is None:
+                        # no piggyback reached us (older peer): assume
+                        # SHEDDING with the default hint so pacing holds
+                        self.health.observe_heartbeat(
+                            owner, overload_state=int(
+                                OverloadState.SHEDDING),
+                            retry_after_s=1.0)
+                    return _SHED
+                self.health.observe_alive(owner)   # it answered
                 self._dead_letter(owner, payload, f"peer rejected: {e}")
-                return True
-        return False
+                return _OK
+        return _DOWN
 
-    def _dead_letter(self, owner: int, payload: bytes, reason: str) -> None:
-        with self._lock:
-            self.dead_lettered += payload.count(b"\n") + 1
+    def _dead_letter(self, owner: int, payload: bytes, reason: str,
+                     kind: str = "undeliverable-forward") -> None:
+        self._m_dead.inc(payload.count(b"\n") + 1)
         logger.warning("dead-lettering forward batch for peer %d: %s",
                        owner, reason)
         if self.dead_letters is not None:
-            self.dead_letters.append_json({
-                "kind": "undeliverable-forward",
+            doc = {
+                "kind": kind,
                 "peer": owner,
                 "reason": reason,
-                "payload": payload.decode("utf-8", "replace"),
-            })
+            }
+            if kind == "forward-shed":
+                # replayable contract (mirrors intake-shed): hex payload
+                # so Instance.requeue_dead_letter re-routes it through
+                # ingest_payload once the owner recovers
+                doc["payload"] = payload.hex()
+                doc["state"] = self.health.snapshot().get(
+                    str(owner), {}).get("overload", "SHEDDING")
+            else:
+                doc["payload"] = payload.decode("utf-8", "replace")
+            self.dead_letters.append_json(doc)
 
     # -- lifecycle -----------------------------------------------------------
 
     def _flush_loop(self) -> None:
         while not self._stop.wait(self.deadline_s / 2):
             self.flush(only_expired=True)
+            self.health.tick()
+
+    def _heartbeat_loop(self) -> None:
+        """The fleet heartbeat: every interval, one ``fleet.heartbeat``
+        per remote peer carrying this host's overload state, Retry-After
+        hint, per-peer pending spool lag, and incarnation; the RESPONSE
+        body is the peer's same record, so one exchange teaches both
+        directions.  Failures feed the failure detector — the heartbeat
+        IS the liveness probe for peers with no traffic."""
+        while not self._stop.wait(self.heartbeat_interval_s):
+            with self._lock:
+                peers = [(p, d) for p, d in self.peers.items()
+                         if d is not None]
+            for p, demux in peers:
+                if self._stop.is_set():
+                    return
+                try:
+                    body, _ = demux.call(
+                        "fleet.heartbeat", self.heartbeat_body(p),
+                        timeout_s=max(1.0, 2 * self.heartbeat_interval_s),
+                        deadline_s=max(1.0, 2 * self.heartbeat_interval_s))
+                    self._m_hb_sent.inc()
+                    self.observe_peer_heartbeat(p, body)
+                except ChannelUnavailable:
+                    self._m_hb_fail.inc()
+                    self.health.observe_failure(p)
+                except DeadlineExpired:
+                    # NEUTRAL: a client-side budget lapse (e.g. every
+                    # replica's connect timeout burned it) is not
+                    # liveness evidence — counting it as life would pin
+                    # a dead peer ALIVE forever.  A server-side
+                    # rejection DID answer, but its piggyback headers
+                    # already fed observe_piggyback via the channel's
+                    # header listener, so nothing is lost here.
+                    self._m_hb_fail.inc()
+                except RpcError:
+                    # the peer ANSWERED (an old peer without the method
+                    # says not_found): liveness evidence, no state
+                    self._m_hb_sent.inc()
+                    self.health.observe_alive(p)
+            self.health.tick()
+            self._m_pending.set(self.pending_rows())
+
+    def heartbeat_body(self, target: int) -> Dict[str, object]:
+        """This host's health record as the heartbeat wire shape."""
+        state, retry_after = 0, 0.0
+        if self.overload is not None:
+            state = int(self.overload.state)
+            retry_after = float(self.overload.retry_after())
+        return {
+            "processId": int(self.process_id),
+            "incarnation": int(self.incarnation),
+            "state": state,
+            "retryAfterS": round(retry_after, 3),
+            "spoolLag": int(self.pending_for(target)),
+        }
+
+    def observe_peer_heartbeat(self, peer: int, body) -> None:
+        """Feed one heartbeat body (request or response side) into the
+        health table — the ``fleet.heartbeat`` server handler calls this
+        so receiving a beat teaches as much as sending one."""
+        if not isinstance(body, dict):
+            return
+        try:
+            self.health.observe_heartbeat(
+                int(peer),
+                incarnation=int(body.get("incarnation", 0)),
+                overload_state=int(body.get("state", 0)),
+                retry_after_s=float(body.get("retryAfterS", 0.0)),
+                spool_lag=int(body.get("spoolLag", 0)))
+        except (TypeError, ValueError):
+            logger.warning("malformed heartbeat from peer %s ignored", peer)
+
+    def pending_for(self, owner: int) -> int:
+        """Rows currently retained toward one peer (spool or buffer —
+        ROW units in both modes; spool records are multi-row payloads,
+        so reader.lag would under-report)."""
+        with self._lock:
+            if self.durable:
+                return int(self._pending_rows.get(owner, 0))
+            return len(self._buffers.get(owner, ()))
+
+    def pending_rows(self) -> int:
+        with self._lock:
+            if self.durable:
+                return sum(self._pending_rows.get(o, 0)
+                           for o in self._spool_readers)
+            return sum(len(v) for v in self._buffers.values())
 
     def _pending_owners(self, only_expired: bool) -> List[int]:
         now = time.monotonic()
@@ -561,7 +959,11 @@ class HostForwarder(LifecycleComponent):
             if only_expired:
                 owners = [o for o in owners
                           if now - since.get(o, 0.0) >= self.deadline_s]
-        return owners
+        # parked peers whose probe slot hasn't come up yet are skipped
+        # OUTSIDE the lock (health's lock is a leaf): no sender thread
+        # is spawned just to park — the flusher tick stays O(healthy)
+        return [o for o in owners
+                if self.health.can_drain(o) or self.health.probe_ready(o)]
 
     def flush(self, only_expired: bool = False, wait: bool = False) -> None:
         for owner in self._pending_owners(only_expired):
@@ -577,6 +979,12 @@ class HostForwarder(LifecycleComponent):
         self._flusher = threading.Thread(
             target=self._flush_loop, name=f"{self.name}-flush", daemon=True)
         self._flusher.start()
+        if self.heartbeat_interval_s > 0 and any(
+                d is not None for d in self.peers.values()):
+            self._heartbeater = threading.Thread(
+                target=self._heartbeat_loop,
+                name=f"{self.name}-heartbeat", daemon=True)
+            self._heartbeater.start()
         # crash recovery: anything spooled-but-uncommitted from a prior
         # run ships now (replay-from-offset, MicroserviceKafkaConsumer
         # semantics applied to the producer side)
@@ -589,7 +997,24 @@ class HostForwarder(LifecycleComponent):
         if self._flusher is not None:
             self._flusher.join(timeout=5)
             self._flusher = None
+        if self._heartbeater is not None:
+            self._heartbeater.join(timeout=5)
+            self._heartbeater = None
         self.flush(wait=True)
+        if not self.durable:
+            # fire-and-forget mode: rows still buffered for parked /
+            # shedding peers die with the process — audit them as
+            # replayable forward-shed records instead of vanishing
+            with self._lock:
+                leftovers = [o for o, buf in self._buffers.items() if buf]
+            for owner in leftovers:
+                with self._lock:
+                    payload = self._drain_memory_locked(owner)
+                if payload:
+                    self._dead_letter(
+                        owner, payload,
+                        f"rows retained for parked peer {owner} at stop "
+                        "(memory mode)", kind="forward-shed")
         for spool in self._spools.values():
             spool.close()
         super().stop()
@@ -635,6 +1060,9 @@ class HostForwarder(LifecycleComponent):
                     old_tails.append(
                         (reader, self._spools[owner], reader.position))
                     self._spool_since.pop(owner, None)
+                # every spool tail is in `pending` now: the row counts
+                # rebuild as the re-ingest below re-routes them
+                self._pending_rows = {}
 
                 if process_id is not None:
                     self.process_id = process_id
@@ -672,6 +1100,12 @@ class HostForwarder(LifecycleComponent):
             for lock in old_locks:
                 lock.release()
 
+        # health plane follows the membership: departed peers drop out
+        # of the table, joiners start optimistic; piggyback taps rebind
+        self.health.set_peers(
+            [p for p, d in peer_demuxes.items() if d is not None])
+        self._bind_piggyback(peer_demuxes)
+
         # Re-ingest outside every lock: rows route freshly under the new
         # map (local rows journal in the dispatcher, remote rows spool
         # for their new owners) — durably re-placed BEFORE the old
@@ -696,16 +1130,27 @@ class HostForwarder(LifecycleComponent):
         self.flush()
         return requeued
 
-    def metrics(self) -> Dict[str, int]:
+    def metrics(self) -> Dict[str, object]:
+        """Topology/admin view.  The canonical observable surface is the
+        registered ``forward.*`` metric family (counters, the pending
+        gauge, per-peer health-state gauges) — this dict is a snapshot
+        of the same numbers plus the health table."""
         with self._lock:
             if self.durable:
-                pending = sum(r.lag for r in self._spool_readers.values())
+                pending = sum(self._pending_rows.get(o, 0)
+                              for o in self._spool_readers)
             else:
                 pending = sum(len(v) for v in self._buffers.values())
-            return {
+            out = {
                 "local_rows": self.local_rows,
                 "forwarded_rows": self.forwarded_rows,
                 "dead_lettered": self.dead_lettered,
                 "pending": pending,
                 "durable": self.durable,
             }
+        self._m_pending.set(pending)
+        out["send_attempts"] = int(self._m_attempts.value)
+        out["probe_sends"] = int(self._m_probes.value)
+        out["edge_refusals"] = int(self._m_edge.value)
+        out["peers"] = self.health.snapshot()
+        return out

@@ -23,7 +23,13 @@ import threading
 from typing import Callable, Dict, Optional, Tuple
 
 from sitewhere_tpu.rpc import wire
+from sitewhere_tpu.rpc.channel import (
+    DEADLINE_ERROR_CODE,
+    deadline_remaining_s,
+)
+from sitewhere_tpu.rpc.health import HEADER_OVERLOAD, HEADER_RETRY_AFTER
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.metrics import global_registry
 from sitewhere_tpu.runtime.overload import OverloadShed
 from sitewhere_tpu.services.common import (
     AuthError,
@@ -90,13 +96,23 @@ class RpcServer(LifecycleComponent):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  tokens=None, tracer=None, name: str = "rpc-server",
-                 max_inflight_per_conn: int = 32):
+                 max_inflight_per_conn: int = 32, metrics=None):
         super().__init__(name)
         self._host = host
         self._port = port
         self._tokens = tokens
         self._tracer = tracer
+        # instance-scoped registry when provided (co-resident instances
+        # must not share counters); process-global otherwise
+        self._metrics = metrics if metrics is not None else global_registry()
         self.max_inflight_per_conn = max_inflight_per_conn
+        # overload piggyback source: a callable returning
+        # ``(overload_state_int, retry_after_s)`` stamped into EVERY
+        # response's headers (success, error, even deadline rejections)
+        # so callers' health tables learn pressure at call rate — set by
+        # the instance when an OverloadController exists
+        self.overload_provider: Optional[
+            Callable[[], Tuple[int, float]]] = None
         self._handlers: Dict[str, _Handler] = {}
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -227,11 +243,44 @@ class RpcServer(LifecycleComponent):
                 f"{handler.authority} required for {username}")
         return username, authorities
 
+    def _piggyback_headers(self) -> Dict[str, str]:
+        """Overload state for the response metadata lane (empty when no
+        provider is wired — single-host instances pay nothing)."""
+        provider = self.overload_provider
+        if provider is None:
+            return {}
+        try:
+            state, retry_after = provider()
+        except Exception:   # noqa: BLE001 — telemetry must not fail replies
+            logger.exception("overload provider failed")
+            return {}
+        return {HEADER_OVERLOAD: str(int(state)),
+                HEADER_RETRY_AFTER: f"{float(retry_after):.3f}"}
+
     def _dispatch(self, sock, frame: wire.Frame, peer: str,
                   send_lock: Optional[threading.Lock] = None) -> None:
         send_lock = send_lock or threading.Lock()
         if frame.is_response:
             logger.warning("rpc %s: response frame on server side", peer)
+            return
+        # Deadline gate BEFORE any work (auth included): a call whose
+        # propagated budget lapsed in flight is answered with the
+        # retryable deadline_expired code without executing the handler
+        # — work a slow fabric already made useless is refused, not run.
+        remaining = deadline_remaining_s(frame.headers)
+        if remaining is not None and remaining <= 0:
+            self._metrics.counter("rpc.deadline_rejected").inc()
+            try:
+                payload = wire.encode(wire.response_frame(
+                    frame.request_id,
+                    {"error": DEADLINE_ERROR_CODE,
+                     "message": (f"{frame.method}: deadline expired "
+                                 f"{-remaining:.3f}s before dispatch")},
+                    error=True, headers=self._piggyback_headers()))
+                with send_lock:
+                    sock.sendall(payload)
+            except OSError:
+                pass
             return
         try:
             handler = self._handlers.get(frame.method)
@@ -264,7 +313,8 @@ class RpcServer(LifecycleComponent):
             if isinstance(result, tuple):
                 result, attachment = result
             payload = wire.encode(wire.response_frame(
-                frame.request_id, result, attachment))
+                frame.request_id, result, attachment,
+                headers=self._piggyback_headers()))
             with send_lock:
                 sock.sendall(payload)
         except Exception as e:     # noqa: BLE001 — every fault must answer
@@ -278,7 +328,8 @@ class RpcServer(LifecycleComponent):
             try:
                 payload = wire.encode(wire.response_frame(
                     frame.request_id,
-                    {"error": code, "message": str(e)}, error=True))
+                    {"error": code, "message": str(e)}, error=True,
+                    headers=self._piggyback_headers()))
                 with send_lock:
                     sock.sendall(payload)
             except OSError:

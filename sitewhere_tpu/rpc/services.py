@@ -83,6 +83,12 @@ def bind_instance(server: RpcServer, inst) -> None:
         n = inst.dispatcher.ingest_wire_lines(
             ctx.attachment,
             source_id=(body or {}).get("sourceId", f"rpc:{ctx.peer}"))
+        # replicated-ack: the SENDER commits its spool cursor (and later
+        # prunes the spool) on this reply, so the ack must mean durably
+        # journaled — fsync before answering, or a kill of both hosts
+        # in the ack window loses the batch from both sides
+        # (crashrec_bench crash.mid_forward pins this)
+        inst.ingest_journal.flush()
         return {"accepted": int(n)}
 
     reg("events.ingest", events_ingest)
@@ -134,6 +140,35 @@ def bind_instance(server: RpcServer, inst) -> None:
     reg("instance.ping", lambda c, b: {"instance": inst.instance_id,
                                        "ts": time.time()},
         auth_required=False)
+
+    # ---- fleet health plane (rpc/health.py) --------------------------------
+    def fleet_heartbeat(ctx: CallContext, body):
+        """One heartbeat exchange teaches both directions: the request
+        body is the SENDER's health record (fed into our table), the
+        response body is OURS — overload state, Retry-After hint,
+        pending spool lag toward the sender, incarnation."""
+        body = body if isinstance(body, dict) else {}
+        fwd = inst.forwarder
+        try:
+            sender = int(body.get("processId"))
+        except (TypeError, ValueError):
+            # malformed beats are ignored, never an 'internal' error —
+            # a buggy/fuzzing peer must not flood logs at beat rate
+            sender = None
+        if fwd is not None and sender is not None:
+            fwd.observe_peer_heartbeat(sender, body)
+        if fwd is not None:
+            return fwd.heartbeat_body(sender if sender is not None else -1)
+        ov = inst.overload
+        return {
+            "processId": -1, "incarnation": 0,
+            "state": int(ov.state) if ov is not None else 0,
+            "retryAfterS": (round(float(ov.retry_after()), 3)
+                            if ov is not None else 0.0),
+            "spoolLag": 0,
+        }
+
+    reg("fleet.heartbeat", fleet_heartbeat)
 
     # ---- the remaining management domains (per-domain ApiDemux analog) -----
     from sitewhere_tpu.rpc.domains import bind_domains

@@ -39,7 +39,11 @@ from typing import Dict, Optional, Tuple
 # (the reference's tracing interceptors stamp gRPC metadata the same
 # way): ``trace-id`` + ``parent-id`` + ``trace-sampled`` headers —
 # written by Trace.propagate, read by Tracer.join (runtime/tracing.py);
-# the wire layer itself treats them as opaque headers.
+# the wire layer itself treats them as opaque headers.  The same lane
+# carries the call's deadline budget (``deadline-ms``, absolute unix
+# epoch milliseconds — the gRPC grpc-timeout analog; channel.py writes
+# it, server.py rejects already-expired work before dispatch) and the
+# response-side overload piggyback (``x-overload``/``x-retry-after``).
 
 MAGIC = b"SWR1"
 FLAG_RESPONSE = 0x01
@@ -87,9 +91,14 @@ def request_frame(request_id: int, method: str, body: object,
 
 
 def response_frame(request_id: int, body: object,
-                   attachment: bytes = b"", error: bool = False) -> Frame:
+                   attachment: bytes = b"", error: bool = False,
+                   headers: Optional[Dict[str, str]] = None) -> Frame:
+    """``headers`` is the response metadata lane: the server piggybacks
+    its overload state (``x-overload`` / ``x-retry-after``) on every
+    reply so clients learn fleet pressure at call rate — see
+    ``rpc/health.py``."""
     flags = FLAG_RESPONSE | (FLAG_ERROR if error else 0)
-    return Frame(flags, request_id, "", {}, body, attachment)
+    return Frame(flags, request_id, "", headers or {}, body, attachment)
 
 
 def encode(frame: Frame) -> bytes:

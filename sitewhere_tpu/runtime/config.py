@@ -123,11 +123,17 @@ DEFAULTS: Dict[str, Any] = {
     # process at index "process_id".  Multi-host REQUIRES a shared
     # security.jwt_secret (the reference shares its instance JWT secret
     # across microservices the same way).
+    # heartbeat_interval_s drives the fleet health plane (rpc/health.py:
+    # failure detection windows + probe pacing scale with it; <=0
+    # disables the loop); call_timeout_s is the per-forward-call budget
+    # propagated as the deadline-ms header so owners drop stale work.
     "rpc": {
         "server": {"enabled": False, "host": "127.0.0.1", "port": 0},
         "process_id": 0,
         "peers": [],
         "forward_deadline_ms": 25.0,
+        "heartbeat_interval_s": 0.5,
+        "call_timeout_s": 10.0,
     },
     "security": {"jwt_secret": None},
 }

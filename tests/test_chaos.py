@@ -1191,20 +1191,28 @@ class TestCrashRecBench:
 
     def test_smoke_three_fixed_kill_points(self, tmp_path):
         """Tier-1: SIGKILL at mid-ring, mid-egress, mid-background-seal,
-        mid-compaction-swap and pre-manifest on a small journal; every
-        kill must recover with zero committed-event loss, a consistent
-        segment catalog, golden-equal analytics, and exported recovery
-        gauges."""
+        mid-compaction-swap, pre-manifest and mid-forward-send on a
+        small journal; every kill must recover with zero
+        committed-event loss, a consistent segment catalog,
+        golden-equal analytics, and exported recovery gauges (the
+        mid-forward case instead proves the 2-host spool-tail replay)."""
         res = self._run("--smoke", "--json",
                         str(tmp_path / "crashrec.json"))
         assert res.returncode == 0, res.stdout + res.stderr
         doc = json.loads((tmp_path / "crashrec.json").read_text())
-        assert doc["ok"] and doc["summary"]["killed"] == 5
+        assert doc["ok"] and doc["summary"]["killed"] == 6
         points = {k["point"] for k in doc["kills"]}
-        assert {"crash.mid_seal", "crash.mid_compact"} <= points
+        assert {"crash.mid_seal", "crash.mid_compact",
+                "crash.mid_forward"} <= points
         for kill in doc["kills"]:
             assert kill["killed"] and not kill["failures"]
-            assert kill["restore_s"] is not None
+            if kill["point"] == "crash.mid_forward":
+                # fleet-shaped case: the spool tail replayed to the
+                # owner's journal and drained to zero
+                assert kill["spool_pending_after"] == 0
+                assert kill["owner_journal_rows"] >= kill["spooled_rows"]
+            else:
+                assert kill["restore_s"] is not None
 
     @pytest.mark.slow
     def test_randomized_sweep(self, tmp_path):
@@ -1216,3 +1224,36 @@ class TestCrashRecBench:
         assert res.returncode == 0, res.stdout + res.stderr
         doc = json.loads((tmp_path / "crashrec.json").read_text())
         assert doc["ok"] and doc["summary"]["killed"] == 6
+
+
+class TestFleetChaosBench:
+    """tools/fleet_chaos_bench.py: the 3-host fleet health-plane proof
+    (ISSUE 14 acceptance — shed, partition, recover; smooth goodput)."""
+
+    def test_smoke_shed_partition_recover(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SW_CRASHPOINT", None)
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(root, "tools", "fleet_chaos_bench.py"),
+             "--smoke", "--json", str(tmp_path / "fleet.json")],
+            capture_output=True, text=True, timeout=240, env=env)
+        assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+        doc = json.loads((tmp_path / "fleet.json").read_text())
+        assert doc["ok"]
+        # the scripted failure walked the detector where it should
+        assert doc["state_after_partition"] in ("SUSPECT", "DOWN")
+        assert doc["edge_refusal"]["refused"]
+        # bounded probes while unhealthy, zero forward dead letters,
+        # spool drained, at-least-once toward the sick host
+        for phase in ("shed", "partition"):
+            p = doc["phases"][phase]
+            assert p["sick_ingest_attempts"] <= p["attempt_budget"]
+        assert doc["forward_dead_lettered"] == 0
+        assert doc["pending_after_recovery"] == 0
+        assert doc["sick_accepted_rows"] >= doc["sick_sent_rows"]

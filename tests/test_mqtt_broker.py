@@ -237,6 +237,11 @@ def test_broker_receiver_feeds_instance_pipeline(tmp_path):
             b'"request":{"name":"temp","value":21.5,"eventDate":1000}}',
             qos=1)
         assert _wait(lambda: rx.received_count == 1)
+        # received_count ticks BEFORE the sink runs (Receiver._emit);
+        # wait for admission too, or the flush below can race the
+        # broker-session thread's ingest and observe an empty store
+        assert _wait(
+            lambda: inst.dispatcher.metrics_snapshot()["accepted"] >= 1)
         inst.dispatcher.flush()
         inst.event_store.flush()
         assert inst.event_store.total_events == 1

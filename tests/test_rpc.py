@@ -1031,3 +1031,636 @@ class TestForwarding:
         finally:
             inst.stop()
             inst.terminate()
+
+
+# ---------------------------------------------------------------------------
+# fleet health plane (rpc/health.py) + deadline propagation
+# ---------------------------------------------------------------------------
+
+from sitewhere_tpu.rpc import DeadlineExpired, PeerHealthTable, PeerState
+from sitewhere_tpu.runtime import faults
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.overload import OverloadShed, OverloadState
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestPeerHealthTable:
+    def _table(self, **kw):
+        clock = _Clock()
+        kw.setdefault("heartbeat_interval_s", 1.0)
+        return PeerHealthTable([1], clock=clock, **kw), clock
+
+    def test_silence_escalates_and_heartbeat_recovers(self):
+        table, clock = self._table()   # suspect 3s, down 8s, dwell 2s
+        assert table.state(1) == PeerState.ALIVE
+        clock.advance(3.0)
+        table.tick()
+        assert table.state(1) == PeerState.SUSPECT
+        clock.advance(5.0)
+        table.tick()
+        assert table.state(1) == PeerState.DOWN
+        clock.advance(2.1)             # past the dwell
+        table.observe_heartbeat(1)
+        assert table.state(1) == PeerState.ALIVE
+
+    def test_failure_streak_escalates_without_silence(self):
+        """One-way partition: the peer's heartbeats still arrive but our
+        sends fail — the send-failure streak must suspect it anyway,
+        and an INCOMING beat must not paper over it (only an answered
+        OUTBOUND call proves the path works again)."""
+        table, clock = self._table(suspect_failures=3)
+        for _ in range(2):
+            table.observe_failure(1)
+        assert table.state(1) == PeerState.ALIVE
+        clock.advance(2.5)             # dwell satisfied
+        table.observe_failure(1)
+        assert table.state(1) == PeerState.SUSPECT
+        # the peer's own beats keep arriving: still parked
+        table.observe_heartbeat(1)
+        clock.advance(2.5)
+        table.tick()
+        assert table.state(1) == PeerState.SUSPECT
+        # an answered outbound call (a delivered probe) recovers it
+        table.observe_alive(1)
+        assert table.state(1) == PeerState.ALIVE
+
+    def test_flapping_peer_never_oscillates_faster_than_hysteresis(self):
+        """ISSUE acceptance: a peer flapping at the heartbeat period
+        can change the table's verdict at most once per hysteresis
+        dwell — no park/resume storms (fake clock, bit-exact)."""
+        table, clock = self._table(hysteresis_s=2.0)
+        transition_times = []
+        last = table.state(1)
+        # worst-case flap: one beat, then silence past suspect_after,
+        # repeatedly, sampled every heartbeat period for 60 "seconds"
+        for step in range(60):
+            if step % 4 == 0:
+                table.observe_heartbeat(1)
+            clock.advance(1.0)
+            table.tick()
+            now_state = table.state(1)
+            if now_state != last:
+                transition_times.append(clock.t)
+                last = now_state
+        assert len(transition_times) >= 2      # it did flap
+        gaps = [b - a for a, b in zip(transition_times,
+                                      transition_times[1:])]
+        assert min(gaps) >= 2.0, f"oscillated faster than dwell: {gaps}"
+        snap = table.snapshot()["1"]
+        assert snap["suppressed_flaps"] > 0    # hysteresis did real work
+
+    def test_probe_pacing_claims_one_slot_per_interval(self):
+        table, clock = self._table(probe_interval_s=2.0)
+        table.observe_heartbeat(1, overload_state=int(OverloadState.SHEDDING),
+                                retry_after_s=5.0)
+        assert not table.can_drain(1)
+        assert table.probe_due(1)
+        assert not table.probe_due(1)          # slot claimed
+        clock.advance(2.5)
+        assert not table.probe_due(1)          # SHEDDING: retry-after (5s)
+        clock.advance(3.0)                     # 5.5s > max(2, 5)
+        assert table.probe_due(1)
+
+    def test_owner_pressure_only_when_shedding(self):
+        table, clock = self._table()
+        assert table.owner_pressure(1) is None
+        table.observe_heartbeat(1, overload_state=int(OverloadState.DEGRADED))
+        assert table.owner_pressure(1) is None
+        table.observe_heartbeat(1, overload_state=int(OverloadState.SHEDDING),
+                                retry_after_s=2.0)
+        assert table.owner_pressure(1) == (int(OverloadState.SHEDDING), 2.0)
+
+    def test_piggyback_headers_update_overload(self):
+        table, clock = self._table()
+        table.observe_piggyback(1, {"x-overload": "2",
+                                    "x-retry-after": "1.500"})
+        assert table.overload_state(1) == 2
+        assert table.retry_after(1) == 1.5
+        assert not table.can_drain(1)
+        table.observe_piggyback(1, {"x-overload": "0"})
+        assert table.can_drain(1)
+
+    def test_incarnation_change_is_recorded(self):
+        table, clock = self._table()
+        table.observe_heartbeat(1, incarnation=7)
+        table.observe_heartbeat(1, incarnation=9)
+        assert table.snapshot()["1"]["incarnation"] == 9
+
+    def test_forward_metric_names_pass_the_lint(self):
+        """Satellite: the forward.* family is a registered, linted
+        metric surface — not a dict-only side channel."""
+        from sitewhere_tpu.analysis.metric_names import lint_names
+
+        registry = MetricsRegistry()
+        fwd = HostForwarder(None, 0, {0: None, 1: RpcDemux(["127.0.0.1:1"])},
+                            metrics=registry)
+        names = [n for n in registry.names() if n.startswith("forward.")]
+        assert "forward.pending_rows" in names
+        assert "forward.peer_state.1" in names
+        assert lint_names(names) == []
+
+
+class TestDeadlinePropagation:
+    def _server(self, fn):
+        srv = RpcServer(port=0)
+        srv.register("work.do", fn, auth_required=False)
+        srv.start()
+        return srv
+
+    def test_expired_call_rejected_before_handler_runs(self):
+        """ISSUE acceptance: injected fabric latency burns the budget in
+        flight; the server answers deadline_expired WITHOUT executing
+        the handler, and the rejection is retryable + distinct from
+        peer-down."""
+        ran = []
+        srv = self._server(lambda c, b: ran.append(1) or {"ok": True})
+        chan = RpcChannel(srv.endpoint)
+        try:
+            with faults.net_injected(srv.endpoint, latency_s=0.4):
+                with pytest.raises(DeadlineExpired) as exc:
+                    chan.call("work.do", {}, timeout_s=5.0, deadline_s=0.2)
+            assert ran == []                       # handler never ran
+            assert isinstance(exc.value, RpcError)  # retryable app error
+            assert not isinstance(exc.value, ChannelUnavailable)
+            # healthy fabric, fresh budget: the same call succeeds
+            body, _ = chan.call("work.do", {}, deadline_s=5.0)
+            assert body["ok"] and ran == [1]
+        finally:
+            chan.close()
+            srv.stop()
+
+    def test_budget_already_burned_fails_client_side(self):
+        srv = self._server(lambda c, b: {"ok": True})
+        chan = RpcChannel(srv.endpoint)
+        try:
+            with pytest.raises(DeadlineExpired):
+                chan.call("work.do", {}, deadline_s=0.0)
+            assert not chan.connected    # never even dialed
+        finally:
+            chan.close()
+            srv.stop()
+
+    def test_client_timeout_derives_from_budget(self):
+        """A propagated 0.3s budget must cap the wait even when the
+        caller passed a 30s transport timeout."""
+        srv = self._server(lambda c, b: time.sleep(1.2) or {"ok": True})
+        chan = RpcChannel(srv.endpoint)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(ChannelUnavailable):
+                chan.call("work.do", {}, timeout_s=30.0, deadline_s=0.3)
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            chan.close()
+            srv.stop()
+
+    def test_one_way_partition_executes_but_times_out(self):
+        """The half-open link: the request is delivered (the handler
+        runs!) but the reply is lost — the caller sees a transport
+        fault, the distinct-from-deadline ambiguity a real network
+        gives you."""
+        ran = []
+        srv = self._server(lambda c, b: ran.append(1) or {"ok": True})
+        chan = RpcChannel(srv.endpoint)
+        try:
+            with faults.net_injected(srv.endpoint, drop=1.0, one_way=True):
+                with pytest.raises(ChannelUnavailable):
+                    chan.call("work.do", {}, timeout_s=0.4)
+            deadline = time.time() + 5
+            while time.time() < deadline and not ran:
+                time.sleep(0.01)
+            assert ran == [1]
+        finally:
+            chan.close()
+            srv.stop()
+
+    def test_response_piggyback_reaches_header_listener(self):
+        seen = {}
+        srv = self._server(lambda c, b: {"ok": True})
+        srv.overload_provider = lambda: (2, 3.5)
+        chan = RpcChannel(srv.endpoint, header_listener=seen.update)
+        try:
+            chan.call("work.do", {})
+            assert seen["x-overload"] == "2"
+            assert float(seen["x-retry-after"]) == 3.5
+        finally:
+            chan.close()
+            srv.stop()
+
+
+class _DownDemux:
+    """Fake peer demux: every call is a transport failure."""
+
+    def __init__(self):
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def call(self, *a, **kw):
+        with self._lock:
+            self.calls += 1
+        raise ChannelUnavailable("fake peer down")
+
+
+class _ShedDemux:
+    """Fake peer demux: admission refuses everything until healed."""
+
+    def __init__(self):
+        self.calls = 0
+        self.accepted = []
+        self.shedding = True
+        self._lock = threading.Lock()
+
+    def call(self, method, body=None, attachment=b"", **kw):
+        with self._lock:
+            self.calls += 1
+            if self.shedding:
+                raise RpcError("overloaded", "telemetry shed in SHEDDING",
+                               {"x-overload": "2", "x-retry-after": "0.5"})
+            lines = [l for l in attachment.split(b"\n") if l]
+            self.accepted.extend(lines)
+            return {"accepted": len(lines)}, b""
+
+
+class _AcceptDemux:
+    """Fake peer demux: accepts everything, records the lines."""
+
+    def __init__(self):
+        self.accepted = []
+        self._lock = threading.Lock()
+
+    def call(self, method, body=None, attachment=b"", **kw):
+        if method != "events.ingest":
+            return {}, b""
+        with self._lock:
+            lines = [l for l in attachment.split(b"\n") if l]
+            self.accepted.extend(lines)
+            return {"accepted": len(lines)}, b""
+
+
+class _CollectorDispatcher:
+    """Dispatcher stub: records locally-ingested wire lines."""
+
+    def __init__(self):
+        self.lines = []
+        self._lock = threading.Lock()
+
+    def ingest_wire_lines(self, payload, source_id="wire",
+                          raise_on_decode_error=False):
+        lines = [l for l in payload.split(b"\n") if l.strip()]
+        with self._lock:
+            self.lines.extend(lines)
+        return len(lines)
+
+
+def _wait_senders(fwd, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with fwd._lock:
+            if not fwd._senders:
+                return
+        time.sleep(0.01)
+    raise AssertionError("senders did not quiesce")
+
+
+def _line_for(owner, n_processes, value):
+    tok = next(f"dev-{i}" for i in range(200)
+               if owning_process(f"dev-{i}", n_processes) == owner)
+    return (b'{"deviceToken": "%s", "type": "Measurement",'
+            b' "request": {"name": "t", "value": %d,'
+            b' "eventDate": 1000}}' % (tok.encode(), value))
+
+
+class TestHealthGatedForwarding:
+    def _health(self, clock, **kw):
+        kw.setdefault("heartbeat_interval_s", 1.0)
+        kw.setdefault("hysteresis_s", 0.0)
+        kw.setdefault("suspect_failures", 1)
+        return PeerHealthTable([1], clock=clock, **kw)
+
+    def test_unhealthy_peer_probes_are_paced_not_a_retry_storm(
+            self, tmp_path):
+        """ISSUE acceptance: a SUSPECT peer's sender parks the spool and
+        sends ONE paced probe per interval — send attempts stay bounded
+        no matter how often the flusher ticks."""
+        clock = _Clock()
+        down = _DownDemux()
+        fwd = HostForwarder(None, 0, {0: None, 1: down},
+                            max_retries=1, data_dir=str(tmp_path),
+                            metrics=MetricsRegistry(),
+                            health=self._health(clock, probe_interval_s=2.0),
+                            heartbeat_interval_s=0)
+        try:
+            fwd.ingest_payload(_line_for(1, 2, 1))
+            fwd.flush()
+            _wait_senders(fwd)
+            assert fwd.health.state(1) == PeerState.SUSPECT
+            after_first = down.calls
+            assert after_first >= 1
+            # flusher storm with the probe clock FROZEN: at most the one
+            # already-claimed slot may still fire; no pile-up
+            for _ in range(30):
+                fwd.flush()
+                _wait_senders(fwd)
+            assert down.calls <= after_first + 1
+            # clock advances past the probe interval: exactly one more
+            clock.advance(2.5)
+            mid = down.calls
+            for _ in range(10):
+                fwd.flush()
+                _wait_senders(fwd)
+            assert mid < down.calls <= mid + 1
+            assert fwd.metrics()["pending"] == 1   # retained, never lost
+            assert fwd.dead_lettered == 0
+        finally:
+            fwd.stop()
+
+    def test_shed_peer_rows_park_then_drain_on_recovery(self, tmp_path):
+        """An overloaded (SHEDDING) owner's rows park in the spool; the
+        paced probe redelivers once it recovers and the spool drains —
+        zero dead letters for rows the owner was always going to take."""
+        clock = _Clock()
+        shed = _ShedDemux()
+        fwd = HostForwarder(None, 0, {0: None, 1: shed},
+                            data_dir=str(tmp_path),
+                            metrics=MetricsRegistry(),
+                            health=self._health(clock, probe_interval_s=1.0),
+                            heartbeat_interval_s=0)
+        try:
+            fwd.ingest_payload(_line_for(1, 2, 1))
+            fwd.flush()
+            _wait_senders(fwd)
+            # the shed marked the peer's overload state off the error
+            # frame's piggyback headers
+            assert fwd.health.overload_state(1) == int(OverloadState.SHEDDING)
+            assert not fwd.health.can_drain(1)
+            assert fwd.metrics()["pending"] == 1
+            assert fwd.dead_lettered == 0
+            shed.shedding = False
+            clock.advance(5.0)        # probe slot opens
+            fwd.flush()
+            _wait_senders(fwd)
+            assert len(shed.accepted) == 1
+            assert fwd.metrics()["pending"] == 0
+            assert fwd.forwarded_rows == 1
+        finally:
+            fwd.stop()
+
+    def test_memory_mode_shed_rows_buffer_then_forward_shed_kind(self):
+        """Satellite: memory-mode overload-shed rows are NOT
+        dead-lettered as 'peer unreachable' — they buffer under the
+        retention bound, and a bound-forced drop dead-letters with the
+        replayable forward-shed kind (hex payload, like intake-shed)."""
+        from sitewhere_tpu.runtime.resilience import CollectingSink
+
+        clock = _Clock()
+        shed = _ShedDemux()
+        sink = CollectingSink()
+        dispatcher = _CollectorDispatcher()
+        remote_line = _line_for(1, 2, 201)
+        # retention bound fits exactly two remote lines; the third drops
+        bound = 2 * (len(remote_line) + 1) + 4
+        fwd = HostForwarder(dispatcher, 0, {0: None, 1: shed},
+                            dead_letters=sink,
+                            metrics=MetricsRegistry(),
+                            health=self._health(clock, probe_interval_s=1.0),
+                            heartbeat_interval_s=0,
+                            max_retained_bytes=bound)
+        # mixed local+remote payloads (the gateway-bulk shape): the edge
+        # gate never fires, the remote share parks behind the shed owner
+        fwd.ingest_payload(_line_for(0, 2, 101) + b"\n" + remote_line)
+        fwd.flush()
+        _wait_senders(fwd)
+        assert fwd.metrics()["pending"] == 1      # retained, not dead
+        assert len(sink) == 0
+        # two more shed batches overflow the retention bound
+        for v in (202, 203):
+            clock.advance(5.0)
+            fwd.ingest_payload(
+                _line_for(0, 2, v - 100) + b"\n" + _line_for(1, 2, v))
+            fwd.flush()
+            _wait_senders(fwd)
+        kinds = [d["kind"] for d in sink.records]
+        assert kinds and set(kinds) == {"forward-shed"}
+        dropped = sink.records[0]
+        assert bytes.fromhex(dropped["payload"])  # replayable (hex) payload
+        assert dropped["state"] == "SHEDDING"
+        # every local row was ingested in place, every remote row is
+        # either retained or audited as forward-shed: no silent loss
+        assert len(dispatcher.lines) == 3
+        retained = fwd.metrics()["pending"]
+        dropped_rows = sum(
+            bytes.fromhex(d["payload"]).count(b"\n") + 1
+            for d in sink.records)
+        assert retained + dropped_rows == 3
+        # stop() in memory mode audits still-parked rows as replayable
+        # forward-shed records — they die with the process, but never
+        # silently
+        fwd.stop()
+        stop_rows = sum(
+            bytes.fromhex(d["payload"]).count(b"\n") + 1
+            for d in sink.records)
+        assert stop_rows == 3
+        assert {d["kind"] for d in sink.records} == {"forward-shed"}
+
+    def test_stop_aborts_sender_backoff_promptly(self):
+        """Satellite: sender retry backoff waits on the stop event —
+        stop() returns promptly instead of waiting out ~2s sleeps."""
+        down = _DownDemux()
+        fwd = HostForwarder(None, 0, {0: None, 1: down},
+                            max_retries=6,       # 0.1+0.2+...+2.0 ≈ 3.5s
+                            metrics=MetricsRegistry(),
+                            heartbeat_interval_s=0)
+        fwd.start()
+        fwd.ingest_payload(_line_for(1, 2, 1))
+        fwd.flush()                     # sender enters its backoff loop
+        time.sleep(0.15)
+        t0 = time.monotonic()
+        fwd.stop()
+        assert time.monotonic() - t0 < 1.5
+
+    def test_edge_refusal_reflects_remote_owner_overload(self):
+        """ISSUE layer 3: a purely remote-owned telemetry payload whose
+        owner advertises SHEDDING is refused with the OWNER's hint —
+        the receiving transport turns that into 429 / 5.03 / pause."""
+        clock = _Clock()
+        fwd = HostForwarder(_CollectorDispatcher(), 0,
+                            {0: None, 1: _AcceptDemux()},
+                            metrics=MetricsRegistry(),
+                            health=self._health(clock),
+                            heartbeat_interval_s=0)
+        fwd.health.observe_heartbeat(
+            1, overload_state=int(OverloadState.SHEDDING), retry_after_s=4.0)
+        with pytest.raises(OverloadShed) as exc:
+            fwd.ingest_payload(_line_for(1, 2, 1))
+        assert exc.value.retry_after_s == 4.0
+        assert exc.value.state == OverloadState.SHEDDING
+        assert fwd.metrics()["pending"] == 0      # nothing buffered
+        # a CRITICAL-looking payload is never gated: the owner's own
+        # admission decides (alerts are never shed)
+        tok = next(f"dev-{i}" for i in range(200)
+                   if owning_process(f"dev-{i}", 2) == 1)
+        alert = (b'{"deviceToken": "%s", "type": "Alert", "request":'
+                 b' {"type": "hot", "level": "warning", "eventDate": 1000}}'
+                 % tok.encode())
+        fwd.ingest_payload(alert)                 # no raise
+        assert fwd.metrics()["pending"] == 1
+        # mixed local+remote payloads forward too (spool absorbs)
+        mixed = _line_for(0, 2, 7) + b"\n" + _line_for(1, 2, 8)
+        fwd.ingest_payload(mixed)
+        # recovery clears the gate
+        fwd.health.observe_heartbeat(1, overload_state=0)
+        fwd.ingest_payload(_line_for(1, 2, 9))
+        fwd.stop()
+
+    def test_heartbeat_learns_peer_overload_end_to_end(self, tmp_path):
+        """The fleet.heartbeat loop against a real bound instance: the
+        sender's table converges on the peer's forced overload state,
+        then recovers."""
+        inst = Instance(make_config(tmp_path))
+        inst.start()
+        srv = RpcServer(port=0, tokens=inst.tokens)
+        bind_instance(srv, inst)
+        srv.start()
+        if inst.overload is not None:
+            srv.overload_provider = lambda: (int(inst.overload.state),
+                                             inst.overload.retry_after())
+        jwt = inst.tokens.mint("system", ["ROLE_ADMIN"])
+        demux = RpcDemux([srv.endpoint], token_provider=lambda: jwt)
+        fwd = HostForwarder(None, 0, {0: None, 1: demux},
+                            metrics=MetricsRegistry(),
+                            heartbeat_interval_s=0.05)
+        fwd.start()
+        try:
+            inst.overload.force(OverloadState.SHEDDING, reason="test")
+            deadline = time.time() + 10
+            while time.time() < deadline and fwd.health.overload_state(1) \
+                    != int(OverloadState.SHEDDING):
+                time.sleep(0.02)
+            assert fwd.health.overload_state(1) == int(OverloadState.SHEDDING)
+            assert fwd.health.state(1) == PeerState.ALIVE
+            inst.overload.force(OverloadState.NORMAL, reason="test-done")
+            deadline = time.time() + 10
+            while time.time() < deadline and fwd.health.overload_state(1):
+                time.sleep(0.02)
+            assert fwd.health.overload_state(1) == 0
+        finally:
+            fwd.stop()
+            demux.close()
+            srv.stop()
+            inst.stop()
+            inst.terminate()
+
+
+class TestMembershipUnderTraffic:
+    def test_route_remote_rejects_stale_generation(self):
+        fwd = HostForwarder(_CollectorDispatcher(), 0,
+                            {0: None, 1: _AcceptDemux()},
+                            metrics=MetricsRegistry(),
+                            heartbeat_interval_s=0)
+        with fwd._lock:
+            gen = fwd._member_gen
+        assert fwd._route_remote({}, gen)          # current gen: accepted
+        with fwd._lock:
+            fwd._member_gen += 1
+        assert not fwd._route_remote({}, gen)      # stale: caller recomputes
+        fwd.stop()
+
+    def test_flapping_membership_under_concurrent_ingest_loses_nothing(
+            self):
+        """Satellite: apply_membership while ingest threads hammer the
+        forwarder — every row lands at exactly one destination (no
+        loss, no double-ownership), and rows ingested after the final
+        map settle at their final owners."""
+        dispatcher = _CollectorDispatcher()
+        demux_a, demux_b, demux_c = (_AcceptDemux(), _AcceptDemux(),
+                                     _AcceptDemux())
+        maps = [
+            {0: None, 1: demux_a, 2: demux_b},
+            {0: None, 1: demux_a, 2: demux_b, 3: demux_c},
+        ]
+        fwd = HostForwarder(dispatcher, 0, dict(maps[0]),
+                            metrics=MetricsRegistry(),
+                            deadline_ms=2.0,
+                            heartbeat_interval_s=0)
+        fwd.start()
+        n_threads, per_thread = 4, 40
+        stop_flap = threading.Event()
+
+        def ingest(tid):
+            for i in range(per_thread):
+                value = tid * 1000 + i
+                # unique value marks the row across every destination
+                fwd.ingest_payload(
+                    b'{"deviceToken": "dev-%d", "type": "Measurement",'
+                    b' "request": {"name": "t", "value": %d,'
+                    b' "eventDate": 1000}}' % (value % 64, value))
+
+        def flap():
+            i = 0
+            while not stop_flap.is_set():
+                fwd.apply_membership(dict(maps[i % 2]))
+                i += 1
+            fwd.apply_membership(dict(maps[0]))    # final map: 3 processes
+
+        threads = [threading.Thread(target=ingest, args=(t,))
+                   for t in range(n_threads)]
+        flapper = threading.Thread(target=flap)
+        flapper.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        stop_flap.set()
+        flapper.join(timeout=30)
+        # rows ingested AFTER the final membership: ownership must be
+        # computed under the final 3-process map, never a stale one
+        tail_marker = 999_999
+        fwd.ingest_payload(
+            b'{"deviceToken": "dev-1", "type": "Measurement",'
+            b' "request": {"name": "t", "value": %d,'
+            b' "eventDate": 1000}}' % tail_marker)
+        fwd.flush(wait=True)
+        fwd.stop()
+
+        import re as _re
+
+        def values(lines):
+            return [int(_re.search(rb'"value": (\d+)', l).group(1))
+                    for l in lines]
+
+        placed = {
+            "local": values(dispatcher.lines),
+            "a": values(demux_a.accepted),
+            "b": values(demux_b.accepted),
+            "c": values(demux_c.accepted),
+        }
+        want = {t * 1000 + i for t in range(n_threads)
+                for i in range(per_thread)} | {tail_marker}
+        got = [v for vs in placed.values() for v in vs]
+        missing = want - set(got)
+        assert not missing, f"lost rows: {sorted(missing)[:10]}"
+        # exactly-once across DESTINATIONS: a row may never be accepted
+        # by two different owners (memory-mode requeue is move, not copy)
+        from collections import Counter as _Counter
+
+        dup = {v for dest, vs in placed.items()
+               for v in vs
+               if sum(v in set(ovs) for ovs in placed.values()) > 1}
+        assert not dup, f"double-owned rows: {sorted(dup)[:10]}"
+        counts = _Counter(got)
+        repeats = {v: c for v, c in counts.items() if c > 1}
+        assert not repeats, f"duplicated rows: {list(repeats.items())[:10]}"
+        # the tail row landed where the FINAL map says it belongs
+        owner = owning_process("dev-1", 3)
+        dest = {0: "local", 1: "a", 2: "b"}[owner]
+        assert tail_marker in placed[dest]

@@ -236,6 +236,87 @@ def main() -> int:
         inst3.stop()
         inst3.terminate()
 
+        # -- fleet phase (ISSUE 14): a SHEDDING peer must park the
+        # forward spool (paced probes, zero dead letters), the edge
+        # must refuse with the OWNER's hint, and recovery must drain
+        # the spool to zero
+        from sitewhere_tpu.rpc import (
+            HostForwarder,
+            RpcDemux,
+            RpcServer,
+            bind_instance,
+        )
+        from sitewhere_tpu.rpc.forward import owning_process
+
+        peer = _make_instance(os.path.join(root, "peer"))
+        peer.start()
+        peer.device_management.create_device_type(token="sensor", name="S")
+        tok = next(f"p-{i}" for i in range(100)
+                   if owning_process(f"p-{i}", 2) == 1)
+        peer.device_management.create_device(token=tok,
+                                             device_type="sensor")
+        peer.device_management.create_device_assignment(device=tok)
+        srv = RpcServer(port=0, tokens=peer.tokens)
+        bind_instance(srv, peer)
+        srv.overload_provider = lambda: (int(peer.overload.state),
+                                         peer.overload.retry_after())
+        srv.start()
+        jwt = peer.tokens.mint("system", ["ROLE_ADMIN"])
+        demux = RpcDemux([srv.endpoint], token_provider=lambda: jwt)
+        fwd = HostForwarder(None, 0, {0: None, 1: demux},
+                            data_dir=os.path.join(root, "fwd-spool"),
+                            max_retries=1, heartbeat_interval_s=0)
+        fwd.start()
+        fleet_report = {}
+        try:
+            line = _line(tok, 5.0, 1_753_960_000).encode()
+            peer.overload.force(OverloadState.SHEDDING, reason="chaos-fleet")
+            # rows sent into a shedding owner park in the spool (the
+            # first delivery learns the state off the refusal's
+            # piggyback headers) — never a dead letter
+            fwd.ingest_payload(line)
+            fwd.flush(wait=True)
+            if fwd.dead_lettered:
+                failures.append("fleet: rows for a SHEDDING owner were "
+                                "dead-lettered instead of retained")
+            if fwd.pending_rows() != 1:
+                failures.append("fleet: shed rows not retained in spool")
+            # a paced-probe window must stay bounded: hammer flushes
+            attempts0 = int(fwd._m_attempts.value)
+            for _ in range(25):
+                fwd.flush(wait=True)
+            storm = int(fwd._m_attempts.value) - attempts0
+            fleet_report["parked_window_attempts"] = storm
+            if storm > 3:
+                failures.append(
+                    f"fleet: {storm} send attempts while parked — "
+                    "retry storm, probes not paced")
+            # the device-facing edge refuses with the owner's hint
+            try:
+                fwd.ingest_payload(_line(tok, 6.0, 1_753_960_001).encode())
+                failures.append("fleet: edge accepted a payload for a "
+                                "SHEDDING owner without backpressure")
+            except OverloadShed as e:
+                fleet_report["edge_retry_after_s"] = e.retry_after_s
+            # recovery: probes redeliver, the spool drains to zero
+            peer.overload.force(OverloadState.NORMAL, reason="chaos-done")
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and fwd.pending_rows():
+                fwd.flush(wait=True)
+                time.sleep(0.2)
+            fleet_report["pending_after_recovery"] = fwd.pending_rows()
+            if fwd.pending_rows():
+                failures.append("fleet: spool did not drain on recovery")
+            if fwd.dead_lettered:
+                failures.append("fleet: recovery dead-lettered rows")
+            fleet_report["peer_health"] = fwd.health.snapshot().get("1")
+        finally:
+            fwd.stop()
+            demux.close()
+            srv.stop()
+            peer.stop()
+            peer.terminate()
+
         print(json.dumps({
             "seed": seed,
             "ingested": ingested,
@@ -246,6 +327,7 @@ def main() -> int:
             "resilience": resilience,
             "overload": overload_report,
             "recovery": recovery_report,
+            "fleet": fleet_report,
             "ok": not failures,
         }, indent=2))
     finally:

@@ -73,6 +73,11 @@ SMOKE_KILLS = [
     # appear exactly once), not double them
     ("crash.mid_compact", 1),
     ("crash.pre_manifest", 2),
+    # kill a forward-spool sender between the spool poll and the peer
+    # ack (a 2-host fleet in one process): the uncommitted spool tail
+    # must replay to the owner on restart — at-least-once across the
+    # DCN hop, no lost rows (runs through run_forward_kill_case)
+    ("crash.mid_forward", 1),
 ]
 SWEEP_CATALOG = {
     "crash.mid_ring": (1, 5),
@@ -82,6 +87,7 @@ SWEEP_CATALOG = {
     "crash.mid_compact": (1, 2),
     "crash.mid_checkpoint": (1, 3),
     "crash.pre_manifest": (1, 3),
+    "crash.mid_forward": (1, 3),
 }
 
 QUERY_DOCS = [
@@ -367,6 +373,215 @@ def verify(data_dir, matches_path, expected, committed_at_kill):
     return failures, report
 
 
+# ---------------------------------------------------------------------------
+# crash.mid_forward: the forward-spool sender's kill window (2-host fleet)
+# ---------------------------------------------------------------------------
+
+FWD_PAYLOADS = 10
+FWD_ROWS = 8
+
+
+def _fwd_free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _forward_config(data_dir, ports, pid):
+    from sitewhere_tpu.runtime.config import Config
+
+    return Config({
+        "instance": {"id": f"crashfwd-{pid}", "data_dir": data_dir},
+        "pipeline": {"width": 16, "registry_capacity": 128,
+                     "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 86400},
+        "checkpoint": {"interval_s": 0},
+        "analytics": {"enabled": False},
+        "slo": {"enabled": False},
+        "overload": {"enabled": False},
+        # forwarded rows auto-register on the OWNER (no model setup)
+        "registration": {"default_device_type": "sensor",
+                         "allow_new_devices": True},
+        "rpc": {
+            "server": {"enabled": True, "host": "127.0.0.1",
+                       "port": ports[pid]},
+            "process_id": pid,
+            "peers": [f"127.0.0.1:{p}" for p in ports],
+            "forward_deadline_ms": 10.0,
+            "heartbeat_interval_s": 0.2,
+        },
+        "security": {"jwt_secret": "crashfwd-secret"},
+    }, apply_env=False)
+
+
+def _forward_payload(k):
+    lines = []
+    for r in range(FWD_ROWS):
+        i = k * FWD_ROWS + r
+        lines.append(json.dumps({
+            "deviceToken": f"f-{i % 6}", "type": "Measurement",
+            "request": {"name": "temp", "value": float(i % 40),
+                        "eventDate": T0 + i},
+        }))
+    return "\n".join(lines).encode()
+
+
+def _forward_boot(root, ports):
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.rpc.forward import owning_process
+    from sitewhere_tpu.services.common import DuplicateToken
+
+    # owner (host 1) first so the sender's spool can drain into it
+    insts = []
+    for pid in (1, 0):
+        inst = Instance(_forward_config(
+            os.path.join(root, f"host{pid}"), ports, pid))
+        # model BEFORE start(): the boot-time journal replay needs the
+        # device type (and each host its own devices) already present
+        dm = inst.device_management
+        try:
+            dm.create_device_type(token="sensor", name="Sensor")
+        except DuplicateToken:
+            pass
+        for i in range(6):
+            tok = f"f-{i}"
+            if owning_process(tok, 2) != pid:
+                continue
+            try:
+                dm.create_device(token=tok, device_type="sensor")
+                dm.create_device_assignment(device=tok)
+            except DuplicateToken:
+                pass
+        inst.start()
+        insts.append(inst)
+    insts.reverse()     # [host0, host1]
+    return insts
+
+
+def run_forward_child(root, ports):
+    """One 2-host fleet life: every payload enters host 0's forwarder,
+    remote rows spool and ship to host 1.  Under SW_CRASHPOINT=
+    crash.mid_forward the whole process SIGKILLs in the sender's
+    poll→send window; unarmed it drains and stops clean."""
+    insts = _forward_boot(root, ports)
+    for k in range(FWD_PAYLOADS):
+        insts[0].forwarder.ingest_payload(_forward_payload(k),
+                                          source_id="crashfwd")
+        insts[0].forwarder.flush()
+        time.sleep(0.02)
+    insts[0].forwarder.flush(wait=True)
+    for inst in insts:
+        inst.dispatcher.flush()
+        inst.stop()
+        inst.terminate()
+
+
+def _journal_rows(data_dir, name):
+    """(ts → value) for every measurement row in one journal (forward
+    spools store multi-line payloads; same NDJSON decode)."""
+    from sitewhere_tpu.ingest.journal import Journal
+
+    out = {}
+    path = os.path.join(data_dir, name)
+    if not os.path.isdir(path):
+        return out
+    journal = Journal(data_dir, name=name)
+    try:
+        for _off, payload in journal.scan(0):
+            for line in payload.split(b"\n"):
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if doc.get("type", "").lower() != "measurement":
+                    continue
+                req = doc.get("request") or {}
+                out[int(req["eventDate"])] = float(req["value"])
+    finally:
+        journal.close()
+    return out
+
+
+def verify_forward(root, ports):
+    """Reboot the 2-host fleet on the survivors' dirs and check the
+    FORWARD contract: host 0's forwarder replays the uncommitted spool
+    tail on start(), and every row that was durably SPOOLED toward
+    host 1 lands in host 1's durable intake journal — at-least-once
+    across the DCN hop (duplicates above the sender's committed cursor
+    are legal, loss is not).  Store materialization past the journal is
+    the other kill points' contract, not this one's."""
+    failures = []
+    # the spool's surviving content, read BEFORE the restart drains it
+    expected = _journal_rows(os.path.join(root, "host0"), "forward-1")
+    if not expected:
+        failures.append("forward spool empty at the kill — the "
+                        "crosspoint fired too early to test anything")
+    t0 = time.perf_counter()
+    insts = _forward_boot(root, ports)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline \
+                and insts[0].forwarder.pending_rows() > 0:
+            insts[0].forwarder.flush()
+            time.sleep(0.05)
+        pending = insts[0].forwarder.pending_rows()
+        if pending:
+            failures.append(
+                f"forward spool never drained after restart ({pending})")
+        dead = int(insts[0].forwarder.dead_lettered)
+        if dead:
+            failures.append(
+                f"{dead} rows dead-lettered during forward replay")
+        for inst in insts:
+            inst.dispatcher.flush()
+    finally:
+        for inst in insts:
+            inst.stop()
+            inst.terminate()
+    # journals are closed now: read the owner's durable intake
+    delivered = _journal_rows(os.path.join(root, "host1"), "ingest")
+    lost = sorted(ts for ts in expected if ts not in delivered)
+    if lost:
+        failures.append(
+            f"forward-replay loss: {len(lost)} spooled rows never "
+            f"reached the owner's journal (e.g. ts={lost[:5]})")
+    report = {
+        "spooled_rows": len(expected),
+        "owner_journal_rows": len(delivered),
+        "spool_pending_after": pending,
+        "verify_wall_s": round(time.perf_counter() - t0, 3),
+    }
+    return failures, report
+
+
+def run_forward_kill_case(root, case, hits, child_cmd):
+    data_dir = os.path.join(root, f"{case:03d}-crash-mid-forward-{hits}")
+    os.makedirs(data_dir, exist_ok=True)
+    ports = [_fwd_free_port(), _fwd_free_port()]
+    env = dict(os.environ,
+               SW_CRASHPOINT=f"crash.mid_forward:{hits}",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        child_cmd + ["--forward-child", data_dir,
+                     "--ports", f"{ports[0]},{ports[1]}"],
+        env=env, capture_output=True, timeout=300)
+    killed = proc.returncode == -signal.SIGKILL
+    failures = []
+    if not killed:
+        failures.append(
+            f"forward child was not killed (rc={proc.returncode}): "
+            f"{proc.stderr.decode(errors='replace')[-800:]}")
+        return failures, {"killed": False}
+    vfail, report = verify_forward(data_dir, ports)
+    failures.extend(vfail)
+    report["killed"] = killed
+    return failures, report
+
+
 def run_kill_case(root, case, point, hits, golden_matches, child_cmd):
     data_dir = os.path.join(
         root, f"{case:03d}-{point.replace('.', '-')}-{hits}")
@@ -411,6 +626,8 @@ def run_kill_case(root, case, point, hits, golden_matches, child_cmd):
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--child", metavar="DATA_DIR")
+    parser.add_argument("--forward-child", metavar="DATA_DIR")
+    parser.add_argument("--ports", default="")
     parser.add_argument("--matches", default="matches.jsonl")
     parser.add_argument("--smoke", action="store_true")
     parser.add_argument("--sweep", type=int, default=0)
@@ -420,6 +637,10 @@ def main(argv=None) -> int:
 
     if args.child:
         run_child(args.child, args.matches)
+        return 0
+    if args.forward_child:
+        run_forward_child(args.forward_child,
+                          [int(p) for p in args.ports.split(",")])
         return 0
 
     seed = args.seed if args.seed is not None \
@@ -458,8 +679,13 @@ def main(argv=None) -> int:
               f"{len(golden_matches)} matches")
 
         for case, (point, hits) in enumerate(kills):
-            failures, report = run_kill_case(
-                root, case, point, hits, golden_matches, child_cmd)
+            if point == "crash.mid_forward":
+                # fleet-shaped case: its own 2-host child + verifier
+                failures, report = run_forward_kill_case(
+                    root, case, hits, child_cmd)
+            else:
+                failures, report = run_kill_case(
+                    root, case, point, hits, golden_matches, child_cmd)
             report.update({"point": point, "hit": hits,
                            "failures": failures})
             results["kills"].append(report)
