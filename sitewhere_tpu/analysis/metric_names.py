@@ -55,6 +55,17 @@ FAMILIES: Dict[str, Optional[Set[str]]] = {
                          "state_writes", "presence_merges"},
     "device.stage_ms": None,            # per-stage histograms, probe-named
     "device.cost": {"flops", "bytes_accessed"},
+    # device-tier fault containment (runtime/dispatcher.py +
+    # runtime/devguard.py): chain/step faults, the bisect → poison-row
+    # path, re-leases, breaker ladder state, watchdog budget trips
+    "device.fault": {"chain_faults", "step_faults", "bisect_rounds",
+                     "poison_rows", "releases", "breaker_state",
+                     "breaker_trips", "watchdog_soft_trips",
+                     "watchdog_hard_trips", "host_copy_faults",
+                     "cpu_fallback_steps"},
+    # numeric-integrity quarantine (dispatcher _scan_quarantine): NaN/Inf
+    # rows masked on device, attributed + quarantined host-side
+    "pipeline.quarantine": {"devices", "rows_nonfinite", "state_changes"},
     "slo.burn_rate": None,              # slo.burn_rate.<objective>.<win>
     "slo.alert": None,                  # slo.alert.<objective>
     "flightrec": {"records", "anomalies", "snapshots", "suppressed_dumps"},

@@ -201,7 +201,7 @@ def cep_features(
     capacity = state.win.shape[0]
     mrow = ok & (dev >= 0) & (dev < capacity) & (
         et == int(EventType.MEASUREMENT)) & (
-        (cross_mtype < 0) | (mt == cross_mtype))
+        (cross_mtype < 0) | (mt == cross_mtype)) & jnp.isfinite(val)
     win = jnp.where(mrow, ts // jnp.int32(window_s), -2)
     idx = jnp.arange(n)
     # previous measurement row (any device): device rows are contiguous

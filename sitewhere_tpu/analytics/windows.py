@@ -163,6 +163,9 @@ def aggregate_windows(
         valid
         & (device_id >= 0) & (device_id < n_devices)
         & (window_idx >= 0) & (window_idx < n_windows)
+        # defense in depth vs the pipeline's nonfinite mask: one NaN in
+        # sums/sumsqs would poison the cell for the store's lifetime
+        & jnp.isfinite(value)
     )
     flat = jnp.where(ok, device_id * n_windows + window_idx, cells)
     v = jnp.where(ok, value, 0.0)

@@ -451,6 +451,8 @@ class Instance(LifecycleComponent):
             overload=self.overload,
             flightrec=self.flightrec,
             slo=self.slo,
+            quarantine_after=int(self.config.get(
+                "pipeline.quarantine_after", 3)),
             cost_analysis=self.config.get("telemetry.cost_analysis"),
         ))
         self.presence = self.add_child(PresenceManager(
@@ -523,7 +525,10 @@ class Instance(LifecycleComponent):
                 heartbeat_interval_s=float(self.config.get(
                     "rpc.heartbeat_interval_s", 0.5)),
                 call_timeout_s=float(self.config.get(
-                    "rpc.call_timeout_s", 10.0))))
+                    "rpc.call_timeout_s", 10.0)),
+                # hung-step watchdog flag on every beat: peers park
+                # forwards toward a host whose device tier is wedged
+                device_unhealthy=lambda: self.dispatcher.device_unhealthy))
         else:
             self._peer_demuxes = {}
         self._rpc_peers = list(peers)
@@ -885,12 +890,19 @@ class Instance(LifecycleComponent):
         # the probes must run at this deployment's actual capacities
         rules = self.rules.publish()
         zones = self.mirror.publish_zones()
-        return profile_device_stages(
+        result = profile_device_stages(
             width=int(self.config["pipeline.width"]),
             capacity=int(self.config["pipeline.registry_capacity"]),
             rules_capacity=int(rules.threshold.shape[0]),
             zones_capacity=int(zones.nvert.shape[0]),
             iters=iters, repeats=repeats, metrics=self.metrics)
+        full_ms = result.get("full_ms")
+        if full_ms:
+            # re-anchor the hung-step watchdog's soft/hard budgets to
+            # the MEASURED per-step device time (floored inside
+            # calibrate so a CPU test host never false-trips)
+            self.dispatcher.watchdog.calibrate(float(full_ms))
+        return result
 
     def start_profiler_capture(self) -> dict:
         """Start an on-demand ``jax.profiler`` trace into the data dir
@@ -1560,6 +1572,33 @@ class Instance(LifecycleComponent):
                 self._mark_requeued(offset)
             return {"requeued": rows > 0, "kind": kind, "rows": rows,
                     **({"unreadable_refs": missing} if missing else {})}
+        if kind == "device-poison" and doc.get("columns"):
+            # poison rows isolated by the dispatcher's bisect
+            # (_dead_letter_poison): the document carries the raw host
+            # columns, so the rows re-enter the normal batch path
+            # exactly as fresh ingest — requeue AFTER the producer-side
+            # corruption is fixed (or to reproduce the quarantine)
+            import numpy as np
+
+            from sitewhere_tpu.ingest.batcher import _COL_FIELDS, _DTYPE
+            from sitewhere_tpu.runtime.overload import OverloadShed
+
+            columns = doc["columns"]
+            if "device_id" not in columns:
+                return {"requeued": False, "kind": kind,
+                        "reason": "poison record lacks device_id column"}
+            cols = {
+                field: np.asarray(columns[field],
+                                  dtype=_DTYPE.get(field, np.float32))
+                for field in _COL_FIELDS if field in columns
+            }
+            try:
+                rows = self.dispatcher.requeue_rows(cols)
+            except OverloadShed as e:
+                return {"requeued": False, "kind": kind,
+                        "reason": f"refused by admission: {e}"}
+            self._mark_requeued(offset)
+            return {"requeued": True, "kind": kind, "rows": rows}
         if kind == "undelivered-command" and doc.get("command") \
                 and doc.get("assignment"):
             ok = self.commands.invoke(CommandInvocation(

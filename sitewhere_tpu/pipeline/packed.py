@@ -67,7 +67,8 @@ BATCH_I = ("valid", "device_id", "tenant_id", "event_type", "ts_s", "ts_ns",
 BATCH_F = ("value", "lat", "lon", "elevation")
 STATE_I = ("last_event_ts_s", "last_event_ts_ns", "last_event_type",
            "last_location_ts_s", "last_location_ts_ns", "last_alert_code",
-           "last_alert_ts_s", "last_alert_ts_ns", "presence_missing")
+           "last_alert_ts_s", "last_alert_ts_ns", "presence_missing",
+           "nonfinite_count")
 STATE_F = ("last_lat", "last_lon", "last_elevation")
 OUT_I = ("flags", "device_type_id", "assignment_id", "area_id",
          "customer_id", "asset_id", "rule_id", "zone_id",
@@ -85,7 +86,14 @@ METRIC_SCALARS = ("processed", "accepted", "unregistered", "unassigned",
 #   state_writes     rows that actually merged into DeviceState
 #                    (accepted AND update_state)
 #   presence_merges  devices the step's presence map marked present
-TELEMETRY_SCALARS = ("rows_invalid", "state_writes", "presence_merges")
+#   rows_nonfinite   valid rows carrying NaN/Inf in a float column —
+#                    masked out of rules/state/analytics on device; a
+#                    nonzero value triggers the dispatcher's host-side
+#                    quarantine scan (the rare path), so the common
+#                    all-finite batch costs one fused reduction and
+#                    nothing else
+TELEMETRY_SCALARS = ("rows_invalid", "state_writes", "presence_merges",
+                     "rows_nonfinite")
 
 PRESENCE_ROW = STATE_I.index("presence_missing")
 
@@ -112,7 +120,7 @@ class PackedTables:
 class PackedState:
     """DeviceState packed to two buffers (the donated step carry)."""
 
-    si: jax.Array  # int32[9 + 2M, D]
+    si: jax.Array  # int32[10 + 2M, D]
     sf: jax.Array  # float32[3 + M + M*K, D]
     num_mtype_slots: int = struct.field(pytree_node=False, default=8)
     num_ewma_scales: int = struct.field(pytree_node=False, default=3)
@@ -191,7 +199,7 @@ def unpack_batch(bi: jax.Array, bf: jax.Array) -> EventBatch:
 def pack_outputs(out: PipelineOutputs,
                  batch: Optional[EventBatch] = None
                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """PipelineOutputs → (oi [10, B] int32, metrics [15] int32, present[D]).
+    """PipelineOutputs → (oi [10, B] int32, metrics [16] int32, present[D]).
 
     The metrics vector is the step scalars + per-type counts + the
     :data:`TELEMETRY_SCALARS` occupancy block (computed on device from
@@ -219,6 +227,7 @@ def pack_outputs(out: PipelineOutputs,
         jnp.int32(width) - m.processed,                  # rows_invalid
         writes.sum(dtype=jnp.int32),                     # state_writes
         out.present_now.sum(dtype=jnp.int32),            # presence_merges
+        out.nonfinite.sum(dtype=jnp.int32),              # rows_nonfinite
     ])
     metrics = jnp.concatenate([
         jnp.stack([getattr(m, f) for f in METRIC_SCALARS]), m.by_type,
@@ -586,7 +595,7 @@ class RingFetch:
     """ONE D2H fetch shared by every step view of a chained dispatch.
 
     The packed chain returns the whole ring's outputs stacked
-    (``ois [K, 10, B]``, ``metrics [K, 12]``); the first step view that
+    (``ois [K, 10, B]``, ``metrics [K, 16]``); the first step view that
     egress touches blocks on a single ``device_get`` for the pair, and
     every sibling slot reads its slice from the same host copy — K steps,
     one host sync.  The copies were started asynchronously at dispatch
@@ -611,7 +620,7 @@ class RingFetch:
 
 class RingStepView(PackedView):
     """One chained step's :class:`PackedView`, backed by the ring's
-    shared fetch — slot ``k``'s ``[10, B]`` block and ``[15]`` metrics
+    shared fetch — slot ``k``'s ``[10, B]`` block and ``[16]`` metrics
     row sliced from the stacked host copy.  ``present_now`` is None:
     presence commits at chain granularity (the chain's OR'd map), never
     per slot."""

@@ -87,7 +87,8 @@ def build_sharded_step(mesh: Mesh, donate: bool = True):
     )
     outputs_t = PipelineOutputs(
         accepted=jnp.zeros(8, bool), unregistered=jnp.zeros(8, bool),
-        unassigned=jnp.zeros(8, bool), device_type_id=jnp.zeros(8, jnp.int32),
+        unassigned=jnp.zeros(8, bool), nonfinite=jnp.zeros(8, bool),
+        device_type_id=jnp.zeros(8, jnp.int32),
         assignment_id=jnp.zeros(8, jnp.int32), area_id=jnp.zeros(8, jnp.int32),
         customer_id=jnp.zeros(8, jnp.int32), asset_id=jnp.zeros(8, jnp.int32),
         rule_id=jnp.zeros(8, jnp.int32), zone_id=jnp.zeros(8, jnp.int32),

@@ -78,6 +78,11 @@ class PipelineOutputs:
     accepted: jax.Array      # bool[B]
     unregistered: jax.Array  # bool[B] → auto-registration (SURVEY.md §3.5)
     unassigned: jax.Array    # bool[B]
+    # Numeric-integrity mask: valid rows carrying NaN/Inf in value or
+    # geo columns.  These rows still persist as history (accepted stays
+    # raw — no silent loss) but are masked out of rules, state merge and
+    # analytics so a poison value can never enter the carried aggregates.
+    nonfinite: jax.Array     # bool[B]
     # Enrichment context (reference IDeviceEventContext):
     device_type_id: jax.Array  # int32[B]
     assignment_id: jax.Array   # int32[B]
@@ -497,11 +502,29 @@ def pipeline_step(
     Pure function of its inputs — jit/pjit it once and feed batches forever.
     """
     accepted, unregistered, unassigned, enrich = validate_and_enrich(registry, batch)
+    # Numeric integrity: a NaN/Inf in any float column would flow through
+    # the EWMA fold, the rule compares (NE is True for NaN!) and the
+    # time-ordered scatters straight into CARRIED state — poisoning the
+    # device's history forever.  Clean rows feed rules/state; raw
+    # ``accepted`` still routes persistence so nothing is silently lost.
+    finite = (jnp.isfinite(batch.value) & jnp.isfinite(batch.lat)
+              & jnp.isfinite(batch.lon) & jnp.isfinite(batch.elevation))
+    nonfinite = batch.valid & ~finite
+    clean = accepted & finite
     rule_fired, rule_id, ewma_candidates = eval_threshold_rules(
-        rules, state, batch, accepted)
-    zone_fired, zone_id = eval_zone_rules(zones, batch, accepted, enrich["area_id"])
+        rules, state, batch, clean)
+    zone_fired, zone_id = eval_zone_rules(zones, batch, clean, enrich["area_id"])
     new_state, present_now = update_device_state(
-        state, batch, accepted, ewma_candidates)
+        state, batch, clean, ewma_candidates)
+    # Per-device attribution rides device state (one scatter-add, no host
+    # sync): the quarantine threshold is evaluated host-side from the
+    # packed telemetry scalar + this counter.
+    cap = state.capacity
+    nf_idx = jnp.where(nonfinite & (batch.device_id >= 0)
+                       & (batch.device_id < cap), batch.device_id, cap)
+    new_state = new_state.replace(
+        nonfinite_count=new_state.nonfinite_count.at[nf_idx].add(
+            1, mode="drop"))
     derived = _build_derived_alerts(batch, rules, zones, rule_id, zone_id)
 
     metrics = StepMetrics(
@@ -517,6 +540,7 @@ def pipeline_step(
         accepted=accepted,
         unregistered=unregistered,
         unassigned=unassigned,
+        nonfinite=nonfinite,
         rule_id=rule_id,
         zone_id=zone_id,
         present_now=present_now,

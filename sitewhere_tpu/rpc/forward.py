@@ -211,6 +211,7 @@ class HostForwarder(LifecycleComponent):
                  heartbeat_interval_s: float = 0.5,
                  call_timeout_s: float = 10.0,
                  max_retained_bytes: Optional[int] = None,
+                 device_unhealthy=None,
                  name: str = "host-forwarder"):
         super().__init__(name)
         self.dispatcher = dispatcher
@@ -244,6 +245,10 @@ class HostForwarder(LifecycleComponent):
         # restart epoch for the fleet heartbeat: a rebooted sender's
         # first beat replaces peers' stale view of us atomically
         self.incarnation = int(time.time())
+        # zero-arg callable: this host's hung-step watchdog flag
+        # (dispatcher.device_unhealthy) — advertised on every beat so
+        # peers park forwards while OUR device tier is wedged
+        self.device_unhealthy = device_unhealthy
         # instance-scoped registry by default (a PRIVATE one when none
         # is injected — forwarders are per-instance objects and their
         # counters must never bleed across co-resident instances)
@@ -906,12 +911,19 @@ class HostForwarder(LifecycleComponent):
         if self.overload is not None:
             state = int(self.overload.state)
             retry_after = float(self.overload.retry_after())
+        unhealthy = False
+        if self.device_unhealthy is not None:
+            try:
+                unhealthy = bool(self.device_unhealthy())
+            except Exception:
+                logger.exception("device_unhealthy probe failed")
         return {
             "processId": int(self.process_id),
             "incarnation": int(self.incarnation),
             "state": state,
             "retryAfterS": round(retry_after, 3),
             "spoolLag": int(self.pending_for(target)),
+            "deviceUnhealthy": unhealthy,
         }
 
     def observe_peer_heartbeat(self, peer: int, body) -> None:
@@ -926,7 +938,8 @@ class HostForwarder(LifecycleComponent):
                 incarnation=int(body.get("incarnation", 0)),
                 overload_state=int(body.get("state", 0)),
                 retry_after_s=float(body.get("retryAfterS", 0.0)),
-                spool_lag=int(body.get("spoolLag", 0)))
+                spool_lag=int(body.get("spoolLag", 0)),
+                device_unhealthy=bool(body.get("deviceUnhealthy", False)))
         except (TypeError, ValueError):
             logger.warning("malformed heartbeat from peer %s ignored", peer)
 

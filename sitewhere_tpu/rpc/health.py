@@ -84,7 +84,7 @@ class _Peer:
     __slots__ = ("state", "last_heard", "last_transition", "incarnation",
                  "overload_state", "retry_after_s", "spool_lag",
                  "fail_streak", "next_probe_at", "transitions",
-                 "suppressed")
+                 "suppressed", "device_unhealthy")
 
     def __init__(self, now: float):
         self.state = PeerState.ALIVE        # optimistic boot (grace)
@@ -98,6 +98,7 @@ class _Peer:
         self.next_probe_at = now
         self.transitions = 0
         self.suppressed = 0                 # hysteresis-refused changes
+        self.device_unhealthy = False       # peer's hung-step watchdog flag
 
 
 class PeerHealthTable:
@@ -185,6 +186,7 @@ class PeerHealthTable:
                           overload_state: int = 0,
                           retry_after_s: float = 0.0,
                           spool_lag: int = 0,
+                          device_unhealthy: bool = False,
                           now: Optional[float] = None) -> None:
         """A full heartbeat (request or response body) from ``peer``."""
         now = self._now(now)
@@ -205,6 +207,11 @@ class PeerHealthTable:
                 rec.incarnation = incarnation
             self._overload_locked(peer, rec, overload_state, retry_after_s)
             rec.spool_lag = max(0, int(spool_lag))
+            if bool(device_unhealthy) != rec.device_unhealthy:
+                logger.warning("peer %d device tier %s", peer,
+                               "unhealthy (hung dispatch)"
+                               if device_unhealthy else "recovered")
+            rec.device_unhealthy = bool(device_unhealthy)
             if rec.fail_streak < self.suspect_failures:
                 self._transition_locked(peer, rec, PeerState.ALIVE, now,
                                         "heartbeat")
@@ -311,7 +318,12 @@ class PeerHealthTable:
             if rec is None:
                 return True
             return (rec.state == PeerState.ALIVE
-                    and rec.overload_state < _SHED_THRESHOLD)
+                    and rec.overload_state < _SHED_THRESHOLD
+                    # the peer's RPC plane answers but its device tier
+                    # is wedged (hung-step watchdog): forwarded rows
+                    # would pile into a queue nothing drains — park
+                    # them in the spool until the flag clears
+                    and not rec.device_unhealthy)
 
     def probe_ready(self, peer: int, now: Optional[float] = None) -> bool:
         """Non-stamping peek: is a probe currently allowed?  (The flush
@@ -397,5 +409,6 @@ class PeerHealthTable:
                     "fail_streak": rec.fail_streak,
                     "transitions": rec.transitions,
                     "suppressed_flaps": rec.suppressed,
+                    "device_unhealthy": rec.device_unhealthy,
                 }
             return out

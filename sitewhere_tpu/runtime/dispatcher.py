@@ -229,6 +229,9 @@ class PipelineDispatcher(LifecycleComponent):
         ring_depth: Optional[int] = None,
         flightrec=None,
         slo=None,
+        breaker=None,
+        watchdog=None,
+        quarantine_after: int = 3,
         cost_analysis: Optional[bool] = None,
         name: str = "pipeline-dispatcher",
     ):
@@ -509,6 +512,66 @@ class PipelineDispatcher(LifecycleComponent):
             for key in ("rows_admitted", "rows_invalid", "rules_fired",
                         "state_writes", "presence_merges")
         }
+        # Device-tier fault containment (runtime/devguard.py + the
+        # _recover_ring/_contain_step_failure paths below).  The metric
+        # families are declared closed in analysis/metric_names.py —
+        # device.* is a governed prefix.
+        self._m_fault = {
+            key: metrics.counter(f"device.fault.{key}")
+            for key in ("chain_faults", "step_faults", "bisect_rounds",
+                        "poison_rows", "releases", "breaker_trips",
+                        "watchdog_soft_trips", "watchdog_hard_trips",
+                        "host_copy_faults", "cpu_fallback_steps")
+        }
+        self._m_breaker_state = metrics.gauge("device.fault.breaker_state")
+        self._m_quar_devices = metrics.gauge("pipeline.quarantine.devices")
+        self._m_quar_rows = metrics.counter(
+            "pipeline.quarantine.rows_nonfinite")
+        self._m_quar_changes = metrics.counter(
+            "pipeline.quarantine.state_changes")
+        from sitewhere_tpu.runtime.devguard import (
+            DeviceBreaker,
+            DeviceWatchdog,
+        )
+
+        # Breaker: repeated device faults across distinct batches demote
+        # dispatch chained → single-step → CPU fallback; a cooldown
+        # probe restores.  Watchdog: wall-clock budgets over in-flight
+        # dispatches; past the hard budget the tier is unhealthy and the
+        # flag rides the heartbeat (instance wiring).  Callers may pass
+        # pre-configured guards (thresholds/clock); the dispatcher
+        # attaches its own handlers to any that were left unset.
+        self.breaker = breaker if breaker is not None else DeviceBreaker()
+        if self.breaker.on_trip is None:
+            self.breaker.on_trip = self._on_breaker_trip
+        if self.breaker.on_restore is None:
+            self.breaker.on_restore = self._on_breaker_restore
+        self.watchdog = (watchdog if watchdog is not None
+                         else DeviceWatchdog())
+        if self.watchdog.on_soft is None:
+            self.watchdog.on_soft = self._on_watchdog_soft
+        if self.watchdog.on_unhealthy is None:
+            self.watchdog.on_unhealthy = self._on_watchdog_hard
+        if self.watchdog.on_recovered is None:
+            self.watchdog.on_recovered = self._on_watchdog_recovered
+        # NaN/Inf quarantine: host policy over the device-counted
+        # rows_nonfinite telemetry scalar.  The per-device attribution
+        # scan runs ONLY when a plan's scalar is nonzero (the rare
+        # path); a device crossing `quarantine_after` cumulative poison
+        # rows emits one STATE_CHANGE through normal egress.
+        self.quarantine_after = max(1, int(quarantine_after))
+        self._nonfinite_seen: Dict[int, int] = {}
+        self._quarantined: set = set()
+        # D2H copy-fault escalation: _on_host_copy_error flags the
+        # suspect; the egress failure that follows re-dispatches the
+        # plan single-step instead of surfacing the secondary fetch
+        # error as an unexplained egress crash.
+        self._copy_suspect = False
+        # Watchdog tokens per dispatched plan, keyed by id(plan) —
+        # BatchPlan has __slots__, and the token is dispatch-scoped
+        # bookkeeping, not plan state.
+        self._wd_tokens: Dict[int, int] = {}
+        self._cpu_step = None   # lazily-built FALLBACK-level step
         # XLA cost analysis of the compiled chain at warm-up (flops /
         # bytes as device.cost.* gauges — the static roofline half).
         # Backend-adaptive default: the AOT lower+compile costs a second
@@ -1055,14 +1118,27 @@ class PipelineDispatcher(LifecycleComponent):
                 if self.slo is not None:
                     # SLO burn-rate sample (rate-limited inside tick)
                     self.slo.tick()
+                # Hung-step watchdog: dispatch is async, so this thread
+                # stays live even with a wedged chain in flight — the
+                # blocking fetch happens at egress, not here.
+                self.watchdog.check()
                 # Backpressure: with the in-flight window full, a deadline
                 # tick would emit a PARTIAL plan behind `depth` queued
                 # steps — it gains no latency and fragments the width.
                 # Drain one slot instead; pending rows keep coalescing
                 # toward full-width plans (the counts>=seg ingest path is
                 # unaffected and self-paces the source thread).
-                with self._step_lock:
+                # NEVER block this thread on the step lock: a wedged
+                # dispatch holds it for the whole hang, and the watchdog
+                # check above is the only thing that can still observe
+                # it — a blocking acquire here would cap the loop at ONE
+                # check per wedge (exactly when budget trips matter).
+                if not self._step_lock.acquire(blocking=False):
+                    continue
+                try:
                     full = len(self._inflight) >= self.inflight_depth
+                finally:
+                    self._step_lock.release()
                 if full:
                     self._drain_inflight(max_n=1)
                     continue
@@ -1345,7 +1421,10 @@ class PipelineDispatcher(LifecycleComponent):
                 and self.mesh is None
                 and plan.packed_i is not None
                 and plan.reason == "fill"
-                and plan.n_events == plan.width)
+                and plan.n_events == plan.width
+                # breaker demoted past CHAINED: bisectable single-step
+                # dispatch only, until a cooldown probe succeeds
+                and self.breaker.allow_chain())
 
     def _stall_for_egress_room(self) -> None:
         """Bounded offload queue: stall — never while holding the step
@@ -1459,12 +1538,33 @@ class PipelineDispatcher(LifecycleComponent):
                  for plan in plans]
         t0 = time.perf_counter()
         tables = self._tables_packed()
+        # one watchdog entry for the whole chain; each slot's egress
+        # decrements a part, so the entry drains when the LAST slot does
+        # (`plans` rides as the opaque payload — the trip callback
+        # renders records lazily, off the per-batch hot path)
+        wd = self.watchdog.begin(plans, parts=k)
+        for plan in plans:
+            self._wd_tokens[id(plan)] = wd
         ctrace = self.tracer.trace("pipeline.chain")
-        with ctrace.span("ring.dispatch").tag("steps", k):
-            _, ois, mets, _present = self._dispatch_chain(
-                chain, tables,
-                [s[0] for s in slots], [s[1] for s in slots])
-        start_host_copy(ois, mets, on_error=self._on_host_copy_error)
+        try:
+            if faults.device_active():
+                # device-fault injection point: fires against the HOST
+                # copies of the packed batch (plan.packed_i/f, always
+                # retained), so when_nonfinite matches exactly what the
+                # device would compute over
+                for plan in plans:
+                    faults.device_fire("device.dispatch",
+                                       values=plan.packed_f,
+                                       valid=plan.packed_i[0] != 0)
+            with ctrace.span("ring.dispatch").tag("steps", k):
+                _, ois, mets, _present = self._dispatch_chain(
+                    chain, tables,
+                    [s[0] for s in slots], [s[1] for s in slots])
+            start_host_copy(ois, mets, on_error=self._on_host_copy_error)
+        except Exception as e:
+            ctrace.end()
+            self._recover_ring(plans, e)
+            return
         ctrace.end()
         # chaos kill point: the K-step chain dispatched and committed on
         # device, but NO slot has egressed — every ring plan must replay
@@ -1483,9 +1583,170 @@ class PipelineDispatcher(LifecycleComponent):
                          slot=slot, seq=plan.seq, chain_k=k)
             self._m_assemble.observe(plan.max_wait_s)
             self._window_step(plan, RingStepView(fetch, slot), 0, trace)
+        # a clean CHAINED dispatch closes a half-open breaker probe
+        self.breaker.record_success(chained=True)
+
+    def _recover_ring(self, plans, exc) -> None:
+        """Chain-failure containment (runs under ``_step_lock``).
+
+        The K plans were popped off the ring BEFORE the dispatch, so a
+        raw failure would leave them invisible to every accounting
+        surface that reads ``self._ring`` — ``oldest_unsealed_wait_s``
+        (the overload ladder's queue-delay signal) and the partial-ring
+        deadline drain both go blind.  Re-parking them at the FRONT
+        restores that accounting (and emission order) first.
+
+        The donated carry is not stranded either: the chain faulted, so
+        ``commit_packed`` never ran and the state manager still holds
+        the last committed epoch — each single-step re-dispatch below
+        re-leases a fresh pack of it (``lease_generation`` advances on
+        the same live manager: recovery without restart).  Recovery must
+        NEVER touch the donated ``ps`` argument itself — swlint's DN001
+        donation pass guards that statically.
+
+        A re-dispatch that fails again is contained by
+        :meth:`_contain_step_failure` (bisect → poison-row quarantine),
+        and repeated faults across distinct batches trip the breaker.
+        """
+        self._ring[:0] = plans
+        self._m_fault["chain_faults"].inc()
+        if self._ring_donate:
+            # the failed chain held the packed lease; the re-dispatches
+            # below re-lease the carry from the last committed epoch
+            self._m_fault["releases"].inc()
+        for plan in plans:
+            self._wd_end(plan)
+        logger.warning(
+            "chained dispatch failed (%d plans re-parked): %s",
+            len(plans), exc)
+        if self.flightrec is not None:
+            for plan in plans:
+                self._flight_record(
+                    plan, None, 0, commit="device-fault",
+                    error=f"{type(exc).__name__}: {exc}")
+            self.flightrec.anomaly(
+                "device-fault",
+                detail=f"chain of {len(plans)} failed: "
+                       f"{type(exc).__name__}: {exc}")
+        self.breaker.record_fault(plans[0].seq)
+        # single-step re-dispatch in emission order; a plan that fails
+        # AGAIN stays re-parked (front of the ring), keeps the commit
+        # gate closed, and journal replay recovers it after restart
+        for _ in range(len(plans)):
+            plan = self._ring.pop(0)
+            try:
+                self._dispatch_plan(plan, 0, stall=False)
+            except Exception:
+                self._ring.insert(0, plan)
+                logger.exception(
+                    "single-step re-dispatch of seq=%d failed; "
+                    "plan stays parked", plan.seq)
+                break
 
     def _on_host_copy_error(self, exc) -> None:
+        """A D2H output copy failed.  The dispatch itself committed, so
+        the rows are NOT lost — but the egress fetch that follows will
+        hit the same dead buffer.  Escalate beyond the counter: flag the
+        plan's egress failure for a single-step re-dispatch (the state
+        re-step is at-least-once, identical to journal replay) and dump
+        the anomaly so the copy fault is attributable, not a mystery
+        egress crash minutes later."""
         self._m_host_copy_err.inc()
+        self._m_fault["host_copy_faults"].inc()
+        self._copy_suspect = True
+        logger.warning("device→host output copy failed: %s", exc)
+        if self.flightrec is not None:
+            self.flightrec.anomaly(
+                "host-copy-fault",
+                detail=f"{type(exc).__name__}: {exc}")
+
+    # --- device-tier fault-containment callbacks (devguard wiring) ---
+
+    def _on_breaker_trip(self, level: int) -> None:
+        from sitewhere_tpu.runtime.devguard import BREAKER_LEVELS
+        from sitewhere_tpu.runtime.overload import OverloadState
+
+        self._m_fault["breaker_trips"].inc()
+        self._m_breaker_state.set(level)
+        logger.warning("device breaker tripped to %s",
+                       BREAKER_LEVELS[level])
+        if self.flightrec is not None:
+            self.flightrec.anomaly(
+                "device-breaker",
+                detail=f"dispatch demoted to {BREAKER_LEVELS[level]}")
+        if (self.overload is not None
+                and self.overload.state == OverloadState.NORMAL):
+            # ride the overload ladder: a demoted device tier sheds the
+            # same way genuine pressure does, and the ladder's own
+            # hysteresis owns any further escalation
+            self.overload.force(OverloadState.DEGRADED,
+                                reason="device-breaker")
+
+    def _on_breaker_restore(self) -> None:
+        from sitewhere_tpu.runtime.overload import OverloadState
+
+        self._m_breaker_state.set(0)
+        logger.info("device breaker restored chained dispatch")
+        if (self.overload is not None
+                and self.overload.state == OverloadState.DEGRADED
+                and getattr(self.overload, "last_driver", None)
+                == "device-breaker"):
+            # release only our own demotion — a ladder driven by real
+            # pressure meanwhile keeps its state
+            self.overload.force(OverloadState.NORMAL,
+                                reason="device-breaker-recovered")
+
+    def _on_watchdog_soft(self, payload, elapsed_s: float) -> None:
+        """Soft budget tripped: dump the in-flight dispatch's plan
+        records to the flight recorder.  ``payload`` is the opaque
+        value handed to ``watchdog.begin`` — a BatchPlan (single-step)
+        or the ring's plan list (chained); records render HERE, on the
+        cold trip path, never per batch."""
+        plans = payload if isinstance(payload, list) else [payload]
+        self._m_fault["watchdog_soft_trips"].inc()
+        logger.warning("device dispatch slow: %.3fs in flight (budget "
+                       "%.3fs), %d plan(s)", elapsed_s,
+                       self.watchdog.soft_s, len(plans))
+        if self.flightrec is not None:
+            for i, plan in enumerate(plans):
+                self.flightrec.record(
+                    kind="hung-step",
+                    **self._wd_record(plan,
+                                      slot=i if len(plans) > 1 else None))
+            self.flightrec.anomaly(
+                "device-hung-step",
+                detail=f"{elapsed_s:.3f}s in flight "
+                       f"(soft budget {self.watchdog.soft_s:.3f}s)")
+
+    def _on_watchdog_hard(self, payload, elapsed_s: float) -> None:
+        self._m_fault["watchdog_hard_trips"].inc()
+        logger.error("device tier unhealthy: dispatch wedged %.3fs "
+                     "(hard budget %.3fs)", elapsed_s,
+                     self.watchdog.hard_s)
+        if self.flightrec is not None:
+            self.flightrec.anomaly(
+                "device-wedged",
+                detail=f"{elapsed_s:.3f}s in flight "
+                       f"(hard budget {self.watchdog.hard_s:.3f}s)")
+
+    def _on_watchdog_recovered(self) -> None:
+        logger.info("device tier recovered: in-flight dispatches drained")
+
+    @property
+    def device_unhealthy(self) -> bool:
+        """Heartbeat export: True while the hung-step watchdog holds the
+        tier unhealthy (rpc/forward.py carries it to peers)."""
+        return self.watchdog.unhealthy
+
+    def _wd_record(self, plan: BatchPlan, slot: Optional[int] = None) -> dict:
+        rec = {"seq": int(plan.seq), "rows": int(plan.n_events),
+               "reason": plan.reason}
+        if slot is not None:
+            rec["slot"] = slot
+        return rec
+
+    def _wd_end(self, plan: BatchPlan) -> None:
+        self.watchdog.end(self._wd_tokens.pop(id(plan), None))
 
     def _on_egress_restart(self, exc) -> None:
         """Supervisor restart of the egress worker — a flight-recorder
@@ -1566,17 +1827,43 @@ class PipelineDispatcher(LifecycleComponent):
 
                     bi, bf = place_packed_batch(self.mesh, bi, bf)
                     ps = place_packed_state(self.mesh, ps)
-                with trace.span("step.dispatch").tag("rows", plan.n_events):
-                    new_ps, oi, metrics, present = self._packed_step(
-                        tables, ps, bi, bf)
-                    self.state_manager.commit_packed(
-                        new_ps, present_now=present, read_epoch=epoch)
-                # Start the egress fetches NOW, asynchronously: the copies
-                # complete in the background while later plans step, so the
-                # blocking np.asarray at the window's egress end finds the
-                # bytes already on the host (≈0 RTT in steady state).
-                start_host_copy(oi, metrics,
-                                on_error=self._on_host_copy_error)
+                # breaker at FALLBACK: the chip is presumed dead — route
+                # the same jitted program to a CPU device (single-chip
+                # path only; a mesh program keeps its own placement)
+                step_fn = self._packed_step
+                if self.mesh is None:
+                    from sitewhere_tpu.runtime.devguard import FALLBACK
+
+                    if self.breaker.level >= FALLBACK:
+                        fallback = self._cpu_packed_step()
+                        if fallback is not None:
+                            step_fn = fallback
+                            self._m_fault["cpu_fallback_steps"].inc()
+                wd = self.watchdog.begin(plan)
+                self._wd_tokens[id(plan)] = wd
+                try:
+                    if faults.device_active() and self.mesh is None:
+                        faults.device_fire("device.dispatch",
+                                           values=plan.packed_f,
+                                           valid=plan.packed_i[0] != 0)
+                    with trace.span("step.dispatch").tag(
+                            "rows", plan.n_events):
+                        new_ps, oi, metrics, present = step_fn(
+                            tables, ps, bi, bf)
+                        self.state_manager.commit_packed(
+                            new_ps, present_now=present, read_epoch=epoch)
+                    # Start the egress fetches NOW, asynchronously: the
+                    # copies complete in the background while later plans
+                    # step, so the blocking np.asarray at the window's
+                    # egress end finds the bytes already on the host
+                    # (≈0 RTT in steady state).
+                    start_host_copy(oi, metrics,
+                                    on_error=self._on_host_copy_error)
+                except Exception as e:
+                    self._wd_end(plan)
+                    self._contain_step_failure(plan, e, replay_depth,
+                                               trace)
+                    return
                 dt = time.perf_counter() - t_dispatch
                 self._m_stage["dispatch"].observe(dt)
                 plan.dispatch_s = dt   # flight-record stage attribution
@@ -1620,6 +1907,164 @@ class PipelineDispatcher(LifecycleComponent):
             self._m_stage["dispatch"].observe(dt)
             plan.dispatch_s = dt
             self._window_step(plan, out, replay_depth, trace)
+
+    def _contain_step_failure(self, plan: BatchPlan, exc,
+                              replay_depth: int, trace) -> None:
+        """A single-step packed dispatch failed: bisect the batch
+        host-side until the poison rows are isolated (runs under
+        ``_step_lock``).
+
+        The full valid-row set is retried FIRST — a transient device
+        fault recovers in one extra dispatch with zero loss.  A subset
+        that still faults splits in half; singles that fault are poison
+        and dead-letter replayably as ``device-poison`` (the raw
+        columns ride the document, so ``requeue_dead_letter`` can
+        re-ingest them after the producer is fixed).  Every CLEAN
+        subset dispatches, commits, and windows normally — committed
+        rows are never lost, only isolated poison rows leave the
+        pipeline, and they leave with a paper trail.
+
+        Subsets mask rows via ``valid=0`` columns (device semantics
+        identical to a short batch), so disjoint subsets never double
+        count and per-device writes keep their time-ordered winner
+        scatter semantics regardless of subset order.
+        """
+        self._m_fault["step_faults"].inc()
+        self.breaker.record_fault(plan.seq)
+        logger.warning("packed step failed for seq=%d (%d rows): %s — "
+                       "bisecting", plan.seq, plan.n_events, exc)
+        if self.flightrec is not None:
+            self._flight_record(
+                plan, None, replay_depth, commit="device-fault",
+                trace=trace, error=f"{type(exc).__name__}: {exc}")
+            self.flightrec.anomaly(
+                "device-fault",
+                detail=f"step seq={plan.seq} failed: "
+                       f"{type(exc).__name__}: {exc}")
+        try:
+            valid_rows = np.nonzero(np.asarray(plan.packed_i[0]) != 0)[0]
+            poison: List[int] = []
+            stack = [valid_rows]
+            while stack:
+                rows = stack.pop()
+                if rows.size == 0:
+                    continue
+                self._m_fault["bisect_rounds"].inc()
+                if self._try_subset(plan, rows, replay_depth, trace):
+                    continue
+                if rows.size == 1:
+                    poison.append(int(rows[0]))
+                    continue
+                mid = rows.size // 2
+                stack.append(rows[mid:])
+                stack.append(rows[:mid])
+            if poison:
+                self._m_fault["poison_rows"].inc(len(poison))
+                logger.warning("isolated %d poison row(s) in seq=%d — "
+                               "dead-lettering", len(poison), plan.seq)
+                self._dead_letter_poison(plan, poison, exc)
+        finally:
+            # the original plan never egresses — its outstanding slot
+            # (incremented at _take) retires here; clean subsets above
+            # balanced their own increments through normal egress
+            with self._lock:
+                self._plans_outstanding -= 1
+
+    def _try_subset(self, plan: BatchPlan, rows: np.ndarray,
+                    replay_depth: int, trace) -> bool:
+        """Dispatch ``plan`` with only ``rows`` valid; True on success.
+
+        Skips ``plan.staged`` on purpose: the bisect path rebuilds the
+        batch from the retained HOST buffers (``packed_i``/``packed_f``)
+        so the masked columns are exactly what the device sees."""
+        bi = np.array(plan.packed_i, copy=True)
+        mask = np.zeros(bi.shape[1], dtype=bool)
+        mask[rows] = True
+        bi[0] = np.where(mask, bi[0], 0)
+        bf = plan.packed_f
+        try:
+            if faults.device_active():
+                faults.device_fire("device.dispatch", values=bf,
+                                   valid=bi[0] != 0)
+            tables = self._tables_packed()
+            epoch = self.state_manager.current_packed
+            with self._lock:
+                self._plans_outstanding += 1
+            try:
+                new_ps, oi, metrics, present = self._packed_step(
+                    tables, epoch, bi, bf)
+                # surface async execution faults HERE, inside the
+                # containment, not at the egress fetch
+                jax.block_until_ready(new_ps)
+                self.state_manager.commit_packed(
+                    new_ps, present_now=present, read_epoch=epoch)
+            except Exception:
+                with self._lock:
+                    self._plans_outstanding -= 1
+                raise
+        except Exception:
+            return False
+        from sitewhere_tpu.pipeline.packed import (
+            PackedView,
+            start_host_copy,
+        )
+
+        start_host_copy(oi, metrics, on_error=self._on_host_copy_error)
+        self._window_step(
+            plan,
+            PackedView(oi, metrics, present,
+                       on_fetch=self._m_host_syncs.inc),
+            replay_depth, trace)
+        return True
+
+    def _dead_letter_poison(self, plan: BatchPlan, rows: List[int],
+                            exc) -> None:
+        """Dead-letter isolated poison rows replayably: the document
+        carries the raw host columns, so the ``device-poison`` requeue
+        branch (instance.py) can rebuild and re-ingest the exact rows
+        once the producer-side corruption is fixed."""
+        if self.dead_letters is None:
+            return
+        idx = np.asarray(rows, dtype=np.int64)
+        columns = {
+            field: np.asarray(col)[idx].tolist()
+            for field, col in plan.host_cols.items()
+        }
+        dead_letter(self.dead_letters, {
+            "kind": "device-poison",
+            "error": f"{type(exc).__name__}: {exc}",
+            "seq": int(plan.seq),
+            "count": len(rows),
+            "columns": columns,
+        }, metrics=self.metrics)
+
+    def _cpu_packed_step(self):
+        """Lazily build (and cache) the packed step jitted for a CPU
+        device — the breaker's FALLBACK level.  Returns None when no CPU
+        device is addressable (the caller then keeps the default path:
+        demoted single-step beats a dead fallback)."""
+        if self._cpu_step is False:
+            return None
+        if self._cpu_step is None:
+            try:
+                from sitewhere_tpu.pipeline.packed import (
+                    packed_pipeline_step,
+                )
+
+                cpu = jax.devices("cpu")[0]
+                jitted = jax.jit(packed_pipeline_step)
+
+                def run(tables, ps, bi, bf, _cpu=cpu, _fn=jitted):
+                    tables, ps, bi, bf = jax.device_put(
+                        (tables, ps, bi, bf), _cpu)
+                    return _fn(tables, ps, bi, bf)
+
+                self._cpu_step = run
+            except Exception as e:
+                logger.warning("CPU fallback unavailable: %s", e)
+                self._cpu_step = False
+                return None
+        return self._cpu_step
 
     def _offloaded(self) -> bool:
         """Is the supervised egress worker accepting work?  False before
@@ -1696,17 +2141,40 @@ class PipelineDispatcher(LifecycleComponent):
         trace id, THEN the anomaly dump: the snapshot must contain the
         batch that died) no matter which thread ran it."""
         try:
-            self._egress(*item)
-        except Exception as e:
-            self.egress_failures += 1
-            self._m_egress_fail.inc()
-            if self.flightrec is not None:
-                self._flight_record(
-                    item[0], item[1], item[2], commit="failed",
-                    trace=item[3],
-                    error=f"{type(e).__name__}: {e}")
-                self.flightrec.anomaly("egress-crash", detail=str(e))
-            raise
+            try:
+                self._egress(*item)
+            except Exception as e:
+                self.egress_failures += 1
+                self._m_egress_fail.inc()
+                if self.flightrec is not None:
+                    self._flight_record(
+                        item[0], item[1], item[2], commit="failed",
+                        trace=item[3],
+                        error=f"{type(e).__name__}: {e}")
+                    self.flightrec.anomaly("egress-crash", detail=str(e))
+                plan = item[0]
+                if (self._copy_suspect and plan.packed_i is not None
+                        and item[2] == 0):
+                    # the async D2H copy for this window faulted
+                    # (_on_host_copy_error flagged it); the egress fetch
+                    # hit the dead buffer.  Re-dispatch the plan
+                    # single-step — the state re-step is at-least-once,
+                    # identical to journal replay.  Ring siblings that
+                    # shared the dead fetch still fail closed and
+                    # recover via replay: only the FIRST faulted plan
+                    # retries inline.
+                    self._copy_suspect = False
+                    logger.warning(
+                        "egress failed after host-copy fault; "
+                        "re-dispatching seq=%d single-step", plan.seq)
+                    self._dispatch_plan(plan, 1, stall=False)
+                    return
+                raise
+        finally:
+            # watchdog retire happens whether egress succeeded, failed,
+            # or handed off to a re-dispatch (the retry registers its
+            # own entry); the pop is idempotent for bisected subsets
+            self._wd_end(item[0])
 
     @hot_path
     def _egress(self, plan: BatchPlan, out, replay_depth: int,
@@ -1762,6 +2230,14 @@ class PipelineDispatcher(LifecycleComponent):
             for key in ("state_writes", "presence_merges"):
                 if key in telemetry:
                     self._m_occ[key].set(telemetry[key])
+            # Numeric-integrity quarantine: the device counted this
+            # plan's NaN/Inf rows on the SAME packed metrics vector
+            # (zero extra syncs) — the per-device host attribution scan
+            # below runs only on the rare nonzero path.
+            nf = int(telemetry.get("rows_nonfinite", 0))
+            if nf:
+                self._m_quar_rows.inc(nf)
+                self._scan_quarantine(plan, replay_depth)
         # monotonic receive time of the plan's oldest row — the watermark
         # the per-stage ingest→seal / ingest→ack gauges measure from
         ingest_t0 = plan.created_at - plan.max_wait_s
@@ -1873,6 +2349,76 @@ class PipelineDispatcher(LifecycleComponent):
         retired ROADMAP-2 worklist entry: the 4.0 ms dispatch-bookkeeping
         suspect)."""
         return EgressColumns(host_cols, out)
+
+    def _scan_quarantine(self, plan: BatchPlan, replay_depth: int) -> None:
+        """Per-device attribution of the plan's nonfinite rows (called
+        ONLY when the device-counted ``rows_nonfinite`` telemetry scalar
+        is nonzero — never on the clean path).
+
+        The device already masked these rows out of state, rules, and
+        analytics (pipeline/step.py) and counted them per device in
+        ``DeviceState.nonfinite_count``; this host scan re-derives the
+        row set from the RETAINED numpy columns to accumulate a
+        per-device strike count.  A device crossing
+        ``quarantine_after`` cumulative poison rows emits ONE
+        STATE_CHANGE (``STATE_CHANGE_QUARANTINED``) through the normal
+        re-injection egress — downstream consumers see the quarantine
+        exactly like a presence transition."""
+        host = plan.host_cols
+        if not host or "device_id" not in host:
+            return
+        valid = np.asarray(host["valid"]) != 0 if "valid" in host \
+            else np.asarray(plan.packed_i[0]) != 0
+        finite = np.ones(valid.shape, dtype=bool)
+        for field in ("value", "lat", "lon", "elevation"):
+            col = host.get(field)
+            if col is not None:
+                finite &= np.isfinite(np.asarray(col, dtype=np.float32))
+        bad = valid & ~finite
+        if not bad.any():
+            return
+        devs = np.asarray(host["device_id"])[bad].tolist()
+        tens = (np.asarray(host["tenant_id"])[bad].tolist()
+                if "tenant_id" in host else [0] * len(devs))
+        newly = []
+        for dev, ten in zip(devs, tens):
+            if dev < 0:
+                continue
+            seen = self._nonfinite_seen.get(dev, 0) + 1
+            self._nonfinite_seen[dev] = seen
+            if (seen >= self.quarantine_after
+                    and dev not in self._quarantined):
+                self._quarantined.add(dev)
+                newly.append((int(dev), int(ten)))
+        self._m_quar_devices.set(len(self._quarantined))
+        if not newly:
+            return
+        self._m_quar_changes.inc(len(newly))
+        logger.warning("quarantined %d device(s) for nonfinite values: %s",
+                       len(newly), [d for d, _ in newly])
+        if self.flightrec is not None:
+            self.flightrec.anomaly(
+                "device-quarantine",
+                detail=f"devices {[d for d, _ in newly]} crossed "
+                       f"{self.quarantine_after} nonfinite rows")
+        if replay_depth < self.max_replay_depth:
+            import jax.numpy as jnp
+
+            from sitewhere_tpu.state.presence import (
+                STATE_CHANGE_QUARANTINED,
+                state_changes_for,
+            )
+
+            n = len(newly)
+            batch = state_changes_for(
+                np.asarray([d for d, _ in newly], np.int32),
+                np.asarray([t for _, t in newly], np.int32),
+                int(time.time()))
+            batch = batch.replace(
+                alert_code=jnp.full(n, STATE_CHANGE_QUARANTINED,
+                                    jnp.int32))
+            self.inject_batch(batch, np.ones(n, dtype=bool),
+                              replay_depth + 1)
 
     def _handle_unregistered(self, host_cols, out, replay_depth: int) -> None:
         mask = np.asarray(out.unregistered)
@@ -1994,6 +2540,18 @@ class PipelineDispatcher(LifecycleComponent):
             lambda: self.batcher.add_arrays(_copy=False, **cols)),
             replay_depth)
 
+    def requeue_rows(self, cols: Dict[str, np.ndarray]) -> int:
+        """Re-ingest raw event columns through the normal batch path —
+        the ``device-poison`` dead-letter requeue (instance.py): the
+        isolated rows re-enter exactly like fresh ingest once the
+        producer-side corruption is fixed.  Returns the row count."""
+        n = int(np.asarray(cols["device_id"]).size)
+        if n == 0:
+            return 0
+        self._run_plans(self._take(
+            lambda: self.batcher.add_arrays(_copy=False, **cols)))
+        return n
+
     def oldest_unsealed_wait_s(self) -> float:
         """LIVE ingest→seal watermark: age of the oldest event admitted
         but not yet through egress — the overload controller's lag
@@ -2044,6 +2602,11 @@ class PipelineDispatcher(LifecycleComponent):
             "ring_depth": self.ring_depth,
             "ring_chains": int(self._m_ring_chains.value),
             "ring_flushed_plans": int(self._m_ring_flushes.value),
+            "device_fault": {
+                "breaker": self.breaker.snapshot(),
+                "watchdog": self.watchdog.snapshot(),
+                "quarantined_devices": len(self._quarantined),
+            },
             **self.totals,
         }
         if samples:

@@ -67,6 +67,14 @@ __all__ = [
     "net_drops",
     "net_shape",
     "net_injected",
+    "device_inject",
+    "device_clear",
+    "device_active",
+    "device_hits",
+    "device_fired",
+    "device_fire",
+    "device_injected",
+    "device_poison_rows",
 ]
 
 
@@ -309,6 +317,182 @@ def net_injected(endpoint: str, **kw) -> Iterator[None]:
         yield
     finally:
         net_clear(endpoint)
+
+
+# ---------------------------------------------------------------------------
+# device fault plane: dispatch exceptions, NaN poisoning, artificial stalls
+# ---------------------------------------------------------------------------
+
+# The device tier's containment protocol (re-park → re-lease → bisect →
+# quarantine, runtime/dispatcher.py) is only testable if the *device* can
+# misbehave on demand.  Three failure shapes matter, and they compose:
+#
+# - a dispatch exception (XLA RESOURCE_EXHAUSTED, TPU preemption): raise
+#   ``exc`` from the dispatch seam, same after_n/times/probability
+#   contract as :func:`fire`;
+# - data-dependent failure (``when_nonfinite=True``): the point fires
+#   ONLY when the staged float block carries a NaN/Inf in a valid column
+#   — this is what gives the host-side bisect its exact semantics (a
+#   masked half without the poison row dispatches clean);
+# - a wedged chip (``stall_s``): the dispatch seam sleeps for real wall
+#   time before (optionally) raising, which is what the hung-step
+#   watchdog's budgets are calibrated against.
+#
+# Disabled cost is one module-global check, per plan — never per row.
+
+
+class _DeviceFault:
+    __slots__ = ("point", "exc", "after_n", "times", "probability",
+                 "stall_s", "when_nonfinite", "rng", "hits", "fired")
+
+    def __init__(self, point: str, exc: Optional[ExcSpec], after_n: int,
+                 times: Optional[int], probability: float, stall_s: float,
+                 when_nonfinite: bool, seed: Optional[int]):
+        self.point = point
+        self.exc = exc
+        self.after_n = int(after_n)
+        self.times = times if times is None else int(times)
+        self.probability = float(probability)
+        self.stall_s = float(stall_s)
+        self.when_nonfinite = bool(when_nonfinite)
+        self.rng = random.Random(seed if seed is not None else 0)
+        self.hits = 0
+        self.fired = 0
+
+    def _make_exc(self) -> Optional[BaseException]:
+        if self.exc is None:
+            return None
+        if isinstance(self.exc, type):
+            return self.exc(f"injected device fault at {self.point!r}")
+        return self.exc
+
+    def check(self, nonfinite: bool) -> Tuple[float, Optional[BaseException]]:
+        """Count one hit; return ``(stall_s, exc-or-None)``."""
+        self.hits += 1
+        if self.hits <= self.after_n:
+            return 0.0, None
+        if self.when_nonfinite and not nonfinite:
+            return 0.0, None
+        if self.times is not None and self.fired >= self.times:
+            return 0.0, None
+        if self.probability < 1.0 and self.rng.random() >= self.probability:
+            return 0.0, None
+        self.fired += 1
+        return self.stall_s, self._make_exc()
+
+
+_dev_armed = False
+_dev_faults: Dict[str, _DeviceFault] = {}
+
+
+def device_inject(point: str, exc: Optional[ExcSpec] = FaultInjected, *,
+                  after_n: int = 0, times: Optional[int] = 1,
+                  probability: float = 1.0, stall_s: float = 0.0,
+                  when_nonfinite: bool = False,
+                  seed: Optional[int] = None) -> None:
+    """Arm a device-tier point (e.g. ``"device.dispatch"``).
+
+    - ``exc``: exception to raise from the dispatch seam; ``None`` makes
+      the fault stall-only (a slow chip, not a dead one).
+    - ``stall_s``: real wall-time sleep before raising — the watchdog's
+      soft/hard budgets are exercised against this.
+    - ``when_nonfinite=True``: fire only when the plan's staged float
+      block holds a NaN/Inf in a valid column; clean (sub-)batches pass.
+    - ``after_n`` / ``times`` / ``probability`` / ``seed``: same
+      deterministic contract as :func:`inject`.
+    """
+    global _dev_armed
+    with _lock:
+        _dev_faults[point] = _DeviceFault(point, exc, after_n, times,
+                                          probability, stall_s,
+                                          when_nonfinite, seed)
+        _dev_armed = True
+
+
+def device_clear(point: Optional[str] = None) -> None:
+    """Disarm one device point, or all of them when ``point`` is None."""
+    global _dev_armed
+    with _lock:
+        if point is None:
+            _dev_faults.clear()
+        else:
+            _dev_faults.pop(point, None)
+        _dev_armed = bool(_dev_faults)
+
+
+def device_active() -> bool:
+    return _dev_armed
+
+
+def device_hits(point: str) -> int:
+    with _lock:
+        f = _dev_faults.get(point)
+        return f.hits if f is not None else 0
+
+
+def device_fired(point: str) -> int:
+    with _lock:
+        f = _dev_faults.get(point)
+        return f.fired if f is not None else 0
+
+
+def device_fire(point: str, values=None, valid=None) -> None:
+    """Device seam hook: stall and/or raise when ``point`` is armed.
+
+    ``values`` is the plan's staged float block (``[F, B]`` host array)
+    and ``valid`` the per-column validity mask — both optional, consulted
+    only by ``when_nonfinite`` rules so the disabled and clean paths
+    allocate nothing.  The stall happens OUTSIDE the registry lock.
+    """
+    if not _dev_armed:
+        return
+    with _lock:
+        f = _dev_faults.get(point)
+        if f is None:
+            return
+        nonfinite = False
+        if f.when_nonfinite and values is not None:
+            import numpy as _np
+
+            vals = _np.asarray(values, dtype=_np.float32)
+            if valid is not None:
+                mask = _np.asarray(valid, dtype=bool)
+                vals = vals[..., mask] if vals.ndim > 1 else vals[mask]
+            nonfinite = bool(_np.size(vals)) and not bool(
+                _np.isfinite(vals).all())
+        stall, exc = f.check(nonfinite)
+    if stall > 0.0:
+        import time as _time
+
+        _time.sleep(stall)
+    if exc is not None:
+        raise exc
+
+
+@contextlib.contextmanager
+def device_injected(point: str, exc: Optional[ExcSpec] = FaultInjected,
+                    **kw) -> Iterator[None]:
+    """Scoped :func:`device_inject` — disarms the point on exit, always."""
+    device_inject(point, exc, **kw)
+    try:
+        yield
+    finally:
+        device_clear(point)
+
+
+def device_poison_rows(columns, rows, fields=("value",),
+                       value=float("nan")) -> None:
+    """Poison host-side staged columns in place (bench/test helper).
+
+    ``columns`` maps field name → numpy array; each index in ``rows``
+    gets ``value`` written into every named field that exists.
+    """
+    for field in fields:
+        col = columns.get(field)
+        if col is None:
+            continue
+        for r in rows:
+            col[int(r)] = value
 
 
 # ---------------------------------------------------------------------------

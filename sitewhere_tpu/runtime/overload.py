@@ -419,6 +419,10 @@ class OverloadController:
             return
         dwell = max(0.0, now - self._entered_at)
         self._state = new
+        # every transition records its driver — forced moves (ops hooks,
+        # the device breaker's DEGRADED ride-along) must be attributable
+        # and releasable by the same check the observe path uses
+        self.last_driver = driver
         self._entered_at = now
         self._below_since = None
         self.transitions += 1

@@ -284,6 +284,12 @@ class DeviceState:
     # the trailing statistics windowed/rate rules evaluate against
     # (RuleTable.ewma_tau_s holds the K time-scales).
     ewma_values: jax.Array       # float32[D, M, K]
+    # Numeric-integrity quarantine: cumulative NaN/Inf rows this device has
+    # sent.  Poison rows never merge into the columns above (pipeline/step
+    # masks them out of state/rules/analytics), so this counter is the only
+    # state a poison value can touch — the host quarantines a device whose
+    # count trips its threshold.
+    nonfinite_count: jax.Array   # int32[D]
 
     @property
     def capacity(self) -> int:
@@ -317,6 +323,7 @@ class DeviceState:
             last_alert_ts_ns=_i32((capacity,)),
             presence_missing=_bool((capacity,)),
             ewma_values=_f32((capacity, num_mtype_slots, num_ewma_scales)),
+            nonfinite_count=_i32((capacity,)),
         )
 
 

@@ -81,6 +81,12 @@ class DeviceStateManager(LifecycleComponent):
         # the packed step loop never force each other's representation.
         self._packed = None
         self._tenant_id_of_device = tenant_id_of_device
+        # Monotonic count of lease_packed() calls — the device-fault
+        # containment protocol's observable: a failed donated chain is
+        # recovered by simply leasing AGAIN from the still-held epoch, so
+        # "re-leased without restart" is `lease_generation` advancing on
+        # one live manager (tools/devfault_bench.py asserts exactly this).
+        self.lease_generation = 0
 
     # -- epoch plumbing ----------------------------------------------------
 
@@ -117,7 +123,11 @@ class DeviceStateManager(LifecycleComponent):
         If the chain crashes before commit, the manager simply still
         holds the pre-chain epoch — the chain's plans stay outstanding
         and journal replay re-steps them (at-least-once), identical to a
-        single-step dispatch failure.
+        single-step dispatch failure.  The dispatcher's containment path
+        leans on exactly that: recovery NEVER touches the donated
+        ``packed`` again (its buffers may be deleted — swlint DN001
+        guards this statically); it re-leases a fresh pack of the held
+        epoch and re-dispatches the re-parked plans single-step.
         """
         with self._lock:
             packed = self.current_packed
@@ -125,6 +135,7 @@ class DeviceStateManager(LifecycleComponent):
                 _, unpack = _packed_codecs()
                 self._state = unpack(packed)
             self._packed = None
+            self.lease_generation += 1
             # token = the materialized twin's identity: every out-of-band
             # state write (commit/sweep/import) replaces _state, so
             # `self._state is token` at commit time means nothing

@@ -34,6 +34,10 @@ logger = logging.getLogger("sitewhere_tpu.state.presence")
 # (reference: IDeviceStateChangeCreateRequest category/type strings
 # "presence"/"missing").
 STATE_CHANGE_PRESENCE_MISSING = 1
+# Device crossed the numeric-integrity quarantine threshold (cumulative
+# NaN/Inf rows — runtime/dispatcher.py _scan_quarantine); rides the same
+# STATE_CHANGE egress as presence transitions.
+STATE_CHANGE_QUARANTINED = 2
 
 
 @jax.jit

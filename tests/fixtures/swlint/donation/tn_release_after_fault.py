@@ -1,0 +1,19 @@
+"""TN: chain-failure recovery re-leases a FRESH pack before retrying.
+
+The failed chain never committed, so the manager still holds the last
+committed epoch — ``lease_packed()`` rebinds ``ps`` to a fresh buffer
+and the retry is donation-safe.
+"""
+from sitewhere_tpu.pipeline.packed import build_packed_chain
+
+
+def dispatch(manager, tables, slots):
+    chain = build_packed_chain(4)
+    ps, token = manager.lease_packed()
+    try:
+        out = chain(tables, ps, *slots)
+    except RuntimeError:
+        ps, token = manager.lease_packed()
+        out = chain(tables, ps, *slots)
+    manager.commit_packed(out[0], present_now=out[3], lease_token=token)
+    return out

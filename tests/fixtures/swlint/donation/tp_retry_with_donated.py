@@ -1,0 +1,17 @@
+"""DN001: chain-failure recovery must NOT retry with the donated carry.
+
+The dispatcher's containment path (_recover_ring) re-leases a fresh pack
+of the last committed epoch; grabbing the SAME ``ps`` for the retry
+reads a buffer the failed chain may already have donated away.
+"""
+from sitewhere_tpu.pipeline.packed import build_packed_chain
+
+
+def dispatch(tables, ps, slots):
+    chain = build_packed_chain(4)
+    try:
+        out = chain(tables, ps, *slots)
+    except RuntimeError:
+        retry = ps
+        out = chain(tables, retry, *slots)
+    return out

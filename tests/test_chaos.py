@@ -38,8 +38,10 @@ pytestmark = pytest.mark.chaos
 @pytest.fixture(autouse=True)
 def _clean_faults():
     faults.clear()
+    faults.device_clear()
     yield
     faults.clear()
+    faults.device_clear()
 
 
 def _wait(cond, timeout=10.0):
@@ -701,6 +703,55 @@ class TestDispatcherChaos:
             inst.stop()
             inst.terminate()
 
+    def test_nonfatal_step_fault_replays_without_restart(self, tmp_path):
+        """ISSUE 16 satellite: the ``dispatcher.step`` seam with a
+        NON-fatal exception class (an arbitrary runtime error, not a
+        SIGKILL crosspoint and not the registry's own marker type).
+        The gate must fail closed exactly as for a crash, but recovery
+        runs IN PROCESS: ``replay_journal`` on the same live instance
+        re-drives the rows, the same state manager keeps committing
+        (no rebuild), and the offset commits past the record."""
+        from sitewhere_tpu.instance import Instance
+
+        class ChipBurp(RuntimeError):
+            pass
+
+        inst = Instance(_instance_config(tmp_path))
+        inst.start()
+        try:
+            _seed_device(inst)
+            sm = inst.device_state
+            payload = _measurement_line("d-0", 9.5, 1_753_800_000).encode()
+            faults.inject("dispatcher.step", exc=ChipBurp("transient"),
+                          times=1)
+            try:
+                inst.dispatcher.ingest_wire_lines(payload)
+            except ChipBurp:
+                pass  # the ingest thread took the plan itself
+            assert _wait(lambda: faults.fired("dispatcher.step") == 1)
+            assert inst.ingest_journal.end_offset == 1
+            inst.dispatcher.flush(timeout_s=0.05)
+            # fail-closed: journaled but neither stored nor committed
+            assert inst.dispatcher.journal_reader.committed == 0
+            assert inst.event_store.total_events == 0
+
+            # in-process recovery: reap the dead plan's accounting (its
+            # rows are exactly what the replay below re-drives), then
+            # replay on the SAME instance — no restart, no state rebuild
+            with inst.dispatcher._lock:
+                inst.dispatcher._plans_outstanding = 0
+            assert inst.dispatcher.replay_journal() == 1
+            inst.event_store.flush()
+            assert inst.event_store.total_events == 1
+            assert inst.dispatcher.journal_reader.committed == 1
+            # the packed epoch re-leased on the surviving manager: same
+            # object, and the replayed row's state committed through it
+            assert inst.device_state is sm
+            assert 9.5 in sm.get_device_state("d-0")["last_values"]
+        finally:
+            inst.stop()
+            inst.terminate()
+
 
 # ---------------------------------------------------------------------------
 # journal replay of a corrupt pre-hardening record (ADVICE high finding)
@@ -1257,3 +1308,34 @@ class TestFleetChaosBench:
         assert doc["forward_dead_lettered"] == 0
         assert doc["pending_after_recovery"] == 0
         assert doc["sick_accepted_rows"] >= doc["sick_sent_rows"]
+
+
+class TestDevFaultBench:
+    """tools/devfault_bench.py --smoke: the ISSUE-16 acceptance proof
+    (chain re-lease, breaker ladder, poison bisect + bit-identical
+    state, quarantine via requeue, watchdog budgets)."""
+
+    def test_smoke_contract_holds(self):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SW_CRASHPOINT", None)
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(root, "tools", "devfault_bench.py"),
+             "--smoke", "--json"],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+        doc = json.loads(res.stdout)
+        assert doc["ok"]
+        ph = doc["phases"]
+        assert ph["chain_fault"]["chain_faults"] == 1
+        assert ph["chain_fault"]["releases"] == 1
+        assert ph["breaker"]["trips"] == 2
+        assert ph["breaker"]["restores"] == 1
+        assert ph["poison"]["state_bit_identical"]
+        assert ph["poison"]["quarantined_devices"] == 1
+        assert ph["watchdog"]["hard_trips"] >= 1

@@ -183,6 +183,7 @@ def test_packed_step_bit_exact():
         (np.asarray(ref.accepted)
          & np.asarray(batch.update_state)).sum())
     assert tel["presence_merges"] == int(np.asarray(ref.present_now).sum())
+    assert tel["rows_nonfinite"] == int(np.asarray(ref.nonfinite).sum())
 
     # derived alerts reconstruct from host cols + packed outputs
     np.testing.assert_array_equal(
@@ -198,6 +199,54 @@ def test_packed_step_bit_exact():
             dcols["device_id"], np.asarray(ref.derived_alerts.device_id)[rows])
         assert (dcols["event_type"] == int(EventType.ALERT)).all()
         assert not dcols["update_state"].any()
+
+
+def test_packed_nonfinite_guard_bit_exact():
+    """NaN/Inf rows are masked out of state/analytics ON DEVICE, counted
+    per device in ``nonfinite_count``, and surfaced as the
+    ``rows_nonfinite`` telemetry scalar on the SAME packed metrics
+    vector — bit-exact against the unpacked step."""
+    registry, rules, zones = _tables()
+    state = _seeded_state()
+    cols = _batch(seed=7)
+    width = len(cols["device_id"])
+    # poison a handful of KNOWN-valid, registered rows
+    bad = [i for i in range(width)
+           if cols["valid"][i] and 0 <= cols["device_id"][i] < 180][:5]
+    cols["value"][bad[0]] = np.nan
+    cols["value"][bad[1]] = np.inf
+    cols["lat"][bad[2]] = np.nan
+    cols["lon"][bad[3]] = -np.inf
+    cols["elevation"][bad[4]] = np.nan
+    batch = EventBatch(**{k: jnp.asarray(v) for k, v in cols.items()})
+
+    ref_state, ref_out = jax.jit(pipeline_step)(
+        registry, state, rules, zones, batch)
+
+    t = pack_tables(registry, rules, zones)
+    ps = pack_state(state)
+    bi, bf = pack_batch_host(cols, width)
+    ps2, oi, metrics, present = jax.jit(packed_pipeline_step)(
+        t, ps, jnp.asarray(bi), jnp.asarray(bf))
+    view = PackedView(oi, metrics, present)
+
+    nonfinite = np.asarray(ref_out.nonfinite)
+    assert nonfinite.sum() >= len(bad)   # the injected rows all flagged
+    assert view.telemetry["rows_nonfinite"] == int(nonfinite.sum())
+
+    got = unpack_state(ps2)
+    for f in ref_state.__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref_state, f)),
+            np.asarray(getattr(got, f)), err_msg=f)
+    # the poisoned devices took a strike, not a state write
+    nf_count = np.asarray(got.nonfinite_count)
+    for i in (bad[0], bad[1]):
+        dev = int(cols["device_id"][i])
+        assert nf_count[dev] >= 1
+        np.testing.assert_array_equal(
+            np.asarray(got.last_values[dev]),
+            np.asarray(state.last_values[dev]))
 
 
 def test_packed_chain_donation():

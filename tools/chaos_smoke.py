@@ -183,6 +183,49 @@ def main() -> int:
             ingested += 1
             overload_report = inst.overload.snapshot()
 
+        # -- device-fault phase (ISSUE 16): mid-storm device faults are
+        # CONTAINED — faulted dispatches retry/bisect with zero row
+        # loss, and a NaN row is masked + counted on the device's
+        # packed telemetry instead of corrupting state
+        faults.device_inject("device.dispatch", exc=OSError("dead chip"),
+                             times=2, seed=rng.randrange(1 << 30))
+        dev_rows = 0
+        for k in range(6):
+            lines = [
+                _line(f"d-{(k + r) % 8}",
+                      float("nan") if k == 3 and r == 0 else float(k),
+                      1_754_000_000 + k * ROWS_PER_PAYLOAD + r)
+                for r in range(ROWS_PER_PAYLOAD)
+            ]
+            inst.dispatcher.ingest_wire_lines("\n".join(lines).encode())
+            dev_rows += ROWS_PER_PAYLOAD
+        inst.dispatcher.flush()
+        faults.device_clear()
+        inst.event_store.flush()
+        dev_after = inst.event_store.total_events
+        counters = inst.metrics.snapshot()["counters"]
+        dev_faults = (int(counters.get("device.fault.step_faults", 0))
+                      + int(counters.get("device.fault.chain_faults", 0)))
+        if dev_faults < 1:
+            failures.append("device faults armed but the containment "
+                            "path never counted one")
+        if dev_after - stored < dev_rows:
+            failures.append(
+                f"device-fault containment lost rows: {dev_rows} "
+                f"ingested, {dev_after - stored} stored")
+        if int(counters.get("pipeline.quarantine.rows_nonfinite", 0)) < 1:
+            failures.append("a NaN row never reached the device-counted "
+                            "nonfinite telemetry")
+        stored = dev_after
+        ingested += dev_rows
+        device_report = {
+            "rows": dev_rows,
+            "step_faults": dev_faults,
+            "rows_nonfinite": int(counters.get(
+                "pipeline.quarantine.rows_nonfinite", 0)),
+            "breaker": inst.dispatcher.breaker.snapshot(),
+        }
+
         inst.stop()
         inst.terminate()
 
@@ -326,12 +369,14 @@ def main() -> int:
             "fault_hits": fault_hits,
             "resilience": resilience,
             "overload": overload_report,
+            "device_fault": device_report,
             "recovery": recovery_report,
             "fleet": fleet_report,
             "ok": not failures,
         }, indent=2))
     finally:
         faults.clear()
+        faults.device_clear()
         shutil.rmtree(root, ignore_errors=True)
 
     if failures:

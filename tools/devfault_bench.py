@@ -1,0 +1,515 @@
+#!/usr/bin/env python
+"""Device-tier fault containment bench: poison-row quarantine,
+chain-failure re-lease, breaker ladder, hung-step watchdog.
+
+Drives a REAL instance through the dispatcher's device-fault plane
+(``sitewhere_tpu/runtime/faults.py`` device points) and asserts the
+containment contract end to end:
+
+- ``chain-fault``: a transient fault inside a chained (donated-carry)
+  ring dispatch re-parks every ring plan, re-leases the carry from the
+  last committed epoch on the SAME live state manager
+  (``lease_generation`` advances without restart), and re-dispatches
+  single-step with ZERO row loss.
+- ``breaker``: repeated faults across distinct batches demote dispatch
+  chained → single-step → cpu-fallback, ride the overload ladder
+  (DEGRADED while demoted), and a cooldown probe restores chained
+  dispatch + releases the ladder.
+- ``poison``: rows that fault the device bisect down to the exact
+  poison singles, which dead-letter replayably (``device-poison``); all
+  clean rows commit (zero committed-row loss) and the surviving state is
+  BIT-IDENTICAL to a fault-free run of the same clean traffic.
+- ``quarantine``: requeuing the poison letters re-ingests the rows; the
+  device masks the nonfinite values out of state/analytics, counts them
+  on the packed telemetry vector (zero extra host syncs), and the host
+  attribution scan quarantines the offending device with one
+  STATE_CHANGE through the normal egress.
+- ``watchdog``: a stalled dispatch trips the soft then the hard budget
+  (flight-recorder anomalies; the tier goes unhealthy for peers) and
+  self-clears when the dispatch drains.
+
+Usage::
+
+    python tools/devfault_bench.py [--smoke] [--json]
+
+Exit status 0 = every phase held its contract.
+"""
+
+import argparse
+import json
+import math
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Deterministic CPU (sitecustomize hooks may override the env var —
+# force via the config API before any backend initializes).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from sitewhere_tpu.runtime import faults  # noqa: E402
+
+WIDTH = 64
+N_DEVICES = 8
+POISON_DEVICE = f"d-{N_DEVICES - 1}"
+TS0 = 1_754_500_000
+
+
+def _make_instance(data_dir, **overrides):
+    from sitewhere_tpu.instance import Instance
+    from sitewhere_tpu.runtime.config import Config
+
+    pipeline = {"width": WIDTH, "registry_capacity": 256,
+                "mtype_slots": 4, "deadline_ms": 5.0, "n_shards": 1,
+                "ring_depth": 0, "quarantine_after": 3}
+    pipeline.update(overrides)
+    cfg = Config({
+        "instance": {"id": "devfault-bench", "data_dir": data_dir},
+        "pipeline": pipeline,
+        # only the bench releases the forced DEGRADED (via the breaker
+        # restore) — the ladder's own cooldown must not race it
+        "overload": {"cooldown_s": 3600.0},
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+    }, apply_env=False)
+    return Instance(cfg)
+
+
+def _register(inst):
+    dm = inst.device_management
+    dm.create_device_type(token="sensor", name="Sensor")
+    for i in range(N_DEVICES):
+        dm.create_device(token=f"d-{i}", device_type="sensor")
+        dm.create_device_assignment(device=f"d-{i}")
+
+
+class _Traffic:
+    """Deterministic full-width payload builder (one fill plan each)."""
+
+    def __init__(self, ts0=TS0, clean_devices=N_DEVICES):
+        self.ts = ts0
+        self.clean = clean_devices
+
+    def _row(self, token, value, ts):
+        return json.dumps({
+            "deviceToken": token, "type": "Measurement",
+            "request": {"name": "temp", "value": value, "eventDate": ts},
+        })
+
+    def payload(self, rows=WIDTH, poison_rows=0):
+        """``rows`` wire lines; the LAST ``poison_rows`` of them carry a
+        NaN value on the dedicated poison device."""
+        lines = []
+        for r in range(rows):
+            self.ts += 1
+            if r >= rows - poison_rows:
+                lines.append(self._row(POISON_DEVICE, float("nan"),
+                                       self.ts))
+            else:
+                tok = f"d-{r % self.clean}"
+                lines.append(self._row(tok, float(self.ts % 997), self.ts))
+        return "\n".join(lines).encode()
+
+
+def _counters(inst):
+    return inst.metrics.snapshot()["counters"]
+
+
+def _gauges(inst):
+    return inst.metrics.snapshot()["gauges"]
+
+
+def _settle(inst):
+    inst.dispatcher.flush()
+    inst.event_store.flush()
+    return inst.event_store.total_events
+
+
+# ---------------------------------------------------------------------------
+# phases
+# ---------------------------------------------------------------------------
+
+def phase_chain_fault(root, check):
+    """Transient chained-dispatch fault → re-park, re-lease, zero loss."""
+    inst = _make_instance(os.path.join(root, "chain"),
+                          ring_depth=2, deadline_ms=200.0)
+    # exercise the REAL donated-carry protocol (lease_packed + donated
+    # chain); the CPU backend ignores the donation itself but the
+    # lease/re-lease bookkeeping is identical to the TPU path
+    inst.dispatcher._ring_donate = True
+    inst.start()
+    _register(inst)
+    traffic = _Traffic()
+    d = inst.dispatcher
+    sm = inst.device_state
+
+    # warm: one clean chained ring (2 fill plans = ring_depth)
+    d.ingest_wire_lines(traffic.payload())
+    d.ingest_wire_lines(traffic.payload())
+    ingested = 2 * WIDTH
+    stored0 = _settle(inst)
+    check(stored0 >= ingested, "warm ring lost rows "
+          f"({stored0} stored of {ingested})")
+    gen0 = sm.lease_generation
+    check(gen0 > 0, "donated ring never leased the packed carry")
+
+    # the fault: first chained dispatch dies once, then the chip is fine
+    faults.device_inject("device.dispatch", times=1)
+    d.ingest_wire_lines(traffic.payload())
+    d.ingest_wire_lines(traffic.payload())   # ring full -> chain -> fault
+    ingested += 2 * WIDTH
+    stored = _settle(inst)
+    faults.device_clear()
+
+    c = _counters(inst)
+    check(c.get("device.fault.chain_faults", 0) == 1,
+          f"expected 1 chain fault, saw {c.get('device.fault.chain_faults')}")
+    check(c.get("device.fault.releases", 0) == 1,
+          "the faulted chain's donated lease was not re-leased")
+    check(stored >= ingested,
+          f"chain fault lost rows: {ingested} ingested, {stored} stored")
+    check(d.breaker.snapshot()["level"] == 0,
+          "a single transient fault must not trip the breaker")
+
+    # recovery without restart: the SAME live manager leases the carry
+    # again for the next chained ring (lease_generation advances)
+    d.ingest_wire_lines(traffic.payload())
+    d.ingest_wire_lines(traffic.payload())
+    ingested += 2 * WIDTH
+    stored = _settle(inst)
+    check(sm.lease_generation > gen0,
+          "lease_generation did not advance across the fault "
+          "(re-lease on the same live manager)")
+    check(sm is d.state_manager, "state manager identity changed")
+    check(stored >= ingested,
+          f"post-recovery ring lost rows: {ingested} in, {stored} stored")
+
+    report = {
+        "ingested": ingested,
+        "stored": int(stored),
+        "chain_faults": int(c.get("device.fault.chain_faults", 0)),
+        "releases": int(c.get("device.fault.releases", 0)),
+        "lease_generation": int(sm.lease_generation),
+        "breaker": d.breaker.snapshot(),
+    }
+    inst.stop()
+    inst.terminate()
+    return report
+
+
+def phase_breaker(root, check):
+    """Repeated faults demote chained → single-step → cpu-fallback; a
+    cooldown probe restores chained dispatch and releases the ladder."""
+    from sitewhere_tpu.runtime.overload import OverloadState
+
+    inst = _make_instance(os.path.join(root, "breaker"),
+                          ring_depth=2, deadline_ms=200.0)
+    inst.start()
+    _register(inst)
+    traffic = _Traffic()
+    d = inst.dispatcher
+    d.breaker.cooldown_s = 3600.0     # no accidental half-open mid-phase
+    ingested = 0
+
+    def fault_cycle():
+        nonlocal ingested
+        faults.device_inject("device.dispatch", times=1)
+        d.ingest_wire_lines(traffic.payload())
+        d.ingest_wire_lines(traffic.payload())
+        ingested += 2 * WIDTH
+        _settle(inst)
+        faults.device_clear()
+
+    # three distinct-batch faults: chained -> single-step
+    for _ in range(d.breaker.threshold):
+        fault_cycle()
+    snap = d.breaker.snapshot()
+    check(snap["level"] == 1 and snap["trips"] == 1,
+          f"breaker did not demote to single-step: {snap}")
+    check(inst.overload.state == OverloadState.DEGRADED,
+          "breaker trip did not ride the overload ladder to DEGRADED")
+    check(inst.overload.last_driver == "device-breaker",
+          "forced DEGRADED lost its driver attribution")
+
+    # three more: single-step -> cpu-fallback
+    for _ in range(d.breaker.threshold):
+        fault_cycle()
+    snap = d.breaker.snapshot()
+    check(snap["level"] == 2 and snap["trips"] == 2,
+          f"breaker did not demote to cpu-fallback: {snap}")
+
+    # at FALLBACK a clean dispatch routes to the CPU device
+    d.ingest_wire_lines(traffic.payload())
+    ingested += WIDTH
+    _settle(inst)
+    c = _counters(inst)
+    check(c.get("device.fault.cpu_fallback_steps", 0) >= 1,
+          "FALLBACK level never routed a step to the CPU fallback")
+
+    # recovery: cooldown elapses -> half-open probe -> chained success
+    d.breaker.cooldown_s = 0.0
+    d.ingest_wire_lines(traffic.payload())
+    d.ingest_wire_lines(traffic.payload())
+    ingested += 2 * WIDTH
+    stored = _settle(inst)
+    snap = d.breaker.snapshot()
+    check(snap["level"] == 0 and snap["restores"] == 1,
+          f"probe did not restore chained dispatch: {snap}")
+    check(inst.overload.state == OverloadState.NORMAL,
+          "breaker restore did not release the forced DEGRADED")
+    check(stored >= ingested,
+          f"breaker ladder lost rows: {ingested} ingested, {stored} stored")
+
+    c = _counters(inst)
+    report = {
+        "ingested": ingested,
+        "stored": int(stored),
+        "trips": snap["trips"],
+        "restores": snap["restores"],
+        "breaker_trips_metric": int(c.get("device.fault.breaker_trips", 0)),
+        "cpu_fallback_steps": int(c.get("device.fault.cpu_fallback_steps", 0)),
+        "overload": inst.overload.state.name,
+    }
+    inst.stop()
+    inst.terminate()
+    return report
+
+
+def _clean_state(inst):
+    """Exported state rows of every clean device, keyed by token."""
+    out = {}
+    for i in range(N_DEVICES - 1):
+        tok = f"d-{i}"
+        out[tok] = inst.device_state.get_device_state(tok)
+    return out
+
+
+def phase_poison(root, check, smoke):
+    """Poison rows bisect to dead letters; clean rows commit bit-identical
+    to a fault-free control run; requeue replays into the quarantine."""
+    inst = _make_instance(os.path.join(root, "poison"))
+    control = _make_instance(os.path.join(root, "control"))
+    inst.start()
+    control.start()
+    _register(inst)
+    _register(control)
+    d = inst.dispatcher
+    d.breaker.threshold = 99   # this phase proves bisect, not the ladder
+    n_poison = 3
+    n_clean_payloads = 2 if smoke else 4
+    ingested_clean = 0
+
+    # identical clean traffic to both runs (same values, same timestamps)
+    t_fault = _Traffic(clean_devices=N_DEVICES - 1)
+    t_ctl = _Traffic(clean_devices=N_DEVICES - 1)
+    for _ in range(n_clean_payloads):
+        p = t_fault.payload()
+        d.ingest_wire_lines(p)
+        control.dispatcher.ingest_wire_lines(t_ctl.payload())
+        ingested_clean += WIDTH
+
+    # the poison payload: same clean rows to both; the faulted run
+    # additionally carries NaN rows that make the device fault
+    faults.device_inject("device.dispatch", times=None,
+                         when_nonfinite=True)
+    d.ingest_wire_lines(t_fault.payload(poison_rows=n_poison))
+    control.dispatcher.ingest_wire_lines(
+        t_ctl.payload(rows=WIDTH - n_poison))
+    t_ctl.ts += n_poison          # keep the clocks aligned
+    ingested_clean += WIDTH - n_poison
+    stored = _settle(inst)
+    stored_ctl = _settle(control)
+    faults.device_clear()
+
+    c = _counters(inst)
+    check(c.get("device.fault.poison_rows", 0) == n_poison,
+          f"bisect isolated {c.get('device.fault.poison_rows')} rows, "
+          f"expected exactly {n_poison}")
+    check(c.get("device.fault.bisect_rounds", 0) > 0, "bisect never ran")
+    letters = [l for l in inst.list_dead_letters(limit=50)
+               if l.get("kind") == "device-poison"]
+    check(len(letters) >= 1, "no device-poison dead letters")
+    dl_rows = sum(int(l.get("count", 0)) for l in letters)
+    check(dl_rows == n_poison,
+          f"dead letters carry {dl_rows} rows, expected {n_poison}")
+    for letter in letters:
+        vals = letter.get("columns", {}).get("value", [])
+        check(all(not math.isfinite(v) for v in vals),
+              "a dead-lettered poison row has a finite value")
+    check(stored >= ingested_clean,
+          f"poison containment lost clean rows: {ingested_clean} clean "
+          f"ingested, {stored} stored")
+
+    # bit-identical surviving state vs the fault-free control run
+    st, st_ctl = _clean_state(inst), _clean_state(control)
+    mismatched = [tok for tok in st if st[tok] != st_ctl[tok]]
+    check(not mismatched,
+          f"unpoisoned device state diverged from the fault-free run: "
+          f"{mismatched}")
+
+    # goodput recovers: the next clean payload lands in full
+    d.ingest_wire_lines(t_fault.payload())
+    ingested_clean += WIDTH
+    stored_after = _settle(inst)
+    check(stored_after >= stored + WIDTH,
+          "goodput did not recover after containment")
+
+    # --- quarantine via replay: requeue the poison letters ------------
+    g0 = _gauges(inst)
+    check(g0.get("pipeline.quarantine.devices", 0) == 0,
+          "device quarantined before any nonfinite row ever egressed")
+    requeued_rows = 0
+    for letter in letters:
+        res = inst.requeue_dead_letter(int(letter["offset"]))
+        check(res.get("requeued") is True,
+              f"device-poison requeue refused: {res}")
+        requeued_rows += int(res.get("rows", 0))
+    check(requeued_rows == n_poison,
+          f"requeue replayed {requeued_rows} rows, expected {n_poison}")
+    _settle(inst)
+    c = _counters(inst)
+    g = _gauges(inst)
+    check(c.get("pipeline.quarantine.rows_nonfinite", 0) >= n_poison,
+          "device-counted nonfinite telemetry never surfaced")
+    check(g.get("pipeline.quarantine.devices", 0) == 1,
+          f"expected 1 quarantined device, gauge says "
+          f"{g.get('pipeline.quarantine.devices')}")
+    check(c.get("pipeline.quarantine.state_changes", 0) == 1,
+          "quarantine did not emit exactly one STATE_CHANGE")
+    check(d.metrics_snapshot()["device_fault"]["quarantined_devices"] == 1,
+          "dispatcher snapshot disagrees on quarantined devices")
+
+    report = {
+        "clean_rows": ingested_clean,
+        "stored": int(stored_after),
+        "control_stored": int(stored_ctl),
+        "poison_rows": n_poison,
+        "dead_letters": len(letters),
+        "bisect_rounds": int(c.get("device.fault.bisect_rounds", 0)),
+        "requeued_rows": requeued_rows,
+        "quarantined_devices": int(g.get("pipeline.quarantine.devices", 0)),
+        "state_bit_identical": not mismatched,
+    }
+    inst.stop()
+    inst.terminate()
+    control.stop()
+    control.terminate()
+    return report
+
+
+def phase_watchdog(root, check):
+    """A stalled dispatch trips soft then hard budgets, goes unhealthy
+    for peers, and self-clears when the dispatch drains."""
+    inst = _make_instance(os.path.join(root, "watchdog"))
+    inst.start()
+    _register(inst)
+    d = inst.dispatcher
+    d.watchdog.soft_s = 0.05
+    d.watchdog.hard_s = 0.2
+    traffic = _Traffic()
+
+    unhealthy_seen = []
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            if d.device_unhealthy:
+                unhealthy_seen.append(True)
+            time.sleep(0.01)
+
+    t = threading.Thread(target=sampler, daemon=True)
+    t.start()
+    anomalies0 = int(_counters(inst).get("flightrec.anomalies", 0))
+    faults.device_inject("device.dispatch", exc=None, stall_s=0.6)
+    d.ingest_wire_lines(traffic.payload())   # stalls 0.6 s on this thread
+    faults.device_clear()
+    stored = _settle(inst)
+    stop.set()
+    t.join(timeout=2)
+
+    wd = d.watchdog.snapshot()
+    c = _counters(inst)
+    check(wd["softTrips"] >= 1, "soft budget never tripped")
+    check(wd["hardTrips"] >= 1, "hard budget never tripped")
+    check(bool(unhealthy_seen),
+          "device_unhealthy was never observable while wedged")
+    check(not wd["unhealthy"],
+          "unhealthy flag did not self-clear after the dispatch drained")
+    check(c.get("device.fault.watchdog_soft_trips", 0) >= 1
+          and c.get("device.fault.watchdog_hard_trips", 0) >= 1,
+          "watchdog trip counters missing")
+    anomalies = int(c.get("flightrec.anomalies", 0))
+    check(anomalies > anomalies0,
+          "no flight-recorder anomaly for the hung step")
+    check(stored >= WIDTH, "stalled dispatch lost rows")
+
+    report = {
+        "soft_trips": wd["softTrips"],
+        "hard_trips": wd["hardTrips"],
+        "unhealthy_observed": bool(unhealthy_seen),
+        "self_cleared": not wd["unhealthy"],
+        "anomalies": anomalies - anomalies0,
+        "stored": int(stored),
+    }
+    inst.stop()
+    inst.terminate()
+    return report
+
+
+# ---------------------------------------------------------------------------
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="reduced volumes (CI gate)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON on stdout")
+    args = ap.parse_args()
+
+    failures = []
+
+    def check(ok, msg):
+        if not ok and msg:
+            failures.append(msg)
+
+    root = tempfile.mkdtemp(prefix="devfault-bench-")
+    report = {"smoke": bool(args.smoke), "width": WIDTH, "phases": {}}
+    t0 = time.monotonic()
+    try:
+        report["phases"]["chain_fault"] = phase_chain_fault(root, check)
+        report["phases"]["breaker"] = phase_breaker(root, check)
+        report["phases"]["poison"] = phase_poison(root, check, args.smoke)
+        report["phases"]["watchdog"] = phase_watchdog(root, check)
+    finally:
+        faults.device_clear()
+        faults.clear()
+        shutil.rmtree(root, ignore_errors=True)
+    report["wall_s"] = round(time.monotonic() - t0, 2)
+    report["ok"] = not failures
+    if failures:
+        report["failures"] = failures
+
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        for name, ph in report["phases"].items():
+            print(f"{name}: {json.dumps(ph)}")
+        print(f"wall: {report['wall_s']}s")
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("devfault_bench: containment contract held",
+          file=sys.stderr if args.json else sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
