@@ -107,9 +107,19 @@ FAMILIES: Dict[str, Optional[Set[str]]] = {
     # per-peer health gauges: dynamic <process-id> suffixes
     "forward.peer_state": None,      # 0 ALIVE / 1 SUSPECT / 2 DOWN
     "forward.peer_overload": None,   # the peer's advertised OverloadState
+    # tenant metering plane (runtime/metering.py): the CLOSED core is
+    # the ledger's own health gauges; the per-tenant surfaces are OPEN
+    # (top-K tenant tokens label the suffix, the long tail aggregates
+    # under ``...other``, and tenants rotating out of the top-K have
+    # their gauges removed — the governed-cardinality contract)
+    "tenant.meter": {"tracked", "collided_buckets", "window_rows"},
+    "tenant.usage.rows": None,        # tenant.usage.rows.<token> | .other
+    "tenant.usage.sealed_bytes": None,
+    "tenant.share": None,             # window row share ∈ [0, 1]
+    "tenant.shed": None,              # admission sheds (overload ladder)
 }
 # prefixes where EVERY name must resolve to a declared family (MN003)
-GOVERNED_PREFIXES = ("device.", "slo.", "store.", "forward.")
+GOVERNED_PREFIXES = ("device.", "slo.", "store.", "forward.", "tenant.")
 
 
 def family_of(name: str) -> Optional[str]:

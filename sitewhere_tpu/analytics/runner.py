@@ -616,6 +616,9 @@ class QueryRunner(LifecycleComponent):
         self._q: "_queue.Queue" = _queue.Queue(maxsize=queue_depth)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # tenant metering hook (instance-wired UsageLedger): each live
+        # eval batch bills its wall time to tenants by row share
+        self.usage_ledger = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -852,6 +855,10 @@ class QueryRunner(LifecycleComponent):
         # synthesized for synthetic/test batches.
         batch = {k: np.asarray(cols[k])[mask] for k in self._LIVE_COLS
                  if k != "payload_ref"}
+        if self.usage_ledger is not None and "tenant_id" in cols:
+            # optional rider (never mandatory: synthetic/test batches
+            # omit it) — _eval_batch bills eval time by tenant row share
+            batch["tenant_id"] = np.asarray(cols["tenant_id"])[mask]
         if "payload_ref" in cols:
             batch["payload_ref"] = np.asarray(cols["payload_ref"])[mask]
         else:
@@ -954,6 +961,7 @@ class QueryRunner(LifecycleComponent):
         # advance together, so a checkpoint (snapshot_state holds the
         # same mutex) can never pair query A's post-batch state with
         # query B's pre-batch state, or either with the wrong offset.
+        eval_t0 = time.perf_counter()
         with self._eval_mutex:
             for entry in entries:
                 with trace.span("analytics.query") as sp:
@@ -974,6 +982,19 @@ class QueryRunner(LifecycleComponent):
                 for ref in [r for r in self._applied_partial
                             if r < committed]:
                     del self._applied_partial[ref]
+        tenants = batch.get("tenant_id")
+        if self.usage_ledger is not None and tenants is not None \
+                and len(tenants):
+            # bill the batch's eval wall time to tenants by row share
+            # (same attribution rule as decode time on the dispatcher)
+            try:
+                per_row = (time.perf_counter() - eval_t0) / len(tenants)
+                self.usage_ledger.charge_rows_host(
+                    np.asarray(tenants), "eval_s",
+                    weights=np.full(len(tenants), per_row))
+            except Exception:
+                logging.getLogger("sitewhere_tpu.analytics").exception(
+                    "analytics usage charge failed")
         for entry, matches in results:
             self._record(entry, matches, live=True)
         trace.end()

@@ -305,6 +305,32 @@ class Instance(LifecycleComponent):
                         f"overload-{new.name.lower()}",
                         f"{old.name}->{new.name}"))
 
+        # Tenant metering plane (runtime/metering.py): sliding-window
+        # per-tenant usage ledger fed by (a) the packed step's tenant
+        # scatter block — riding the existing D2H fetch, zero extra
+        # syncs — and (b) host-side charges from shed/dead-letter/seal/
+        # outbound/analytics paths.  Feeds measured share back into the
+        # overload ladder's DEGRADED per-tenant rate limits and exports
+        # the governed ``tenant.*`` metric family.
+        self.usage_ledger = None
+        if bool(self.config.get("metering.enabled", True)):
+            from sitewhere_tpu.runtime.metering import UsageLedger
+
+            self.usage_ledger = UsageLedger(
+                top_k=int(self.config.get("metering.top_k", 32)),
+                window_s=float(self.config.get("metering.window_s", 60.0)),
+                fair_share_frac=float(self.config.get(
+                    "metering.fair_share_frac", 0.25)),
+                min_rate_frac=float(self.config.get(
+                    "metering.min_rate_frac", 0.1)),
+            )
+            self.usage_ledger.bind_metrics(
+                self.metrics, resolve=self.identity.tenant.token_of)
+            if self.overload is not None:
+                self.overload.set_usage_ledger(
+                    self.usage_ledger, resolve=self._tenant_dense_id)
+            self.event_store.usage_ledger = self.usage_ledger
+
         # domain services the dispatcher egresses into — registered as
         # children BEFORE it so the reverse-order stop keeps them alive
         # through the dispatcher's shutdown flush
@@ -335,6 +361,7 @@ class Instance(LifecycleComponent):
         self.outbound = self.add_child(
             OutboundConnectorsManager(metrics=self.metrics,
                                       overload=self.overload))
+        self.outbound.usage_ledger = self.usage_ledger
         # Streaming analytics & CEP (analytics/ subsystem): registered
         # Window/Session/Pattern queries compile once and run BOTH on
         # the live enriched batches (dispatcher egress offers them to
@@ -364,6 +391,7 @@ class Instance(LifecycleComponent):
                 fanout_matches=bool(self.config.get(
                     "analytics.fanout_matches", True)),
             ))
+            self.analytics.usage_ledger = self.usage_ledger
         self.registration = self.add_child(RegistrationManager(
             self.device_management,
             default_device_type=self.config.get("registration.default_device_type"),
@@ -454,6 +482,7 @@ class Instance(LifecycleComponent):
             quarantine_after=int(self.config.get(
                 "pipeline.quarantine_after", 3)),
             cost_analysis=self.config.get("telemetry.cost_analysis"),
+            usage_ledger=self.usage_ledger,
         ))
         self.presence = self.add_child(PresenceManager(
             self.device_state,
@@ -609,6 +638,15 @@ class Instance(LifecycleComponent):
 
         self.checkpointer.register_provider(
             catalog_state_provider(self.event_store))
+        if self.usage_ledger is not None:
+            # tenant usage totals + heavy-hitter/count-min sketches; the
+            # sliding window deliberately restarts empty (shares describe
+            # CURRENT load, not pre-restart load)
+            self.checkpointer.register_provider(StateProvider(
+                name="tenant-metering",
+                snapshot_fn=self.usage_ledger.snapshot_payload,
+                restore_fn=self.usage_ledger.restore_payload,
+                version=1))
         self.restored = self.checkpointer.restore()
 
     # -- wiring helpers -----------------------------------------------------

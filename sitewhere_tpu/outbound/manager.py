@@ -49,6 +49,9 @@ class OutboundConnectorsManager(LifecycleComponent):
         # notifiers, command bridges); bulk fan-out (search indexers,
         # file sinks, analytics taps) sheds and is counted per worker
         self.overload = overload
+        # tenant metering hook (instance-wired): rows offered to at
+        # least one connector bill ``outbound_rows`` to their tenant
+        self.usage_ledger = None
         self._workers: Dict[str, "_Worker"] = {}
         for c in connectors or []:
             self.add_connector(c)
@@ -81,6 +84,7 @@ class OutboundConnectorsManager(LifecycleComponent):
         oldest row, for the ingest→outbound-ack watermark gauge."""
         item = (cols, mask, trace or _NOOP_TRACE, ingest_t0,
                 time.monotonic())
+        offered = 0
         for worker in self._workers.values():
             if (self.overload is not None
                     and not self.overload.allow_fanout(
@@ -90,6 +94,21 @@ class OutboundConnectorsManager(LifecycleComponent):
                     worker._m_shed.inc()
                 continue
             worker.offer(item)
+            offered += 1
+        if offered and self.usage_ledger is not None:
+            # bill fan-out per ROW × connectors offered: tenant cost
+            # scales with how much delivery work their rows fan into
+            try:
+                tenants = cols.get("tenant_id") if hasattr(cols, "get") \
+                    else None
+                if tenants is not None:
+                    self.usage_ledger.charge_rows_host(
+                        np.asarray(tenants)[np.asarray(mask)],
+                        "outbound_rows",
+                        weights=np.full(int(np.asarray(mask).sum()),
+                                        float(offered)))
+            except Exception:
+                logger.exception("outbound usage charge failed")
 
     def drain(self, timeout: float = 10.0) -> None:
         """Block until all queued batches are processed (tests/shutdown)."""

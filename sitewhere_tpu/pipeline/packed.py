@@ -10,9 +10,10 @@ packs the step's interface into ELEVEN buffers total:
 
   inputs:  PackedTables (6: epoch-cached) + PackedState (2, donated)
            + batch ints [12, B] + batch floats [4, B]
-  outputs: PackedState' (2) + out ints [10, B] + metrics [15] + present[D]
+  outputs: PackedState' (2) + out ints [10, B] + metrics [n] + present[D]
            (metrics = step scalars + per-type counts + the on-device
-           occupancy telemetry block, ``TELEMETRY_SCALARS``)
+           occupancy telemetry block, ``TELEMETRY_SCALARS`` + the
+           per-tenant attribution block, ``TENANT_METER_*``)
 
 Column-major ``[C, B]`` layout so every unpacked column is a contiguous
 row slice (free under XLA fusion) and the host packs each column with one
@@ -94,6 +95,23 @@ METRIC_SCALARS = ("processed", "accepted", "unregistered", "unassigned",
 #                    nothing else
 TELEMETRY_SCALARS = ("rows_invalid", "state_writes", "presence_merges",
                      "rows_nonfinite")
+
+# Per-tenant attribution block, appended after TELEMETRY_SCALARS in the
+# SAME packed metrics vector (PR-17 metering substrate).  Each batch's
+# rows are bucketed by ``tenant_id % TENANT_METER_SLOTS`` and three
+# masked counts are scatter-added per bucket in ONE segment-sum inside
+# the compiled step — the block rides the shared D2H fetch per ring, so
+# per-tenant device visibility costs ZERO additional host syncs and
+# psums across shards like every other metrics scalar.  The host owns
+# exact bucket→tenant resolution: it holds the batch's tenant column, so
+# a single-tenant bucket attributes exactly and a (rare) collision
+# apportions by row share (``runtime/metering.py``).
+#   rows           accepted rows (admitted into the pipeline)
+#   state_writes   accepted rows that merged into DeviceState
+#   rows_nonfinite accepted-width rows masked for NaN/Inf floats
+TENANT_METER_COUNTERS = ("rows", "state_writes", "rows_nonfinite")
+TENANT_METER_SLOTS = 16
+TENANT_METER_BLOCK = len(TENANT_METER_COUNTERS) * TENANT_METER_SLOTS
 
 PRESENCE_ROW = STATE_I.index("presence_missing")
 
@@ -199,14 +217,17 @@ def unpack_batch(bi: jax.Array, bf: jax.Array) -> EventBatch:
 def pack_outputs(out: PipelineOutputs,
                  batch: Optional[EventBatch] = None
                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """PipelineOutputs → (oi [10, B] int32, metrics [16] int32, present[D]).
+    """PipelineOutputs → (oi [10, B] int32, metrics [n] int32, present[D]).
 
     The metrics vector is the step scalars + per-type counts + the
-    :data:`TELEMETRY_SCALARS` occupancy block (computed on device from
-    outputs the step already materialized — a handful of fused
-    reductions, free under XLA).  ``batch`` feeds the state-write count
-    (``accepted & update_state`` is the mask ``update_device_state``
-    applies); without it state_writes degrades to the accepted count.
+    :data:`TELEMETRY_SCALARS` occupancy block + the per-tenant
+    :data:`TENANT_METER_COUNTERS` scatter block (all computed on device
+    from outputs the step already materialized — a handful of fused
+    reductions plus one segment-sum, free under XLA).  ``batch`` feeds
+    the state-write count (``accepted & update_state`` is the mask
+    ``update_device_state`` applies) and the tenant bucketing; without
+    it state_writes degrades to the accepted count and the tenant block
+    is zeros (legacy single-output callers).
     """
     derived = out.derived_alerts
     flags = (out.accepted * F_ACCEPTED
@@ -229,9 +250,24 @@ def pack_outputs(out: PipelineOutputs,
         out.present_now.sum(dtype=jnp.int32),            # presence_merges
         out.nonfinite.sum(dtype=jnp.int32),              # rows_nonfinite
     ])
+    if batch is not None:
+        # Per-tenant block: bucket rows by tenant hash and scatter-add
+        # the three masked counts in ONE segment-sum ([B, 3] data over
+        # [B] segment ids → [T, 3]).  jnp's mod keeps negative ids
+        # (NULL_ID padding) in range; padded rows carry all-False masks
+        # so they contribute zeros wherever they land.
+        bucket = batch.tenant_id.astype(jnp.int32) % TENANT_METER_SLOTS
+        counts = jnp.stack([
+            out.accepted, writes, out.nonfinite,
+        ], axis=-1).astype(jnp.int32)                    # [B, 3]
+        per_tenant = jax.ops.segment_sum(
+            counts, bucket, num_segments=TENANT_METER_SLOTS)
+        tenant_block = per_tenant.T.reshape(-1)          # counter-major
+    else:
+        tenant_block = jnp.zeros((TENANT_METER_BLOCK,), jnp.int32)
     metrics = jnp.concatenate([
         jnp.stack([getattr(m, f) for f in METRIC_SCALARS]), m.by_type,
-        telemetry])
+        telemetry, tenant_block])
     return oi, metrics, out.present_now
 
 
@@ -275,7 +311,8 @@ def build_packed_chain(k: int, donate: bool = True) -> Callable:
     from sitewhere_tpu.pipeline.step import NUM_EVENT_TYPES
 
     n_out = len(OUT_I)
-    n_met = len(METRIC_SCALARS) + NUM_EVENT_TYPES + len(TELEMETRY_SCALARS)
+    n_met = (len(METRIC_SCALARS) + NUM_EVENT_TYPES + len(TELEMETRY_SCALARS)
+             + TENANT_METER_BLOCK)
 
     def chain(tables, ps, *slots):
         ring_i = jnp.stack(slots[:k])   # [K, 12, B]
@@ -569,6 +606,22 @@ class PackedView:
         return {f: int(v[base + i])
                 for i, f in enumerate(TELEMETRY_SCALARS)}
 
+    @property
+    def tenant_meter(self) -> Optional[np.ndarray]:
+        """The per-tenant attribution block as ``[len(
+        TENANT_METER_COUNTERS), TENANT_METER_SLOTS]`` int — sliced from
+        the SAME fetched metrics vector (never an extra sync).  None for
+        pre-metering vectors (stubs/legacy captures), mirroring how
+        :attr:`telemetry` degrades to ``{}``."""
+        if self._metrics_host is None:
+            self._fetch()
+        v = self._metrics_host
+        base = len(METRIC_SCALARS) + NUM_EVENT_TYPES + len(TELEMETRY_SCALARS)
+        if len(v) < base + TENANT_METER_BLOCK:
+            return None
+        return np.asarray(v[base:base + TENANT_METER_BLOCK]).reshape(
+            len(TENANT_METER_COUNTERS), TENANT_METER_SLOTS)
+
     def derived_cols(self, host_cols: Dict[str, np.ndarray],
                      rows: np.ndarray) -> Dict[str, np.ndarray]:
         """Reconstruct the derived-alert event columns for ``rows`` from
@@ -647,4 +700,5 @@ __all__ = [
     "F_ACCEPTED", "F_UNREGISTERED", "F_UNASSIGNED", "F_DERIVED",
     "BATCH_I", "BATCH_F", "OUT_I", "PRESENCE_ROW",
     "METRIC_SCALARS", "TELEMETRY_SCALARS",
+    "TENANT_METER_COUNTERS", "TENANT_METER_SLOTS", "TENANT_METER_BLOCK",
 ]

@@ -379,11 +379,17 @@ class PeerHealthTable:
                 del self._peers[p]
                 gauges = self._gauges.pop(p, None)
                 if gauges is not None:
-                    # the registry has no removal API: zero a departed
-                    # peer's gauges so dashboards never keep alerting on
-                    # a frozen DOWN from a host that no longer exists
+                    # zero first (holders of the popped Gauge see a
+                    # quiet value, dashboards stop alerting on a frozen
+                    # DOWN), then unregister so a long-lived fleet that
+                    # churns membership doesn't accrete one gauge pair
+                    # per peer that ever existed
                     gauges[0].set(0)
                     gauges[1].set(0)
+                    remove = getattr(self._metrics, "remove", None)
+                    if remove is not None:
+                        remove(f"forward.peer_state.{p}",
+                               f"forward.peer_overload.{p}")
 
     def transitions(self, peer: int) -> int:
         with self._lock:

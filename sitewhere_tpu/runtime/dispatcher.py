@@ -233,6 +233,7 @@ class PipelineDispatcher(LifecycleComponent):
         watchdog=None,
         quarantine_after: int = 3,
         cost_analysis: Optional[bool] = None,
+        usage_ledger=None,
         name: str = "pipeline-dispatcher",
     ):
         super().__init__(name)
@@ -580,6 +581,16 @@ class PipelineDispatcher(LifecycleComponent):
         if cost_analysis is None:
             cost_analysis = jax.default_backend() != "cpu"
         self.cost_analysis = bool(cost_analysis)
+        # Tenant metering plane (runtime/metering.py UsageLedger): egress
+        # folds each plan's device-side per-tenant scatter block into it
+        # (_meter_plan) — the block rides the same fetched metrics
+        # vector as TELEMETRY_SCALARS, so attribution costs zero extra
+        # host syncs.  None = metering off (bare test dispatchers).
+        self.usage_ledger = usage_ledger
+        # decode-stage attribution mark: egress is serialized per plan,
+        # so the delta of the decode timer's running total between
+        # meter calls is the decode time this plan's rows paid for
+        self._meter_decode_mark = 0.0
         # host-aggregated counters (metrics endpoint surface)
         self.steps = 0
         self.totals: Dict[str, int] = {
@@ -667,6 +678,13 @@ class PipelineDispatcher(LifecycleComponent):
             "tenant": tenant,
             "payload": payload.hex(),
         })
+        if self.usage_ledger is not None:
+            try:
+                self.usage_ledger.charge(
+                    self.resolve_tenant(tenant), "dead_letter_rows",
+                    sum(shed.values()))
+            except Exception:
+                logger.exception("dead-letter usage charge failed")
 
     def _admit_requests(self, reqs: List[DecodedRequest], payload: bytes,
                         source_id: str) -> List[DecodedRequest]:
@@ -2037,6 +2055,10 @@ class PipelineDispatcher(LifecycleComponent):
             "count": len(rows),
             "columns": columns,
         }, metrics=self.metrics)
+        if self.usage_ledger is not None and "tenant_id" in columns:
+            self.usage_ledger.charge_rows_host(
+                np.asarray(columns["tenant_id"], np.int64),
+                "dead_letter_rows")
 
     def _cpu_packed_step(self):
         """Lazily build (and cache) the packed step jitted for a CPU
@@ -2238,6 +2260,10 @@ class PipelineDispatcher(LifecycleComponent):
             if nf:
                 self._m_quar_rows.inc(nf)
                 self._scan_quarantine(plan, replay_depth)
+        # Tenant metering: fold the device-side per-tenant scatter block
+        # (same fetched vector — zero extra syncs) into the usage ledger
+        if self.usage_ledger is not None:
+            self._meter_plan(out, host_cols)
         # monotonic receive time of the plan's oldest row — the watermark
         # the per-stage ingest→seal / ingest→ack gauges measure from
         ingest_t0 = plan.created_at - plan.max_wait_s
@@ -2350,6 +2376,30 @@ class PipelineDispatcher(LifecycleComponent):
         suspect)."""
         return EgressColumns(host_cols, out)
 
+    def _meter_plan(self, out, host_cols: Dict[str, np.ndarray]) -> None:
+        """Bill one egressed plan to its tenants (tenant metering plane).
+
+        The device already bucketed accepted rows / state writes /
+        nonfinite rows by ``tenant_id % TENANT_METER_SLOTS`` inside the
+        compiled step; the ledger resolves buckets against the plan's
+        retained host tenant column (exact attribution, collision-
+        apportioned) — no per-row host work on the common path.  The
+        decode stage's running-total delta rides along so decode time
+        is row-share-attributed to the same tenants."""
+        block = getattr(out, "tenant_meter", None)
+        tenants = host_cols.get("tenant_id") if host_cols else None
+        if block is None or tenants is None:
+            return
+        decode_total = self._m_stage["decode"].total
+        decode_s = max(0.0, decode_total - self._meter_decode_mark)
+        self._meter_decode_mark = decode_total
+        try:
+            self.usage_ledger.charge_device_block(
+                block, tenants, decode_s=decode_s)
+            self.usage_ledger.publish(min_interval_s=1.0)
+        except Exception:
+            logger.exception("tenant metering failed for one plan")
+
     def _scan_quarantine(self, plan: BatchPlan, replay_depth: int) -> None:
         """Per-device attribution of the plan's nonfinite rows (called
         ONLY when the device-counted ``rows_nonfinite`` telemetry scalar
@@ -2397,6 +2447,13 @@ class PipelineDispatcher(LifecycleComponent):
         logger.warning("quarantined %d device(s) for nonfinite values: %s",
                        len(newly), [d for d, _ in newly])
         if self.flightrec is not None:
+            # ring record BEFORE the anomaly dump so the snapshot's own
+            # evidence includes which devices tripped and on which plan
+            # (tools/flightrec_timeline.py renders kind-style records)
+            self.flightrec.record(
+                kind="quarantine", seq=int(plan.seq),
+                rows=len(devs), devices=[d for d, _ in newly],
+                strikes=self.quarantine_after)
             self.flightrec.anomaly(
                 "device-quarantine",
                 detail=f"devices {[d for d, _ in newly]} crossed "

@@ -244,6 +244,24 @@ class MetricsRegistry:
             return sorted({*self._counters, *self._gauges, *self._timers,
                            *self._histograms})
 
+    def remove(self, *names: str) -> int:
+        """Unregister metrics by (sanitized) name across every instrument
+        table; returns how many instruments were dropped.  Exists for
+        bounded-lifetime DYNAMIC families — per-peer gauges pruned on a
+        membership rebind, per-tenant gauges rotated out of the top-K —
+        so departed label values stop haunting the scrape surface.
+        Code-authored long-lived metrics are never removed; holders of a
+        popped instrument keep a harmless orphan that no longer renders."""
+        dropped = 0
+        with self._lock:
+            for name in names:
+                key = sanitize_metric_name(name)
+                for table in (self._counters, self._gauges, self._timers,
+                              self._histograms):
+                    if table.pop(key, None) is not None:
+                        dropped += 1
+        return dropped
+
     def snapshot(self) -> dict:
         """Serializable view for the REST/admin surface."""
         with self._lock:

@@ -320,10 +320,37 @@ class OverloadController:
         # client could otherwise mint unbounded Counter objects (and a
         # registry lock + name-sanitize on the hottest path of an
         # already-overloaded system); overflow tenants aggregate under
-        # ``overload.shed.tenant.other``
+        # ``tenant.shed.other`` (the governed tenant.* family — PR 4's
+        # overload.shed.tenant.* counters folded into it)
         self._tenant_counters: Dict[str, object] = {}
-        self._m_shed_other = self._metrics.counter(
-            "overload.shed.tenant.other")
+        self._m_shed_other = self._metrics.counter("tenant.shed.other")
+        # Tenant metering plane (runtime/metering.py, set_usage_ledger):
+        # sheds charge the ledger per tenant, and DEGRADED telemetry
+        # buckets derive their rate from the tenant's MEASURED share of
+        # the windowed row stream instead of the uniform budget.
+        self.usage_ledger = None
+        self._ledger_resolve: Optional[Callable[[str], int]] = None
+
+    def set_usage_ledger(self, ledger,
+                         resolve: Optional[Callable[[str], int]] = None
+                         ) -> None:
+        """Attach the tenant metering plane: ``ledger`` is a
+        :class:`~sitewhere_tpu.runtime.metering.UsageLedger`, ``resolve``
+        maps the intake's tenant TOKEN to the dense id the ledger bills
+        (the instance passes its identity mint).  From then on DEGRADED
+        telemetry budgets scale by the tenant's measured share
+        (:meth:`UsageLedger.rate_scale`) and every shed charges
+        ``shed_rows`` to its tenant."""
+        self.usage_ledger = ledger
+        self._ledger_resolve = resolve
+
+    def _tenant_id(self, tenant: str) -> Optional[int]:
+        if self._ledger_resolve is None:
+            return None
+        try:
+            return int(self._ledger_resolve(tenant))
+        except Exception:
+            return None
 
     # -- state machine -------------------------------------------------------
 
@@ -473,6 +500,22 @@ class OverloadController:
             if cls == PriorityClass.TELEMETRY:
                 rate = self.degraded_telemetry_rate_per_s
                 burst = self.degraded_telemetry_burst
+                # Measured-share scaling (tenant metering plane): a
+                # tenant above its fair share of the windowed row
+                # stream gets a proportionally tighter DEGRADED budget;
+                # a quiet tenant keeps the full uniform one.  Sampled
+                # at bucket build — buckets clear on the NORMAL
+                # transition, so each overload episode re-derives its
+                # rates from the share measured as it begins.
+                if self.usage_ledger is not None:
+                    tid = self._tenant_id(tenant)
+                    if tid is not None:
+                        try:
+                            scale = self.usage_ledger.rate_scale(tid)
+                        except Exception:
+                            scale = 1.0
+                        rate *= scale
+                        burst *= scale
             else:
                 rate = self.shedding_command_rate_per_s
                 burst = self.shedding_command_burst
@@ -520,12 +563,18 @@ class OverloadController:
         counter = self._tenant_counters.get(tenant)
         if counter is None:
             if len(self._tenant_counters) < 64:
-                counter = self._metrics.counter(
-                    f"overload.shed.tenant.{tenant}")
+                counter = self._metrics.counter(f"tenant.shed.{tenant}")
                 self._tenant_counters[tenant] = counter
             else:
                 counter = self._m_shed_other
         counter.inc(n)
+        if self.usage_ledger is not None:
+            tid = self._tenant_id(tenant)
+            if tid is not None:
+                try:
+                    self.usage_ledger.charge(tid, "shed_rows", n)
+                except Exception:
+                    logger.exception("usage ledger shed charge failed")
         self._m_shed_rows.observe(n, trace_id=self._transition_trace_id)
         return False
 

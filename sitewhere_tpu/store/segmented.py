@@ -54,6 +54,7 @@ from sitewhere_tpu.store.segment import (
     COLUMNS,
     FLOAT_COLUMNS,
     INT_COLUMNS,
+    _INT_INDEX,
     Segment,
     event_id,
     open_segment,
@@ -135,6 +136,9 @@ class SegmentStore(EventStore):
     ):
         self.metrics = metrics if metrics is not None else global_registry()
         self.n_shards = max(1, int(n_shards))
+        # tenant metering hook: the instance points this at its
+        # UsageLedger so sealed bytes bill per tenant (_commit_sealed)
+        self.usage_ledger = None
         super().__init__(
             root, flush_rows=flush_rows, flush_interval_s=flush_interval_s,
             retention_s=retention_s, resident_bytes=resident_bytes,
@@ -388,6 +392,20 @@ class SegmentStore(EventStore):
         self.metrics.counter("store.bytes_written").inc(
             int(job.ints.nbytes + job.flts.nbytes))
         self.metrics.histogram("store.seal_s").observe(seal_s)
+        # Tenant metering: every sealed row bills its storage-bytes
+        # share to its tenant (the tenant column is right there in the
+        # job's packed ints; one bincount on the seal WORKER — never
+        # the hot path).  Attribute wired by the instance; None = off.
+        ledger = getattr(self, "usage_ledger", None)
+        if ledger is not None and job.n:
+            bytes_per_row = (job.ints.nbytes + job.flts.nbytes) / job.n
+            try:
+                ledger.charge_rows_host(
+                    job.ints[_INT_INDEX["tenant_id"], :job.n],
+                    "sealed_bytes",
+                    weights=np.full(job.n, bytes_per_row))
+            except Exception:
+                logger.exception("sealed-bytes usage charge failed")
         self._update_gauges()
 
     # -- flush / drain -------------------------------------------------------

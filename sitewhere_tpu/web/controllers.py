@@ -130,6 +130,43 @@ def register_routes(gw: RestGateway, inst) -> None:
     r("GET", "/api/tenants",
       lambda q: page_response(inst.tenants.list_tenants(q.criteria())))
     r("POST", "/api/tenants", lambda q: inst.tenants.create_tenant(**q.json()))
+
+    # ---- tenant usage metering (runtime/metering.py ledger) ---------------
+    # registered BEFORE /api/tenants/{token}: the router is first-match,
+    # so "usage" must not be swallowed by the {token} capture
+    def _ledger():
+        ledger = getattr(inst, "usage_ledger", None)
+        require(ledger is not None,
+                EntityNotFound("tenant metering is disabled"))
+        return ledger
+
+    def tenants_usage(q):
+        """Ranked top-K tenant usage (rows, shed, time, bytes) with the
+        long-tail aggregate, totals, window shares and sketch config."""
+        ledger = _ledger()
+        try:
+            k = int(q.q1("top", "0")) or None
+        except ValueError:
+            k = None
+        return ledger.snapshot(resolve=inst.identity.tenant.token_of, k=k)
+    r("GET", "/api/tenants/usage", tenants_usage)
+
+    def tenant_usage_one(q):
+        """Drill-down for one tenant: exact row when top-K-tracked, else
+        the count-min lifetime estimate (flagged ``estimated``)."""
+        from sitewhere_tpu.ids import NULL_ID
+
+        ledger = _ledger()
+        token = q.params["token"]
+        tid = inst.identity.tenant.lookup(token)
+        require(tid != NULL_ID, EntityNotFound(f"no tenant {token!r}"))
+        body = ledger.usage_of(tid)
+        body.update(tenant=token, tenant_id=int(tid),
+                    window_share=round(ledger.shares().get(int(tid), 0.0), 6),
+                    rate_scale=round(ledger.rate_scale(tid), 6))
+        return body
+    r("GET", "/api/tenants/usage/{token}", tenant_usage_one)
+
     r("GET", "/api/tenants/{token}",
       lambda q: inst.tenants.get_tenant(q.params["token"]))
     r("PUT", "/api/tenants/{token}",
@@ -683,6 +720,11 @@ def register_routes(gw: RestGateway, inst) -> None:
             render_openmetrics,
         )
 
+        ledger = getattr(inst, "usage_ledger", None)
+        if ledger is not None:
+            # refresh the governed tenant.* gauges so a scrape always
+            # sees the current top-K even between dispatcher publishes
+            ledger.publish()
         text = render_openmetrics(inst.metrics, global_registry())
         return RawResponse(
             text.encode("utf-8"),

@@ -213,6 +213,13 @@ class TestTimelineRenderer:
                    dispatch_ms=0.5, egress_ms=0.0, e2e_ms=4.0,
                    overload="DEGRADED", commit="failed",
                    error="ValueError: boom")
+        # kind-style EVENT records interleave with the batch rows: the
+        # watchdog's hung-step dump and the nonfinite scan's quarantine
+        # strike (the device-fault containment plane's cold paths)
+        rec.record(kind="hung-step", seq=3, rows=64, reason="fill",
+                   slot=0)
+        rec.record(kind="quarantine", seq=3, rows=2, devices=[7, 9],
+                   strikes=3)
         path = rec.snapshot("egress-crash")
 
         tool = os.path.join(os.path.dirname(__file__), os.pardir,
@@ -226,7 +233,10 @@ class TestTimelineRenderer:
         assert "egress-crash" in out
         assert "!!failed" in out
         assert "ValueError: boom" in out
-        assert "2 records shown, 1 failed commits" in out
+        assert "** hung-step" in out
+        assert "** quarantine" in out
+        assert "devices=[7, 9]" in out
+        assert "2 batches shown, 1 failed commits, 2 events" in out
 
 
 # ---------------------------------------------------------------------------

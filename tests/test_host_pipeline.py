@@ -723,6 +723,11 @@ class TestHostpathBenchSmoke:
         # record cost stays under 1% of the throughput-bounding stage
         assert r["flightrec_record_s"] > 0.0
         assert r["flightrec_overhead_frac"] < 0.01
+        # ISSUE 17 acceptance: per-tenant usage attribution rides the
+        # same bar — the per-plan ledger charge (bucket→tenant resolve +
+        # sketch/window fold) stays under 1% of the bounding stage
+        assert r["metering_charge_s"] > 0.0
+        assert r["metering_overhead_frac"] < 0.01
         # ISSUE 10 acceptance: the decode A/B + bytes-copied columns are
         # recorded, and with the native toolchain the fill-direct path
         # copies ZERO bytes per event (3x-fewer bar trivially cleared)

@@ -1,0 +1,284 @@
+"""Tenant metering plane: sketches, attribution, ledger, ladder hook.
+
+The accuracy contract is tested against exact counting on a skewed
+O(10k)-tenant stream: space-saving reports every count within its own
+error bound (≤ N/k) and never loses a tenant above the threshold, and
+count-min point reads never underestimate.  The ledger tests cover the
+deferred device-block fold (segment-sum blocks are additive, so reads
+must flush pending accumulation), decode-time apportionment, eviction
+folding into the long-tail aggregate, the checkpoint round-trip (window
+deliberately restarts empty), and the overload-ladder integration: a
+heavy tenant's DEGRADED budget tightens from its MEASURED share while a
+quiet tenant keeps the uniform one — all on injected clocks, no sleeps.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.pipeline.packed import TENANT_METER_SLOTS
+from sitewhere_tpu.runtime.metering import (
+    CountMin,
+    SpaceSaving,
+    UsageLedger,
+    attribute_block,
+)
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.overload import (
+    OverloadController,
+    OverloadState,
+    PriorityClass,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _skewed_stream(n_tenants=10_000, base=3_000, seed=7):
+    """Zipf-ish per-tenant true counts and a shuffled offer order."""
+    true = np.maximum(1, base // np.arange(1, n_tenants + 1))
+    stream = np.repeat(np.arange(n_tenants), true)
+    rng = np.random.default_rng(seed)
+    rng.shuffle(stream)
+    return true, stream
+
+
+class TestSketches:
+    def test_space_saving_error_bound_on_skewed_fleet(self):
+        k = 128
+        true, stream = _skewed_stream()
+        ss = SpaceSaving(k)
+        for t in stream.tolist():
+            ss.offer(t)
+        n = len(stream)
+        ranked = ss.topk()
+        assert len(ranked) == k
+        for key, count, error in ranked:
+            # reported ∈ [true, true + error], error ≤ N/k
+            assert count >= true[key]
+            assert count - error <= true[key]
+            assert error <= n / k
+        # guaranteed capture: every tenant above N/k is tracked
+        tracked = {key for key, _, _ in ranked}
+        for t in np.nonzero(true > n / k)[0].tolist():
+            assert t in tracked, f"tenant {t} (true={true[t]}) lost"
+        # and the rank order surfaces the actual heaviest tenant first
+        assert ranked[0][0] == 0
+
+    def test_count_min_never_underestimates(self):
+        true, stream = _skewed_stream(n_tenants=5_000, base=2_000)
+        cm = CountMin(width=1024, depth=4)
+        cm.add_many(stream, np.ones(len(stream), np.int64))
+        assert cm.total == len(stream)
+        bound = 2 * len(stream) / cm.width
+        over = []
+        for t in range(0, 5_000, 97):
+            est = cm.estimate(t)
+            assert est >= true[t]
+            over.append(est - true[t])
+        # expected overestimate ≤ 2N/width with prob ≥ 1-(1/2)^depth;
+        # deterministic stream + fixed salts, so assert the mean holds
+        assert np.mean(over) <= bound
+
+    def test_add_many_matches_scalar_add(self):
+        a, b = CountMin(width=64, depth=4), CountMin(width=64, depth=4)
+        keys = [3, 99, 123_456, 3, 2**40 + 5]
+        amounts = [5, 2, 9, 4, 1]
+        for k, amt in zip(keys, amounts):
+            a.add(k, amt)
+        b.add_many(keys, amounts)
+        np.testing.assert_array_equal(a.table, b.table)
+        assert a.total == b.total
+
+
+class TestAttributeBlock:
+    def _block(self, ids):
+        block = np.zeros((3, TENANT_METER_SLOTS), np.int64)
+        block[0] = np.bincount(ids % TENANT_METER_SLOTS,
+                               minlength=TENANT_METER_SLOTS)
+        block[1] = block[0]
+        return block
+
+    def test_single_owner_buckets_attribute_exactly(self):
+        ids = np.array([1] * 30 + [2] * 10 + [5] * 3, np.int32)
+        out, collided = attribute_block(self._block(ids), ids)
+        assert collided == 0
+        assert {t: v["rows"] for t, v in out.items()} == {1: 30, 2: 10, 5: 3}
+        assert out[1]["state_writes"] == 30
+
+    def test_collision_apportions_by_row_share(self):
+        # 1 and 1 + slots land in the same bucket
+        ids = np.array([1] * 30 + [1 + TENANT_METER_SLOTS] * 10, np.int32)
+        out, collided = attribute_block(self._block(ids), ids)
+        assert collided == 1
+        assert out[1]["rows"] == pytest.approx(30)
+        assert out[1 + TENANT_METER_SLOTS]["rows"] == pytest.approx(10)
+        # mass conserved across the split
+        assert sum(v["rows"] for v in out.values()) == pytest.approx(40)
+
+    def test_padding_rows_ignored(self):
+        ids = np.array([-1] * 8 + [5] * 4, np.int32)
+        block = np.zeros((3, TENANT_METER_SLOTS), np.int64)
+        block[0, 5] = 4
+        out, collided = attribute_block(block, ids)
+        assert {t: v["rows"] for t, v in out.items()} == {5: 4}
+        assert collided == 0
+
+    def test_empty_block_and_empty_ids(self):
+        zeros = np.zeros((3, TENANT_METER_SLOTS), np.int64)
+        assert attribute_block(zeros, np.array([1, 2])) == ({}, 0)
+        block = np.zeros((3, TENANT_METER_SLOTS), np.int64)
+        block[0, 3] = 7
+        assert attribute_block(block, np.array([], np.int32)) == ({}, 0)
+
+
+class TestUsageLedger:
+    def _charge(self, led, ids, decode_s=0.0):
+        block = np.zeros((3, TENANT_METER_SLOTS), np.int64)
+        block[0] = np.bincount(ids % TENANT_METER_SLOTS,
+                               minlength=TENANT_METER_SLOTS)
+        led.charge_device_block(block, ids, decode_s=decode_s)
+
+    def test_deferred_fold_flushes_on_read(self):
+        led = UsageLedger(fold_every=8, clock=FakeClock())
+        ids = np.array([1] * 30 + [2] * 10, np.int32)
+        for _ in range(3):          # below the fold cadence
+            self._charge(led, ids, decode_s=0.01)
+        u = led.usage_of(1)         # read surface flushes pending
+        assert u["tracked"]
+        assert u["usage"]["rows"] == 90
+        # decode time apportioned by accepted-row share: 30/40 of 0.03
+        assert u["usage"]["decode_s"] == pytest.approx(0.0225)
+        assert led.usage_of(2)["usage"]["rows"] == 30
+        assert led.snapshot()["totals"]["rows"] == 120
+
+    def test_fold_cadence_triggers_without_reads(self):
+        clock = FakeClock()
+        led = UsageLedger(fold_every=4, clock=clock)
+        ids = np.array([3] * 10, np.int32)
+        for _ in range(4):
+            self._charge(led, ids)
+        # folded by cadence alone — inspect without the flushing readers
+        with led._lock:
+            assert led._totals["rows"] == 40
+
+    def test_eviction_folds_exact_row_into_other(self):
+        led = UsageLedger(top_k=2, fold_every=1, clock=FakeClock())
+        self._charge(led, np.full(100, 1, np.int32))
+        self._charge(led, np.full(50, 2, np.int32))
+        self._charge(led, np.full(60, 3, np.int32))   # evicts tenant 2
+        snap = led.snapshot()
+        assert {t["tenant_id"] for t in snap["tenants"]} == {1, 3}
+        assert snap["other"]["rows"] == 50
+        assert snap["totals"]["rows"] == 210
+        u = led.usage_of(2)
+        assert not u["tracked"] and u["estimated"]
+        assert u["rows_estimate"] >= 50    # count-min floor
+        # space-saving overestimate carries the evicted floor as error
+        top = {key: (count, err) for key, count, err in led.topk()}
+        assert top[3] == (110, 50)
+
+    def test_window_shares_and_rate_scale(self):
+        clock = FakeClock()
+        led = UsageLedger(window_s=60.0, fold_every=1, clock=clock,
+                          fair_share_frac=0.25, min_rate_frac=0.1)
+        self._charge(led, np.full(75, 1, np.int32))
+        self._charge(led, np.full(25, 2, np.int32))
+        shares = led.shares()
+        assert shares[1] == pytest.approx(0.75)
+        assert shares[2] == pytest.approx(0.25)
+        assert led.rate_scale(1) == pytest.approx(0.25 / 0.75)
+        assert led.rate_scale(2) == 1.0          # at fair share: untouched
+        # window expiry: shares describe CURRENT load only
+        clock.t += 120.0
+        assert led.shares() == {}
+        assert led.rate_scale(1) == 1.0
+        # lifetime usage is NOT windowed
+        assert led.usage_of(1)["usage"]["rows"] == 75
+
+    def test_checkpoint_round_trip(self):
+        clock = FakeClock()
+        led = UsageLedger(top_k=4, fold_every=1, clock=clock)
+        self._charge(led, np.array([1] * 30 + [2] * 10, np.int32),
+                     decode_s=0.02)
+        led.charge(1, "shed_rows", 5)
+        led.charge_rows_host(np.full(6, 2, np.int32), "outbound_rows")
+        payload, header = led.snapshot_payload()
+        json.loads(payload.decode())    # checkpoint body is valid JSON
+
+        led2 = UsageLedger(top_k=4, clock=FakeClock())
+        led2.restore_payload(header or {}, payload)
+        assert led2.usage_of(1) == led.usage_of(1)
+        assert led2.usage_of(2) == led.usage_of(2)
+        assert led2.snapshot()["totals"] == led.snapshot()["totals"]
+        assert led2._cm.estimate(1) == led._cm.estimate(1)
+        # the sliding window restarts empty: pre-crash load is not
+        # evidence about the post-restart stream
+        assert led2.shares() == {}
+        # and the restored ledger keeps charging correctly
+        self._charge(led2, np.full(10, 1, np.int32))
+        assert led2.usage_of(1)["usage"]["rows"] == 40
+
+    def test_restore_drops_stale_geometry_sketch(self):
+        led = UsageLedger(sketch_width=64, sketch_depth=2, fold_every=1,
+                          clock=FakeClock())
+        self._charge(led, np.full(10, 1, np.int32))
+        payload, header = led.snapshot_payload()
+        led2 = UsageLedger(sketch_width=128, sketch_depth=2,
+                           clock=FakeClock())
+        led2.restore_payload(header or {}, payload)
+        # exact rows restore; the mis-shaped sketch starts fresh rather
+        # than mis-hash restored cells
+        assert led2.usage_of(1)["usage"]["rows"] == 10
+        assert led2._cm.total == 0
+
+
+class TestLadderIntegration:
+    def test_heavy_tenant_degraded_rate_tightens(self):
+        clock = FakeClock()
+        led = UsageLedger(fold_every=1, clock=clock,
+                          fair_share_frac=0.25, min_rate_frac=0.1)
+        # measured window: heavy=75% of rows, quiet=25%
+        block = np.zeros((3, TENANT_METER_SLOTS), np.int64)
+        ids = np.array([1] * 75 + [2] * 25, np.int32)
+        block[0] = np.bincount(ids % TENANT_METER_SLOTS,
+                               minlength=TENANT_METER_SLOTS)
+        led.charge_device_block(block, ids)
+
+        dense = {"heavy": 1, "quiet": 2}
+        c = OverloadController(clock=clock, metrics=MetricsRegistry(),
+                               degraded_telemetry_rate_per_s=12.0,
+                               degraded_telemetry_burst=6.0)
+        c.set_usage_ledger(led, resolve=dense.__getitem__)
+        c.force(OverloadState.DEGRADED)
+
+        # quiet tenant keeps the full uniform burst of 6
+        assert c.admit(PriorityClass.TELEMETRY, tenant="quiet", n=6)
+        assert not c.admit(PriorityClass.TELEMETRY, tenant="quiet", n=1)
+        # heavy tenant's budget scales by fair/share = 1/3: burst 2
+        assert c.admit(PriorityClass.TELEMETRY, tenant="heavy", n=2)
+        assert not c.admit(PriorityClass.TELEMETRY, tenant="heavy", n=1)
+        # refill follows the scaled rate (12/3 = 4/s) but is capped at
+        # the scaled burst of 2 — a half second already tops it up
+        clock.t += 0.5
+        assert c.admit(PriorityClass.TELEMETRY, tenant="heavy", n=2)
+        assert not c.admit(PriorityClass.TELEMETRY, tenant="heavy", n=1)
+
+    def test_shed_charges_ledger_and_unknown_tenant_is_safe(self):
+        clock = FakeClock()
+        led = UsageLedger(fold_every=1, clock=clock)
+        dense = {"acme": 9}
+        c = OverloadController(clock=clock, metrics=MetricsRegistry())
+        c.set_usage_ledger(led, resolve=dense.__getitem__)
+        c.force(OverloadState.SHEDDING)
+        assert not c.admit(PriorityClass.TELEMETRY, tenant="acme", n=7)
+        assert led.snapshot()["totals"]["shed_rows"] == 7
+        # an unmapped tenant sheds without charging (resolve raises)
+        assert not c.admit(PriorityClass.TELEMETRY, tenant="ghost", n=3)
+        assert led.snapshot()["totals"]["shed_rows"] == 7

@@ -247,7 +247,7 @@ class TestAdmission:
         c.force(OverloadState.SHEDDING)
         c.admit(PriorityClass.TELEMETRY, tenant="acme", n=7)
         assert reg.counter("overload.shed.telemetry").value == 7
-        assert reg.counter("overload.shed.tenant.acme").value == 7
+        assert reg.counter("tenant.shed.acme").value == 7
         assert c.shed_total == 7
 
     def test_buckets_reset_on_return_to_normal(self):

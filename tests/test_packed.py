@@ -16,6 +16,8 @@ from sitewhere_tpu.pipeline import pipeline_step
 from sitewhere_tpu.pipeline.packed import (
     BATCH_F,
     BATCH_I,
+    TENANT_METER_COUNTERS,
+    TENANT_METER_SLOTS,
     PackedView,
     pack_batch_host,
     pack_state,
@@ -184,6 +186,22 @@ def test_packed_step_bit_exact():
          & np.asarray(batch.update_state)).sum())
     assert tel["presence_merges"] == int(np.asarray(ref.present_now).sum())
     assert tel["rows_nonfinite"] == int(np.asarray(ref.nonfinite).sum())
+
+    # the per-tenant meter block matches a numpy segment-sum of the
+    # reference outputs bucketed by tenant_id % TENANT_METER_SLOTS
+    tm = view.tenant_meter
+    assert tm is not None
+    assert tm.shape == (len(TENANT_METER_COUNTERS), TENANT_METER_SLOTS)
+    buckets = cols["tenant_id"].astype(np.int64) % TENANT_METER_SLOTS
+    accepted = np.asarray(ref.accepted).astype(np.int64)
+    writes = accepted & cols["update_state"]
+    nonfinite = np.asarray(ref.nonfinite).astype(np.int64)
+    for ci, per_row in enumerate((accepted, writes, nonfinite)):
+        expect = np.bincount(buckets, weights=per_row,
+                             minlength=TENANT_METER_SLOTS)
+        np.testing.assert_array_equal(
+            tm[ci], expect.astype(tm.dtype),
+            err_msg=TENANT_METER_COUNTERS[ci])
 
     # derived alerts reconstruct from host cols + packed outputs
     np.testing.assert_array_equal(

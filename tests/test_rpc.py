@@ -1156,6 +1156,33 @@ class TestPeerHealthTable:
         table.observe_heartbeat(1, incarnation=9)
         assert table.snapshot()["1"]["incarnation"] == 9
 
+    def test_departed_peer_gauges_are_pruned(self):
+        """Regression: ``forward.peer_state.<p>`` / ``.peer_overload.<p>``
+        for a peer removed by set_peers (the apply_membership rebind
+        path) used to linger forever — a fleet that churns membership
+        accreted one gauge pair per peer that EVER existed, and the
+        departed peer's frozen DOWN kept dashboards alerting."""
+        registry = MetricsRegistry()
+        clock = _Clock()
+        table = PeerHealthTable([1, 2], clock=clock,
+                                heartbeat_interval_s=1.0, metrics=registry)
+        assert "forward.peer_state.2" in registry.names()
+        table.set_peers([1, 3])
+        names = registry.names()
+        # peer 2 left: both its gauges unregister; peer 3 joined
+        assert "forward.peer_state.2" not in names
+        assert "forward.peer_overload.2" not in names
+        assert "forward.peer_state.1" in names
+        assert "forward.peer_state.3" in names
+        # a full scrape after the churn carries no ghost peers
+        from sitewhere_tpu.runtime.metrics import (
+            parse_exposition,
+            render_openmetrics,
+        )
+
+        families = parse_exposition(render_openmetrics(registry))
+        assert "forward_peer_state_2" not in families
+
     def test_forward_metric_names_pass_the_lint(self):
         """Satellite: the forward.* family is a registered, linted
         metric surface — not a dict-only side channel."""

@@ -19,6 +19,15 @@ Usage::
 Failed commits render with a ``!!`` marker and their error; the bar
 legend is ``w`` batcher wait, ``d`` step dispatch, ``e`` egress, ``·``
 unattributed (device dwell + queueing between stages).
+
+Besides per-batch rows the ring also holds EVENT records carrying a
+``kind`` field — the device-fault containment plane appends them on its
+cold paths (``hung-step`` plans caught in flight by the watchdog,
+``quarantine`` strikes from the nonfinite scan).  These render as
+``**``-marked event lines in sequence with the batches instead of being
+dropped as unknown records, so a ``device-hung-step`` /
+``device-quarantine`` / ``device-fault`` snapshot shows WHAT tripped
+amid the batches around it.
 """
 
 from __future__ import annotations
@@ -44,6 +53,23 @@ def _bar(rec: dict) -> str:
     return bar + "·" * (BAR_WIDTH - len(bar))
 
 
+def _event_line(rec: dict) -> str:
+    """One ``**`` event line for a kind-style ring record (hung-step,
+    quarantine, …): seq/slot/rows columns stay aligned with the batch
+    rows; everything else folds into a key=value tail so unknown kinds
+    still render complete instead of being dropped."""
+    kind = rec["kind"]
+    slot = rec.get("slot")
+    extras = {k: v for k, v in rec.items()
+              if k not in ("kind", "seq", "slot", "rows", "ts")}
+    tail = " ".join(f"{k}={v}" for k, v in sorted(extras.items()))
+    return (f"{rec.get('seq', -1):>6} "
+            f"{'-' if slot is None else slot:>4} "
+            f"{rec.get('rows', 0):>6} "
+            f"{'':>9} {'':>9}  ** {kind}"
+            + (f": {tail}" if tail else ""))
+
+
 def render(snapshot: dict, limit: int = 100, out=sys.stdout) -> None:
     header = snapshot["header"]
     records = snapshot["records"][-limit:]
@@ -54,7 +80,12 @@ def render(snapshot: dict, limit: int = 100, out=sys.stdout) -> None:
     print(f"{'seq':>6} {'slot':>4} {'rows':>6} {'ovl':<9} "
           f"{'e2e_ms':>9}  {'timeline (w=wait d=dispatch e=egress)':<{BAR_WIDTH}}"
           f"  commit", file=out)
+    events = 0
     for rec in records:
+        if rec.get("kind"):
+            events += 1
+            print(_event_line(rec), file=out)
+            continue
         slot = rec.get("slot")
         mark = "!!" if rec.get("commit") != "ok" else "  "
         line = (f"{rec.get('seq', -1):>6} "
@@ -66,8 +97,11 @@ def render(snapshot: dict, limit: int = 100, out=sys.stdout) -> None:
         if rec.get("error"):
             line += f"  [{rec['error']}]"
         print(line, file=out)
-    failed = sum(1 for r in records if r.get("commit") != "ok")
-    print(f"{len(records)} records shown, {failed} failed commits",
+    batches = len(records) - events
+    failed = sum(1 for r in records
+                 if not r.get("kind") and r.get("commit") != "ok")
+    tail = f", {events} events" if events else ""
+    print(f"{batches} batches shown, {failed} failed commits{tail}",
           file=out)
 
 

@@ -14,7 +14,12 @@ Proof obligations (the PR-2 acceptance criteria, end to end over HTTP):
   REST surface lists and serves, and that parses back with committed
   batch records in it (ISSUE 9 acceptance);
 - a forced-error RPC call leaves a retained trace on BOTH sides of the
-  boundary (tail sampling at a 0% head rate) with the same trace_id.
+  boundary (tail sampling at a 0% head rate) with the same trace_id;
+- a skewed two-tenant load attributes exactly through the metering
+  plane: ``GET /api/tenants/usage`` ranks the heavy tenant first with
+  exact row counts, the drill-down serves its ledger row, and the
+  governed ``tenant.*`` family round-trips the OpenMetrics exposition
+  (ISSUE 17 acceptance).
 
 Usage::
 
@@ -84,6 +89,55 @@ def main() -> int:
         inst.dispatcher.ingest_wire_lines("\n".join(lines).encode())
         inst.dispatcher.flush()
         inst.event_store.flush()
+
+        # -- tenant metering: skewed two-tenant load (ISSUE 17).  Devices
+        #    are tenant-owned, so per-tenant attribution needs tenants +
+        #    devices created through their engines; per-row tenancy rides
+        #    the decoded-request metadata.
+        from sitewhere_tpu.ingest.decoders import DecodedRequest, RequestKind
+
+        tenant_rows = {"acme": 384, "beta": 128}    # 3:1 skew
+        for tok, n in tenant_rows.items():
+            inst.tenants.create_tenant(token=tok, name=tok.title(),
+                                       auth_token=f"{tok}-auth-token-123")
+            tdm = inst.engines.get_engine(tok).device_management
+            tdm.create_device_type(token=f"{tok}-sensor", name="Sensor")
+            tdm.create_device(token=f"{tok}-dev",
+                              device_type=f"{tok}-sensor")
+            tdm.create_device_assignment(device=f"{tok}-dev")
+            reqs = [DecodedRequest(
+                kind=RequestKind.MEASUREMENT, device_token=f"{tok}-dev",
+                ts_s=1_753_800_000 + r, mtype="temp", value=float(r),
+                metadata={"tenant": tok}) for r in range(n)]
+            inst.dispatcher.ingest_many(reqs, payload=b"obs-smoke")
+        inst.dispatcher.flush()
+        inst.event_store.flush()
+
+        # top-K over REST: heavy tenant ranks first, counts are exact
+        admin_jwt = inst.tokens.mint("admin", ["ROLE_ADMIN"])
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{web.port}/api/tenants/usage?top=8",
+            headers={"Authorization": f"Bearer {admin_jwt}"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            usage = json.loads(resp.read())
+        ranked = [t["tenant"] for t in usage.get("tenants", [])]
+        rows_by_tenant = {t["tenant"]: t["usage"]["rows"]
+                          for t in usage.get("tenants", [])}
+        if ranked[:1] != ["acme"]:
+            failures.append(f"heavy tenant not ranked first: {ranked}")
+        for tok, n in tenant_rows.items():
+            if rows_by_tenant.get(tok) != n:
+                failures.append(
+                    f"tenant {tok}: expected {n} rows, "
+                    f"got {rows_by_tenant.get(tok)}")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{web.port}/api/tenants/usage/acme",
+            headers={"Authorization": f"Bearer {admin_jwt}"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            drill = json.loads(resp.read())
+        if not drill.get("tracked") or \
+                drill.get("usage", {}).get("rows") != 384:
+            failures.append(f"tenant drill-down wrong: {drill}")
 
         # -- a forced-error RPC call: the acceptance proof.  The server
         #    runs on the INSTANCE tracer; the handler raises inside the
@@ -156,6 +210,17 @@ def main() -> int:
             if family not in families:
                 failures.append(f"{family} missing from the exposition")
 
+        # -- governed tenant.* family round-trips the exposition ----------
+        for family in ("tenant_meter_tracked", "tenant_usage_rows_acme",
+                       "tenant_usage_rows_beta", "tenant_usage_rows_other"):
+            if family not in families:
+                failures.append(f"{family} missing from the exposition")
+        acme_rows = families.get("tenant_usage_rows_acme", {}).get(
+            "samples", {}).get("tenant_usage_rows_acme", 0.0)
+        if acme_rows != 384.0:
+            failures.append(
+                f"tenant_usage_rows_acme scraped {acme_rows}, want 384")
+
         # -- flight recorder: trigger an anomaly dump, read it back -------
         from sitewhere_tpu.runtime.flightrec import parse_snapshot
 
@@ -203,6 +268,7 @@ def main() -> int:
             "families": len(families),
             "histograms_populated": populated,
             "ingest_to_seal_latency_s": seal_v,
+            "tenant_usage": rows_by_tenant,
             "tracer": stats,
             "flightrec": inst.flightrec.stats(),
             "ok": not failures,
