@@ -54,6 +54,9 @@ PASS_ID = "trace-purity"
 # canonical external names that ARE jit wrappers (arg 0 is traced)
 _JIT_WRAPPERS = {"jax.jit", "jit", "jax.shard_map", "shard_map",
                  "jax.experimental.shard_map.shard_map",
+                 # the project's version-compat shim IS shard_map: bodies
+                 # wrapped through it are traced like any other jit root
+                 "sitewhere_tpu.parallel.shmap.shard_map",
                  "jax.vmap", "jax.grad", "jax.value_and_grad",
                  "jax.checkpoint", "jax.pmap"}
 # control-flow primitives: {canonical: indices of function-valued args}

@@ -209,8 +209,9 @@ def detect_anomalies_window_sharded(
 @functools.lru_cache(maxsize=16)
 def _window_sharded_flagger(mesh, baseline_windows, z_threshold,
                             min_baseline_count, std_floor, n_shards):
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from sitewhere_tpu.parallel.shmap import shard_map
 
     from sitewhere_tpu.parallel.mesh import SHARD_AXIS
 
@@ -339,7 +340,8 @@ def build_window_grid_sharded(
 @functools.lru_cache(maxsize=16)
 def _sharded_grid_builder(mesh, rows_local: int, n_windows: int):
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    from sitewhere_tpu.parallel.shmap import shard_map
 
     from sitewhere_tpu.parallel.mesh import SHARD_AXIS
 

@@ -108,6 +108,10 @@ class _Chunk:
     arrival: float
     start: int = 0
     reserved: Optional["Reservation"] = None
+    # Row offset of this chunk inside its reservation's buffers (sharded
+    # commits enqueue per-shard VIEWS of one buffer; adoption needs each
+    # view to sit exactly at its shard's segment).
+    res_off: int = 0
 
     @property
     def capacity(self) -> int:
@@ -221,6 +225,14 @@ class Reservation:
         # defensive copy needed.
         d = self.device_id[:n]
         bad = (d < 0) | (d >= b.capacity)
+        if b.n_shards > 1:
+            # Sharded commit: the scanner wrote RESOLVED ids, so shard
+            # routing is knowable here.  Segment-ordered payloads (each
+            # shard's rows a contiguous run, runs in shard order) enqueue
+            # zero-copy views of this buffer; anything else takes the
+            # add_arrays gather lane (copies counted, unknown ids
+            # round-robined there).
+            return self._commit_sharded(b, n, bad)
         if bad.any():
             d[bad] = NULL_ID
         cols: Dict[str, np.ndarray] = {
@@ -240,6 +252,66 @@ class Reservation:
         b._pending[0].append(
             _Chunk(cols=cols, length=n, arrival=now, reserved=self))
         b._counts[0] += n
+        if b._oldest is None:
+            b._oldest = now
+        plans: List[BatchPlan] = []
+        while max(b._counts) >= b.seg:
+            plans.append(b._emit())
+        return plans
+
+    def _commit_sharded(self, b: "Batcher", n: int,
+                        bad: np.ndarray) -> List[BatchPlan]:
+        """Sharded enqueue of the scanned rows.  The zero-copy lane
+        requires every id in range and the shard sequence monotonically
+        non-decreasing — then shard ``s``'s rows are one contiguous run
+        and the chunk is a VIEW (``res_off`` records its buffer
+        position, so a full-width segment-aligned reservation can be
+        adopted outright by ``_emit``)."""
+        d = self.device_id[:n]
+        segmented = not bad.any()
+        if segmented:
+            shard = d // b.rows_per_shard
+            if n > 1:
+                segmented = bool((shard[:-1] <= shard[1:]).all())
+        if not segmented:
+            # Gather fallback: same routing/copy contract as columnar
+            # intake (bad ids rewritten + round-robined there).  The
+            # buffers are ours and never touched again — views are safe
+            # to hand over.
+            return b.add_arrays(
+                _copy=False,
+                device_id=d,
+                mtype_id=self.mtype_id[:n],
+                ts_s=self.ts_s[:n],
+                ts_ns=self.ts_ns[:n],
+                update_state=self.update_state[:n],
+                value=self.value[:n],
+                tenant_id=np.broadcast_to(np.int32(self.tenant_id), n),
+                payload_ref=np.broadcast_to(np.int32(self.payload_ref), n),
+            )
+        now = b.clock()
+        bounds = np.searchsorted(shard, np.arange(b.n_shards + 1))
+        for s in range(b.n_shards):
+            lo, hi = int(bounds[s]), int(bounds[s + 1])
+            if lo == hi:
+                continue
+            cols: Dict[str, np.ndarray] = {
+                f: self._irow(f)[lo:hi]
+                for f in ("device_id", "mtype_id", "ts_s", "ts_ns",
+                          "update_state")
+            }
+            cols["value"] = self.value[lo:hi]
+            cols["tenant_id"] = np.broadcast_to(
+                np.int32(self.tenant_id), hi - lo)
+            cols["payload_ref"] = np.broadcast_to(
+                np.int32(self.payload_ref), hi - lo)
+            for f in _COL_FIELDS:
+                if f not in cols:
+                    cols[f] = np.broadcast_to(_FILL_0D[f], hi - lo)
+            b._pending[s].append(_Chunk(
+                cols=cols, length=hi - lo, arrival=now, reserved=self,
+                res_off=lo))
+            b._counts[s] += hi - lo
         if b._oldest is None:
             b._oldest = now
         plans: List[BatchPlan] = []
@@ -737,12 +809,15 @@ class Batcher:
 
     def reserve(self, cap: int) -> Optional["Reservation"]:
         """Hand out a :class:`Reservation` of up to ``cap`` rows for the
-        fill-direct wire scanner, or None when ineligible (sharded
-        batchers route rows by device id AFTER resolution, which a
-        direct scan cannot know, and a payload wider than one batch
-        cannot land in one segment).  The buffers are private until
-        ``commit`` — reserve is safe from any thread."""
-        if self.n_shards != 1 or not 0 < cap <= self.width:
+        fill-direct wire scanner, or None when ineligible (a payload
+        wider than one batch cannot land in one emission).  Sharded
+        batchers reserve too: the scanner writes RESOLVED device ids, so
+        ``commit`` routes by shard after the scan — a segment-ordered
+        full-width payload is adopted zero-copy exactly like the
+        single-shard case, and anything else falls back to the gather
+        lane.  The buffers are private until ``commit`` — reserve is
+        safe from any thread."""
+        if not 0 < cap <= self.width:
             return None
         return Reservation(self, cap)
 
@@ -830,9 +905,13 @@ class Batcher:
         adaptive-controller feedback.  Returns ``(now, wait)``."""
         now = self.clock()
         wait = now - self._oldest if self._oldest is not None else 0.0
-        # Carried-over rows keep their chunk arrival time for the deadline.
-        remaining = [q[0].arrival for q in self._pending if q]
-        self._oldest = min(remaining) if remaining else None
+        # Carried-over rows keep their chunk arrival time for the deadline
+        # (plain min-scan: no per-emit list on the hot path).
+        oldest = None
+        for q in self._pending:
+            if q and (oldest is None or q[0].arrival < oldest):
+                oldest = q[0].arrival
+        self._oldest = oldest
         self.emitted_batches += 1
         self.emitted_events += n
         if self.metrics is not None:
@@ -844,16 +923,41 @@ class Batcher:
             self.controller.on_emit(n, self.width, self.pending, reason)
         return now, wait
 
+    def _adoptable_sharded(self) -> bool:
+        """True when every shard's sole pending chunk is the matching
+        segment of ONE full-width reservation — ``_commit_sharded`` left
+        segment-aligned views, so the reserved buffers already ARE the
+        batch and ``_emit_adopted`` can ship them without a copy."""
+        res = None
+        for s in range(self.n_shards):
+            q = self._pending[s]
+            if len(q) != 1:
+                return False
+            ch = q[0]
+            if ch.reserved is None or ch.start != 0 \
+                    or ch.length != self.seg \
+                    or ch.res_off != s * self.seg:
+                return False
+            if res is None:
+                res = ch.reserved
+            elif ch.reserved is not res:
+                return False
+        return res is not None and res.cap == self.width
+
     @hot_path
     def _emit_adopted(self, reason: str) -> BatchPlan:
-        """Zero-copy emission: the sole pending chunk is a full-width
+        """Zero-copy emission: the pending chunk(s) are a full-width
         reserved segment — its packed buffers BECOME the batch.  Only
         validity, the per-payload constants and any padding are written;
-        no row data moves."""
-        ch = self._pending[0].popleft()
-        res = ch.reserved
-        n = ch.length
-        self._counts[0] -= n
+        no row data moves.  (Sharded: one view-chunk per shard, all of
+        the same reservation, popped together.)"""
+        res = None
+        n = 0
+        for s in range(self.n_shards):
+            ch = self._pending[s].popleft()
+            res = ch.reserved
+            n += ch.length
+            self._counts[s] -= ch.length
         host_cols = res.finalize_adopted(n)
         now, wait = self._emit_tail(n, reason)
         return BatchPlan(
@@ -863,41 +967,54 @@ class Batcher:
             seq=self.emitted_batches - 1, reason=reason,
         )
 
-    @hot_path
-    def _emit(self, reason: str = "fill") -> BatchPlan:
-        if self.emit_packed and self.n_shards == 1:
-            q = self._pending[0]
-            if len(q) == 1 and q[0].reserved is not None \
-                    and q[0].start == 0 \
-                    and q[0].reserved.cap == self.width:
-                return self._emit_adopted(reason)
-        ibuf = fbuf = None
-        if self.emit_packed:
-            # Build the host columns directly as rows of the packed wire
-            # buffers — the fill loop below writes into them via the
-            # ``out`` views, so emission costs no extra pass.  Bool
-            # columns keep their own arrays (host_cols consumers expect
-            # bool dtype) and land in their int rows at the end.
-            from sitewhere_tpu.pipeline.packed import BATCH_F, BATCH_I
+    def _assemble_buffers(self):
+        """Fallback batch-assembly buffers — the copying lane's
+        allocations, off the adopted path.  Full-width fill emissions
+        (single-shard AND segment-ordered sharded reservations) adopt
+        the reservation's packed buffers and never come here; this
+        allocates only for the mixed/deadline/flush leftovers whose rows
+        genuinely have to be gathered out of multiple chunks.
 
-            ibuf = np.empty((len(BATCH_I), self.width), np.int32)
-            fbuf = np.empty((len(BATCH_F), self.width), np.float32)
-            out = {}
-            for i, f in enumerate(BATCH_I):
-                if f in ("valid", "update_state"):
-                    out[f] = np.full(self.width, _FILL[f], np.bool_)
-                else:
-                    ibuf[i].fill(_FILL[f])
-                    out[f] = ibuf[i]
-            for i, f in enumerate(BATCH_F):
-                fbuf[i].fill(_FILL[f])
-                out[f] = fbuf[i]
-            out["valid"][:] = False
-        else:
-            out = {
+        Packed mode builds the host columns directly as rows of the
+        packed wire buffers — ``_emit``'s fill loop writes through the
+        ``out`` views, so emission costs no extra pass.  Bool columns
+        keep their own arrays (host_cols consumers expect bool dtype)
+        and land in their int rows at the end."""
+        if not self.emit_packed:
+            return None, None, {
                 name: np.full(self.width, fill, dtype=dt)
                 for name, dt, fill in _FIELDS
             }
+        from sitewhere_tpu.pipeline.packed import BATCH_F, BATCH_I
+
+        ibuf = np.empty((len(BATCH_I), self.width), np.int32)
+        fbuf = np.empty((len(BATCH_F), self.width), np.float32)
+        out = {}
+        for i, f in enumerate(BATCH_I):
+            if f in ("valid", "update_state"):
+                out[f] = np.full(self.width, _FILL[f], np.bool_)
+            else:
+                ibuf[i].fill(_FILL[f])
+                out[f] = ibuf[i]
+        for i, f in enumerate(BATCH_F):
+            fbuf[i].fill(_FILL[f])
+            out[f] = fbuf[i]
+        out["valid"][:] = False
+        return ibuf, fbuf, out
+
+    @hot_path
+    def _emit(self, reason: str = "fill") -> BatchPlan:
+        if self.emit_packed:
+            q = self._pending[0]
+            if self.n_shards == 1:
+                if len(q) == 1 and q[0].reserved is not None \
+                        and q[0].start == 0 \
+                        and q[0].reserved.cap == self.width:
+                    return self._emit_adopted(reason)
+            elif q and q[0].reserved is not None \
+                    and self._adoptable_sharded():
+                return self._emit_adopted(reason)
+        ibuf, fbuf, out = self._assemble_buffers()
         n = 0
         for s in range(self.n_shards):
             base = s * self.seg

@@ -152,13 +152,30 @@ class Instance(LifecycleComponent):
 
         # durable stores — the log-structured sharded segment store
         # (sitewhere_tpu/store): parallel background seal off the hot
-        # path, catalog-governed retention/compaction, packed hot tier
+        # path, catalog-governed retention/compaction, packed hot tier.
+        # On a mesh, segment shards key to MESH shards (the registry
+        # block owning each device) instead of the tenant/device hash,
+        # so one egress segment's columns append into one shard buffer —
+        # they never scatter across store shards host-side.
+        if self.mesh is not None:
+            import numpy as np
+
+            _rows_per_shard = max(1, cap // n_shards)
+
+            def _mesh_store_key(dev, ten, _r=_rows_per_shard, _np=np):
+                return _np.asarray(dev, _np.int64) // _r
+
+            store_shard_key = _mesh_store_key
+        else:
+            store_shard_key = None
         self.event_store = self.add_child(SegmentStore(
             self.data_dir,
             flush_interval_s=0.25,
             retention_s=self.config.get("events.retention_s"),
             resident_bytes=int(self.config["events.resident_bytes"]),
-            n_shards=int(self.config["events.shards"]),
+            n_shards=(n_shards if self.mesh is not None
+                      else int(self.config["events.shards"])),
+            shard_key=store_shard_key,
             seal_workers=int(self.config["events.seal_workers"]),
             hot_bytes=int(self.config["events.hot_bytes"]),
             compact_interval_s=float(
@@ -556,8 +573,12 @@ class Instance(LifecycleComponent):
                 call_timeout_s=float(self.config.get(
                     "rpc.call_timeout_s", 10.0)),
                 # hung-step watchdog flag on every beat: peers park
-                # forwards toward a host whose device tier is wedged
-                device_unhealthy=lambda: self.dispatcher.device_unhealthy))
+                # forwards toward a host whose device tier is wedged —
+                # plus the mesh-shard attribution so a single sick
+                # shard's wedge doesn't park the whole host
+                device_unhealthy=lambda: self.dispatcher.device_unhealthy,
+                device_unhealthy_shards=(
+                    lambda: self.dispatcher.device_unhealthy_shards)))
         else:
             self._peer_demuxes = {}
         self._rpc_peers = list(peers)

@@ -308,35 +308,53 @@ def build_packed_chain(k: int, donate: bool = True) -> Callable:
     backend ignores donation with a warning, so the dispatcher passes
     ``donate=False`` there.
     """
-    from sitewhere_tpu.pipeline.step import NUM_EVENT_TYPES
-
-    n_out = len(OUT_I)
-    n_met = (len(METRIC_SCALARS) + NUM_EVENT_TYPES + len(TELEMETRY_SCALARS)
-             + TENANT_METER_BLOCK)
-
     def chain(tables, ps, *slots):
-        ring_i = jnp.stack(slots[:k])   # [K, 12, B]
-        ring_f = jnp.stack(slots[k:])   # [K, 4, B]
-        width = ring_i.shape[-1]
-
-        def body(i, carry):
-            c, ois, mets, present = carry
-            bi = jax.lax.dynamic_index_in_dim(ring_i, i, keepdims=False)
-            bf = jax.lax.dynamic_index_in_dim(ring_f, i, keepdims=False)
-            c, oi, met, pres = packed_pipeline_step(tables, c, bi, bf)
-            ois = jax.lax.dynamic_update_index_in_dim(ois, oi, i, 0)
-            mets = jax.lax.dynamic_update_index_in_dim(mets, met, i, 0)
-            return c, ois, mets, present | pres
-
-        init = (
-            ps,
-            jnp.zeros((k, n_out, width), jnp.int32),
-            jnp.zeros((k, n_met), jnp.int32),
-            jnp.zeros((ps.capacity,), bool),
-        )
-        return jax.lax.fori_loop(0, k, body, init)
+        return chain_over_slots(packed_pipeline_step, k, tables, ps, slots)
 
     return jax.jit(chain, donate_argnums=(1,) if donate else ())
+
+
+def packed_metric_entries() -> int:
+    """Length of the packed metrics vector (one authority for builders)."""
+    from sitewhere_tpu.pipeline.step import NUM_EVENT_TYPES
+
+    return (len(METRIC_SCALARS) + NUM_EVENT_TYPES + len(TELEMETRY_SCALARS)
+            + TENANT_METER_BLOCK)
+
+
+def chain_over_slots(step, k: int, tables, ps, slots):
+    """The K-step fori_loop core shared by the single-chip and the
+    sharded (``shard_map`` local-body) chains: cycle the K pre-staged
+    ``(bi, bf)`` slots through ``step`` threading the ``PackedState``
+    carry on device, stacking per-step output blocks along a leading
+    slot axis and OR-ing presence over the chain.
+
+    ``step`` has the :func:`packed_pipeline_step` signature; the sharded
+    builder passes its id-offsetting local step instead.  Returns
+    ``(ps', ois [K, 10, B], metrics [K, n], present [D])``.
+    """
+    n_out = len(OUT_I)
+    n_met = packed_metric_entries()
+    ring_i = jnp.stack(slots[:k])   # [K, 12, B]
+    ring_f = jnp.stack(slots[k:])   # [K, 4, B]
+    width = ring_i.shape[-1]
+
+    def body(i, carry):
+        c, ois, mets, present = carry
+        bi = jax.lax.dynamic_index_in_dim(ring_i, i, keepdims=False)
+        bf = jax.lax.dynamic_index_in_dim(ring_f, i, keepdims=False)
+        c, oi, met, pres = step(tables, c, bi, bf)
+        ois = jax.lax.dynamic_update_index_in_dim(ois, oi, i, 0)
+        mets = jax.lax.dynamic_update_index_in_dim(mets, met, i, 0)
+        return c, ois, mets, present | pres
+
+    init = (
+        ps,
+        jnp.zeros((k, n_out, width), jnp.int32),
+        jnp.zeros((k, n_met), jnp.int32),
+        jnp.zeros((ps.capacity,), bool),
+    )
+    return jax.lax.fori_loop(0, k, body, init)
 
 
 def ring_depth_default() -> int:
@@ -534,6 +552,7 @@ class PackedView:
         self._oi = None
         self._metrics = None
         self._metrics_host = None
+        self._accepted = None
         # host-sync instrumentation: called ONCE, at the blocking fetch
         # (the dispatcher wires its ``pipeline.host_syncs`` counter)
         self._on_fetch = on_fetch
@@ -560,7 +579,14 @@ class PackedView:
 
     @property
     def accepted(self) -> np.ndarray:
-        return (self._row("flags") & F_ACCEPTED) != 0
+        # memoized against the fetched block: egress consults the mask
+        # several times per plan (store/outbound/analytics/command
+        # routing), and the ring's shared fetch should materialize it
+        # once per slot, not once per consumer
+        a = self._accepted
+        if a is None:
+            a = self._accepted = (self._row("flags") & F_ACCEPTED) != 0
+        return a
 
     @property
     def unregistered(self) -> np.ndarray:
@@ -694,7 +720,8 @@ __all__ = [
     "RingFetch", "RingStepView",
     "pack_tables", "unpack_tables", "pack_state", "unpack_state",
     "unpack_batch", "pack_outputs", "packed_pipeline_step",
-    "build_packed_chain", "ring_depth_default",
+    "build_packed_chain", "chain_over_slots", "packed_metric_entries",
+    "ring_depth_default",
     "pack_batch_host", "stage_packed_batch", "start_host_copy",
     "supports_async_host_copy", "supports_batch_staging",
     "F_ACCEPTED", "F_UNREGISTERED", "F_UNASSIGNED", "F_DERIVED",

@@ -28,9 +28,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from sitewhere_tpu.parallel.mesh import SHARD_AXIS
+from sitewhere_tpu.parallel.shmap import shard_map
 from sitewhere_tpu.pipeline.step import PipelineOutputs, StepMetrics, pipeline_step
 from sitewhere_tpu.schema import (
     DeviceState,
@@ -181,6 +181,74 @@ def build_sharded_packed_step(mesh: Mesh):
         check_vma=False,
     )
     return jax.jit(mapped)
+
+
+def build_sharded_packed_chain(mesh: Mesh, k: int, donate: bool = True):
+    """The K-deep packed chain running SPMD over the mesh — the fusion
+    of :func:`sitewhere_tpu.pipeline.packed.build_packed_chain` (host
+    syncs 1/K) with :func:`build_sharded_packed_step` (device-state,
+    dedup and presence sharded by device-id).
+
+    Same layout authority as the single step — ``_packed_tables_specs``
+    for the resident tables, :data:`_PACKED_STATE_SPEC` for the state
+    planes and every staged batch slot — so host-side placement
+    (:func:`place_packed_batch` / :func:`place_packed_state`) feeds both
+    paths identically.  Inside the ``shard_map`` body the local chain is
+    :func:`~sitewhere_tpu.pipeline.packed.chain_over_slots` over the
+    id-offsetting local step; rule eval stays data-parallel (rule/zone
+    tables are replicated, so no gather crosses shards — the all-gather
+    hook only matters once rules reference foreign-device state).  The
+    stacked per-step metrics are ``psum``-ed ONCE per chain — K steps,
+    one collective, exactly the per-step psum summed over the chain.
+
+    Returns ``(ps', ois [K, 10, B], metrics [K, n], present [D])`` with
+    ``ois`` width-sharded, metrics replicated, ``present`` block-sharded
+    by capacity.  ``donate=True`` donates the state carry: the mesh ring
+    runs on a ``DeviceStateManager.lease_packed`` exclusive hand-off, so
+    unlike :func:`build_sharded_packed_step` (which steps the live
+    epoch) the chain may consume its input planes.
+    """
+    from sitewhere_tpu.pipeline.packed import (
+        chain_over_slots,
+        pack_outputs,
+        pack_state,
+        unpack_batch,
+        unpack_state,
+        unpack_tables,
+    )
+
+    tables_specs = _packed_tables_specs()
+    state_specs = _PACKED_STATE_SPEC
+    slot_spec = P(None, SHARD_AXIS)
+    in_specs = (tables_specs, state_specs) + (slot_spec,) * (2 * k)
+    out_specs = (state_specs, P(None, None, SHARD_AXIS), P(), P(SHARD_AXIS))
+
+    def local_step(tables, ps, bi, bf):
+        registry, rules, zones = unpack_tables(tables)
+        state = unpack_state(ps)
+        batch = unpack_batch(bi, bf)
+        rows_local = registry.capacity
+        offset = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int32) * rows_local
+        local_ids = jnp.where(batch.device_id >= 0,
+                              batch.device_id - offset, -1)
+        local_batch = batch.replace(device_id=local_ids)
+        new_state, out = pipeline_step(
+            registry, state, rules, zones, local_batch)
+        return pack_state(new_state), *pack_outputs(out, local_batch)
+
+    def local_chain(tables, ps, *slots):
+        c, ois, mets, present = chain_over_slots(local_step, k, tables,
+                                                 ps, slots)
+        # one collective per chain: psum of the stacked [K, n] block is
+        # the per-step psum the single sharded step would have done K×
+        mets = jax.lax.psum(mets, SHARD_AXIS)
+        return c, ois, mets, present
+
+    mapped = shard_map(
+        local_chain, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(1,) if donate else ())
 
 
 # The packed-mesh sharding layout lives HERE, once: the shard_map specs

@@ -212,6 +212,7 @@ class HostForwarder(LifecycleComponent):
                  call_timeout_s: float = 10.0,
                  max_retained_bytes: Optional[int] = None,
                  device_unhealthy=None,
+                 device_unhealthy_shards=None,
                  name: str = "host-forwarder"):
         super().__init__(name)
         self.dispatcher = dispatcher
@@ -249,6 +250,11 @@ class HostForwarder(LifecycleComponent):
         # (dispatcher.device_unhealthy) — advertised on every beat so
         # peers park forwards while OUR device tier is wedged
         self.device_unhealthy = device_unhealthy
+        # zero-arg callable, mesh refinement of the flag above
+        # (dispatcher.device_unhealthy_shards): which mesh shards the
+        # wedge attributes to.  Empty = whole tier (single-chip, or an
+        # unattributable wedge) — peers keep the conservative park.
+        self.device_unhealthy_shards = device_unhealthy_shards
         # instance-scoped registry by default (a PRIVATE one when none
         # is injected — forwarders are per-instance objects and their
         # counters must never bleed across co-resident instances)
@@ -917,6 +923,12 @@ class HostForwarder(LifecycleComponent):
                 unhealthy = bool(self.device_unhealthy())
             except Exception:
                 logger.exception("device_unhealthy probe failed")
+        shards: list = []
+        if unhealthy and self.device_unhealthy_shards is not None:
+            try:
+                shards = [int(s) for s in self.device_unhealthy_shards()]
+            except Exception:
+                logger.exception("device_unhealthy_shards probe failed")
         return {
             "processId": int(self.process_id),
             "incarnation": int(self.incarnation),
@@ -924,6 +936,7 @@ class HostForwarder(LifecycleComponent):
             "retryAfterS": round(retry_after, 3),
             "spoolLag": int(self.pending_for(target)),
             "deviceUnhealthy": unhealthy,
+            "unhealthyShards": shards,
         }
 
     def observe_peer_heartbeat(self, peer: int, body) -> None:
@@ -939,7 +952,9 @@ class HostForwarder(LifecycleComponent):
                 overload_state=int(body.get("state", 0)),
                 retry_after_s=float(body.get("retryAfterS", 0.0)),
                 spool_lag=int(body.get("spoolLag", 0)),
-                device_unhealthy=bool(body.get("deviceUnhealthy", False)))
+                device_unhealthy=bool(body.get("deviceUnhealthy", False)),
+                unhealthy_shards=tuple(
+                    int(s) for s in body.get("unhealthyShards", ()) or ()))
         except (TypeError, ValueError):
             logger.warning("malformed heartbeat from peer %s ignored", peer)
 

@@ -84,7 +84,7 @@ class _Peer:
     __slots__ = ("state", "last_heard", "last_transition", "incarnation",
                  "overload_state", "retry_after_s", "spool_lag",
                  "fail_streak", "next_probe_at", "transitions",
-                 "suppressed", "device_unhealthy")
+                 "suppressed", "device_unhealthy", "unhealthy_shards")
 
     def __init__(self, now: float):
         self.state = PeerState.ALIVE        # optimistic boot (grace)
@@ -99,6 +99,8 @@ class _Peer:
         self.transitions = 0
         self.suppressed = 0                 # hysteresis-refused changes
         self.device_unhealthy = False       # peer's hung-step watchdog flag
+        self.unhealthy_shards = ()          # mesh shards the wedge names
+                                            # (empty = whole tier)
 
 
 class PeerHealthTable:
@@ -187,6 +189,7 @@ class PeerHealthTable:
                           retry_after_s: float = 0.0,
                           spool_lag: int = 0,
                           device_unhealthy: bool = False,
+                          unhealthy_shards: tuple = (),
                           now: Optional[float] = None) -> None:
         """A full heartbeat (request or response body) from ``peer``."""
         now = self._now(now)
@@ -209,9 +212,15 @@ class PeerHealthTable:
             rec.spool_lag = max(0, int(spool_lag))
             if bool(device_unhealthy) != rec.device_unhealthy:
                 logger.warning("peer %d device tier %s", peer,
-                               "unhealthy (hung dispatch)"
+                               ("unhealthy (hung dispatch, shards "
+                                f"{list(unhealthy_shards) or 'ALL'})")
                                if device_unhealthy else "recovered")
             rec.device_unhealthy = bool(device_unhealthy)
+            # shard-scoped refinement: which mesh shards the peer's
+            # wedge attributes to (empty = whole tier).  Tracked even
+            # without a flag edge — attribution can sharpen mid-episode.
+            rec.unhealthy_shards = (tuple(unhealthy_shards)
+                                    if device_unhealthy else ())
             if rec.fail_streak < self.suspect_failures:
                 self._transition_locked(peer, rec, PeerState.ALIVE, now,
                                         "heartbeat")
@@ -416,5 +425,6 @@ class PeerHealthTable:
                     "transitions": rec.transitions,
                     "suppressed_flaps": rec.suppressed,
                     "device_unhealthy": rec.device_unhealthy,
+                    "unhealthy_shards": list(rec.unhealthy_shards),
                 }
             return out

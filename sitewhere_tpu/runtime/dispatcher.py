@@ -358,23 +358,31 @@ class PipelineDispatcher(LifecycleComponent):
         # single host dispatch and — via the shared RingFetch — a single
         # D2H sync for the whole ring's egress.  None = backend-adaptive
         # (8 on TPU where the ~70 ms host RTT dwarfs the device step, off
-        # elsewhere); any value < 2 disables.  Mesh dispatch keeps its
-        # sharded per-step path (the chain is a single-chip program).
-        # Latency stays bounded: deadline/flush/replay plans — and the
-        # loop thread, once the ring's oldest plan ages past the batcher
-        # deadline — drain the ring through the single-step path IN
-        # ORDER, so per-device event order is never reordered around
+        # elsewhere); any value < 2 disables.  On a mesh the SAME ring
+        # runs the sharded chain (pipeline/sharded.py
+        # build_sharded_packed_chain): one SPMD program steps all K
+        # slots across every shard, so the 1/K host-sync economy and the
+        # mesh's aggregate throughput compose instead of excluding each
+        # other.  Latency stays bounded: deadline/flush/replay plans —
+        # and the loop thread, once the ring's oldest plan ages past the
+        # batcher deadline — drain the ring through the single-step path
+        # IN ORDER, so per-device event order is never reordered around
         # ring-held predecessors and an idle trickle degrades to exactly
         # the pre-ring behavior.
         if ring_depth is None or ring_depth < 0:
             from sitewhere_tpu.pipeline.packed import ring_depth_default
 
             ring_depth = ring_depth_default()
-        if mesh is not None:
-            ring_depth = 0
         self.ring_depth = int(ring_depth) if int(ring_depth) >= 2 else 0
         self._ring: List[BatchPlan] = []
         self._ring_chains: Dict[int, Callable] = {}
+        # Ring-shaped dispatch scratch: the K slot references a chain
+        # dispatch hands to the jitted call are written into these
+        # preallocated lists (and cleared after the dispatch so staged
+        # H2D buffers don't outlive their ring) — the steady-state chain
+        # path allocates no per-dispatch K-length lists.
+        self._ring_slots_i: List = [None] * self.ring_depth
+        self._ring_slots_f: List = [None] * self.ring_depth
         # Donate the chain carry only where donation is real (the CPU
         # backend ignores it with a warning per call): the state manager
         # hands the epoch over exclusively via lease_packed, so donation
@@ -533,6 +541,7 @@ class PipelineDispatcher(LifecycleComponent):
         from sitewhere_tpu.runtime.devguard import (
             DeviceBreaker,
             DeviceWatchdog,
+            ShardBreakers,
         )
 
         # Breaker: repeated device faults across distinct batches demote
@@ -542,11 +551,34 @@ class PipelineDispatcher(LifecycleComponent):
         # flag rides the heartbeat (instance wiring).  Callers may pass
         # pre-configured guards (thresholds/clock); the dispatcher
         # attaches its own handlers to any that were left unset.
-        self.breaker = breaker if breaker is not None else DeviceBreaker()
+        #
+        # Mesh dispatch gets a PER-SHARD breaker bank: a fault
+        # attributed to one shard's batch segment demotes that shard
+        # alone — its rows are masked out of the chain and side-routed
+        # (_sidecar_shard_rows) while the healthy shards keep chaining.
+        self._mesh_shards = (batcher.n_shards
+                             if mesh is not None and batcher.n_shards > 1
+                             else 0)
+        # batch rows of shard s live at [s*seg, (s+1)*seg) — the
+        # batcher's routed segment layout, the attribution key for
+        # nonfinite-row → shard fault mapping
+        self._shard_seg = (batcher.width // batcher.n_shards
+                           if self._mesh_shards else 0)
+        if breaker is not None:
+            self.breaker = breaker
+        elif self._mesh_shards:
+            self.breaker = ShardBreakers(self._mesh_shards)
+        else:
+            self.breaker = DeviceBreaker()
+        self._shard_breakers = hasattr(self.breaker, "demoted_shards")
         if self.breaker.on_trip is None:
-            self.breaker.on_trip = self._on_breaker_trip
+            self.breaker.on_trip = (self._on_shard_breaker_trip
+                                    if self._shard_breakers
+                                    else self._on_breaker_trip)
         if self.breaker.on_restore is None:
-            self.breaker.on_restore = self._on_breaker_restore
+            self.breaker.on_restore = (self._on_shard_breaker_restore
+                                       if self._shard_breakers
+                                       else self._on_breaker_restore)
         self.watchdog = (watchdog if watchdog is not None
                          else DeviceWatchdog())
         if self.watchdog.on_soft is None:
@@ -555,6 +587,12 @@ class PipelineDispatcher(LifecycleComponent):
             self.watchdog.on_unhealthy = self._on_watchdog_hard
         if self.watchdog.on_recovered is None:
             self.watchdog.on_recovered = self._on_watchdog_recovered
+        # Shard-scoped wedge attribution: when the hard budget trips on
+        # a mesh, the breaker bank's suspect shards are recorded here
+        # and ride the heartbeat (device_unhealthy_shards) so peers can
+        # park forwards for the sick shard's device range only.  Cleared
+        # when the watchdog recovers.
+        self._unhealthy_shards: tuple = ()
         # NaN/Inf quarantine: host policy over the device-counted
         # rows_nonfinite telemetry scalar.  The per-device attribution
         # scan runs ONLY when a plan's scalar is nonzero (the rare
@@ -640,9 +678,18 @@ class PipelineDispatcher(LifecycleComponent):
         """Start the async H2D copy of a packed plan (double-buffer front
         half; capability-probed no-op on the CPU backend / older JAX —
         the jitted call then transfers synchronously as before).  Mesh
-        plans keep their placement path (place_packed_batch)."""
-        if plan.staged is None and plan.packed_i is not None \
-                and self.mesh is None:
+        plans stage through place_packed_batch: the per-shard device_put
+        is asynchronous, so a burst's later placements overlap earlier
+        steps exactly like the single-chip staging path."""
+        if plan.staged is None and plan.packed_i is not None:
+            if self.mesh is not None:
+                from sitewhere_tpu.pipeline.sharded import place_packed_batch
+
+                plan.staged = place_packed_batch(
+                    self.mesh, plan.packed_i, plan.packed_f)
+                self._m_bytes["h2d"].inc(
+                    plan.packed_i.nbytes + plan.packed_f.nbytes)
+                return
             from sitewhere_tpu.pipeline.packed import stage_packed_batch
 
             plan.staged = stage_packed_batch(plan.packed_i, plan.packed_f)
@@ -1042,7 +1089,7 @@ class PipelineDispatcher(LifecycleComponent):
         REAL chain doesn't charge a multi-second jit compile to live
         traffic's p99.  Best-effort: a failure only defers the compile
         to the first chain."""
-        if not (self.ring_depth and self.mesh is None):
+        if not self.ring_depth:
             return
         try:
             from sitewhere_tpu.pipeline.packed import BATCH_F, BATCH_I
@@ -1090,6 +1137,15 @@ class PipelineDispatcher(LifecycleComponent):
         relies on the fail-closed window for execution failures."""
         if self._ring_donate:
             ps, token = self.state_manager.lease_packed()
+            if self.mesh is not None:
+                # a freshly-materialized lease pack has no mesh layout
+                # yet; device_put is a no-op once the planes already
+                # carry it (every lease after the first chain)
+                from sitewhere_tpu.pipeline.sharded import (
+                    place_packed_state,
+                )
+
+                ps = place_packed_state(self.mesh, ps)
             out = chain(tables, ps, *slots_i, *slots_f)
             if block:
                 jax.block_until_ready(out)
@@ -1097,7 +1153,14 @@ class PipelineDispatcher(LifecycleComponent):
                 out[0], present_now=out[3], lease_token=token)
         else:
             epoch = self.state_manager.current_packed
-            out = chain(tables, epoch, *slots_i, *slots_f)
+            ps = epoch
+            if self.mesh is not None:
+                from sitewhere_tpu.pipeline.sharded import (
+                    place_packed_state,
+                )
+
+                ps = place_packed_state(self.mesh, ps)
+            out = chain(tables, ps, *slots_i, *slots_f)
             if block:
                 jax.block_until_ready(out)
             self.state_manager.commit_packed(
@@ -1357,6 +1420,14 @@ class PipelineDispatcher(LifecycleComponent):
 
     # -- one step -----------------------------------------------------------
 
+    def _mesh_put(self, x, spec):
+        """One leaf's mesh placement — a bound method, not a per-call
+        closure, so the unpacked re-take path allocates no lambda per
+        step (swlint HP004)."""
+        from jax.sharding import NamedSharding
+
+        return jax.device_put(x, NamedSharding(self.mesh, spec))
+
     def _placed(self, kind: str, obj, replicated: bool = False):
         """Place a provider epoch on the mesh, cached by object identity."""
         cached = self._placed_epochs.get(kind)
@@ -1427,16 +1498,16 @@ class PipelineDispatcher(LifecycleComponent):
 
     def _ring_eligible(self, plan: BatchPlan, replay_depth: int) -> bool:
         """May this plan wait in the ring for a chained dispatch?  Only
-        depth-0 full-width fill emissions on the single-chip packed path:
-        deadline/flush partials are latency-sensitive, re-injected plans
-        (derived alerts, replay) must not recurse through the ring, and
-        mesh plans keep the sharded per-step program.  The explicit
+        depth-0 full-width fill emissions on the packed path:
+        deadline/flush partials are latency-sensitive and re-injected
+        plans (derived alerts, replay) must not recurse through the
+        ring.  Mesh plans chain through the sharded packed chain — the
+        fused mode — under the same eligibility rules.  The explicit
         width check matters with n_shards > 1, where a single skewed
         shard segment triggers a "fill" emission far below full width —
         those are latency-carrying partials too."""
         return (self.ring_depth > 0
                 and replay_depth == 0
-                and self.mesh is None
                 and plan.packed_i is not None
                 and plan.reason == "fill"
                 and plan.n_events == plan.width
@@ -1518,9 +1589,17 @@ class PipelineDispatcher(LifecycleComponent):
         variation without recompiling every dispatch)."""
         chain = self._ring_chains.get(k)
         if chain is None:
-            from sitewhere_tpu.pipeline.packed import build_packed_chain
+            if self.mesh is not None:
+                from sitewhere_tpu.pipeline.sharded import (
+                    build_sharded_packed_chain,
+                )
 
-            chain = build_packed_chain(k, donate=self._ring_donate)
+                chain = build_sharded_packed_chain(
+                    self.mesh, k, donate=self._ring_donate)
+            else:
+                from sitewhere_tpu.pipeline.packed import build_packed_chain
+
+                chain = build_packed_chain(k, donate=self._ring_donate)
             self._ring_chains[k] = chain
         return chain
 
@@ -1549,11 +1628,30 @@ class PipelineDispatcher(LifecycleComponent):
         k = len(plans)
         chain = self._ring_chain(k)
         now = time.monotonic()
-        for plan in plans:
+        # per-shard containment (mesh): shards the breaker bank has
+        # demoted get their rows side-routed + masked BEFORE the chain,
+        # so one sick chip degrades its own shard without costing the
+        # healthy shards the 1/K host-sync economy
+        demoted = (self.breaker.demoted_shards()
+                   if self._shard_breakers else ())
+        if demoted:
+            self._sidecar_shard_rows(plans, demoted)
+        # ring-shaped scratch: slot references land in the preallocated
+        # K-length lists (cleared after the dispatch), so the chain path
+        # builds no per-dispatch lists (swlint HP001)
+        slots_i, slots_f = self._ring_slots_i, self._ring_slots_f
+        while len(slots_i) < k:   # mid-chaos partial chain (cold path)
+            slots_i.append(None)
+            slots_f.append(None)
+        while len(slots_i) > k:
+            slots_i.pop()
+            slots_f.pop()
+        for i, plan in enumerate(plans):
             self._m_stage["ring_wait"].observe(
                 max(0.0, now - plan.created_at))
-        slots = [plan.staged or (plan.packed_i, plan.packed_f)
-                 for plan in plans]
+            staged = plan.staged or (plan.packed_i, plan.packed_f)
+            slots_i[i] = staged[0]
+            slots_f[i] = staged[1]
         t0 = time.perf_counter()
         tables = self._tables_packed()
         # one watchdog entry for the whole chain; each slot's egress
@@ -1576,13 +1674,18 @@ class PipelineDispatcher(LifecycleComponent):
                                        valid=plan.packed_i[0] != 0)
             with ctrace.span("ring.dispatch").tag("steps", k):
                 _, ois, mets, _present = self._dispatch_chain(
-                    chain, tables,
-                    [s[0] for s in slots], [s[1] for s in slots])
+                    chain, tables, slots_i, slots_f)
             start_host_copy(ois, mets, on_error=self._on_host_copy_error)
         except Exception as e:
             ctrace.end()
             self._recover_ring(plans, e)
             return
+        finally:
+            # drop the slot references: staged H2D buffers must not
+            # outlive their ring pinned in the dispatch scratch
+            for i in range(k):
+                slots_i[i] = None
+                slots_f[i] = None
         ctrace.end()
         # chaos kill point: the K-step chain dispatched and committed on
         # device, but NO slot has egressed — every ring plan must replay
@@ -1601,8 +1704,13 @@ class PipelineDispatcher(LifecycleComponent):
                          slot=slot, seq=plan.seq, chain_k=k)
             self._m_assemble.observe(plan.max_wait_s)
             self._window_step(plan, RingStepView(fetch, slot), 0, trace)
-        # a clean CHAINED dispatch closes a half-open breaker probe
-        self.breaker.record_success(chained=True)
+        # a clean CHAINED dispatch closes a half-open breaker probe —
+        # per-shard, it vouches only for the shards that actually rode
+        # the chain (a masked shard proved nothing)
+        if demoted:
+            self.breaker.record_success(chained=True, masked=demoted)
+        else:
+            self.breaker.record_success(chained=True)
 
     def _recover_ring(self, plans, exc) -> None:
         """Chain-failure containment (runs under ``_step_lock``).
@@ -1646,7 +1754,10 @@ class PipelineDispatcher(LifecycleComponent):
                 "device-fault",
                 detail=f"chain of {len(plans)} failed: "
                        f"{type(exc).__name__}: {exc}")
-        self.breaker.record_fault(plans[0].seq)
+        # per-shard attribution on a mesh: nonfinite rows in a shard's
+        # batch segment strike THAT shard's breaker; an unattributable
+        # chain fault strikes every shard (fail conservative)
+        self._record_device_fault(plans[0].seq, plans)
         # single-step re-dispatch in emission order; a plan that fails
         # AGAIN stays re-parked (front of the ring), keeps the commit
         # gate closed, and journal replay recovers it after restart
@@ -1714,6 +1825,131 @@ class PipelineDispatcher(LifecycleComponent):
             self.overload.force(OverloadState.NORMAL,
                                 reason="device-breaker-recovered")
 
+    def _on_shard_breaker_trip(self, shard: int, level: int) -> None:
+        """One mesh shard demoted (ShardBreakers callback): the gauge
+        tracks the WORST shard, the flight recorder names the sick one,
+        and the overload ladder only engages once NO shard can chain —
+        a single demoted shard still rides masked on a healthy mesh."""
+        from sitewhere_tpu.runtime.devguard import BREAKER_LEVELS
+        from sitewhere_tpu.runtime.overload import OverloadState
+
+        self._m_fault["breaker_trips"].inc()
+        self._m_breaker_state.set(self.breaker.level)
+        logger.warning("device breaker tripped to %s for mesh shard %d "
+                       "(other shards keep chaining)",
+                       BREAKER_LEVELS[level], shard)
+        if self.flightrec is not None:
+            self.flightrec.anomaly(
+                "device-breaker",
+                detail=f"shard {shard} demoted to {BREAKER_LEVELS[level]}")
+        if (self.overload is not None
+                and not self.breaker.allow_chain()
+                and self.overload.state == OverloadState.NORMAL):
+            self.overload.force(OverloadState.DEGRADED,
+                                reason="device-breaker")
+
+    def _on_shard_breaker_restore(self, shard: int) -> None:
+        from sitewhere_tpu.runtime.overload import OverloadState
+
+        self._m_breaker_state.set(self.breaker.level)
+        logger.info("device breaker restored chained dispatch for "
+                    "mesh shard %d", shard)
+        if (self.breaker.level == 0
+                and self.overload is not None
+                and self.overload.state == OverloadState.DEGRADED
+                and getattr(self.overload, "last_driver", None)
+                == "device-breaker"):
+            self.overload.force(OverloadState.NORMAL,
+                                reason="device-breaker-recovered")
+
+    def _fault_shards(self, plans) -> Optional[set]:
+        """Attribute a mesh dispatch fault to shard(s): scan the retained
+        HOST batch buffers for nonfinite float rows (the dominant device
+        fault the injection harness and real poison produce) and map
+        each poisoned row's batch position to its shard segment.  None =
+        unattributable — the caller strikes every shard, because an
+        un-guarded tier is worse than a conservatively demoted one."""
+        if not self._mesh_shards:
+            return None
+        shards: set = set()
+        for plan in plans:
+            if plan.packed_i is None:
+                continue
+            bf = np.asarray(plan.packed_f)
+            valid = np.asarray(plan.packed_i[0]) != 0
+            bad = valid & ~np.isfinite(bf).all(axis=0)
+            for row in np.nonzero(bad)[0]:
+                shards.add(int(row) // self._shard_seg)
+        return shards or None
+
+    def _record_device_fault(self, seq: int, plans) -> None:
+        """Route one device fault into the breaker — per-shard when the
+        bank is shard-aware AND the fault attributes to specific
+        segments, tier-wide otherwise."""
+        if not self._shard_breakers:
+            self.breaker.record_fault(seq)
+            return
+        shards = self._fault_shards(plans)
+        if shards is None:
+            self.breaker.record_fault(seq)
+        else:
+            for s in sorted(shards):
+                self.breaker.record_fault(seq, shard=s)
+
+    def _sidecar_shard_rows(self, plans, demoted: tuple) -> None:
+        """Demoted-shard side route (mesh ring, under ``_step_lock``):
+        dispatch each ring plan's rows belonging to ``demoted`` shards
+        through the containment subset path — the sharded single step
+        while the shard sits at SINGLE_STEP, the CPU fallback once it
+        reaches FALLBACK — then mask those rows out of the staged chain
+        batch.  The healthy shards keep the fused chain; the sick
+        shard's rows still flow (degraded), commit via the same
+        read-epoch merge, and window/egress normally.  A side dispatch
+        that FAILS leaves its rows in the chain on purpose: the chain
+        fault that follows re-enters `_recover_ring`'s containment
+        instead of silently dropping rows."""
+        from sitewhere_tpu.runtime.devguard import FALLBACK
+
+        fallback = any(self.breaker.level_of(s) >= FALLBACK
+                       for s in demoted)
+        step_fn = self._cpu_packed_step() if fallback else None
+        if step_fn is None:
+            # no addressable CPU device: demoted single-step through the
+            # mesh beats a dead fallback (same policy as _dispatch_plan)
+            fallback = False
+        seg = self._shard_seg
+        for plan in plans:
+            if plan.packed_i is None:
+                continue
+            valid = np.asarray(plan.packed_i[0]) != 0
+            take = np.zeros(valid.shape[0], dtype=bool)
+            for s in demoted:
+                take[s * seg:(s + 1) * seg] = True
+            rows = np.nonzero(take & valid)[0]
+            if rows.size == 0:
+                continue
+            trace = self.tracer.trace("pipeline.shard-sidecar")
+            trace.record("shard.sidecar", 0.0, seq=plan.seq,
+                         rows=int(rows.size), shards=list(demoted))
+            if not self._try_subset(plan, rows, 0, trace,
+                                    step_fn=step_fn):
+                logger.warning(
+                    "sidecar dispatch for demoted shard(s) %s failed "
+                    "(seq=%d); rows stay in the chain for containment",
+                    demoted, plan.seq)
+                continue
+            if fallback:
+                self._m_fault["cpu_fallback_steps"].inc()
+            # mask the side-routed rows out of the chained dispatch:
+            # fresh host buffer (the retained original must keep its
+            # rows for bisect/dead-letter), restaged on the mesh
+            bi = np.array(plan.packed_i, copy=True)
+            bi[0][rows] = 0
+            plan.packed_i = bi
+            from sitewhere_tpu.pipeline.sharded import place_packed_batch
+
+            plan.staged = place_packed_batch(self.mesh, bi, plan.packed_f)
+
     def _on_watchdog_soft(self, payload, elapsed_s: float) -> None:
         """Soft budget tripped: dump the in-flight dispatch's plan
         records to the flight recorder.  ``payload`` is the opaque
@@ -1738,9 +1974,17 @@ class PipelineDispatcher(LifecycleComponent):
 
     def _on_watchdog_hard(self, payload, elapsed_s: float) -> None:
         self._m_fault["watchdog_hard_trips"].inc()
+        # shard-scoped wedge attribution (mesh): the breaker bank's
+        # suspects — shards with live strikes or an elevated level — are
+        # the best available culprit for the wedge; () means the whole
+        # tier is suspect and peers park everything, same as single-chip
+        if self._shard_breakers:
+            self._unhealthy_shards = self.breaker.suspect_shards()
         logger.error("device tier unhealthy: dispatch wedged %.3fs "
-                     "(hard budget %.3fs)", elapsed_s,
-                     self.watchdog.hard_s)
+                     "(hard budget %.3fs)%s", elapsed_s,
+                     self.watchdog.hard_s,
+                     (f", suspect shards {self._unhealthy_shards}"
+                      if self._unhealthy_shards else ""))
         if self.flightrec is not None:
             self.flightrec.anomaly(
                 "device-wedged",
@@ -1748,6 +1992,7 @@ class PipelineDispatcher(LifecycleComponent):
                        f"(hard budget {self.watchdog.hard_s:.3f}s)")
 
     def _on_watchdog_recovered(self) -> None:
+        self._unhealthy_shards = ()
         logger.info("device tier recovered: in-flight dispatches drained")
 
     @property
@@ -1755,6 +2000,17 @@ class PipelineDispatcher(LifecycleComponent):
         """Heartbeat export: True while the hung-step watchdog holds the
         tier unhealthy (rpc/forward.py carries it to peers)."""
         return self.watchdog.unhealthy
+
+    @property
+    def device_unhealthy_shards(self) -> tuple:
+        """Heartbeat export, mesh refinement of :attr:`device_unhealthy`:
+        the shard ids suspected in the CURRENT wedge.  Empty while
+        healthy — and also when a wedge cannot be attributed, in which
+        case peers treat the whole tier as sick (the conservative
+        single-chip semantics)."""
+        if not self.watchdog.unhealthy:
+            return ()
+        return self._unhealthy_shards
 
     def _wd_record(self, plan: BatchPlan, slot: Optional[int] = None) -> dict:
         rec = {"seq": int(plan.seq), "rows": int(plan.n_events),
@@ -1843,7 +2099,8 @@ class PipelineDispatcher(LifecycleComponent):
                         place_packed_state,
                     )
 
-                    bi, bf = place_packed_batch(self.mesh, bi, bf)
+                    if plan.staged is None:
+                        bi, bf = place_packed_batch(self.mesh, bi, bf)
                     ps = place_packed_state(self.mesh, ps)
                 # breaker at FALLBACK: the chip is presumed dead — route
                 # the same jitted program to a CPU device (single-chip
@@ -1860,7 +2117,10 @@ class PipelineDispatcher(LifecycleComponent):
                 wd = self.watchdog.begin(plan)
                 self._wd_tokens[id(plan)] = wd
                 try:
-                    if faults.device_active() and self.mesh is None:
+                    if faults.device_active():
+                        # fires against the retained HOST copies, so the
+                        # injection point is mesh-agnostic — per-shard
+                        # containment drills rely on it firing here too
                         faults.device_fire("device.dispatch",
                                            values=plan.packed_f,
                                            valid=plan.packed_i[0] != 0)
@@ -1905,12 +2165,9 @@ class PipelineDispatcher(LifecycleComponent):
                 # never hit; device_put is a no-op once the epoch already
                 # carries the mesh sharding (i.e. after the first step).
                 from sitewhere_tpu.pipeline.sharded import _specs_sharded
-                from jax.sharding import NamedSharding
 
                 state = jax.tree_util.tree_map(
-                    lambda x, s: jax.device_put(
-                        x, NamedSharding(self.mesh, s)),
-                    state, _specs_sharded(state))
+                    self._mesh_put, state, _specs_sharded(state))
                 batch = place_batch(self.mesh, batch)
             else:
                 registry = self.registry_provider()
@@ -1948,7 +2205,7 @@ class PipelineDispatcher(LifecycleComponent):
         scatter semantics regardless of subset order.
         """
         self._m_fault["step_faults"].inc()
-        self.breaker.record_fault(plan.seq)
+        self._record_device_fault(plan.seq, (plan,))
         logger.warning("packed step failed for seq=%d (%d rows): %s — "
                        "bisecting", plan.seq, plan.n_events, exc)
         if self.flightrec is not None:
@@ -1989,17 +2246,21 @@ class PipelineDispatcher(LifecycleComponent):
                 self._plans_outstanding -= 1
 
     def _try_subset(self, plan: BatchPlan, rows: np.ndarray,
-                    replay_depth: int, trace) -> bool:
+                    replay_depth: int, trace, step_fn=None) -> bool:
         """Dispatch ``plan`` with only ``rows`` valid; True on success.
 
         Skips ``plan.staged`` on purpose: the bisect path rebuilds the
         batch from the retained HOST buffers (``packed_i``/``packed_f``)
-        so the masked columns are exactly what the device sees."""
+        so the masked columns are exactly what the device sees.
+        ``step_fn`` overrides the packed step — the demoted-shard
+        sidecar routes FALLBACK-level shards through the CPU step."""
         bi = np.array(plan.packed_i, copy=True)
         mask = np.zeros(bi.shape[1], dtype=bool)
         mask[rows] = True
         bi[0] = np.where(mask, bi[0], 0)
         bf = plan.packed_f
+        if step_fn is None:
+            step_fn = self._packed_step
         try:
             if faults.device_active():
                 faults.device_fire("device.dispatch", values=bf,
@@ -2009,7 +2270,7 @@ class PipelineDispatcher(LifecycleComponent):
             with self._lock:
                 self._plans_outstanding += 1
             try:
-                new_ps, oi, metrics, present = self._packed_step(
+                new_ps, oi, metrics, present = step_fn(
                     tables, epoch, bi, bf)
                 # surface async execution faults HERE, inside the
                 # containment, not at the egress fetch
@@ -2227,7 +2488,11 @@ class PipelineDispatcher(LifecycleComponent):
             self._m_host_syncs.inc()
         with trace.span("egress.fetch-outputs"):
             m = as_numpy(out.metrics)
-            accepted = np.asarray(out.accepted)
+            # packed/ring views hand back the host mask memoized on the
+            # shared fetch; only the unpacked fallback still pays a
+            # device→host conversion here
+            accepted = (out.accepted if hasattr(out, "_fetch")
+                        else as_numpy(out.accepted))
             cols = self._columns(host_cols, out)
         for key in ("processed", "accepted", "unregistered", "unassigned",
                     "threshold_alerts", "zone_alerts"):

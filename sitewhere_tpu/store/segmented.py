@@ -127,6 +127,7 @@ class SegmentStore(EventStore):
         name: str = "event-store",
         *,
         n_shards: int = 4,
+        shard_key=None,
         seal_workers: int = 2,
         hot_bytes: int = 64 << 20,
         compact_min_rows: int = 0,
@@ -136,6 +137,14 @@ class SegmentStore(EventStore):
     ):
         self.metrics = metrics if metrics is not None else global_registry()
         self.n_shards = max(1, int(n_shards))
+        # Optional placement override: ``shard_key(device_ids, tenant_ids)
+        # -> shard array``.  The instance passes a MESH-aligned key on a
+        # multi-chip deployment — store shards keyed to the mesh shard
+        # owning each device's registry block — so one egress segment's
+        # columns land in ONE shard buffer instead of hash-scattering
+        # across all of them host-side.  None keeps the tenant/device
+        # hash (best load spread for single-chip).
+        self._shard_key = shard_key
         # tenant metering hook: the instance points this at its
         # UsageLedger so sealed bytes bill per tenant (_commit_sealed)
         self.usage_ledger = None
@@ -303,6 +312,11 @@ class SegmentStore(EventStore):
     def _shard_of(self, dev: np.ndarray, ten: np.ndarray) -> np.ndarray:
         if self.n_shards <= 1:
             return np.zeros(len(dev), np.int64)
+        if self._shard_key is not None:
+            # mesh-keyed placement; the modulo keeps an out-of-range key
+            # (unregistered NULL_ID rows) a valid shard, never a crash
+            return (np.asarray(self._shard_key(dev, ten), np.int64)
+                    % self.n_shards)
         d = dev.astype(np.int64)
         t = ten.astype(np.int64)
         return ((d * _MIX_DEV) ^ (t * _MIX_TEN)) % self.n_shards

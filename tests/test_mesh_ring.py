@@ -1,0 +1,267 @@
+"""Mesh-fused ring dispatch: the K-deep packed chain under shard_map.
+
+Four contracts of the fused chained mesh (conftest forces an 8-device
+virtual CPU mesh, so a 4-way mesh is always available):
+
+- host-sync amortization: K chained steps cost ONE device round-trip,
+  so ``host_syncs == steps / K`` when every emission chains;
+- split invariance: the mesh chain is bit-identical to (a) the same
+  mesh stepping one batch at a time and (b) the single-chip chain —
+  sharding and chaining are pure execution strategies, never semantics;
+- per-shard containment: poison rows on one shard demote ONLY that
+  shard's breaker; the other shards keep chaining and no clean row is
+  lost;
+- zero-copy sharded ingest: a segment-ordered full-width reservation is
+  ADOPTED by the sharded batcher — ``pipeline.bytes_copied.batch``
+  stays 0 end-to-end.
+"""
+
+import numpy as np
+import pytest
+
+try:
+    from sitewhere_tpu.pipeline.sharded import (  # noqa: F401
+        build_sharded_packed_chain,
+    )
+    _SHARDED_ERR = None
+except Exception as e:  # pragma: no cover - environment-dependent
+    _SHARDED_ERR = e
+
+pytestmark = pytest.mark.skipif(
+    _SHARDED_ERR is not None,
+    reason=f"sharded pipeline unavailable: {_SHARDED_ERR}")
+
+WIDTH = 128
+CAP = 256
+N_SHARDS = 4
+K = 4
+SEG = WIDTH // N_SHARDS       # rows per shard per full batch
+RPS = CAP // N_SHARDS         # device handles per registry block
+
+
+def _config(tmp_path, name, *, n_shards, ring_depth, **extra):
+    from sitewhere_tpu.runtime.config import Config
+
+    pipeline = {"width": WIDTH, "registry_capacity": CAP,
+                "mtype_slots": 4, "deadline_ms": 200.0}
+    if n_shards > 1:
+        pipeline["n_shards"] = n_shards
+    if ring_depth:
+        pipeline["ring_depth"] = ring_depth
+    cfg = {
+        "instance": {"id": name, "data_dir": str(tmp_path / name)},
+        "pipeline": pipeline,
+        "presence": {"scan_interval_s": 3600.0, "missing_after_s": 1800},
+        "registration": {"default_device_type": "sensor"},
+    }
+    cfg.update(extra)
+    return Config(cfg, apply_env=False)
+
+
+def _start(cfg, *, rule=False):
+    from sitewhere_tpu.instance import Instance
+
+    inst = Instance(cfg)
+    inst.start()
+    dm = inst.device_management
+    dm.create_device_type(token="sensor", name="Sensor")
+    if rule:
+        from sitewhere_tpu.schema import AlertLevel, ComparisonOp
+
+        inst.rules.create_rule(mtype=None, op=ComparisonOp.GT,
+                               threshold=90.0, alert_type="hot",
+                               alert_level=AlertLevel.WARNING)
+    for i in range(CAP):
+        dm.create_device(token=f"d-{i}", device_type="sensor")
+        dm.create_device_assignment(device=f"d-{i}")
+    handles = np.asarray(
+        inst.identity.device.lookup_many([f"d-{i}" for i in range(CAP)]),
+        np.int32)
+    by_shard = [handles[(handles // RPS) == s] for s in range(N_SHARDS)]
+    assert all(len(b) >= SEG for b in by_shard), [len(b) for b in by_shard]
+    return inst, by_shard
+
+
+def _balanced_round(rng, by_shard):
+    """Exactly SEG rows per shard, shard-block ordered — every emission
+    is a full-width fill batch whose layout is identical on the sharded
+    and single-shard batchers (segment s == arrival block s)."""
+    return np.concatenate([
+        rng.choice(by_shard[s], SEG) for s in range(N_SHARDS)
+    ]).astype(np.int32)
+
+
+def _ingest_rounds(inst, by_shard, rounds, seed, poison=None,
+                   values=None):
+    rng = np.random.default_rng(seed)
+    for r in range(rounds):
+        dev = _balanced_round(rng, by_shard)
+        if values is None:
+            value = rng.uniform(0, 100, WIDTH).astype(np.float32)
+        else:
+            value = values(r, rng)
+        if poison is not None:
+            poison(r, value)
+        inst.dispatcher.ingest_arrays(
+            device_id=dev,
+            event_type=np.zeros(WIDTH, np.int32),
+            ts_s=np.full(WIDTH, 1_753_800_000 + r, np.int32),
+            mtype_id=np.zeros(WIDTH, np.int32),
+            value=value,
+            lat=rng.uniform(-20, 20, WIDTH).astype(np.float32),
+            lon=rng.uniform(-20, 20, WIDTH).astype(np.float32),
+        )
+    inst.dispatcher.flush()
+    inst.dispatcher.flush()   # drain re-injected derived alerts
+
+
+def test_mesh_chain_amortizes_host_syncs(tmp_path):
+    """K fused steps, one D2H fetch: host_syncs == steps / K."""
+    rounds = 2 * K
+    inst, by_shard = _start(
+        _config(tmp_path, "mesh-ring", n_shards=N_SHARDS, ring_depth=K))
+    try:
+        _ingest_rounds(inst, by_shard, rounds, seed=7)
+        snap = inst.dispatcher.metrics_snapshot()
+        assert snap["processed"] == rounds * WIDTH
+        assert snap["steps"] == rounds, snap
+        assert snap["ring_chains"] == rounds // K, snap
+        assert snap["host_syncs"] == snap["steps"] // K, snap
+        assert inst.event_store.total_events == rounds * WIDTH
+        st = inst.device_state.current
+        assert len(st.last_event_ts_s.sharding.device_set) == N_SHARDS
+    finally:
+        inst.stop()
+        inst.terminate()
+
+
+def test_mesh_chain_matches_single_chip_and_split(tmp_path):
+    """Shard-split AND batch-split invariance, bit-for-bit: the fused
+    4-way mesh chain == the same mesh stepping batch-by-batch == the
+    single-chip chain, on identical traffic (rule leg included, so the
+    all-gathered rule eval is part of the equality).
+
+    Alerts fire only in the LAST round: derived-alert re-injection is
+    deliberately deferred past every full batch, because mid-stream
+    alerts join LATER batches at dispatch-timing-dependent points —
+    fused mode egresses (and so re-injects) K batches at a time — which
+    legitimately regroups intra-batch dedup winners without changing
+    any aggregate.  The invariance contract is over execution strategy,
+    not over re-injection arrival timing."""
+    import jax
+
+    rounds = 2 * K
+
+    def _values(r, rng):
+        lo, hi = ((80.0, 100.0) if r == rounds - 1 else (0.0, 50.0))
+        return rng.uniform(lo, hi, WIDTH).astype(np.float32)
+    variants = {
+        "mesh-fused": _config(tmp_path, "g-mesh-fused",
+                              n_shards=N_SHARDS, ring_depth=K),
+        "mesh-step": _config(tmp_path, "g-mesh-step",
+                             n_shards=N_SHARDS, ring_depth=0),
+        "single-chip": _config(tmp_path, "g-single",
+                               n_shards=1, ring_depth=K),
+    }
+    states, metrics, stored = {}, {}, {}
+    for name, cfg in variants.items():
+        inst, by_shard = _start(cfg, rule=True)
+        try:
+            _ingest_rounds(inst, by_shard, rounds, seed=3,
+                           values=_values)
+            snap = inst.dispatcher.metrics_snapshot()
+            states[name] = [
+                np.asarray(leaf) for leaf in
+                jax.tree_util.tree_leaves(inst.device_state.current)
+            ]
+            metrics[name] = {key: snap[key] for key in
+                             ("processed", "accepted", "threshold_alerts")}
+            stored[name] = inst.event_store.total_events
+        finally:
+            inst.stop()
+            inst.terminate()
+    ref = states["mesh-fused"]
+    for other in ("mesh-step", "single-chip"):
+        assert metrics[other] == metrics["mesh-fused"], (other, metrics)
+        assert stored[other] == stored["mesh-fused"], (other, stored)
+        assert len(states[other]) == len(ref)
+        for i, (a, b) in enumerate(zip(ref, states[other])):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"state leaf {i} differs vs {other}")
+    assert metrics["mesh-fused"]["threshold_alerts"] > 0, metrics
+
+
+@pytest.mark.chaos
+def test_single_shard_fault_contained(tmp_path):
+    """Poison rows on shard 2 demote ONLY shard 2's breaker: its rows
+    dead-letter row-by-row, shards 0/1/3 never strike and keep
+    chaining, and every clean row lands in the store."""
+    from sitewhere_tpu.runtime import faults
+
+    inst, by_shard = _start(_config(
+        tmp_path, "shard-contain", n_shards=N_SHARDS, ring_depth=K,
+        overload={"cooldown_s": 3600.0}))
+    poison_rounds, clean_rounds, ppr = 2 * K, 2 * K, 2
+
+    def _poison(r, value):
+        if r < poison_rounds:
+            value[2 * SEG:2 * SEG + ppr] = np.nan
+
+    try:
+        faults.device_inject("device.dispatch", times=None,
+                             when_nonfinite=True)
+        _ingest_rounds(inst, by_shard, poison_rounds + clean_rounds,
+                       seed=7, poison=_poison)
+        faults.device_clear()
+        inst.event_store.flush()
+        snap = inst.dispatcher.metrics_snapshot()
+        br = snap["device_fault"]["breaker"]
+        assert br["shards"][2]["level"] >= 1, br
+        for s in (0, 1, 3):
+            assert br["shards"][s]["level"] == 0, (s, br)
+        npoison = poison_rounds * ppr
+        letters = [d for d in inst.list_dead_letters(limit=100)
+                   if d.get("kind") == "device-poison"]
+        assert sum(d["count"] for d in letters) == npoison, letters
+        total = (poison_rounds + clean_rounds) * WIDTH
+        assert inst.event_store.total_events == total - npoison
+        assert snap["ring_chains"] >= 1, "healthy shards stopped chaining"
+    finally:
+        faults.device_clear()
+        inst.stop()
+        inst.terminate()
+
+
+def test_sharded_reservation_adopts_zero_copy(tmp_path):
+    """Fill-direct on the mesh: segment-ordered full-width reservations
+    are adopted by the sharded batcher, chain through the fused ring,
+    and the batch-assembly copy counter stays at ZERO."""
+    inst, by_shard = _start(
+        _config(tmp_path, "mesh-adopt", n_shards=N_SHARDS, ring_depth=K))
+    rounds = K
+    try:
+        rng = np.random.default_rng(11)
+        for r in range(rounds):
+            res = inst.dispatcher.batcher.reserve(WIDTH)
+            assert res is not None
+            dev = _balanced_round(rng, by_shard)
+            res.device_id[:WIDTH] = dev
+            res.mtype_id[:WIDTH] = 0
+            res.value[:WIDTH] = rng.uniform(0, 50, WIDTH).astype(np.float32)
+            res.ts_s[:WIDTH] = 1_753_800_000 + r
+            res.ts_ns[:WIDTH] = 0
+            res.update_state[:WIDTH] = 1
+            res.n = WIDTH
+            inst.dispatcher.ingest_wire_decoded(b"", res, [],
+                                                source_id="test")
+        inst.dispatcher.flush()
+        snap = inst.dispatcher.metrics_snapshot()
+        assert snap["processed"] == rounds * WIDTH
+        assert snap["ring_chains"] == rounds // K, snap
+        counters = inst.metrics.snapshot()["counters"]
+        assert counters.get("pipeline.bytes_copied.batch", 0) == 0, counters
+        assert inst.dispatcher.batcher.copied_bytes == 0
+        assert inst.event_store.total_events == rounds * WIDTH
+    finally:
+        inst.stop()
+        inst.terminate()

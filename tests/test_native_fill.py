@@ -332,13 +332,16 @@ class TestFillBail:
 # ---------------------------------------------------------------------------
 
 class TestReserveCommit:
-    def test_reserve_refuses_sharded_and_oversize(self):
+    def test_reserve_refuses_oversize_only(self):
+        """Sharded batchers reserve too (commit routes the resolved ids
+        by shard); only cap-out-of-range payloads are refused."""
         dev, mt, al = _spaces()
         sharded = Batcher(width=WIDTH, n_shards=2,
                           registry_capacity=CAPACITY,
                           resolve_device=dev.lookup,
                           resolve_mtype=mt.mint, resolve_alert=al.mint)
-        assert sharded.reserve(4) is None
+        assert isinstance(sharded.reserve(4), Reservation)
+        assert sharded.reserve(WIDTH + 1) is None
         batcher = _batcher(dev, mt, al)
         assert batcher.reserve(WIDTH + 1) is None
         assert batcher.reserve(0) is None

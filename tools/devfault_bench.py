@@ -27,6 +27,10 @@ containment contract end to end:
 - ``watchdog``: a stalled dispatch trips the soft then the hard budget
   (flight-recorder anomalies; the tier goes unhealthy for peers) and
   self-clears when the dispatch drains.
+- ``shard_containment``: on a 4-way mesh running the fused ring, poison
+  rows landing on ONE shard demote only that shard's breaker; the other
+  shards keep chaining, every clean row commits, and the episode leaves
+  a flight-recorder dump behind.
 
 Usage::
 
@@ -48,7 +52,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Deterministic CPU (sitecustomize hooks may override the env var —
-# force via the config API before any backend initializes).
+# force via the config API before any backend initializes).  The
+# shard-containment phase needs a multi-device mesh, so force virtual
+# host devices BEFORE the backend comes up.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -463,6 +474,89 @@ def phase_watchdog(root, check):
     return report
 
 
+def phase_shard_containment(root, check):
+    """Fused mesh ring under a one-shard poison storm: only the sick
+    shard's breaker demotes, the healthy shards keep chaining, no clean
+    row is lost, and the episode dumps the flight recorder."""
+    n_shards, K, cap = 4, 2, 32
+    seg = WIDTH // n_shards
+    rps = cap // n_shards
+    inst = _make_instance(os.path.join(root, "shards"),
+                          n_shards=n_shards, ring_depth=K,
+                          deadline_ms=200.0, registry_capacity=cap)
+    inst.start()
+    dm = inst.device_management
+    dm.create_device_type(token="sensor", name="Sensor")
+    for i in range(cap):
+        dm.create_device(token=f"d-{i}", device_type="sensor")
+        dm.create_device_assignment(device=f"d-{i}")
+    handles = np.asarray(
+        inst.identity.device.lookup_many([f"d-{i}" for i in range(cap)]),
+        np.int32)
+    by_shard = [handles[(handles // rps) == s] for s in range(n_shards)]
+    rng = np.random.default_rng(5)
+    poison_rounds, clean_rounds, ppr = 2 * K, 2 * K, 2
+
+    faults.device_inject("device.dispatch", times=None,
+                         when_nonfinite=True)
+    try:
+        for r in range(poison_rounds + clean_rounds):
+            # balanced shard-block-ordered full rounds: every emission
+            # is ring-eligible on every shard
+            dev = np.concatenate([
+                rng.choice(by_shard[s], seg) for s in range(n_shards)
+            ]).astype(np.int32)
+            value = rng.uniform(0, 100, WIDTH).astype(np.float32)
+            if r < poison_rounds:
+                value[2 * seg:2 * seg + ppr] = np.nan   # shard 2 only
+            inst.dispatcher.ingest_arrays(
+                device_id=dev,
+                event_type=np.zeros(WIDTH, np.int32),
+                ts_s=np.full(WIDTH, TS0 + r, np.int32),
+                mtype_id=np.zeros(WIDTH, np.int32),
+                value=value)
+    finally:
+        faults.device_clear()
+    stored = _settle(inst)
+
+    snap = inst.dispatcher.metrics_snapshot()
+    br = snap["device_fault"]["breaker"]
+    check(br["shards"][2]["level"] >= 1,
+          f"poisoned shard 2 never demoted: {br}")
+    for s in (0, 1, 3):
+        check(br["shards"][s]["level"] == 0,
+              f"healthy shard {s} was demoted with the sick one: {br}")
+    npoison = poison_rounds * ppr
+    letters = [l for l in inst.list_dead_letters(limit=100)
+               if l.get("kind") == "device-poison"]
+    dl_rows = sum(int(l.get("count", 0)) for l in letters)
+    check(dl_rows == npoison,
+          f"dead letters carry {dl_rows} rows, expected {npoison}")
+    total = (poison_rounds + clean_rounds) * WIDTH
+    check(stored == total - npoison,
+          f"clean-row loss: {total - npoison} expected, {stored} stored")
+    check(snap["ring_chains"] >= 1,
+          "healthy shards never chained while shard 2 was demoted")
+    dump = (inst.flightrec.snapshot("shard-containment")
+            if inst.flightrec is not None else None)
+    check(dump is not None, "no flight-recorder dump for the episode")
+
+    report = {
+        "n_shards": n_shards,
+        "ring_depth": K,
+        "poison_rows": npoison,
+        "stored": int(stored),
+        "expected_stored": total - npoison,
+        "shard_levels": [int(sh["level"]) for sh in br["shards"]],
+        "ring_chains": int(snap["ring_chains"]),
+        "dead_letter_rows": dl_rows,
+        "flightrec_dump": dump,
+    }
+    inst.stop()
+    inst.terminate()
+    return report
+
+
 # ---------------------------------------------------------------------------
 
 def main() -> int:
@@ -487,6 +581,8 @@ def main() -> int:
         report["phases"]["breaker"] = phase_breaker(root, check)
         report["phases"]["poison"] = phase_poison(root, check, args.smoke)
         report["phases"]["watchdog"] = phase_watchdog(root, check)
+        report["phases"]["shard_containment"] = phase_shard_containment(
+            root, check)
     finally:
         faults.device_clear()
         faults.clear()
