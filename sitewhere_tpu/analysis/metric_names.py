@@ -115,11 +115,26 @@ FAMILIES: Dict[str, Optional[Set[str]]] = {
     "tenant.meter": {"tracked", "collided_buckets", "window_rows"},
     "tenant.usage.rows": None,        # tenant.usage.rows.<token> | .other
     "tenant.usage.sealed_bytes": None,
+    "tenant.usage.eval_s": None,      # metered rule/analytics eval time
     "tenant.share": None,             # window row share ∈ [0, 1]
     "tenant.shed": None,              # admission sheds (overload ladder)
+    # bring-your-own-rules compiler/engine (sitewhere_tpu/rules): the
+    # bucketing guarantee made observable — compiled_shapes is the gauge
+    # tools/rulebench.py asserts stays ≤ MAX_STRUCTURE_KEYS at 100k
+    # programs, swaps counts zero-stall operand republishes
+    "rules": {
+        # gauges
+        "programs", "groups", "compiled_shapes",
+        # counters
+        "swaps", "compiles", "live_batches", "live_dropped",
+        "live_shed", "alerts",
+        # timers
+        "eval_s",
+    },
 }
 # prefixes where EVERY name must resolve to a declared family (MN003)
-GOVERNED_PREFIXES = ("device.", "slo.", "store.", "forward.", "tenant.")
+GOVERNED_PREFIXES = ("device.", "slo.", "store.", "forward.", "tenant.",
+                     "rules.")
 
 
 def family_of(name: str) -> Optional[str]:

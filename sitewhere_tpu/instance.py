@@ -409,6 +409,33 @@ class Instance(LifecycleComponent):
                     "analytics.fanout_matches", True)),
             ))
             self.analytics.usage_ledger = self.usage_ledger
+        # Bring-your-own-rules (rules/ subsystem): per-tenant declarative
+        # rule & enrichment programs compiled into per-structure batched
+        # kernels.  Same egress-offer lifecycle as analytics — added
+        # before the dispatcher so the reverse-order stop keeps the
+        # engine draining through the dispatcher's shutdown flush.
+        self.rule_engine = None
+        if bool(self.config.get("rules.programs_enabled", True)):
+            from sitewhere_tpu.rules.engine import RuleEngineRunner
+
+            self.rule_engine = self.add_child(RuleEngineRunner(
+                capacity=cap,
+                n_mtype_slots=int(self.config.get(
+                    "pipeline.mtype_slots", 8)),
+                asset_capacity=int(self.config.get(
+                    "rules.asset_capacity", 1024)),
+                resolve_mtype=self.identity.mtype.mint,
+                resolve_alert=self.identity.alert_type.mint,
+                overload=self.overload,
+                metrics=self.metrics,
+                programs_per_tenant=int(self.config.get(
+                    "rules.programs_per_tenant", 4)),
+                max_programs=int(self.config.get(
+                    "rules.max_programs", 262144)),
+                queue_depth=int(self.config.get(
+                    "rules.queue_depth", 64)),
+            ))
+            self.rule_engine.usage_ledger = self.usage_ledger
         self.registration = self.add_child(RegistrationManager(
             self.device_management,
             default_device_type=self.config.get("registration.default_device_type"),
@@ -475,6 +502,7 @@ class Instance(LifecycleComponent):
             registration=self.registration,
             on_command_rows=self._on_command_rows,
             analytics=self.analytics,
+            rules_engine=self.rule_engine,
             journal=self.ingest_journal,
             dead_letters=self.dead_letters,
             resolve_tenant=self._tenant_dense_id,
@@ -501,6 +529,10 @@ class Instance(LifecycleComponent):
             cost_analysis=self.config.get("telemetry.cost_analysis"),
             usage_ledger=self.usage_ledger,
         ))
+        if self.rule_engine is not None:
+            # fired tenant programs re-enter the pipeline as first-class
+            # ALERT events through the dispatcher's derived-alert edge
+            self.rule_engine.inject = self.dispatcher.inject_rule_alerts
         self.presence = self.add_child(PresenceManager(
             self.device_state,
             check_interval_s=float(self.config["presence.scan_interval_s"]),
@@ -642,6 +674,15 @@ class Instance(LifecycleComponent):
                 name="analytics",
                 snapshot_fn=self.analytics.snapshot_state,
                 restore_fn=self.analytics.restore_state,
+                version=1))
+        if self.rule_engine is not None:
+            # tenant rule programs + attribute tables (docs are the
+            # durable identity; operand tables and kernels rebuild on
+            # the first post-restore publish)
+            self.checkpointer.register_provider(StateProvider(
+                name="rule-programs",
+                snapshot_fn=self.rule_engine.snapshot_state,
+                restore_fn=self.rule_engine.restore_state,
                 version=1))
         # ingest dedup tables + forward-spool cursors (the spools
         # themselves are already durable journals; the cursor record is

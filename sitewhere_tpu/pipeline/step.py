@@ -183,6 +183,29 @@ def _gather_meas_state(
     return ipack[:, 0], ipack[:, 1], fpack[:, 0], fpack[:, 1:]
 
 
+def fold_ewma_arrays(
+    prev_ts: jax.Array,
+    prev_ns: jax.Array,
+    ewma_prev: jax.Array,
+    ts_s: jax.Array,
+    ts_ns: jax.Array,
+    value: jax.Array,
+    taus: jax.Array,
+) -> jax.Array:
+    """Array-level irregular-sampling EWMA fold — the single source of
+    the fold math, shared by the fused step and the bring-your-own-rules
+    program kernels (``rules/compile.py``), so both lanes stay bitwise
+    aligned with the ``rules/interp.py`` golden reference."""
+    seeded = prev_ts > 0
+    # sub-second resolution: fast sensors sample at > 1 Hz
+    dt = jnp.maximum(
+        (ts_s - prev_ts).astype(jnp.float32)
+        + (ts_ns - prev_ns).astype(jnp.float32) * 1e-9, 0.0)
+    alpha = 1.0 - jnp.exp(-dt[:, None] / jnp.maximum(taus[None, :], 1e-9))
+    v = value[:, None]
+    return jnp.where(seeded[:, None], ewma_prev + alpha * (v - ewma_prev), v)
+
+
 def _fold_ewma_from(
     prev_ts: jax.Array,
     prev_ns: jax.Array,
@@ -191,14 +214,8 @@ def _fold_ewma_from(
     taus: jax.Array,
 ) -> jax.Array:
     """EWMA fold given pre-gathered slot state (see :func:`fold_ewma`)."""
-    seeded = prev_ts > 0
-    # sub-second resolution: fast sensors sample at > 1 Hz
-    dt = jnp.maximum(
-        (batch.ts_s - prev_ts).astype(jnp.float32)
-        + (batch.ts_ns - prev_ns).astype(jnp.float32) * 1e-9, 0.0)
-    alpha = 1.0 - jnp.exp(-dt[:, None] / jnp.maximum(taus[None, :], 1e-9))
-    v = batch.value[:, None]
-    return jnp.where(seeded[:, None], ewma_prev + alpha * (v - ewma_prev), v)
+    return fold_ewma_arrays(prev_ts, prev_ns, ewma_prev,
+                            batch.ts_s, batch.ts_ns, batch.value, taus)
 
 
 def fold_ewma(
@@ -214,6 +231,25 @@ def fold_ewma(
     """
     prev_ts, prev_ns, _, ewma_prev = _gather_meas_state(state, batch)
     return _fold_ewma_from(prev_ts, prev_ns, ewma_prev, batch, taus)
+
+
+def compare_select(op: jax.Array, val: jax.Array,
+                   thr: jax.Array) -> jax.Array:
+    """Data-driven :class:`~sitewhere_tpu.schema.ComparisonOp` dispatch.
+
+    A select-chain, NOT a stacked ``[6, ...]`` gather: the stack
+    materializes six full result-shaped masks (6x the HBM traffic of
+    the compare itself); selects keep one mask live (measured 2.3x on
+    [16k, 4k]).  Shared by the built-in rule pass and the
+    bring-your-own-rules program kernels, where ``op`` is an operand —
+    per-program data, never a compiled shape."""
+    return jnp.select(
+        [op == ComparisonOp.GT, op == ComparisonOp.LT,
+         op == ComparisonOp.GTE, op == ComparisonOp.LTE,
+         op == ComparisonOp.EQ],
+        [val > thr, val < thr, val >= thr, val <= thr, val == thr],
+        default=(val != thr),
+    )
 
 
 def eval_threshold_rules(
@@ -270,16 +306,7 @@ def eval_threshold_rules(
 
     thr = rules.threshold[None, :]  # [1, R]
     op = rules.op[None, :]
-    # select-chain, NOT a stacked [6, B, R] gather: the stack materializes
-    # six full [B, R] masks (6x the HBM traffic of the compare itself);
-    # selects keep one mask live (measured 2.3x on [16k, 4k])
-    hit = jnp.select(
-        [op == ComparisonOp.GT, op == ComparisonOp.LT,
-         op == ComparisonOp.GTE, op == ComparisonOp.LTE,
-         op == ComparisonOp.EQ],
-        [val > thr, val < thr, val >= thr, val <= thr, val == thr],
-        default=(val != thr),
-    )  # [B, R]
+    hit = compare_select(op, val, thr)  # [B, R]
 
     tenant_ok = (rules.tenant_id[None, :] == NULL_ID) | (
         rules.tenant_id[None, :] == batch.tenant_id[:, None]

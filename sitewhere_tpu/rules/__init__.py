@@ -1,0 +1,32 @@
+"""Bring-your-own-rules: per-tenant rule & enrichment programs compiled
+into a bounded set of batched kernels.
+
+- ``dsl``       — declarative program documents, validation, canonical
+                  form, and the structure key that buckets programs;
+- ``interp``    — slow numpy reference interpreter (golden semantics);
+- ``compile``   — the bucketing compiler: one jitted kernel group per
+                  structure key, constants lifted into operand tables;
+- ``registry``  — per-tenant store with epoch-published operand tables
+                  (hot-swap under traffic, zero recompiles);
+- ``enrich``    — sharded/replicated on-device attribute tables for
+                  metadata-join predicates;
+- ``engine``    — the lifecycle runner wired into the dispatcher.
+"""
+
+from sitewhere_tpu.rules.dsl import (  # noqa: F401
+    RuleProgramError,
+    parse_program,
+    structure_key,
+)
+from sitewhere_tpu.rules.engine import RuleEngineRunner  # noqa: F401
+from sitewhere_tpu.rules.enrich import AttributeStore  # noqa: F401
+from sitewhere_tpu.rules.registry import ProgramRegistry  # noqa: F401
+
+__all__ = [
+    "RuleProgramError",
+    "parse_program",
+    "structure_key",
+    "RuleEngineRunner",
+    "AttributeStore",
+    "ProgramRegistry",
+]

@@ -1,0 +1,429 @@
+"""The bucketing compiler: structure-shared kernels over operand tables.
+
+Programs sharing a :func:`~sitewhere_tpu.rules.dsl.structure_key` share
+ONE jitted kernel; everything that distinguishes them — thresholds,
+comparison ops, window choices, polygon rings, attribute ids, alert
+codes — is data in padded operand tables indexed by a per-row program
+id, exactly the ``RuleTable`` design scaled out to arbitrary programs.
+The trace cache is keyed by structure: :func:`kernel_for` returns the
+same jitted callable for every group with the same key, so loading 100k
+programs mints at most ``dsl.MAX_STRUCTURE_KEYS`` compiled shapes and a
+tenant hot-swapping constants can never trigger a retrace.
+
+Two kernels per batch:
+
+- :func:`rules_prepare_batch` (ONE compile, shared by every group):
+  folds each row against the engine's trailing per-(device, mtype-slot)
+  state — EWMA ladder + rate since the previous sample, reusing the
+  fused step's :func:`~sitewhere_tpu.pipeline.step.fold_ewma_arrays` —
+  updates the trail with the batch winners (``ops/scatter``'s
+  time-ordered scatter, the same winner contract as ``DeviceState``),
+  and gathers the metadata-join enrichment rows from the device/asset
+  attribute tables.  On a mesh this is the sharded part: trail and
+  device-attribute tables shard by ``device_id // rows_per_shard``
+  exactly like device state (:func:`sharded_prepare`), each shard masks
+  the rows it owns, and the per-row features combine with one psum.
+
+- :func:`rules_group_eval` (one compile per structure key): decodes the
+  operand tables for up to ``S`` programs per row-tenant and reduces the
+  padded ``[B, S, C, P]`` predicate lattice to fired/alert outputs.
+  Runs on replicated features, so the mesh path needs no second
+  shard_map.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.ids import NULL_ID
+from sitewhere_tpu.pipeline.step import compare_select, fold_ewma_arrays
+from sitewhere_tpu.ops.scatter import scatter_last_by_time
+from sitewhere_tpu.rules.dsl import (
+    PK_ATTR,
+    PK_EVENT_TYPE,
+    PK_GEO,
+    PK_PAD,
+    PK_RATE,
+    PK_VALUE,
+)
+from sitewhere_tpu.schema import EventType
+
+
+class GroupTables(NamedTuple):
+    """Operand tables for ONE structure group (epoch-immutable).
+
+    ``kind``/``pf`` are ``[G, C, P]``; ``pint`` packs the four int
+    operands ``(op, i0, i1, i2)`` as ``[G, C, P, 4]`` so the per-row
+    decode is two gathers, not six.  ``meta`` packs per-program
+    ``(tenant_id, alert_code, alert_level, active)`` as ``[G, 4]``;
+    ``slots`` maps dense tenant id to up to ``S`` program rows
+    (``[T, S]``, NULL_ID padded); ``verts`` is the group's polygon pool
+    ``[Z, V, 2]`` (a 1-row dummy for geo-less structures)."""
+
+    kind: jax.Array
+    pint: jax.Array
+    pf: jax.Array
+    meta: jax.Array
+    slots: jax.Array
+    verts: jax.Array
+
+
+class BatchFeatures(NamedTuple):
+    """Per-row features produced by the prepare kernel, consumed by
+    every group kernel (replicated on a mesh)."""
+
+    ewma: jax.Array        # f32[B, K]   candidate EWMAs incl. this row
+    rate: jax.Array        # f32[B]      value delta / dt vs prev sample
+    rate_valid: jax.Array  # bool[B]     previous sample exists, dt > 0
+    dev_attr: jax.Array    # i32[B, Ad]  device attribute row (NULL_ID unset)
+    asset_attr: jax.Array  # i32[B, Aa]  asset attribute row
+
+
+def _pip_rows(px: jax.Array, py: jax.Array, verts: jax.Array) -> jax.Array:
+    """Ray-crossing containment for per-row gathered polygons.
+
+    ``ops/geo.points_in_polygons`` tests every point against every
+    polygon — dense ``[B, Z]`` — which is the wrong shape here: a batch
+    references only the polygons its rows' programs name, so the verts
+    arrive pre-gathered as ``[..., V, 2]`` aligned with the predicate
+    lattice.  The arithmetic (slope-first ordering, guarded denominator)
+    mirrors ``points_in_polygons`` exactly so both lanes agree on
+    boundary rounding."""
+    x1 = verts[..., :, 0]
+    y1 = verts[..., :, 1]
+    x2 = jnp.roll(verts[..., :, 0], -1, axis=-1)
+    y2 = jnp.roll(verts[..., :, 1], -1, axis=-1)
+    pxe = px[..., None]
+    pye = py[..., None]
+    straddles = (y1 > pye) != (y2 > pye)
+    denom = jnp.where(y2 == y1, 1.0, y2 - y1)
+    slope = (x2 - x1) / denom
+    x_cross = slope * (pye - y1) + x1
+    crossing = straddles & (pxe < x_cross)
+    return (jnp.sum(crossing.astype(jnp.int32), axis=-1) % 2) == 1
+
+
+def _attr_col(attr: jax.Array, col: jax.Array) -> jax.Array:
+    """Select per-predicate attribute columns from a per-row attribute
+    block: ``attr[B, A]`` x ``col[B, S, C, P]`` → ``[B, S, C, P]``.
+    One-hot accumulate over the (small, static) column count — a
+    take-along on this shape lowers to a scalar gather loop."""
+    out = jnp.full(col.shape, NULL_ID, jnp.int32)
+    for c in range(attr.shape[1]):
+        out = jnp.where(col == c, attr[:, c][:, None, None, None], out)
+    return out
+
+
+def rules_group_eval(
+    tables: GroupTables,
+    feats: BatchFeatures,
+    tenant_id: jax.Array,
+    event_type: jax.Array,
+    mtype_id: jax.Array,
+    value: jax.Array,
+    lon: jax.Array,
+    lat: jax.Array,
+    accepted: jax.Array,
+    *,
+    has_geo: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Evaluate every program of one structure group over one batch.
+
+    Returns ``(fired[B, S], code[B, S], level[B, S], pid[B, S])`` — up
+    to S programs per row-tenant, each independently firing its own
+    alert.  Cost is O(B * S * C * P) regardless of how many programs the
+    group holds: the per-row program-id indirection (``slots``) is what
+    decouples eval cost from program count."""
+    T, S = tables.slots.shape
+    G = tables.kind.shape[0]
+
+    pid = tables.slots[jnp.clip(tenant_id, 0, T - 1)]          # [B, S]
+    g = jnp.clip(pid, 0, G - 1)
+    meta = tables.meta[g]                                      # [B, S, 4]
+    # BYO programs evaluate device telemetry; alert rows (including this
+    # engine's own re-injected alerts) are masked to keep the
+    # re-injection loop contraction-free
+    row_ok = accepted & (event_type != EventType.ALERT)
+    ok = ((pid != NULL_ID) & row_ok[:, None]
+          & (meta[..., 0] == tenant_id[:, None]) & (meta[..., 3] != 0))
+
+    kind = tables.kind[g]                                      # [B, S, C, P]
+    pint = tables.pint[g]                                      # [B, S, C, P, 4]
+    f0 = tables.pf[g]
+    op = pint[..., 0]
+    i0 = pint[..., 1]
+    i1 = pint[..., 2]
+    i2 = pint[..., 3]
+
+    # float lane: value / EWMA / rate vs threshold, gated on measurement
+    # rows + optional mtype filter (NULL_ID = any), rate additionally on
+    # a usable previous sample — the built-in pass's gates, generalized
+    is_meas = accepted & (event_type == EventType.MEASUREMENT)
+    e_sel = jnp.zeros(kind.shape, jnp.float32)
+    for k in range(feats.ewma.shape[1]):
+        e_sel = jnp.where(i1 == k, feats.ewma[:, k][:, None, None, None],
+                          e_sel)
+    v = value[:, None, None, None]
+    fval = jnp.where(kind == PK_VALUE, v,
+                     jnp.where(kind == PK_RATE,
+                               feats.rate[:, None, None, None], e_sel))
+    mtype_ok = (i0 == NULL_ID) | (i0 == mtype_id[:, None, None, None])
+    fgate = (is_meas[:, None, None, None] & mtype_ok
+             & ((kind != PK_RATE)
+                | feats.rate_valid[:, None, None, None]))
+    fhit = compare_select(op, fval, f0) & fgate
+
+    # int lane: attribute joins (unset attributes never match) and
+    # event-type gates
+    aval = jnp.where(i2 == 1, _attr_col(feats.asset_attr, i1),
+                     _attr_col(feats.dev_attr, i1))
+    ahit = compare_select(op, aval, i0) & (aval != NULL_ID)
+    ehit = compare_select(op, event_type[:, None, None, None], i0)
+
+    if has_geo:
+        Z = tables.verts.shape[0]
+        vg = tables.verts[jnp.clip(i1, 0, Z - 1)]     # [B, S, C, P, V, 2]
+        inside = _pip_rows(lon[:, None, None, None],
+                           lat[:, None, None, None], vg)
+        is_loc = accepted & (event_type == EventType.LOCATION)
+        ghit = (jnp.where(i0 == 1, inside, ~inside)
+                & is_loc[:, None, None, None])
+    else:
+        ghit = jnp.zeros(kind.shape, bool)
+
+    res = jnp.where(
+        kind == PK_PAD, True,
+        jnp.where(kind <= PK_RATE, fhit,
+                  jnp.where(kind == PK_GEO, ghit,
+                            jnp.where(kind == PK_ATTR, ahit, ehit))))
+    clause_real = (kind != PK_PAD).any(axis=-1)        # [B, S, C]
+    clause_hit = res.all(axis=-1) & clause_real
+    fired = clause_hit.any(axis=-1) & ok               # [B, S]
+    code = jnp.where(fired, meta[..., 1], NULL_ID)
+    level = jnp.where(fired, meta[..., 2], 0)
+    return fired, code, level, pid
+
+
+def rules_prepare_batch(
+    trail_ts: jax.Array,
+    trail_ns: jax.Array,
+    trail_v: jax.Array,
+    trail_ewma: jax.Array,
+    dev_attr: jax.Array,
+    asset_attr: jax.Array,
+    device_id: jax.Array,
+    asset_id: jax.Array,
+    ts_s: jax.Array,
+    ts_ns: jax.Array,
+    mtype_id: jax.Array,
+    value: jax.Array,
+    event_type: jax.Array,
+    accepted: jax.Array,
+    taus: jax.Array,
+) -> Tuple[BatchFeatures, Tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
+    """Per-row features + updated trailing state for one batch.
+
+    The trail is the engine's own per-(device, mtype-slot) last-sample /
+    EWMA store, ``[D, M]``-shaped like ``DeviceState`` and updated with
+    the same newest-(ts_s, ts_ns)-wins winner scatter, so window and
+    rate predicates see exactly the semantics ``rules/interp.py``
+    defines.  Attribute rows gather NULL_ID for ids outside the tables
+    (unset attributes never match a join predicate)."""
+    D, M = trail_ts.shape
+    K = trail_ewma.shape[2]
+    is_meas = accepted & (event_type == EventType.MEASUREMENT)
+
+    ids = jnp.clip(device_id, 0, D - 1)
+    slot = jnp.where(mtype_id >= 0, mtype_id % M, 0)
+    flat = ids * M + slot
+    ipack = jnp.stack([trail_ts.reshape(-1), trail_ns.reshape(-1)],
+                      axis=1)[flat]                        # [B, 2]
+    fpack = jnp.concatenate(
+        [trail_v.reshape(-1, 1), trail_ewma.reshape(-1, K)],
+        axis=1)[flat]                                      # [B, 1 + K]
+    prev_ts, prev_ns = ipack[:, 0], ipack[:, 1]
+    prev_v, ewma_prev = fpack[:, 0], fpack[:, 1:]
+
+    seeded = prev_ts > 0
+    dt = jnp.maximum(
+        (ts_s - prev_ts).astype(jnp.float32)
+        + (ts_ns - prev_ns).astype(jnp.float32) * 1e-9, 0.0)
+    rate_valid = seeded & (dt > 0) & is_meas
+    rate = jnp.where(rate_valid,
+                     (value - prev_v) / jnp.maximum(dt, 1e-9), 0.0)
+    ewma_new = fold_ewma_arrays(prev_ts, prev_ns, ewma_prev,
+                                ts_s, ts_ns, value, taus)   # [B, K]
+
+    new_ts, new_ns, (new_v, new_ewma) = scatter_last_by_time(
+        trail_ts.reshape(-1), trail_ns.reshape(-1),
+        (trail_v.reshape(-1), trail_ewma.reshape(-1, K)),
+        flat, ts_s, ts_ns, (value, ewma_new),
+        is_meas & (device_id >= 0) & (device_id < D),
+    )
+
+    dev_ok = (device_id >= 0) & (device_id < dev_attr.shape[0])
+    da = jnp.where(dev_ok[:, None],
+                   dev_attr[jnp.clip(device_id, 0, dev_attr.shape[0] - 1)],
+                   NULL_ID)
+    asset_ok = (asset_id >= 0) & (asset_id < asset_attr.shape[0])
+    aa = jnp.where(asset_ok[:, None],
+                   asset_attr[jnp.clip(asset_id, 0,
+                                       asset_attr.shape[0] - 1)],
+                   NULL_ID)
+
+    feats = BatchFeatures(ewma=ewma_new, rate=rate, rate_valid=rate_valid,
+                          dev_attr=da, asset_attr=aa)
+    trail = (new_ts.reshape(D, M), new_ns.reshape(D, M),
+             new_v.reshape(D, M), new_ewma.reshape(D, M, K))
+    return feats, trail
+
+
+# -- trace cache (keyed by structure) ---------------------------------------
+
+_CACHE_LOCK = threading.Lock()
+_EVAL_KERNELS: Dict[str, object] = {}
+_PREPARE_KERNEL = None
+
+
+def kernel_for(key: str):
+    """The jitted group kernel for a structure key.  Every group with
+    the same key shares the SAME callable (and thus XLA's per-shape
+    executable cache) — the trace cache the hot-swap contract rests on."""
+    with _CACHE_LOCK:
+        fn = _EVAL_KERNELS.get(key)
+        if fn is None:
+            fn = jax.jit(rules_group_eval, static_argnames=("has_geo",))
+            _EVAL_KERNELS[key] = fn
+        return fn
+
+
+def prepare_kernel():
+    """The (single) jitted prepare kernel, trail buffers donated."""
+    global _PREPARE_KERNEL
+    with _CACHE_LOCK:
+        if _PREPARE_KERNEL is None:
+            _PREPARE_KERNEL = jax.jit(rules_prepare_batch,
+                                      donate_argnums=(0, 1, 2, 3))
+        return _PREPARE_KERNEL
+
+
+def compile_count() -> int:
+    """Total XLA executables minted across the rules kernels — the
+    number ``tools/rulebench.py`` bounds and the hot-swap tests assert
+    is FLAT across an operand swap."""
+    total = 0
+    with _CACHE_LOCK:
+        kernels = list(_EVAL_KERNELS.values())
+        if _PREPARE_KERNEL is not None:
+            kernels.append(_PREPARE_KERNEL)
+    for fn in kernels:
+        size = getattr(fn, "_cache_size", None)
+        if callable(size):
+            try:
+                total += int(size())
+            except Exception:
+                pass
+    return total
+
+
+def structure_keys_compiled() -> int:
+    with _CACHE_LOCK:
+        return len(_EVAL_KERNELS)
+
+
+def reset_trace_cache() -> None:
+    """Test/bench hook: drop every cached kernel (fresh compile counts)."""
+    global _PREPARE_KERNEL
+    with _CACHE_LOCK:
+        _EVAL_KERNELS.clear()
+        _PREPARE_KERNEL = None
+
+
+# -- mesh-sharded prepare ----------------------------------------------------
+
+def sharded_prepare(mesh, rows_per_shard: int):
+    """shard_map'd prepare: trail + device-attribute tables sharded by
+    ``device_id // rows_per_shard`` exactly like device state; batch and
+    the (small) asset table replicated; features psummed.
+
+    Each shard computes features only for rows whose device it owns and
+    contributes neutral values elsewhere, so the single psum reassembles
+    the full per-row feature block bit-identically to the unsharded
+    kernel (every accepted row's device lives on exactly one shard; the
+    NULL_ID attribute fill rides the ``x + 1`` shift so never-owned rows
+    still read as unset).  Trail updates stay shard-local — no
+    cross-shard traffic beyond the one feature psum."""
+    from jax.sharding import PartitionSpec as P
+
+    from sitewhere_tpu.parallel.mesh import SHARD_AXIS
+    from sitewhere_tpu.parallel.shmap import shard_map
+
+    shard1 = P(SHARD_AXIS)
+    rep = P()
+    in_specs = (
+        shard1, shard1, shard1, shard1,          # trail ts/ns/v/ewma
+        shard1, rep,                             # dev_attr, asset_attr
+        rep, rep, rep, rep, rep, rep, rep, rep,  # batch columns
+        rep,                                     # taus
+    )
+    out_specs = (
+        BatchFeatures(ewma=rep, rate=rep, rate_valid=rep,
+                      dev_attr=rep, asset_attr=rep),
+        (shard1, shard1, shard1, shard1),
+    )
+
+    def local_prepare(trail_ts, trail_ns, trail_v, trail_ewma,
+                      dev_attr, asset_attr, device_id, asset_id,
+                      ts_s, ts_ns, mtype_id, value, event_type,
+                      accepted, taus):
+        offset = (jax.lax.axis_index(SHARD_AXIS).astype(jnp.int32)
+                  * rows_per_shard)
+        local_id = device_id - offset
+        owned = (local_id >= 0) & (local_id < rows_per_shard)
+        feats, trail = rules_prepare_batch(
+            trail_ts, trail_ns, trail_v, trail_ewma, dev_attr,
+            asset_attr, jnp.where(owned, local_id, NULL_ID), asset_id,
+            ts_s, ts_ns, mtype_id, value, event_type,
+            accepted & owned, taus)
+        own_f = owned.astype(jnp.float32)
+        shifted = BatchFeatures(
+            ewma=feats.ewma * own_f[:, None],
+            rate=feats.rate * own_f,
+            rate_valid=feats.rate_valid & owned,
+            # +1 shift: psum of zeros from non-owner shards recovers
+            # NULL_ID (-1) for rows no shard owns, the attr value itself
+            # for owned rows
+            dev_attr=jnp.where(owned[:, None], feats.dev_attr + 1, 0),
+            asset_attr=feats.asset_attr + 1,
+        )
+        summed = jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(
+                x.astype(jnp.int32) if x.dtype == bool else x, SHARD_AXIS),
+            shifted)
+        n = jax.lax.psum(1, SHARD_AXIS)
+        feats_out = BatchFeatures(
+            ewma=summed.ewma, rate=summed.rate,
+            rate_valid=summed.rate_valid > 0,
+            dev_attr=summed.dev_attr - 1,
+            # the asset table is replicated: every shard contributes the
+            # same shifted row, so divide the psum back out
+            asset_attr=summed.asset_attr // n - 1,
+        )
+        return feats_out, trail
+
+    return jax.jit(shard_map(
+        local_prepare, mesh=mesh, in_specs=in_specs,
+        out_specs=out_specs))
+
+
+__all__ = [
+    "GroupTables", "BatchFeatures", "rules_group_eval",
+    "rules_prepare_batch", "kernel_for", "prepare_kernel",
+    "compile_count", "structure_keys_compiled", "reset_trace_cache",
+    "sharded_prepare",
+]

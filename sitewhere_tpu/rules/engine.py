@@ -1,0 +1,396 @@
+"""The rule-program runner: live evaluation of compiled tenant programs.
+
+The execution half of the bring-your-own-rules subsystem, shaped like
+``analytics.runner.QueryRunner``: the dispatcher's egress hands every
+accepted enriched batch to :meth:`submit_live` (non-blocking bounded
+offer; sheds from SHEDDING as a non-priority consumer), a single worker
+thread runs the compiled kernels, fired programs become ALERT rows
+re-injected through the dispatcher's derived-alert path, and each
+batch's eval wall time bills to tenants by row share through the
+``UsageLedger`` — rule evaluation is metered compute, same as analytics
+``eval_s``.
+
+Compile-stall contract: :meth:`refresh` (the mutation-side publish)
+warms any kernel whose (structure, shape) signature has not run yet —
+on the MUTATING thread, BEFORE the new epoch becomes current — so the
+eval worker only ever calls already-compiled kernels.  An operand-only
+swap reuses both the epoch's shape signature and the structure-keyed
+trace cache, making the swap cost one host build + device put with zero
+recompiles (asserted by the hot-swap tests and measured by
+``tools/rulebench.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.ids import NULL_ID
+from sitewhere_tpu.rules import compile as rcompile
+from sitewhere_tpu.rules.enrich import AttributeStore
+from sitewhere_tpu.rules.registry import ProgramRegistry, RulesEpoch
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.schema import DEFAULT_EWMA_TAUS, EventType
+
+_LOG = logging.getLogger("sitewhere_tpu.rules")
+
+_CHECKPOINT_VERSION = 1
+
+
+class RuleEngineRunner(LifecycleComponent):
+    """Lifecycle wrapper: trail state + attribute tables + program
+    registry + the eval worker."""
+
+    _LIVE_COLS = ("device_id", "tenant_id", "event_type", "mtype_id",
+                  "value", "lon", "lat", "ts_s", "ts_ns")
+
+    def __init__(self, capacity: int, n_mtype_slots: int = 8,
+                 asset_capacity: int = 1024,
+                 resolve_mtype=None, resolve_alert=None,
+                 overload=None, metrics=None,
+                 programs_per_tenant: int = 4,
+                 max_programs: int = 262144,
+                 queue_depth: int = 64,
+                 mesh=None, rows_per_shard: Optional[int] = None,
+                 name: str = "rule-programs"):
+        import queue as _queue
+
+        super().__init__(name)
+        self.capacity = int(capacity)
+        self.n_mtype_slots = int(n_mtype_slots)
+        self.overload = overload
+        self.mesh = mesh
+        self.rows_per_shard = rows_per_shard
+        if metrics is None:
+            from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self.attributes = AttributeStore(capacity, asset_capacity)
+        self.registry = ProgramRegistry(
+            programs_per_tenant=programs_per_tenant,
+            max_programs=max_programs,
+            resolve_alert=resolve_alert,
+            resolve_mtype=resolve_mtype,
+            resolve_attr=self.attributes.resolve)
+        self.taus = jnp.asarray(DEFAULT_EWMA_TAUS, jnp.float32)
+        self._trail = self._fresh_trail()
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=queue_depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # serializes kernel eval + trail mutation against checkpoint
+        # snapshots (the QueryRunner _eval_mutex discipline)
+        self._eval_mutex = threading.Lock()
+        self._warm_lock = threading.Lock()
+        self._warmed: set = set()
+        self._prepare_sharded = None
+        # dispatcher hooks (instance-wired): alert re-injection
+        self.inject = None
+        self.usage_ledger = None
+        # rules.* metric family (closed; analysis/metric_names.py)
+        self._m_programs = metrics.gauge("rules.programs")
+        self._m_groups = metrics.gauge("rules.groups")
+        self._m_shapes = metrics.gauge("rules.compiled_shapes")
+        self._m_swaps = metrics.counter("rules.swaps")
+        self._m_compiles = metrics.counter("rules.compiles")
+        self._m_batches = metrics.counter("rules.live_batches")
+        self._m_dropped = metrics.counter("rules.live_dropped")
+        self._m_shed = metrics.counter("rules.live_shed")
+        self._m_alerts = metrics.counter("rules.alerts")
+        self._t_eval = metrics.timer("rules.eval_s")
+        self._swaps_seen = 0
+        self._compiles_seen = 0
+
+    def _fresh_trail(self):
+        D, M = self.capacity, self.n_mtype_slots
+        K = len(DEFAULT_EWMA_TAUS)
+        return (jnp.zeros((D, M), jnp.int32), jnp.zeros((D, M), jnp.int32),
+                jnp.zeros((D, M), jnp.float32),
+                jnp.zeros((D, M, K), jnp.float32))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        super().start()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._worker, name=f"{self.name}-eval", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self.drain(timeout_s=5.0)
+        self._stop.set()
+        if self._thread is not None:
+            try:
+                self._q.put_nowait(None)
+            except Exception:
+                pass
+            self._thread.join(timeout=5)
+            self._thread = None
+        super().stop()
+
+    # -- mutation side -------------------------------------------------------
+
+    def put_program(self, tenant: int, doc: dict) -> Dict[str, object]:
+        out = self.registry.put_program(tenant, doc)
+        self.refresh()
+        return out
+
+    def delete_program(self, tenant: int, token: str) -> bool:
+        found = self.registry.delete_program(tenant, token)
+        if found:
+            self.refresh()
+        return found
+
+    def refresh(self) -> Optional[RulesEpoch]:
+        """Publish registry + attribute epochs and warm any kernel whose
+        shape signature has not executed yet — all on the calling
+        (mutation) thread, so the eval worker never pays a compile."""
+        epoch = self.registry.publish()
+        self.attributes.publish()
+        if epoch is not None:
+            for group in epoch.groups:
+                self._warm(group)
+        self._publish_metrics()
+        return epoch
+
+    def _warm(self, group) -> None:
+        sig = group.shape_sig()
+        with self._warm_lock:
+            if sig in self._warmed:
+                return
+        B = 8  # dummy width; XLA re-specializes per real batch width,
+        #        which the first real batch pays once per width — the
+        #        swap path's widths are already warm by then
+        zi = jnp.zeros(B, jnp.int32)
+        zf = jnp.zeros(B, jnp.float32)
+        K = len(DEFAULT_EWMA_TAUS)
+        feats = rcompile.BatchFeatures(
+            ewma=jnp.zeros((B, K), jnp.float32), rate=zf,
+            rate_valid=jnp.zeros(B, bool),
+            dev_attr=jnp.zeros((B, self.attributes.max_columns),
+                               jnp.int32),
+            asset_attr=jnp.zeros((B, self.attributes.max_columns),
+                                 jnp.int32))
+        fired, _, _, _ = group.eval_fn(
+            group.tables, feats, zi, zi, zi, zf, zf, zf,
+            jnp.zeros(B, bool), has_geo=group.has_geo)
+        fired.block_until_ready()
+        with self._warm_lock:
+            self._warmed.add(sig)
+
+    def _publish_metrics(self) -> None:
+        self._m_programs.set(self.registry.program_count())
+        self._m_groups.set(self.registry.group_count())
+        self._m_shapes.set(rcompile.structure_keys_compiled())
+        swaps = self.registry.swaps
+        if swaps > self._swaps_seen:
+            self._m_swaps.inc(swaps - self._swaps_seen)
+            self._swaps_seen = swaps
+        compiles = rcompile.compile_count()
+        if compiles > self._compiles_seen:
+            self._m_compiles.inc(compiles - self._compiles_seen)
+            self._compiles_seen = compiles
+
+    # -- live path -----------------------------------------------------------
+
+    def submit_live(self, cols, mask: np.ndarray, trace=None,
+                    committed: Optional[int] = None) -> None:
+        """Offer one accepted enriched batch (non-blocking, called from
+        dispatcher egress).  Sheds as a non-priority consumer from
+        SHEDDING up; drops (counted) when the queue is full."""
+        if self.registry.current_epoch() is None:
+            return
+        if self.overload is not None \
+                and not self.overload.allow_fanout(priority=False):
+            self._m_shed.inc()
+            return
+        mask = np.asarray(mask)
+        batch = {k: np.asarray(cols[k])[mask] for k in self._LIVE_COLS}
+        batch["asset_id"] = np.asarray(
+            cols["asset_id"])[mask] if "asset_id" in cols else np.full(
+                len(batch["device_id"]), NULL_ID, np.int32)
+        if not len(batch["device_id"]):
+            return
+        try:
+            self._q.put_nowait(batch)
+        except Exception:
+            self._m_dropped.inc()
+
+    def drain(self, timeout_s: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        with self._q.all_tasks_done:
+            while self._q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._q.all_tasks_done.wait(remaining)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._q.get(timeout=0.1)
+            except Exception:
+                continue
+            try:
+                if item is None:
+                    continue
+                self._m_batches.inc()
+                self._eval_batch(item)
+            except Exception:
+                _LOG.exception("rule program eval failed")
+            finally:
+                self._q.task_done()
+
+    def _prepare(self, batch: Dict[str, np.ndarray], attrs):
+        """Run the (possibly mesh-sharded) prepare kernel; updates the
+        trail in place and returns the per-row features."""
+        args = (self._trail + (attrs.device, attrs.asset)
+                + tuple(jnp.asarray(batch[k]) for k in
+                        ("device_id", "asset_id", "ts_s", "ts_ns",
+                         "mtype_id", "value"))
+                + (jnp.asarray(batch["event_type"]),
+                   jnp.asarray(batch.get(
+                       "accepted",
+                       np.ones(len(batch["device_id"]), bool))),
+                   self.taus))
+        if self.mesh is not None:
+            if self._prepare_sharded is None:
+                rows = (self.rows_per_shard
+                        or self.capacity // self.mesh.devices.size)
+                self._prepare_sharded = rcompile.sharded_prepare(
+                    self.mesh, rows)
+            feats, self._trail = self._prepare_sharded(*args)
+        else:
+            feats, self._trail = rcompile.prepare_kernel()(*args)
+        return feats
+
+    def _eval_batch(self, batch: Dict[str, np.ndarray]) -> None:
+        # epoch isolation: grab the published world ONCE — a swap
+        # landing mid-batch takes effect next batch, and the outgoing
+        # epoch's tables are immutable for as long as we hold them
+        epoch = self.registry.current_epoch()
+        if epoch is None:
+            return
+        attrs = self.attributes.publish()
+        t0 = time.perf_counter()
+        fired_out: List[Tuple[np.ndarray, ...]] = []
+        with self._eval_mutex:
+            with self._t_eval.time():
+                feats = self._prepare(batch, attrs)
+                bi = {k: jnp.asarray(batch[k]) for k in
+                      ("tenant_id", "event_type", "mtype_id")}
+                bf = {k: jnp.asarray(batch[k]) for k in
+                      ("value", "lon", "lat")}
+                acc = jnp.asarray(batch.get(
+                    "accepted", np.ones(len(batch["device_id"]), bool)))
+                for group in epoch.groups:
+                    fired, code, level, _pid = group.eval_fn(
+                        group.tables, feats, bi["tenant_id"],
+                        bi["event_type"], bi["mtype_id"], bf["value"],
+                        bf["lon"], bf["lat"], acc,
+                        has_geo=group.has_geo)
+                    fired_out.append((np.asarray(fired),
+                                      np.asarray(code),
+                                      np.asarray(level)))
+        self._fanout(batch, fired_out)
+        tenants = batch.get("tenant_id")
+        if self.usage_ledger is not None and tenants is not None \
+                and len(tenants):
+            # rule eval is metered compute: bill wall time by row share,
+            # the same attribution rule as analytics eval_s
+            try:
+                per_row = (time.perf_counter() - t0) / len(tenants)
+                self.usage_ledger.charge_rows_host(
+                    np.asarray(tenants), "eval_s",
+                    weights=np.full(len(tenants), per_row))
+            except Exception:
+                _LOG.exception("rules usage charge failed")
+
+    def _fanout(self, batch, fired_out) -> None:
+        """Fired (row, program-slot) pairs become ALERT event columns
+        re-injected through the dispatcher's derived-alert path."""
+        rows_all: List[np.ndarray] = []
+        codes_all: List[np.ndarray] = []
+        levels_all: List[np.ndarray] = []
+        for fired, code, level in fired_out:
+            rows, slots = np.nonzero(fired)
+            if rows.size:
+                rows_all.append(rows)
+                codes_all.append(code[rows, slots])
+                levels_all.append(level[rows, slots])
+        if not rows_all:
+            return
+        rows = np.concatenate(rows_all)
+        n = int(rows.size)
+        self._m_alerts.inc(n)
+        if self.inject is None:
+            return
+        cols = {
+            "device_id": batch["device_id"][rows].astype(np.int32),
+            "tenant_id": batch["tenant_id"][rows].astype(np.int32),
+            "event_type": np.full(n, int(EventType.ALERT), np.int32),
+            "ts_s": batch["ts_s"][rows].astype(np.int32),
+            "ts_ns": batch["ts_ns"][rows].astype(np.int32),
+            "value": batch["value"][rows].astype(np.float32),
+            "alert_code": np.concatenate(codes_all).astype(np.int32),
+            "alert_level": np.concatenate(levels_all).astype(np.int32),
+            # derived alerts never re-fold trailing state
+            "update_state": np.zeros(n, bool),
+        }
+        try:
+            self.inject(cols)
+        except Exception:
+            _LOG.exception("rule alert injection failed")
+
+    # -- checkpoint plane ----------------------------------------------------
+
+    def snapshot_state(self) -> Tuple[bytes, Optional[dict]]:
+        """StateProvider body: program docs + attribute tables.  The
+        trailing EWMA/rate state deliberately restarts fresh — like the
+        usage ledger's sliding window, it describes the CURRENT stream;
+        window predicates re-seed from the first post-restore sample
+        (first sample seeds the average, no zero bias)."""
+        self.drain(timeout_s=2.0)
+        with self._eval_mutex:
+            progs, header = self.registry.snapshot_payload()
+            cols, arrays = self.attributes.snapshot_payload()
+        payload = pickle.dumps(
+            {"version": _CHECKPOINT_VERSION, "programs": progs,
+             "attr_cols": cols, "attr_arrays": arrays}, protocol=4)
+        return payload, header
+
+    def restore_state(self, header, payload) -> int:
+        doc = pickle.loads(payload)
+        self.attributes.restore_payload(doc.get("attr_cols") or {},
+                                        doc.get("attr_arrays") or {})
+        self.registry.restore_payload(header or {}, doc["programs"])
+        self._trail = self._fresh_trail()
+        self.refresh()
+        return self.registry.program_count()
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "programs": self.registry.program_count(),
+            "groups": self.registry.group_count(),
+            "structures": self.registry.structure_keys(),
+            "compiledShapes": rcompile.structure_keys_compiled(),
+            "kernelExecutables": rcompile.compile_count(),
+            "swaps": self.registry.swaps,
+            "builds": self.registry.builds,
+            "epoch": (self.registry.current_epoch().epoch
+                      if self.registry.current_epoch() else 0),
+        }
+
+
+__all__ = ["RuleEngineRunner"]

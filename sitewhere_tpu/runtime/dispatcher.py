@@ -213,6 +213,7 @@ class PipelineDispatcher(LifecycleComponent):
         registration=None,
         on_command_rows: Optional[Callable[..., None]] = None,
         analytics=None,
+        rules_engine=None,
         journal: Optional[Journal] = None,
         dead_letters: Optional[Journal] = None,
         resolve_tenant: Optional[Callable[[str], int]] = None,
@@ -251,6 +252,12 @@ class PipelineDispatcher(LifecycleComponent):
         # bounded queue — live CEP/window queries evaluate on the
         # runner's own worker, never on the egress path's budget.
         self.analytics = analytics
+        # Bring-your-own-rules engine (rules/engine.RuleEngineRunner):
+        # same egress offer discipline as analytics — non-blocking
+        # bounded queue, compiled tenant programs evaluate on the
+        # engine's own worker, fired programs re-enter through
+        # inject_rule_alerts below.
+        self.rules_engine = rules_engine
         self.journal = journal
         self.dead_letters = dead_letters
         self.resolve_tenant = resolve_tenant or (lambda token: 0)
@@ -2588,6 +2595,18 @@ class PipelineDispatcher(LifecycleComponent):
                                if self.journal_reader is not None
                                else None))
 
+        # 2c. tenant rule programs (rules/engine.RuleEngineRunner):
+        #     compiled per-structure kernels over the same accepted
+        #     enriched batch; fired programs come back through
+        #     inject_rule_alerts as first-class ALERT events
+        if self.rules_engine is not None and accepted.any():
+            with trace.span("egress.rules"):
+                self.rules_engine.submit_live(
+                    cols, accepted, trace=trace,
+                    committed=(int(self.journal_reader.committed)
+                               if self.journal_reader is not None
+                               else None))
+
         # 3. command invocations (command-delivery analog)
         cmd_mask = accepted & (cols["event_type"] == EventType.COMMAND_INVOCATION)
         if self.on_command_rows is not None and cmd_mask.any():
@@ -2861,6 +2880,26 @@ class PipelineDispatcher(LifecycleComponent):
         self._run_plans(self._take(
             lambda: self.batcher.add_arrays(_copy=False, **cols)),
             replay_depth)
+
+    def inject_rule_alerts(self, cols: Dict[str, np.ndarray]) -> int:
+        """Re-inject fired tenant-program alerts as first-class ALERT
+        events (the BYO-rules half of the derived-alert contract).
+
+        Called from the rule engine's worker thread — the dispatcher
+        lock is an RLock and ``_take``/``_run_plans`` serialize against
+        live intake, so the injection is just another intake edge.  The
+        engine builds the columns with ``update_state=False`` (derived
+        alerts never re-fold trailing state) and the kernels mask ALERT
+        rows at eval, so the path cannot self-amplify."""
+        n = int(np.asarray(cols["device_id"]).size)
+        if n == 0:
+            return 0
+        self.totals["derived_alerts"] += n
+        self.totals["rule_program_alerts"] = (
+            self.totals.get("rule_program_alerts", 0) + n)
+        self._run_plans(self._take(
+            lambda: self.batcher.add_arrays(_copy=False, **cols)))
+        return n
 
     def requeue_rows(self, cols: Dict[str, np.ndarray]) -> int:
         """Re-ingest raw event columns through the normal batch path —

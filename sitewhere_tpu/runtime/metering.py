@@ -590,6 +590,7 @@ class UsageLedger:
             label = self._label(tenant)
             remove(f"tenant.usage.rows.{label}",
                    f"tenant.usage.sealed_bytes.{label}",
+                   f"tenant.usage.eval_s.{label}",
                    f"tenant.share.{label}")
 
     def publish(self, min_interval_s: float = 0.0) -> None:
@@ -621,6 +622,9 @@ class UsageLedger:
                 m.gauge(f"tenant.usage.rows.{label}").set(row["rows"])
                 m.gauge(f"tenant.usage.sealed_bytes.{label}").set(
                     row["sealed_bytes"])
+                # metered eval time (analytics queries + rule programs)
+                m.gauge(f"tenant.usage.eval_s.{label}").set(
+                    round(row.get("eval_s", 0.0), 6))
                 m.gauge(f"tenant.share.{label}").set(
                     round(shares.get(tenant, 0.0), 6))
             for tenant in list(self._published - current):

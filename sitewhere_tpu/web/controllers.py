@@ -189,6 +189,94 @@ def register_routes(gw: RestGateway, inst) -> None:
                 "restarted": True}
     r("POST", "/api/tenants/{token}/engine/restart", engine_restart)
 
+    # ---- bring-your-own-rules (rules/ subsystem) --------------------------
+    # per-tenant declarative rule & enrichment programs; a POST validates
+    # + compiles (warming any novel kernel shape) BEFORE the new operand
+    # epoch publishes, so traffic never pays a compile
+    def _programs():
+        eng = getattr(inst, "rule_engine", None)
+        require(eng is not None,
+                EntityNotFound("rule programs are disabled on this "
+                               "instance (rules.programs_enabled)"))
+        return eng
+
+    def _rules_tenant(q):
+        token = q.params["token"]
+        tid = inst.identity.tenant.lookup(token)
+        require(tid != NULL_ID, EntityNotFound(f"no tenant {token!r}"))
+        return int(tid)
+
+    def _put_rule(q, rtoken=None):
+        from sitewhere_tpu.rules.dsl import RuleProgramError
+
+        eng = _programs()
+        tid = _rules_tenant(q)
+        doc = q.json()
+        if rtoken is not None:
+            doc["token"] = rtoken
+        try:
+            return eng.put_program(tid, doc)
+        except RuleProgramError as e:
+            raise ValidationError(str(e)) from e
+
+    def _get_rule(q):
+        eng = _programs()
+        body = eng.registry.get_program(_rules_tenant(q),
+                                        q.params["rule"])
+        require(body is not None,
+                EntityNotFound(f"no rule program {q.params['rule']!r}"))
+        return body
+
+    def _delete_rule(q):
+        eng = _programs()
+        found = eng.delete_program(_rules_tenant(q), q.params["rule"])
+        require(found,
+                EntityNotFound(f"no rule program {q.params['rule']!r}"))
+        return {"deleted": q.params["rule"]}
+
+    r("GET", "/api/tenants/{token}/rules",
+      lambda q: {"programs":
+                 _programs().registry.list_programs(_rules_tenant(q))})
+    r("POST", "/api/tenants/{token}/rules", _put_rule)
+    r("GET", "/api/tenants/{token}/rules/{rule}", _get_rule)
+    r("PUT", "/api/tenants/{token}/rules/{rule}",
+      lambda q: _put_rule(q, q.params["rule"]))
+    r("DELETE", "/api/tenants/{token}/rules/{rule}", _delete_rule)
+
+    def rules_engine_stats(q):
+        return _programs().stats()
+    r("GET", "/api/rules/programs", rules_engine_stats)
+
+    def put_rule_attribute(q):
+        """Set one enrichment attribute (device or asset table) the
+        programs' metadata-join predicates compare against."""
+        from sitewhere_tpu.rules.dsl import RuleProgramError
+
+        eng = _programs()
+        body = q.json()
+        table = str(body.get("table", "device"))
+        token = body.get("token")
+        require(token, ValidationError("attribute needs entity 'token'"))
+        space = (inst.identity.asset if table == "asset"
+                 else inst.identity.device)
+        eid = space.lookup(str(token))
+        require(eid != NULL_ID,
+                EntityNotFound(f"no {table} {token!r}"))
+        require("column" in body and "value" in body,
+                ValidationError("attribute needs 'column' and 'value'"))
+        try:
+            eng.attributes.set(table, int(eid), str(body["column"]),
+                               int(body["value"]))
+        except RuleProgramError as e:
+            raise ValidationError(str(e)) from e
+        except (TypeError, ValueError) as e:
+            raise ValidationError(f"bad attribute value: {e}") from e
+        eng.refresh()
+        return {"table": table, "token": token,
+                "column": str(body["column"]),
+                "value": int(body["value"])}
+    r("POST", "/api/rules/attributes", put_rule_attribute)
+
     # ---- tracing (Jaeger-sampling analog; spans over REST) ----------------
     def get_traces(q):
         try:

@@ -187,7 +187,11 @@ class TestRepoGate:
                 "pipeline.step.pipeline_step",
                 "pipeline.sharded.build_sharded_packed_step.local_step",
                 "analytics.windows.aggregate_windows",
-                "analytics.query.window_eval"]
+                "analytics.query.window_eval",
+                # BYO rule-program kernels (rules/compile.py): the
+                # structure-keyed group eval + the shared prepare fold
+                "rules.compile.rules_group_eval",
+                "rules.compile.rules_prepare_batch"]
         for suffix in need:
             assert any(qn.endswith(suffix) for qn in traced), suffix
 

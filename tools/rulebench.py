@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""Rule-program bench: the bucketing guarantee + hot-swap contract,
+measured.
+
+Loads a skewed synthetic population of tenant rule programs (default
+100k across ~25k tenants) through the bring-your-own-rules compiler
+(``sitewhere_tpu/rules``) and reports:
+
+1. **Bucketing** — distinct structure keys and distinct COMPILED kernel
+   shapes after loading the whole population (the ≤10-shapes acceptance
+   bar; ``MAX_STRUCTURE_KEYS`` bounds it by construction) plus the
+   load/publish/warm wall time.
+2. **Eval throughput** — events/s through the compiled group kernels
+   (prepare fold + every structure group) vs the built-in dense
+   ``eval_threshold_rules`` path over the same event stream — the cost
+   of tenant-programmable rules relative to the fixed-function table.
+3. **Swap under traffic** — per-batch eval latency while a random
+   program's constants republish every few batches; reports p50/p99 for
+   the swap phase vs the quiet phase and asserts the kernel-executable
+   count stayed FLAT across every swap (operand swaps must never
+   recompile — the zero-stall contract).
+
+Usage::
+
+    python tools/rulebench.py [--programs 100000] [--tenants 25000]
+                              [--devices 4096] [--events 100000]
+                              [--batch 4096] [--smoke] [--json]
+
+Exit status is always 0 (reporting tool); the tier-1 smoke test asserts
+shape + sanity, like analytics_bench/hostpath_bench.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+_POLY = [[0.0, 0.0], [10.0, 0.0], [10.0, 10.0], [0.0, 10.0]]
+
+
+def _program_doc(rng, idx: int) -> dict:
+    """One synthetic program, drawn from a skewed structure mix.
+
+    The mix is deliberately lopsided — most tenants write simple
+    threshold specs — and most of the *spelling* diversity (thresholds,
+    ops, windows, polygons) is operand diversity, which must collapse
+    into the same handful of structure keys."""
+    token = f"p{idx}"
+    thr = float(rng.uniform(10.0, 90.0))
+    op = str(rng.choice(["gt", "lt", "gte", "lte"]))
+    level = str(rng.choice(["info", "warning", "error", "critical"]))
+    alert = {"type": f"byo.kind{int(rng.integers(0, 16))}",
+             "level": level}
+    shape = rng.random()
+    if shape < 0.55:
+        # simple threshold (the common tenant)
+        when = {"pred": "value", "op": op, "value": thr}
+    elif shape < 0.70:
+        # trailing trend: ewma + rate in one clause
+        when = {"all": [
+            {"pred": "ewma", "op": op, "value": thr,
+             "window_s": float(rng.choice([60, 600, 3600]))},
+            {"pred": "rate", "op": "gt",
+             "value": float(rng.uniform(0.1, 5.0))}]}
+    elif shape < 0.82:
+        # multi-clause disjunction
+        when = {"any": [
+            {"pred": "value", "op": "gt", "value": thr},
+            {"pred": "value", "op": "lt", "value": thr - 30.0},
+            {"all": [{"pred": "rate", "op": "gt", "value": 1.0},
+                     {"pred": "value", "op": "gt", "value": thr - 10.0}]}]}
+    elif shape < 0.90:
+        # geofence containment
+        jx, jy = rng.uniform(-2, 2, 2)
+        poly = [[x + jx, y + jy] for x, y in _POLY]
+        when = {"pred": "geo", "polygon": poly,
+                "inside": bool(rng.random() < 0.5)}
+    elif shape < 0.95:
+        # wide conjunction with metadata joins (c4p8 bucket)
+        when = {"any": [
+            {"all": [
+                {"pred": "value", "op": "gt", "value": thr},
+                {"pred": "attr", "table": "device", "column": "tier",
+                 "value": int(rng.integers(0, 4)), "op": "eq"},
+                {"pred": "event_type", "value": "measurement"},
+                {"pred": "ewma", "op": "gt", "value": thr - 5.0,
+                 "window_s": 600.0},
+                {"pred": "rate", "op": "gt", "value": 0.5}]},
+            {"all": [{"pred": "value", "op": "lt", "value": 5.0}]},
+            {"all": [{"pred": "value", "op": "gt", "value": 95.0}]}]}
+    else:
+        # geo + float lanes combined
+        when = {"any": [
+            {"all": [{"pred": "geo", "polygon": _POLY, "inside": True},
+                     {"pred": "value", "op": "gt", "value": thr}]},
+            {"all": [{"pred": "rate", "op": "gt", "value": 2.0}]},
+            {"all": [{"pred": "value", "op": "lt", "value": 2.0}]}]}
+    return {"token": token, "name": f"bench-{idx}", "alert": alert,
+            "when": when}
+
+
+def _stream(rng, n_events, n_devices, n_tenants, batch):
+    """Synthetic telemetry batches (measurements + some locations)."""
+    from sitewhere_tpu.schema import EventType
+
+    out = []
+    t0 = 1_753_800_000
+    for lo in range(0, n_events, batch):
+        n = min(batch, n_events - lo)
+        et = np.where(rng.random(n) < 0.9,
+                      int(EventType.MEASUREMENT),
+                      int(EventType.LOCATION)).astype(np.int32)
+        out.append({
+            "device_id": rng.integers(0, n_devices, n).astype(np.int32),
+            "tenant_id": rng.integers(0, n_tenants, n).astype(np.int32),
+            "event_type": et,
+            "mtype_id": rng.integers(0, 4, n).astype(np.int32),
+            "value": rng.uniform(0.0, 100.0, n).astype(np.float32),
+            "lon": rng.uniform(-5.0, 15.0, n).astype(np.float32),
+            "lat": rng.uniform(-5.0, 15.0, n).astype(np.float32),
+            "ts_s": (t0 + lo + np.arange(n)).astype(np.int32),
+            "ts_ns": np.zeros(n, np.int32),
+            "asset_id": np.full(n, -1, np.int32),
+        })
+    return out
+
+
+def run(n_programs: int = 100_000, n_tenants: int = 25_000,
+        n_devices: int = 4096, n_events: int = 100_000,
+        batch: int = 4096, swap_every: int = 8, seed: int = 11):
+    from sitewhere_tpu.rules import compile as rcompile
+    from sitewhere_tpu.rules.dsl import MAX_STRUCTURE_KEYS
+    from sitewhere_tpu.rules.engine import RuleEngineRunner
+
+    rng = np.random.default_rng(seed)
+    result = {"programs": n_programs, "tenants": n_tenants,
+              "devices": n_devices, "events": n_events, "batch": batch,
+              "max_structure_keys": MAX_STRUCTURE_KEYS}
+
+    rcompile.reset_trace_cache()
+    eng = RuleEngineRunner(
+        capacity=n_devices, n_mtype_slots=4,
+        # the population is uniform over tenants, so per-tenant-per-
+        # structure collisions follow a birthday bound; 8 slots holds
+        # 100k over 25k tenants comfortably
+        programs_per_tenant=8, max_programs=max(n_programs, 1024),
+        queue_depth=4)
+    alerts = [0]
+    eng.inject = lambda cols: alerts.__setitem__(
+        0, alerts[0] + len(cols["device_id"]))
+
+    # ---- 1. load + publish + warm (compile) time
+    t0 = time.perf_counter()
+    loaded = 0
+    for i in range(n_programs):
+        doc = _program_doc(rng, i)
+        tenant = int(rng.integers(0, n_tenants))
+        try:
+            eng.registry.put_program(tenant, doc)
+            loaded += 1
+        except Exception:
+            # per-tenant structure-slot collision in the random draw —
+            # counted, not fatal (real tenants hit a 400 at POST)
+            pass
+    t_put = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    eng.refresh()  # single publish: builds every group + warms kernels
+    t_publish = time.perf_counter() - t0
+    result["programs_loaded"] = loaded
+    result["programs_rejected"] = n_programs - loaded
+    result["structure_keys"] = eng.registry.structure_keys()
+    result["compiled_shapes"] = rcompile.structure_keys_compiled()
+    result["load_s"] = round(t_put, 3)
+    result["publish_and_warm_s"] = round(t_publish, 3)
+    result["shapes_within_bound"] = (
+        result["compiled_shapes"] <= MAX_STRUCTURE_KEYS)
+
+    # ---- 2. eval throughput: compiled groups vs built-in dense table
+    batches = _stream(rng, n_events, n_devices, n_tenants, batch)
+    eng._eval_batch(dict(batches[0]))  # warm the batch width
+    t0 = time.perf_counter()
+    for b in batches:
+        eng._eval_batch(dict(b))
+    dt = time.perf_counter() - t0
+    result["eval_events_per_s"] = round(n_events / dt, 1)
+    result["alerts_fired"] = alerts[0]
+    result["builtin_events_per_s"] = _builtin_throughput(
+        batches, n_devices)
+    result["relative_cost"] = round(
+        result["builtin_events_per_s"]
+        / max(result["eval_events_per_s"], 1e-9), 2)
+
+    # ---- 3. swap under traffic: operand republish must not recompile
+    quiet: list = []
+    swap_lat: list = []
+    executables_before = rcompile.compile_count()
+    swaps_before = eng.registry.swaps
+    for i, b in enumerate(batches):
+        if i and i % swap_every == 0:
+            # operand-only mutation: same token, same structure, new
+            # constants — the hot-swap the zero-stall contract covers
+            idx = int(rng.integers(0, n_programs))
+            doc = _program_doc(np.random.default_rng(seed + idx), idx)
+            tenant = int(rng.integers(0, n_tenants))
+            try:
+                eng.put_program(tenant, doc)
+            except Exception:
+                pass
+        t0 = time.perf_counter()
+        eng._eval_batch(dict(b))
+        (swap_lat if i % swap_every == 0 and i else quiet).append(
+            time.perf_counter() - t0)
+    result["swaps_applied"] = eng.registry.swaps - swaps_before
+    result["recompiles_during_swaps"] = (
+        rcompile.compile_count() - executables_before)
+    if quiet:
+        result["quiet_p50_ms"] = round(
+            float(np.percentile(quiet, 50)) * 1e3, 3)
+        result["quiet_p99_ms"] = round(
+            float(np.percentile(quiet, 99)) * 1e3, 3)
+    if swap_lat:
+        result["swap_p50_ms"] = round(
+            float(np.percentile(swap_lat, 50)) * 1e3, 3)
+        result["swap_p99_ms"] = round(
+            float(np.percentile(swap_lat, 99)) * 1e3, 3)
+    return result
+
+
+def _builtin_throughput(batches, n_devices: int) -> float:
+    """The fixed-function comparison: the dense [B, R] built-in
+    threshold kernel over the same stream (1024 rules, one compile)."""
+    import jax.numpy as jnp
+
+    from sitewhere_tpu.ids import NULL_ID
+    from sitewhere_tpu.pipeline.step import eval_threshold_rules
+    from sitewhere_tpu.schema import (
+        DeviceState,
+        EventBatch,
+        RuleKind,
+        RuleTable,
+    )
+
+    R = 1024
+    rng = np.random.default_rng(3)
+    rules = RuleTable.empty(R)
+    rules = RuleTable(
+        active=jnp.ones(R, bool),
+        tenant_id=jnp.full(R, NULL_ID, jnp.int32),
+        mtype_id=jnp.full(R, NULL_ID, jnp.int32),
+        op=jnp.asarray(rng.integers(0, 4, R), jnp.int32),
+        threshold=jnp.asarray(rng.uniform(10, 90, R), jnp.float32),
+        alert_code=jnp.arange(R, dtype=jnp.int32),
+        alert_level=jnp.ones(R, jnp.int32),
+        kind=jnp.full(R, int(RuleKind.INSTANT), jnp.int32),
+        window_idx=jnp.zeros(R, jnp.int32),
+        ewma_tau_s=rules.ewma_tau_s,
+    )
+    state = DeviceState.empty(n_devices, num_mtype_slots=4)
+    jitted = jax.jit(eval_threshold_rules)
+
+    def to_batch(cols):
+        n = len(cols["device_id"])
+        eb = EventBatch.empty(n)
+        return eb.replace(
+            valid=jnp.ones(n, bool),
+            device_id=jnp.asarray(cols["device_id"]),
+            tenant_id=jnp.asarray(cols["tenant_id"]),
+            event_type=jnp.asarray(cols["event_type"]),
+            mtype_id=jnp.asarray(cols["mtype_id"]),
+            value=jnp.asarray(cols["value"]),
+            ts_s=jnp.asarray(cols["ts_s"]),
+            ts_ns=jnp.asarray(cols["ts_ns"]),
+        )
+
+    eb = to_batch(batches[0])
+    acc = jnp.ones(len(batches[0]["device_id"]), bool)
+    jax.block_until_ready(jitted(rules, state, eb, acc))  # warm
+    n_events = sum(len(b["device_id"]) for b in batches)
+    t0 = time.perf_counter()
+    for b in batches:
+        eb = to_batch(b)
+        acc = jnp.ones(len(b["device_id"]), bool)
+        out = jitted(rules, state, eb, acc)
+    jax.block_until_ready(out)
+    return round(n_events / (time.perf_counter() - t0), 1)
+
+
+def _render(r) -> str:
+    lines = [
+        f"rule-program bench — {r['programs_loaded']} programs, "
+        f"{r['tenants']} tenants, {r['events']} events, "
+        f"batch {r['batch']}",
+        f"  structure keys   : {len(r['structure_keys'])} "
+        f"({', '.join(r['structure_keys'])})",
+        f"  compiled shapes  : {r['compiled_shapes']} "
+        f"(bound {r['max_structure_keys']}; "
+        f"{'OK' if r['shapes_within_bound'] else 'EXCEEDED'})",
+        f"  load / publish   : {r['load_s']:.2f} s / "
+        f"{r['publish_and_warm_s']:.2f} s",
+        f"  compiled eval    : {r['eval_events_per_s']:>12,.0f} ev/s "
+        f"({r['alerts_fired']} alerts)",
+        f"  built-in table   : {r['builtin_events_per_s']:>12,.0f} ev/s "
+        f"({r['relative_cost']}x)",
+        f"  swap under load  : {r['swaps_applied']} swaps, "
+        f"{r['recompiles_during_swaps']} recompiles",
+    ]
+    if "swap_p99_ms" in r:
+        lines.append(
+            f"  eval latency     : quiet p50/p99 "
+            f"{r.get('quiet_p50_ms', 0)}/{r.get('quiet_p99_ms', 0)} ms, "
+            f"swap-batch p50/p99 "
+            f"{r['swap_p50_ms']}/{r['swap_p99_ms']} ms")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--programs", type=int, default=100_000)
+    ap.add_argument("--tenants", type=int, default=25_000)
+    ap.add_argument("--devices", type=int, default=4096)
+    ap.add_argument("--events", type=int, default=100_000)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--swap-every", type=int, default=8)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small population (tier-1 CI sizing)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        result = run(n_programs=512, n_tenants=64, n_devices=256,
+                     n_events=8192, batch=1024, swap_every=4)
+    else:
+        result = run(args.programs, args.tenants, args.devices,
+                     args.events, args.batch, args.swap_every)
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(_render(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
