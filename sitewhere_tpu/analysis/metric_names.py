@@ -118,6 +118,12 @@ FAMILIES: Dict[str, Optional[Set[str]]] = {
     "tenant.usage.eval_s": None,      # metered rule/analytics eval time
     "tenant.share": None,             # window row share ∈ [0, 1]
     "tenant.shed": None,              # admission sheds (overload ladder)
+    # multitenant isolation (runtime/overload.py TenantBudgets,
+    # runtime/metering.py QuotaTable, state/manager.py TenantPartitions):
+    # CLOSED — these are instance-wide counters/gauges, never per-token
+    "tenant.budget": {"clipped_rows"},
+    "tenant.quota": {"refusals", "eval_rows_skipped"},
+    "tenant.partition": {"tracked", "compiles", "resizes"},
     # bring-your-own-rules compiler/engine (sitewhere_tpu/rules): the
     # bucketing guarantee made observable — compiled_shapes is the gauge
     # tools/rulebench.py asserts stays ≤ MAX_STRUCTURE_KEYS at 100k
@@ -132,9 +138,11 @@ FAMILIES: Dict[str, Optional[Set[str]]] = {
         "eval_s",
     },
 }
-# prefixes where EVERY name must resolve to a declared family (MN003)
+# prefixes where EVERY name must resolve to a declared family (MN003).
+# "tenants." (plural) is reserved alongside "tenant." so a typo'd
+# namespace cannot silently mint ungoverned per-tenant series.
 GOVERNED_PREFIXES = ("device.", "slo.", "store.", "forward.", "tenant.",
-                     "rules.")
+                     "tenants.", "rules.")
 
 
 def family_of(name: str) -> Optional[str]:

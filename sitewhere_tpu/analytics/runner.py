@@ -621,6 +621,10 @@ class QueryRunner(LifecycleComponent):
         # tenant metering hook (instance-wired UsageLedger): each live
         # eval batch bills its wall time to tenants by row share
         self.usage_ledger = None
+        # metered-quota table (runtime/metering.py QuotaTable): rows of
+        # deprioritized/refused tenants are dropped before eval on this
+        # worker thread — never on the dispatcher's ingest path
+        self.quotas = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -953,6 +957,36 @@ class QueryRunner(LifecycleComponent):
                     tally=(), committed: Optional[int] = None) -> None:
         from sitewhere_tpu.runtime.tracing import _NOOP_TRACE
 
+        if self.quotas is not None and "tenant_id" in batch:
+            # quota gate: over-soft-quota tenants are deprioritized by
+            # dropping their rows here; mask is None when no quota is
+            # configured so un-metered deployments pay one branch
+            try:
+                skip = self.quotas.skip_mask(np.asarray(batch["tenant_id"]))
+            except Exception:
+                logging.getLogger("sitewhere_tpu.analytics").exception(
+                    "analytics quota mask failed")
+                skip = None
+            if skip is not None and skip.any():
+                keep = ~skip
+                n = len(skip)
+                if not keep.any():
+                    # still advance the applied watermark: the rows were
+                    # consumed (and refused), not lost
+                    with self._eval_mutex:
+                        for ref, count in tally:
+                            self._applied_partial[ref] = \
+                                self._applied_partial.get(ref, 0) + count
+                        if committed is not None \
+                                and committed > (self.applied_upto or 0):
+                            self.applied_upto = committed
+                            for ref in [r for r in self._applied_partial
+                                        if r < committed]:
+                                del self._applied_partial[ref]
+                    return
+                batch = {k: (np.asarray(v)[keep]
+                             if np.ndim(v) >= 1 and len(v) == n else v)
+                         for k, v in batch.items()}
         with self._lock:
             entries = list(self._queries.values())
         trace = (self.tracer.trace("analytics.eval")

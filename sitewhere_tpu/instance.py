@@ -293,6 +293,8 @@ class Instance(LifecycleComponent):
                 Watermarks,
             )
 
+            from sitewhere_tpu.runtime.overload import TenantBudgets
+
             self.overload = OverloadController(
                 watermarks=Watermarks().replace(
                     self.config.get("overload.watermarks") or {}),
@@ -308,10 +310,17 @@ class Instance(LifecycleComponent):
                     "overload.degraded_telemetry_rate_per_s", 10_000.0)),
                 degraded_telemetry_burst=float(self.config.get(
                     "overload.degraded_telemetry_burst", 20_000.0)),
+                budget_refresh_s=float(self.config.get(
+                    "overload.budget_refresh_s", 5.0)),
                 signals_fn=self._overload_signals,
                 metrics=self.metrics,
                 tracer=self.tracer,
             )
+            # per-tenant budget overlays (tenants.<token>.overload.*):
+            # configured ceilings that compose with — never replace —
+            # the ledger's measured-share scaling (min of the two)
+            self.overload.set_tenant_budgets(
+                TenantBudgets.from_config(self.config.get("tenants")))
             self.labels.load_gate = self.overload.allow_optional
             if self.flightrec is not None:
                 # every ladder move dumps the recorder: the batches
@@ -347,6 +356,51 @@ class Instance(LifecycleComponent):
                 self.overload.set_usage_ledger(
                     self.usage_ledger, resolve=self._tenant_dense_id)
             self.event_store.usage_ledger = self.usage_ledger
+
+        # Metered quotas (runtime/metering.py QuotaTable): per-tenant
+        # rule/analytics eval-seconds budgets over the ledger's sliding
+        # window — deprioritize (live rows skipped) then refuse (429)
+        # as the window fills; NEVER consulted on the ingest hot path.
+        self.quotas = None
+        if self.usage_ledger is not None and bool(self.config.get(
+                "metering.quota.enabled", True)):
+            from sitewhere_tpu.runtime.metering import QuotaTable
+
+            self.quotas = QuotaTable(
+                self.usage_ledger,
+                default_eval_s=self.config.get(
+                    "metering.quota.eval_s_per_window"),
+                soft_frac=float(self.config.get(
+                    "metering.quota.soft_frac", 0.8)),
+                metrics=self.metrics,
+            )
+            tenants_cfg = self.config.get("tenants")
+            if isinstance(tenants_cfg, dict):
+                for tok, overlay in tenants_cfg.items():
+                    quota = (overlay.get("quota")
+                             if isinstance(overlay, dict) else None)
+                    if isinstance(quota, dict) \
+                            and "eval_s_per_window" in quota:
+                        self.quotas.set_quota(
+                            self._tenant_dense_id(str(tok)),
+                            float(quota["eval_s_per_window"]))
+
+        # Tenant-partitioned device-state views (state/manager.py
+        # TenantPartitions): pow2 rung ladders per tenant over the
+        # registry mirror's tenant column, so one tenant's registration
+        # churn resizes/recompiles only its own partition view
+        _mirror = self.mirror
+
+        def _tenant_column():
+            import numpy as np
+
+            return np.where(_mirror.active, _mirror.tenant_id, NULL_ID)
+
+        self.device_state.attach_partitions(
+            _tenant_column,
+            min_capacity=int(self.config.get(
+                "state.partition_min_capacity", 64)),
+            metrics=self.metrics)
 
         # domain services the dispatcher egresses into — registered as
         # children BEFORE it so the reverse-order stop keeps them alive
@@ -409,6 +463,7 @@ class Instance(LifecycleComponent):
                     "analytics.fanout_matches", True)),
             ))
             self.analytics.usage_ledger = self.usage_ledger
+            self.analytics.quotas = self.quotas
         # Bring-your-own-rules (rules/ subsystem): per-tenant declarative
         # rule & enrichment programs compiled into per-structure batched
         # kernels.  Same egress-offer lifecycle as analytics — added
@@ -436,6 +491,7 @@ class Instance(LifecycleComponent):
                     "rules.queue_depth", 64)),
             ))
             self.rule_engine.usage_ledger = self.usage_ledger
+            self.rule_engine.quotas = self.quotas
         self.registration = self.add_child(RegistrationManager(
             self.device_management,
             default_device_type=self.config.get("registration.default_device_type"),
@@ -1567,6 +1623,12 @@ class Instance(LifecycleComponent):
           refused (the audit/replay half of the shedding contract) —
           admission applies again, so a requeue during a STILL-overloaded
           window is refused, not silently re-shed.
+        - ``tenant-budget``: same replay path as ``intake-shed``, for
+          sheds the tenant's CONFIGURED budget overlay caused.  Replay
+          re-checks the tenant's CURRENT budget — re-ingest runs the
+          composed admission again, so a tenant still over its budget
+          is refused (with the budget named), and one whose budget was
+          raised (or whose window drained) gets the rows back.
         - ``forward-shed``: re-route remote-owned rows the forwarder's
           shed-retention bound forced out — back through
           ``HostForwarder.ingest_payload`` so ownership recomputes and
@@ -1615,7 +1677,7 @@ class Instance(LifecycleComponent):
             return {"requeued": True, "kind": kind,
                     "rows": payload.count(b"\n") + 1}
         if kind in ("failed-decode", "failed-stream-request",
-                    "intake-shed") and "payload" in doc:
+                    "intake-shed", "tenant-budget") and "payload" in doc:
             payload = bytes.fromhex(doc["payload"])
             try:
                 reqs = decoder(payload)
@@ -1632,15 +1694,30 @@ class Instance(LifecycleComponent):
             from sitewhere_tpu.runtime.overload import OverloadShed
 
             events = [r for r in reqs if r.event_type is not None]
+            if kind == "tenant-budget" and events:
+                # budget replay carries the shedding tenant: re-stamp
+                # rows that lost their metadata so the re-ingest below
+                # re-checks THAT tenant's current composed budget, not
+                # the default tenant's
+                tenant = doc.get("tenant")
+                if tenant:
+                    for r in events:
+                        if r.metadata is None or "tenant" not in r.metadata:
+                            r.metadata = dict(r.metadata or {},
+                                              tenant=tenant)
             if events:
                 try:
                     self.dispatcher.ingest_many(events, payload,
                                                 source_id="requeue")
                 except OverloadShed as e:
-                    # still overloaded: the record stays un-requeued so
-                    # the operator can retry after recovery
+                    # still overloaded / still over budget: the record
+                    # stays un-requeued so the operator can retry after
+                    # recovery (or after raising the tenant's budget)
+                    reason = ("still over tenant budget"
+                              if kind == "tenant-budget"
+                              else "refused by admission")
                     return {"requeued": False, "kind": kind,
-                            "reason": f"refused by admission: {e}"}
+                            "reason": f"{reason}: {e}"}
             rows = len(events)
             for r in reqs:
                 if r.event_type is not None:

@@ -94,6 +94,11 @@ class RuleEngineRunner(LifecycleComponent):
         # dispatcher hooks (instance-wired): alert re-injection
         self.inject = None
         self.usage_ledger = None
+        # metered-quota table (runtime/metering.py QuotaTable): rows of
+        # deprioritized-or-refused tenants are skipped before eval —
+        # enforcement happens HERE on the worker thread, never on the
+        # dispatcher egress path that offers the batch
+        self.quotas = None
         # rules.* metric family (closed; analysis/metric_names.py)
         self._m_programs = metrics.gauge("rules.programs")
         self._m_groups = metrics.gauge("rules.groups")
@@ -280,6 +285,23 @@ class RuleEngineRunner(LifecycleComponent):
         epoch = self.registry.current_epoch()
         if epoch is None:
             return
+        if self.quotas is not None and "tenant_id" in batch:
+            # quota gate: deprioritized/refused tenants lose their rows
+            # here (off the hot path); the mask is None when no quota
+            # is configured so un-metered deployments pay one branch
+            try:
+                skip = self.quotas.skip_mask(np.asarray(batch["tenant_id"]))
+            except Exception:
+                _LOG.exception("rules quota mask failed")
+                skip = None
+            if skip is not None and skip.any():
+                keep = ~skip
+                if not keep.any():
+                    return
+                n = len(skip)
+                batch = {k: (np.asarray(v)[keep]
+                             if np.ndim(v) >= 1 and len(v) == n else v)
+                         for k, v in batch.items()}
         attrs = self.attributes.publish()
         t0 = time.perf_counter()
         fired_out: List[Tuple[np.ndarray, ...]] = []

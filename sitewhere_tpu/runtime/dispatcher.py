@@ -717,21 +717,33 @@ class PipelineDispatcher(LifecycleComponent):
             self._m_stage["h2d"].observe(time.perf_counter() - t0)
 
     def _shed_intake(self, payload: bytes, shed: Dict[object, int],
-                     source_id: str, tenant: str) -> None:
+                     source_id: str, tenant: str,
+                     budget_bound: bool = False) -> None:
         """Audit one intake shed: dead-letter the payload with reason +
-        per-class counts (kind ``intake-shed``) so shedding is
-        inspectable AND replayable (``requeue_dead_letter`` re-drives it
-        like a failed decode once the overload clears)."""
-        dead_letter(self.dead_letters, {
-            "kind": "intake-shed",
+        per-class counts so shedding is inspectable AND replayable
+        (``requeue_dead_letter`` re-drives it like a failed decode once
+        the overload clears).  Sheds the tenant's CONFIGURED budget
+        overlay caused carry their own kind ``tenant-budget`` (with the
+        budget that clipped them) — distinct from the generic
+        ``intake-shed``, so an operator can tell "the fleet was
+        overloaded" from "this tenant outran the budget it bought";
+        replay re-applies the tenant's CURRENT budget either way."""
+        doc = {
+            "kind": "tenant-budget" if budget_bound else "intake-shed",
             "state": self.overload.state.name,
-            "reason": self.overload.last_driver or "admission",
+            "reason": ("tenant budget exceeded" if budget_bound
+                       else self.overload.last_driver or "admission"),
             "classes": {cls.name.lower(): int(n)
                         for cls, n in shed.items()},
             "source": source_id,
             "tenant": tenant,
             "payload": payload.hex(),
-        })
+        }
+        if budget_bound:
+            overlay = self.overload.tenant_budgets.overlay(tenant)
+            if overlay:
+                doc["budget"] = overlay
+        dead_letter(self.dead_letters, doc)
         if self.usage_ledger is not None:
             try:
                 self.usage_ledger.charge(
@@ -751,19 +763,24 @@ class PipelineDispatcher(LifecycleComponent):
         admitted: List[DecodedRequest] = []
         shed: Dict[object, int] = {}
         worst = None
+        budget_bound = False
         for req in reqs:
             cls = classify_event_type(int(req.event_type))
             tenant = (req.metadata.get("tenant", "default")
                       if req.metadata else "default")
-            if self.overload.admit(cls, tenant=tenant, source=source_id):
+            ok, reason = self.overload.admit_detail(
+                cls, tenant=tenant, source=source_id)
+            if ok:
                 admitted.append(req)
             else:
                 shed[cls] = shed.get(cls, 0) + 1
                 worst = cls
+                budget_bound = budget_bound or reason == "budget"
         if shed:
             tenant = (reqs[0].metadata.get("tenant", "default")
                       if reqs[0].metadata else "default")
-            self._shed_intake(payload, shed, source_id, tenant)
+            self._shed_intake(payload, shed, source_id, tenant,
+                              budget_bound=budget_bound)
         if not admitted and shed:
             raise self.overload.shed_exception(worst)
         return admitted
@@ -926,16 +943,21 @@ class PipelineDispatcher(LifecycleComponent):
             np.int32(int(PriorityClass.COMMAND)))
         keep = np.ones(n, bool)
         shed: Dict[object, int] = {}
+        budget_bound = False
         for cls in (PriorityClass.TELEMETRY, PriorityClass.COMMAND):
             m = classes == int(cls)
             count = int(m.sum())
-            if count and not self.overload.admit(
-                    cls, source=source_id, n=count):
-                keep &= ~m
-                shed[cls] = count
+            if count:
+                ok, reason = self.overload.admit_detail(
+                    cls, source=source_id, n=count)
+                if not ok:
+                    keep &= ~m
+                    shed[cls] = count
+                    budget_bound = budget_bound or reason == "budget"
         if not shed:
             return columns, shed
-        self._shed_intake(payload, shed, source_id, "default")
+        self._shed_intake(payload, shed, source_id, "default",
+                          budget_bound=budget_bound)
         if not keep.any():
             return None, shed
         # decoded columns mix ndarrays (event_type, ts, values) and
@@ -1015,11 +1037,13 @@ class PipelineDispatcher(LifecycleComponent):
         if self.overload is not None:
             from sitewhere_tpu.runtime.overload import PriorityClass
 
-            if not self.overload.admit(PriorityClass.TELEMETRY,
-                                       source=source_id, n=n):
+            ok, reason = self.overload.admit_detail(
+                PriorityClass.TELEMETRY, source=source_id, n=n)
+            if not ok:
                 res.abort()
                 self._shed_intake(payload, {PriorityClass.TELEMETRY: n},
-                                  source_id, "default")
+                                  source_id, "default",
+                                  budget_bound=reason == "budget")
                 raise self.overload.shed_exception(PriorityClass.TELEMETRY)
         ref = NULL_ID
         if self.journal is not None and payload:

@@ -247,12 +247,16 @@ def attribute_block(block: np.ndarray,
 
 
 class _WindowSlice:
-    __slots__ = ("start", "rows", "total")
+    __slots__ = ("start", "rows", "total", "eval_s")
 
     def __init__(self, start: float):
         self.start = start
         self.rows: Dict[int, float] = {}
         self.total = 0.0
+        # per-tenant metered eval seconds (rules + analytics) charged
+        # while this slice was current — the quota denominator rotates
+        # with the window, so an over-quota refusal clears by itself
+        self.eval_s: Dict[int, float] = {}
 
 
 class UsageLedger:
@@ -348,6 +352,10 @@ class UsageLedger:
             return
         with self._lock:
             self._totals[counter] += amount
+            if counter == "eval_s":
+                sl = self._slice(self._clock())
+                sl.eval_s[int(tenant)] = (
+                    sl.eval_s.get(int(tenant), 0.0) + amount)
             if counter in self._RANK_COUNTERS:
                 self._cm.add(tenant, int(amount))
                 self._offer_locked(tenant, int(amount))
@@ -450,10 +458,13 @@ class UsageLedger:
             per = np.bincount(inverse, weights=weights,
                               minlength=len(tenants))
         with self._lock:
+            sl = self._slice(self._clock()) if counter == "eval_s" else None
             for t, amount in zip(tenants.tolist(), per.tolist()):
                 if amount == 0 or t < 0:
                     continue
                 self._totals[counter] += amount
+                if sl is not None:
+                    sl.eval_s[t] = sl.eval_s.get(t, 0.0) + amount
                 row = self._row_locked(t)
                 if row is not None:
                     row[counter] += amount
@@ -485,6 +496,19 @@ class UsageLedger:
                 for t, r in sl.rows.items():
                     agg[t] = agg.get(t, 0.0) + r
             return {t: r / total for t, r in agg.items()}
+
+    def windowed_eval_s(self, tenant: int,
+                        now: Optional[float] = None) -> float:
+        """Metered eval seconds (rules + analytics) this tenant spent
+        inside the CURRENT sliding window — the quota denominator.  Like
+        :meth:`shares`, rotating a slice off the window forgets its
+        charges, so quota refusals clear without any reset call."""
+        self.flush_pending()
+        now = self._clock() if now is None else now
+        tenant = int(tenant)
+        with self._lock:
+            self._slice(now)
+            return sum(sl.eval_s.get(tenant, 0.0) for sl in self._window)
 
     def rate_scale(self, tenant: int, now: Optional[float] = None) -> float:
         """DEGRADED-budget multiplier from measured share: 1.0 while a
@@ -686,7 +710,130 @@ class UsageLedger:
             self._window.clear()
 
 
+class QuotaTable:
+    """Per-tenant metered eval quotas over the ledger's sliding window.
+
+    The enforcement half of ROADMAP item 5's quota story: the ledger
+    already bills rule-program and analytics eval wall time to tenants
+    (``eval_s``, windowed per slice); this table turns that denominator
+    into a two-step ladder —
+
+    - ``deprioritized`` (≥ ``soft_frac`` × quota): the tenant's rows are
+      SKIPPED by the live rule/analytics eval lanes (counted under
+      ``tenant.quota.eval_rows_skipped``), but operator surfaces still
+      work.
+    - ``refused`` (≥ quota): REST eval surfaces (rule program writes,
+      retrospective analytics runs) raise :class:`QuotaExceeded` — a
+      retryable 429 that clears as the usage window rotates.
+
+    The ingest hot path NEVER consults this table: quotas bound metered
+    compute, not telemetry admission (that is the overload ladder's
+    job).  Quotas are configured per tenant (``tenants.<token>.quota.
+    eval_s_per_window``) with an optional instance-wide default
+    (``metering.quota.eval_s_per_window``); a tenant with neither is
+    unlimited.
+    """
+
+    def __init__(self, ledger: UsageLedger,
+                 default_eval_s: Optional[float] = None,
+                 soft_frac: float = 0.8,
+                 metrics=None):
+        self.ledger = ledger
+        self.default_eval_s = (None if default_eval_s is None
+                               else float(default_eval_s))
+        self.soft_frac = float(soft_frac)
+        self._quotas: Dict[int, float] = {}
+        self._m_refusals = None
+        self._m_skipped = None
+        if metrics is not None:
+            self.bind_metrics(metrics)
+
+    def bind_metrics(self, metrics) -> None:
+        self._m_refusals = metrics.counter("tenant.quota.refusals")
+        self._m_skipped = metrics.counter("tenant.quota.eval_rows_skipped")
+
+    def set_quota(self, tenant: int,
+                  eval_s_per_window: Optional[float]) -> None:
+        """Configure one tenant's eval-seconds-per-window quota (None
+        removes the override, falling back to the default)."""
+        if eval_s_per_window is None:
+            self._quotas.pop(int(tenant), None)
+        else:
+            self._quotas[int(tenant)] = float(eval_s_per_window)
+
+    def quota_of(self, tenant: int) -> Optional[float]:
+        return self._quotas.get(int(tenant), self.default_eval_s)
+
+    def state_of(self, tenant: int, now: Optional[float] = None) -> str:
+        """``ok`` | ``deprioritized`` | ``refused`` for one tenant."""
+        quota = self.quota_of(tenant)
+        if quota is None or quota <= 0:
+            return "ok"
+        used = self.ledger.windowed_eval_s(tenant, now)
+        if used >= quota:
+            return "refused"
+        if used >= quota * self.soft_frac:
+            return "deprioritized"
+        return "ok"
+
+    def consumption(self, tenant: int,
+                    now: Optional[float] = None) -> Dict[str, object]:
+        """The REST drill-down body: quota, windowed consumption,
+        remaining headroom, and the enforcement state."""
+        quota = self.quota_of(tenant)
+        used = self.ledger.windowed_eval_s(tenant, now)
+        body: Dict[str, object] = {
+            "eval_s_used": round(used, 6),
+            "eval_s_quota": quota,
+            "window_s": self.ledger.window_s,
+            "state": "ok",
+        }
+        if quota is not None and quota > 0:
+            body["eval_s_remaining"] = round(max(0.0, quota - used), 6)
+            body["state"] = self.state_of(tenant, now)
+        return body
+
+    def check_eval(self, tenant: int, now: Optional[float] = None) -> None:
+        """Gate one REST eval operation; raises :class:`QuotaExceeded`
+        (retryable 429) when the tenant's window is exhausted."""
+        if self.state_of(tenant, now) != "refused":
+            return
+        if self._m_refusals is not None:
+            self._m_refusals.inc()
+        from sitewhere_tpu.services.common import QuotaExceeded
+
+        quota = self.quota_of(tenant)
+        raise QuotaExceeded(
+            f"tenant eval quota exhausted "
+            f"({self.ledger.windowed_eval_s(tenant, now):.3f}s of "
+            f"{quota:.3f}s this {self.ledger.window_s:.0f}s window); "
+            f"retry after the window rotates")
+
+    def skip_mask(self, tenant_ids,
+                  now: Optional[float] = None) -> Optional[np.ndarray]:
+        """Boolean mask of rows whose tenant is deprioritized-or-worse
+        (the live eval lanes drop those rows, counted); None when no
+        tenant in the batch is throttled — the common case costs one
+        unique() and a dict probe per distinct tenant."""
+        if not self._quotas and self.default_eval_s is None:
+            return None
+        ids = np.asarray(tenant_ids)
+        if ids.size == 0:
+            return None
+        skip = None
+        for t in np.unique(ids).tolist():
+            if t < 0 or self.state_of(t, now) == "ok":
+                continue
+            if skip is None:
+                skip = np.zeros(ids.shape, bool)
+            skip |= ids == t
+        if skip is not None and self._m_skipped is not None:
+            self._m_skipped.inc(int(skip.sum()))
+        return skip
+
+
 __all__ = [
-    "CountMin", "SpaceSaving", "UsageLedger", "attribute_block",
+    "CountMin", "SpaceSaving", "UsageLedger", "QuotaTable",
+    "attribute_block",
     "USAGE_COUNTERS", "USAGE_ROW_COUNTERS", "USAGE_TIME_COUNTERS",
 ]

@@ -78,6 +78,7 @@ __all__ = [
     "OverloadSignals",
     "Watermarks",
     "TokenBucket",
+    "TenantBudgets",
     "OverloadController",
 ]
 
@@ -216,6 +217,16 @@ class TokenBucket:
         self._at = clock()
         self._lock = threading.Lock()
 
+    def set_rate(self, rate_per_s: float, burst: float) -> None:
+        """Re-derive the bucket's rate IN PLACE (the budget-refresh
+        path): accrued tokens clamp to the new burst — a tightened
+        budget takes effect immediately, and a loosened one never
+        grants a fresh full burst mid-episode."""
+        with self._lock:
+            self.rate_per_s = float(rate_per_s)
+            self.burst = float(burst)
+            self._tokens = min(self._tokens, self.burst)
+
     def try_take(self, n: float = 1.0,
                  now: Optional[float] = None) -> bool:
         now = self._clock() if now is None else now
@@ -228,6 +239,81 @@ class TokenBucket:
                 self._tokens -= n
                 return True
             return False
+
+
+class TenantBudgets:
+    """Configured per-tenant overload budget overlays.
+
+    Parsed from ``tenants.<token>.overload.*`` config sections (the
+    per-tenant overlay namespace PR 4 opened): a tenant may carry an
+    explicit ``degraded_telemetry_rate_per_s`` / ``_burst`` ceiling.
+    The controller COMPOSES this with the measured-share scaling from
+    the usage ledger — the effective DEGRADED telemetry rate is
+
+        min(configured budget, uniform rate × measured rate_scale)
+
+    so a configured budget can only ever TIGHTEN a tenant's budget,
+    never exempt it from fairness, and a tenant without an overlay is
+    governed purely by measurement.  The fairness floor holds by
+    construction: a quiet tenant (share ≤ fair_share_frac) has
+    rate_scale 1.0 and no overlay, so its admitted rate never drops
+    below the uniform budget while a noisy neighbor is clipped.
+    """
+
+    def __init__(self):
+        self._budgets: Dict[str, Tuple[Optional[float],
+                                       Optional[float]]] = {}
+
+    def set_budget(self, tenant: str,
+                   rate_per_s: Optional[float] = None,
+                   burst: Optional[float] = None) -> None:
+        if rate_per_s is None and burst is None:
+            self._budgets.pop(tenant, None)
+            return
+        self._budgets[tenant] = (
+            None if rate_per_s is None else float(rate_per_s),
+            None if burst is None else float(burst))
+
+    def get(self, tenant: str) -> Optional[Tuple[Optional[float],
+                                                 Optional[float]]]:
+        return self._budgets.get(tenant)
+
+    def overlay(self, tenant: str) -> Optional[Dict[str, float]]:
+        """REST drill-down form of one tenant's configured budget."""
+        got = self._budgets.get(tenant)
+        if got is None:
+            return None
+        out: Dict[str, float] = {}
+        if got[0] is not None:
+            out["degraded_telemetry_rate_per_s"] = got[0]
+        if got[1] is not None:
+            out["degraded_telemetry_burst"] = got[1]
+        return out
+
+    @classmethod
+    def from_config(cls, tenants_cfg) -> "TenantBudgets":
+        """Build from the ``tenants`` config mapping
+        (``{token: {"overload": {...}}, ...}``)."""
+        budgets = cls()
+        if not isinstance(tenants_cfg, dict):
+            return budgets
+        for token, overlay in tenants_cfg.items():
+            if not isinstance(overlay, dict):
+                continue
+            ov = overlay.get("overload")
+            if not isinstance(ov, dict):
+                continue
+            rate = ov.get("degraded_telemetry_rate_per_s")
+            burst = ov.get("degraded_telemetry_burst")
+            if rate is not None or burst is not None:
+                budgets.set_budget(
+                    str(token),
+                    None if rate is None else float(rate),
+                    None if burst is None else float(burst))
+        return budgets
+
+    def __len__(self) -> int:
+        return len(self._budgets)
 
 
 class OverloadController:
@@ -250,6 +336,7 @@ class OverloadController:
         degraded_telemetry_burst: float = 20_000.0,
         shedding_command_rate_per_s: float = 1_000.0,
         shedding_command_burst: float = 2_000.0,
+        budget_refresh_s: float = 5.0,
         signals_fn: Optional[Callable[[], OverloadSignals]] = None,
         clock: Callable[[], float] = time.monotonic,
         metrics: Optional[MetricsRegistry] = None,
@@ -330,6 +417,24 @@ class OverloadController:
         # the windowed row stream instead of the uniform budget.
         self.usage_ledger = None
         self._ledger_resolve: Optional[Callable[[str], int]] = None
+        # Configured per-tenant budget overlays (TenantBudgets): the
+        # effective DEGRADED telemetry rate composes min(configured,
+        # uniform × measured rate_scale).  Buckets record whether the
+        # CONFIGURED overlay was the binding constraint (budget_bound)
+        # — that flag routes sheds to the `tenant-budget` dead-letter
+        # kind instead of the generic `intake-shed`.  Stale buckets
+        # re-derive their rate in place every budget_refresh_s so a
+        # share measured at episode start cannot pin a recovered
+        # tenant's rate for a whole long episode.
+        self.tenant_budgets = TenantBudgets()
+        self.budget_refresh_s = float(budget_refresh_s)
+        self._m_budget_clipped = self._metrics.counter(
+            "tenant.budget.clipped_rows")
+
+    def set_tenant_budgets(self, budgets: TenantBudgets) -> None:
+        """Install the configured per-tenant budget overlay table
+        (parsed from ``tenants.<token>.overload.*`` by the instance)."""
+        self.tenant_budgets = budgets
 
     def set_usage_ledger(self, ledger,
                          resolve: Optional[Callable[[str], int]] = None
@@ -490,37 +595,74 @@ class OverloadController:
 
     # -- admission -----------------------------------------------------------
 
-    def _bucket(self, tenant: str, source: str,
-                cls: PriorityClass) -> TokenBucket:
+    def _telemetry_rate(self, tenant: str) -> Tuple[float, float, bool]:
+        """Effective DEGRADED telemetry (rate, burst, budget_bound) for
+        one tenant: ``min(configured budget, uniform × measured
+        rate_scale)`` per component.  ``budget_bound`` is True when the
+        CONFIGURED overlay is the binding constraint on the rate — the
+        flag that routes that tenant's sheds to the ``tenant-budget``
+        dead-letter kind."""
+        rate = self.degraded_telemetry_rate_per_s
+        burst = self.degraded_telemetry_burst
+        # Measured-share scaling (tenant metering plane): a tenant above
+        # its fair share of the windowed row stream gets a
+        # proportionally tighter DEGRADED budget; a quiet tenant keeps
+        # the full uniform one.
+        if self.usage_ledger is not None:
+            tid = self._tenant_id(tenant)
+            if tid is not None:
+                try:
+                    scale = self.usage_ledger.rate_scale(tid)
+                except Exception:
+                    scale = 1.0
+                rate *= scale
+                burst *= scale
+        budget_bound = False
+        configured = self.tenant_budgets.get(tenant)
+        if configured is not None:
+            c_rate, c_burst = configured
+            if c_rate is not None and c_rate < rate:
+                rate = c_rate
+                budget_bound = True
+            if c_burst is not None and c_burst < burst:
+                burst = c_burst
+                budget_bound = True
+        return rate, burst, budget_bound
+
+    def _bucket(self, tenant: str, source: str, cls: PriorityClass,
+                now: Optional[float] = None) -> TokenBucket:
         key = (tenant, source, int(cls))
+        now = self._clock() if now is None else now
         bucket = self._buckets.get(key)
         if bucket is None:
             if len(self._buckets) >= 1024:
                 self._buckets.clear()   # cardinality bound, not fairness
             if cls == PriorityClass.TELEMETRY:
-                rate = self.degraded_telemetry_rate_per_s
-                burst = self.degraded_telemetry_burst
-                # Measured-share scaling (tenant metering plane): a
-                # tenant above its fair share of the windowed row
-                # stream gets a proportionally tighter DEGRADED budget;
-                # a quiet tenant keeps the full uniform one.  Sampled
-                # at bucket build — buckets clear on the NORMAL
-                # transition, so each overload episode re-derives its
-                # rates from the share measured as it begins.
-                if self.usage_ledger is not None:
-                    tid = self._tenant_id(tenant)
-                    if tid is not None:
-                        try:
-                            scale = self.usage_ledger.rate_scale(tid)
-                        except Exception:
-                            scale = 1.0
-                        rate *= scale
-                        burst *= scale
+                # composed budget, sampled at bucket build — buckets
+                # clear on the NORMAL transition, so each overload
+                # episode re-derives its rates from the share measured
+                # as it begins (and refreshes below while it runs)
+                rate, burst, budget_bound = self._telemetry_rate(tenant)
             else:
                 rate = self.shedding_command_rate_per_s
                 burst = self.shedding_command_burst
+                budget_bound = False
             bucket = TokenBucket(rate, burst, clock=self._clock)
+            bucket.budget_bound = budget_bound
+            bucket.built_at = now
             self._buckets[key] = bucket
+        elif (cls == PriorityClass.TELEMETRY
+              and now - getattr(bucket, "built_at", now)
+              >= self.budget_refresh_s):
+            # stale-budget refresh: re-derive the composed rate IN
+            # PLACE (tokens clamp to the new burst — no fresh-burst
+            # exploit) so a share that shifted mid-episode, or an
+            # operator budget change, takes effect within
+            # budget_refresh_s instead of at the next episode
+            rate, burst, budget_bound = self._telemetry_rate(tenant)
+            bucket.set_rate(rate, burst)
+            bucket.budget_bound = budget_bound
+            bucket.built_at = now
         return bucket
 
     def admit(self, cls: PriorityClass, tenant: str = "default",
@@ -537,25 +679,41 @@ class OverloadController:
         SHEDDING; COMMAND is rate-limited in SHEDDING and refused only
         in EMERGENCY.
         """
+        return self.admit_detail(cls, tenant, source, n, now)[0]
+
+    def admit_detail(self, cls: PriorityClass, tenant: str = "default",
+                     source: str = "", n: int = 1,
+                     now: Optional[float] = None) -> Tuple[bool, str]:
+        """:meth:`admit` plus the shed attribution: ``(ok, reason)``
+        where reason is ``""`` on admit, ``"budget"`` when the refusal
+        came from a bucket whose rate the tenant's CONFIGURED budget
+        overlay bound (the dispatcher dead-letters those under the
+        replayable ``tenant-budget`` kind), and ``"overload"`` for
+        every other shed (state refusal or measured-share clip)."""
         state = self._state
         if cls == PriorityClass.CRITICAL or state == OverloadState.NORMAL:
             self.admitted_total += n
-            return True
+            return True, ""
         if cls == PriorityClass.TELEMETRY:
             if state >= OverloadState.SHEDDING:
-                return self._shed(cls, tenant, n)
-            ok = self._bucket(tenant, source, cls).try_take(n, now)
+                return self._shed(cls, tenant, n), "overload"
+            bucket = self._bucket(tenant, source, cls, now)
+            ok = bucket.try_take(n, now)
         else:   # COMMAND
             if state >= OverloadState.EMERGENCY:
-                return self._shed(cls, tenant, n)
+                return self._shed(cls, tenant, n), "overload"
             if state < OverloadState.SHEDDING:
                 self.admitted_total += n
-                return True
-            ok = self._bucket(tenant, source, cls).try_take(n, now)
+                return True, ""
+            bucket = self._bucket(tenant, source, cls, now)
+            ok = bucket.try_take(n, now)
         if not ok:
-            return self._shed(cls, tenant, n)
+            if getattr(bucket, "budget_bound", False):
+                self._m_budget_clipped.inc(n)
+                return self._shed(cls, tenant, n), "budget"
+            return self._shed(cls, tenant, n), "overload"
         self.admitted_total += n
-        return True
+        return True, ""
 
     def _shed(self, cls: PriorityClass, tenant: str, n: int) -> bool:
         self.shed_total += n
@@ -621,6 +779,7 @@ class OverloadController:
             "shed_total": self.shed_total,
             "admitted_total": self.admitted_total,
             "driver": self.last_driver,
+            "tenant_budgets": len(self.tenant_budgets),
             "signals": {k: round(v, 4)
                         for k, v in self.last_signals.as_dict().items()},
         }

@@ -57,6 +57,17 @@ class ServiceUnavailable(ServiceError):
     http_status = 503
 
 
+class QuotaExceeded(ServiceError):
+    """A tenant exhausted its metered quota (eval seconds per window).
+
+    Retryable by design: the quota is measured over the usage ledger's
+    sliding window, so the refusal clears as the window rotates — 429,
+    not 403.  Never raised on the ingest hot path; only the optional
+    eval surfaces (rule compile/eval, analytics runs) enforce quotas."""
+
+    http_status = 429
+
+
 @dataclasses.dataclass(frozen=True)
 class SearchCriteria:
     """Page + optional time-range criteria.

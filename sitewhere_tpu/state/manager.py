@@ -15,6 +15,7 @@ resulting indices/rows copied back.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Dict, List, Optional
 
@@ -27,6 +28,158 @@ from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.schema import DeviceState, EventBatch
 from sitewhere_tpu.services.common import EntityNotFound, require
 from sitewhere_tpu.state.presence import presence_sweep, state_changes_for
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+@functools.lru_cache(maxsize=64)
+def _partition_gather(rung: int):
+    """Jitted padded gather for one partition rung size — compiled ONCE
+    per pow2 rung and shared by every tenant sitting on that rung, the
+    same bucketing guarantee the rules compiler gives program shapes.
+    Padding rows gather device 0 and carry valid=False."""
+    del rung  # the cache key; the jit specializes on idx.shape
+
+    @jax.jit
+    def gather(state, idx, valid):
+        rows = jax.tree.map(lambda a: a[idx], state)
+        return rows, valid
+
+    return gather
+
+
+class TenantPartitions:
+    """Per-tenant pow2 capacity ladders over the shared state tensors.
+
+    The global :class:`DeviceState` epoch is a single fixed-capacity
+    tensor — it never resizes, so tenant isolation at this layer means
+    each tenant's QUERY/EXPORT surface runs through its own padded
+    partition view: a gather of the tenant's device rows padded to a
+    pow2 rung.  Rungs ride a sticky ladder (grow to the next pow2 when
+    the tenant's device count exceeds the rung, shrink only once count
+    falls to a quarter of it — the registry-ladder hysteresis from the
+    rules subsystem), so registration churn inside one tenant bumps
+    only THAT tenant's rung.  ``compile_count`` counts a tenant's rung
+    transitions — the churn-storm bench pins it flat for untouched
+    tenants while a noisy neighbor registers devices in waves.  The
+    gather kernel itself is cached per RUNG (module-level), so two
+    tenants on the same rung share one compiled executable.
+    """
+
+    def __init__(self, tenant_column_provider,
+                 min_capacity: int = 64, metrics=None):
+        self._provider = tenant_column_provider
+        self.min_capacity = _next_pow2(max(1, int(min_capacity)))
+        self._lock = threading.Lock()
+        # tenant_id → {"count", "rung", "compile_count"}
+        self._parts: Dict[int, Dict[str, int]] = {}
+        self._column: Optional[np.ndarray] = None
+        self._m_tracked = None
+        self._m_compiles = None
+        self._m_resizes = None
+        if metrics is not None:
+            self.bind_metrics(metrics)
+
+    def bind_metrics(self, metrics) -> None:
+        self._m_tracked = metrics.gauge("tenant.partition.tracked")
+        self._m_compiles = metrics.counter("tenant.partition.compiles")
+        self._m_resizes = metrics.counter("tenant.partition.resizes")
+
+    def refresh(self) -> None:
+        """Re-derive per-tenant device counts from the registry mirror's
+        tenant column and walk each tenant's rung ladder.  O(capacity)
+        bincount — called from query surfaces and on a registration
+        cadence, never from the step hot path."""
+        col = np.asarray(self._provider())
+        owned = col[col >= 0]
+        counts = (np.bincount(owned) if owned.size
+                  else np.zeros(0, np.int64))
+        tenants = np.nonzero(counts)[0]
+        with self._lock:
+            self._column = col
+            for t in tenants.tolist():
+                count = int(counts[t])
+                part = self._parts.get(t)
+                if part is None:
+                    self._parts[t] = {
+                        "count": count,
+                        "rung": max(self.min_capacity, _next_pow2(count)),
+                        "compile_count": 1,
+                    }
+                    if self._m_compiles is not None:
+                        self._m_compiles.inc()
+                    continue
+                part["count"] = count
+                rung = part["rung"]
+                if count > rung:
+                    part["rung"] = _next_pow2(count)
+                elif (count <= rung // 4
+                      and rung > self.min_capacity):
+                    # shrink-at-quarter hysteresis: a tenant oscillating
+                    # around a rung boundary never flaps its kernel
+                    part["rung"] = max(self.min_capacity,
+                                       _next_pow2(count))
+                if part["rung"] != rung:
+                    part["compile_count"] += 1
+                    if self._m_compiles is not None:
+                        self._m_compiles.inc()
+                    if self._m_resizes is not None:
+                        self._m_resizes.inc()
+            if self._m_tracked is not None:
+                self._m_tracked.set(len(self._parts))
+
+    def tenants(self) -> List[int]:
+        with self._lock:
+            return sorted(self._parts)
+
+    def compile_count(self, tenant_id: int) -> int:
+        with self._lock:
+            part = self._parts.get(int(tenant_id))
+            return 0 if part is None else part["compile_count"]
+
+    def partition_of(self, tenant_id: int) -> Optional[Dict[str, int]]:
+        with self._lock:
+            part = self._parts.get(int(tenant_id))
+            return None if part is None else dict(part)
+
+    def indices_of(self, tenant_id: int):
+        """``(idx, valid)`` for one tenant's partition view: the
+        tenant's device ids padded to its rung (padding gathers row 0,
+        masked out by ``valid``).  None if the tenant owns nothing."""
+        with self._lock:
+            part = self._parts.get(int(tenant_id))
+            col = self._column
+        if part is None or col is None:
+            return None
+        ids = np.nonzero(col == int(tenant_id))[0].astype(np.int32)
+        rung = part["rung"]
+        idx = np.zeros(rung, np.int32)
+        valid = np.zeros(rung, bool)
+        n = min(len(ids), rung)
+        idx[:n] = ids[:n]
+        valid[:n] = True
+        return idx, valid
+
+    def view(self, state, tenant_id: int):
+        """Padded per-tenant gather of ``state`` — ``(rows, valid)`` on
+        device, through the rung-cached jitted gather."""
+        iv = self.indices_of(tenant_id)
+        if iv is None:
+            return None
+        idx, valid = iv
+        gather = _partition_gather(len(idx))
+        return gather(state, jnp.asarray(idx), jnp.asarray(valid))
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "tenants": len(self._parts),
+                "min_capacity": self.min_capacity,
+                "partitions": {str(t): dict(p)
+                               for t, p in sorted(self._parts.items())},
+            }
 
 
 def _packed_codecs():
@@ -87,6 +240,51 @@ class DeviceStateManager(LifecycleComponent):
         # "re-leased without restart" is `lease_generation` advancing on
         # one live manager (tools/devfault_bench.py asserts exactly this).
         self.lease_generation = 0
+        # Tenant-partitioned query views (attach_partitions): per-tenant
+        # pow2 rung ladders over the shared tensors, so one tenant's
+        # registration churn recompiles only its own partition view
+        self.partitions: Optional[TenantPartitions] = None
+
+    def attach_partitions(self, tenant_column_provider,
+                          min_capacity: int = 64,
+                          metrics=None) -> TenantPartitions:
+        """Wire the tenant-partition ladder (instance passes the registry
+        mirror's tenant column provider)."""
+        self.partitions = TenantPartitions(
+            tenant_column_provider, min_capacity=min_capacity,
+            metrics=metrics)
+        return self.partitions
+
+    def tenant_state_summary(self, tenant_id: int) -> Dict[str, object]:
+        """Per-tenant state summary through the tenant's partition view:
+        the partitioned analog of :meth:`summary`.  Snapshot under the
+        lock, gather + transfer OUTSIDE it (the lease lock must never
+        ride a D2H — see missing_device_ids)."""
+        require(self.partitions is not None,
+                EntityNotFound("tenant partitions are not attached"))
+        self.partitions.refresh()
+        part = self.partitions.partition_of(tenant_id)
+        if part is None:
+            return {"devices": 0, "capacity": 0, "compile_count": 0,
+                    "devices_with_state": 0, "devices_missing": 0}
+        with self._lock:
+            s = self.current
+        view = self.partitions.view(s, tenant_id)
+        if view is None:   # raced a refresh that dropped the column
+            return {"devices": part["count"], "capacity": part["rung"],
+                    "compile_count": part["compile_count"],
+                    "devices_with_state": 0, "devices_missing": 0}
+        rows, valid = view
+        valid = np.asarray(valid)
+        has = np.asarray(rows.last_event_type != NULL_ID) & valid
+        missing = np.asarray(rows.presence_missing) & valid
+        return {
+            "devices": part["count"],
+            "capacity": part["rung"],
+            "compile_count": part["compile_count"],
+            "devices_with_state": int(has.sum()),
+            "devices_missing": int(missing.sum()),
+        }
 
     # -- epoch plumbing ----------------------------------------------------
 

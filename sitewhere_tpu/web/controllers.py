@@ -164,6 +164,16 @@ def register_routes(gw: RestGateway, inst) -> None:
         body.update(tenant=token, tenant_id=int(tid),
                     window_share=round(ledger.shares().get(int(tid), 0.0), 6),
                     rate_scale=round(ledger.rate_scale(tid), 6))
+        # configured budget overlay (overload ladder) and metered-quota
+        # consumption ride along so one GET answers "why am I clipped?"
+        ov = getattr(inst, "overload", None)
+        if ov is not None:
+            budget = ov.tenant_budgets.overlay(token)
+            if budget:
+                body["budget"] = budget
+        quotas = getattr(inst, "quotas", None)
+        if quotas is not None:
+            body["quota"] = quotas.consumption(tid)
         return body
     r("GET", "/api/tenants/usage/{token}", tenant_usage_one)
 
@@ -189,6 +199,21 @@ def register_routes(gw: RestGateway, inst) -> None:
                 "restarted": True}
     r("POST", "/api/tenants/{token}/engine/restart", engine_restart)
 
+    def tenant_state(q):
+        """Per-tenant device-state partition summary: device count, the
+        pow2 capacity rung, and the compile counter the churn-storm
+        invariant pins (untouched tenants must stay flat)."""
+        token = q.params["token"]
+        tid = inst.identity.tenant.lookup(token)
+        require(tid != NULL_ID, EntityNotFound(f"no tenant {token!r}"))
+        sm = getattr(inst, "device_state", None)
+        require(sm is not None and sm.partitions is not None,
+                EntityNotFound("tenant state partitioning is disabled"))
+        body = sm.tenant_state_summary(int(tid))
+        body.update(tenant=token, tenant_id=int(tid))
+        return body
+    r("GET", "/api/tenants/{token}/state", tenant_state)
+
     # ---- bring-your-own-rules (rules/ subsystem) --------------------------
     # per-tenant declarative rule & enrichment programs; a POST validates
     # + compiles (warming any novel kernel shape) BEFORE the new operand
@@ -211,6 +236,11 @@ def register_routes(gw: RestGateway, inst) -> None:
 
         eng = _programs()
         tid = _rules_tenant(q)
+        # a program PUT triggers validate+compile — metered eval work,
+        # so an over-quota tenant is refused (429) before compiling
+        quotas = getattr(inst, "quotas", None)
+        if quotas is not None:
+            quotas.check_eval(tid)
         doc = q.json()
         if rtoken is not None:
             doc["token"] = rtoken
@@ -729,6 +759,15 @@ def register_routes(gw: RestGateway, inst) -> None:
     def run_query_retrospective(q: Request):
         _optional_capacity("analytics")
         body = q.json()
+        # metered quota: a retrospective replay is pure eval compute, so
+        # a tenant that exhausted its eval_s window gets a retryable 429
+        # here (check_eval raises QuotaExceeded) before the scan starts
+        quotas = getattr(inst, "quotas", None)
+        tok = body.get("tenant", q.q1("tenant"))
+        if quotas is not None and tok:
+            tid = inst.identity.tenant.lookup(str(tok))
+            require(tid != NULL_ID, EntityNotFound(f"no tenant {tok!r}"))
+            quotas.check_eval(int(tid))
 
         def _opt_int(key):
             raw = body.get(key, q.q1(key))

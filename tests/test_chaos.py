@@ -1339,3 +1339,37 @@ class TestDevFaultBench:
         assert ph["poison"]["state_bit_identical"]
         assert ph["poison"]["quarantined_devices"] == 1
         assert ph["watchdog"]["hard_trips"] >= 1
+
+
+class TestTenantFairnessBench:
+    """tools/tenant_fairness_bench.py --smoke: the ISSUE-20 acceptance
+    proof (quiet goodput floor under a noisy neighbor, configured budget
+    clip with replayable tenant-budget dead letters, zero-loss
+    accounting, churn-storm partition isolation)."""
+
+    def test_smoke_contract_holds(self):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SW_CRASHPOINT", None)
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(root, "tools", "tenant_fairness_bench.py"),
+             "--smoke", "--json"],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+        doc = json.loads(res.stdout)
+        assert doc["ok"]
+        by_name = {c["name"]: c for c in doc["checks"]}
+        for name in ("quiet_goodput_floor", "quiet_never_shed",
+                     "noisy_clipped_to_budget",
+                     "budget_sheds_dead_lettered",
+                     "shedding_refuses_telemetry_not_critical",
+                     "recovery_restores_noisy_and_replays_budget_sheds",
+                     "zero_rows_lost", "accepted_rows_sealed",
+                     "churn_storm_partition_isolation",
+                     "partition_view_consistent"):
+            assert by_name[name]["pass"], by_name[name]["detail"]
